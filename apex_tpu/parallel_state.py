@@ -1,0 +1,338 @@
+"""Mesh and axis registry — the TPU-native model-parallel state.
+
+Capability parity with ``apex/transformer/parallel_state.py`` ::
+``initialize_model_parallel``, ``get_tensor_model_parallel_group/_rank/
+_world_size``, ``get_pipeline_model_parallel_*``, ``get_data_parallel_*``,
+``is_pipeline_first_stage`` / ``is_pipeline_last_stage``,
+``set_virtual_pipeline_model_parallel_rank``, ``destroy_model_parallel``.
+
+The reference builds ~10 ``torch.distributed`` process groups over NCCL for a
+3D (DP x PP x TP) rank grid.  On TPU there are no process groups: the single
+SPMD program runs over a named :class:`jax.sharding.Mesh` and "groups" are
+mesh axes.  A collective over the tensor-parallel "group" is simply
+``jax.lax.psum(x, axis_name="tp")`` inside :func:`jax.shard_map`.
+
+Axis layout
+-----------
+The canonical mesh is ``(dp, pp, tp)`` with ``tp`` innermost (fastest
+varying) so that tensor-parallel collectives — the highest-bandwidth traffic,
+fired twice per transformer layer per direction (see SURVEY.md §3.4) — map to
+physically adjacent chips over ICI, while ``dp`` (lowest frequency, gradient
+all-reduce once per step) may span DCN on multi-slice topologies.  Megatron
+sequence parallelism ("sp") reuses the ``tp`` axis by construction (the SP
+all-gather / reduce-scatter pair replaces the TP identity/all-reduce pair over
+the *same* ranks), exactly like the reference where SP collectives run on the
+TP process group.
+
+Rank queries
+------------
+In SPMD there is no host-side "my rank": every host traces one program for
+all devices.  Rank helpers (:func:`get_tensor_model_parallel_rank` etc.)
+return a *traced* index via ``jax.lax.axis_index`` and are therefore valid
+only inside ``shard_map`` (or any context binding the axis name).  World-size
+helpers are static Python ints valid anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_PARALLEL_AXIS",
+    "PIPELINE_PARALLEL_AXIS",
+    "TENSOR_PARALLEL_AXIS",
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "get_mesh",
+    "get_data_parallel_world_size",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_rank",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_tensor_model_parallel_src_rank",
+    "get_pipeline_model_parallel_next_rank",
+    "get_pipeline_model_parallel_prev_rank",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "set_virtual_pipeline_model_parallel_world_size",
+    "destroy_model_parallel",
+    "divide",
+    "data_parallel_sharding",
+    "named_sharding",
+    "replicated_sharding",
+]
+
+DATA_PARALLEL_AXIS = "dp"
+PIPELINE_PARALLEL_AXIS = "pp"
+TENSOR_PARALLEL_AXIS = "tp"
+
+_AXIS_ORDER = (DATA_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS, TENSOR_PARALLEL_AXIS)
+
+
+@dataclasses.dataclass
+class _ParallelState:
+    mesh: Mesh
+    data_parallel_size: int
+    pipeline_model_parallel_size: int
+    tensor_model_parallel_size: int
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    # Virtual-pipeline rank is plain host state mutated by the interleaved
+    # 1F1B scheduler, mirroring the reference's module-global
+    # (parallel_state.py :: set_virtual_pipeline_model_parallel_rank).
+    virtual_pipeline_model_parallel_rank: Optional[int] = None
+
+
+_STATE: Optional[_ParallelState] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create and register the global ``(dp, pp, tp)`` mesh.
+
+    ≙ ``apex/transformer/parallel_state.py :: initialize_model_parallel``.
+    Where the reference carves ``world_size`` ranks into NCCL groups, this
+    reshapes ``jax.devices()`` into a named mesh.  ``dp`` is derived:
+    ``n_devices // (tp * pp)``, with the same divisibility requirement the
+    reference enforces.
+
+    Returns the mesh (also retrievable via :func:`get_mesh`).
+    """
+    global _STATE
+    if _STATE is not None:
+        # ≙ the reference's "group is already initialized" asserts.
+        raise RuntimeError(
+            "model parallel state is already initialized — call "
+            "destroy_model_parallel() first"
+        )
+    explicit_devices = devices is not None
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    tp = int(tensor_model_parallel_size)
+    pp = int(pipeline_model_parallel_size)
+    if tp < 1 or pp < 1:
+        raise ValueError("parallel sizes must be >= 1")
+    if n % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({n}) is not divisible by tensor_model_parallel_size "
+            f"({tp}) x pipeline_model_parallel_size ({pp})"
+        )
+    dp = n // (tp * pp)
+    if virtual_pipeline_model_parallel_size is not None:
+        if pp < 2:
+            raise RuntimeError(
+                "pipeline-model-parallel size should be greater than 1 with "
+                "interleaved schedule"
+            )
+    import numpy as np
+
+    if explicit_devices:
+        device_array = np.asarray(devices).reshape(dp, pp, tp)
+    else:
+        # Topology-aware assignment: on a real TPU slice a naive reshape of
+        # jax.devices() can place a tp group across non-adjacent chips;
+        # mesh_utils computes an ICI-friendly layout (innermost axis on the
+        # tightest torus dimension).
+        from jax.experimental import mesh_utils
+
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                (dp, pp, tp), devices=devices
+            )
+        except Exception:
+            device_array = np.asarray(devices).reshape(dp, pp, tp)
+    mesh = Mesh(device_array, _AXIS_ORDER)
+    _STATE = _ParallelState(
+        mesh=mesh,
+        data_parallel_size=dp,
+        pipeline_model_parallel_size=pp,
+        tensor_model_parallel_size=tp,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+        virtual_pipeline_model_parallel_rank=(
+            0 if virtual_pipeline_model_parallel_size is not None else None
+        ),
+    )
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    """≙ parallel_state.py :: model_parallel_is_initialized."""
+    return _STATE is not None
+
+
+def _state() -> _ParallelState:
+    if _STATE is None:
+        raise RuntimeError(
+            "model parallel state is not initialized — call "
+            "apex_tpu.parallel_state.initialize_model_parallel() first"
+        )
+    return _STATE
+
+
+def get_mesh() -> Mesh:
+    """The registered global mesh (axes ``dp``, ``pp``, ``tp``)."""
+    return _state().mesh
+
+
+# ---------------------------------------------------------------------------
+# World sizes — static host ints.
+# ---------------------------------------------------------------------------
+
+
+def get_data_parallel_world_size() -> int:
+    return _state().data_parallel_size
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _state().pipeline_model_parallel_size
+
+
+# ---------------------------------------------------------------------------
+# Ranks — traced values, valid inside shard_map over the global mesh.
+# ---------------------------------------------------------------------------
+
+
+def _axis_index(axis: str):
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError as e:  # axis name not bound: not inside shard_map
+        raise RuntimeError(
+            f"rank query for axis {axis!r} is only meaningful inside "
+            "jax.shard_map over the global mesh (SPMD has no host-side rank); "
+            "use the *_world_size helpers for host logic"
+        ) from e
+
+
+def get_data_parallel_rank():
+    return _axis_index(DATA_PARALLEL_AXIS)
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_index(TENSOR_PARALLEL_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_index(PIPELINE_PARALLEL_AXIS)
+
+
+def get_tensor_model_parallel_src_rank():
+    """Rank 0 of the tensor-parallel group.
+
+    ≙ parallel_state.py :: get_tensor_model_parallel_src_rank.  In mesh terms
+    the "source" is simply index 0 along ``tp``; data broadcast from it is a
+    no-op under SPMD (all members trace identical programs), so this exists
+    for API parity and for `tensor_parallel.data.broadcast_data`.
+    """
+    return 0
+
+
+def get_pipeline_model_parallel_next_rank():
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() + 1) % pp
+
+
+def get_pipeline_model_parallel_prev_rank():
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() - 1) % pp
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced boolean (inside shard_map); honors virtual pipeline rank.
+
+    ≙ parallel_state.py :: is_pipeline_first_stage.
+    """
+    if not ignore_virtual:
+        vpp = get_virtual_pipeline_model_parallel_world_size()
+        if vpp is not None and get_virtual_pipeline_model_parallel_rank() != 0:
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vpp = get_virtual_pipeline_model_parallel_world_size()
+        if vpp is not None and (
+            get_virtual_pipeline_model_parallel_rank() != vpp - 1
+        ):
+            return False
+    pp = get_pipeline_model_parallel_world_size()
+    return get_pipeline_model_parallel_rank() == pp - 1
+
+
+# ---------------------------------------------------------------------------
+# Virtual pipeline (interleaved 1F1B) bookkeeping — host state.
+# ---------------------------------------------------------------------------
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _state().virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    _state().virtual_pipeline_model_parallel_rank = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _state().virtual_pipeline_model_parallel_size
+
+
+def set_virtual_pipeline_model_parallel_world_size(size: Optional[int]) -> None:
+    st = _state()
+    st.virtual_pipeline_model_parallel_size = size
+    if size is None:
+        st.virtual_pipeline_model_parallel_rank = None
+    elif st.virtual_pipeline_model_parallel_rank is None:
+        # Keep the first/last-stage predicates well-defined when virtual PP
+        # is enabled after init (rank defaults to chunk 0, as in __init__).
+        st.virtual_pipeline_model_parallel_rank = 0
+
+
+def destroy_model_parallel() -> None:
+    """≙ parallel_state.py :: destroy_model_parallel."""
+    global _STATE
+    _STATE = None
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers (no reference analog — mesh idioms the rest of the
+# framework builds on).
+# ---------------------------------------------------------------------------
+
+
+def named_sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh for a PartitionSpec."""
+    return NamedSharding(get_mesh(), P(*spec))
+
+
+def data_parallel_sharding(ndim: int) -> NamedSharding:
+    """Batch-leading sharding: dim 0 split over ``dp``, rest replicated."""
+    spec = [DATA_PARALLEL_AXIS] + [None] * (ndim - 1)
+    return NamedSharding(get_mesh(), P(*spec))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(get_mesh(), P())
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """≙ apex/transformer/utils.py :: divide (ensure_divisibility + floordiv)."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+    return numerator // denominator

@@ -1,0 +1,42 @@
+"""Test harness: an 8-device CPU mesh in one process.
+
+The reference's distributed tests spawn one NCCL process per GPU
+(apex/transformer/testing/distributed_test_base.py :: DistributedTestBase) and
+skip when <2 GPUs are present.  The TPU-native analog is strictly better:
+``--xla_force_host_platform_device_count=8`` gives eight XLA CPU devices in a
+single process, so every DP/TP/PP/SP test runs in CI with no hardware.
+
+NOTE: this environment registers an `axon` TPU backend at interpreter startup
+(sitecustomize) and forces ``jax_platforms``; we override back to CPU before
+any backend is initialized.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    """Each test starts from a clean mesh registry."""
+    from apex_tpu import parallel_state
+
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

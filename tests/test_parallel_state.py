@@ -1,0 +1,138 @@
+"""≙ tests/L0/run_transformer/test_parallel_state.py — mesh registry tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+
+
+def test_initialize_and_sizes(eight_devices):
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2
+    )
+    assert ps.model_parallel_is_initialized()
+    assert ps.get_tensor_model_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    assert ps.get_data_parallel_world_size() == 2
+    assert mesh.shape == {"dp": 2, "pp": 2, "tp": 2}
+    ps.destroy_model_parallel()
+    assert not ps.model_parallel_is_initialized()
+
+
+@pytest.mark.parametrize("tp,pp", [(1, 1), (2, 1), (1, 2), (4, 2), (8, 1), (2, 4)])
+def test_valid_factorizations(eight_devices, tp, pp):
+    ps.initialize_model_parallel(tp, pp)
+    assert ps.get_data_parallel_world_size() * tp * pp == 8
+
+
+def test_indivisible_world_size_raises(eight_devices):
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+def test_uninitialized_raises():
+    ps.destroy_model_parallel()
+    with pytest.raises(RuntimeError):
+        ps.get_mesh()
+
+
+def test_ranks_inside_shard_map(eight_devices):
+    mesh = ps.initialize_model_parallel(2, 2)
+
+    def f(_):
+        return (
+            ps.get_data_parallel_rank()[None],
+            ps.get_pipeline_model_parallel_rank()[None],
+            ps.get_tensor_model_parallel_rank()[None],
+        )
+
+    dp, pp, tp = jax.shard_map(
+        f,
+        mesh=mesh,
+        in_specs=P("dp", "pp", "tp"),
+        out_specs=(P("dp"), P("pp"), P("tp")),
+    )(jnp.zeros((2, 2, 2)))
+    assert list(np.asarray(dp)) == [0, 1]
+    assert list(np.asarray(pp)) == [0, 1]
+    assert list(np.asarray(tp)) == [0, 1]
+
+
+def test_rank_outside_shard_map_raises(eight_devices):
+    ps.initialize_model_parallel(2, 2)
+    with pytest.raises(RuntimeError):
+        ps.get_tensor_model_parallel_rank()
+
+
+def test_pipeline_stage_predicates(eight_devices):
+    mesh = ps.initialize_model_parallel(1, 4)
+
+    def f(_):
+        first = ps.is_pipeline_first_stage()
+        last = ps.is_pipeline_last_stage()
+        return (
+            jnp.asarray(first, jnp.int32)[None],
+            jnp.asarray(last, jnp.int32)[None],
+        )
+
+    first, last = jax.shard_map(
+        f, mesh=mesh, in_specs=P("pp"), out_specs=P("pp")
+    )(jnp.zeros((4,)))
+    assert list(np.asarray(first)) == [1, 0, 0, 0]
+    assert list(np.asarray(last)) == [0, 0, 0, 1]
+
+
+def test_virtual_pipeline_bookkeeping(eight_devices):
+    ps.initialize_model_parallel(
+        1, 2, virtual_pipeline_model_parallel_size=2
+    )
+    assert ps.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 0
+    ps.set_virtual_pipeline_model_parallel_rank(1)
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 1
+
+
+def test_virtual_pipeline_requires_pp(eight_devices):
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(1, 1, virtual_pipeline_model_parallel_size=2)
+
+
+def test_reinit_without_destroy_raises(eight_devices):
+    ps.initialize_model_parallel(2, 2)
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(1, 1)
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(1, 1)  # ok after destroy
+
+
+def test_virtual_pp_enabled_after_init(eight_devices):
+    ps.initialize_model_parallel(1, 2)
+    ps.set_virtual_pipeline_model_parallel_world_size(2)
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 0
+    ps.set_virtual_pipeline_model_parallel_world_size(None)
+    assert ps.get_virtual_pipeline_model_parallel_rank() is None
+
+
+def test_lazy_attr_probe_is_attributeerror():
+    import apex_tpu
+
+    # contrib doesn't exist yet on disk; availability probes must see
+    # AttributeError (hasattr False), not ModuleNotFoundError.
+    assert not hasattr(apex_tpu, "does_not_exist")
+
+
+def test_divide():
+    assert ps.divide(8, 2) == 4
+    with pytest.raises(ValueError):
+        ps.divide(7, 2)
+
+
+def test_sharding_helpers(eight_devices):
+    ps.initialize_model_parallel(2, 2)
+    s = ps.data_parallel_sharding(3)
+    assert s.spec == P("dp", None, None)
+    x = jax.device_put(jnp.zeros((4, 3, 3)), s)
+    assert x.sharding.spec == P("dp", None, None)
+    assert ps.replicated_sharding().spec == P()
