@@ -1,0 +1,28 @@
+"""Fused ops — the ``csrc/`` + wrapper layer of the framework.
+
+Every op computes statistics in f32, preserves I/O dtype, and ships a
+``custom_vjp`` backward matching the reference CUDA kernel's math.
+"""
+
+from apex_tpu.ops._dispatch import set_use_pallas, use_pallas  # noqa: F401
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+from apex_tpu.ops.rope import (  # noqa: F401
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+    rotate_half,
+)
+from apex_tpu.ops.scaled_softmax import (  # noqa: F401
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

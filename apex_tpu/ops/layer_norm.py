@@ -1,0 +1,215 @@
+"""Fused LayerNorm / RMSNorm — functional API.
+
+Capability parity with ``apex/normalization/fused_layer_norm.py`` ::
+``fused_layer_norm_affine``, ``fused_layer_norm``, ``fused_rms_norm_affine``,
+``fused_rms_norm`` and their autograd functions
+(``FusedLayerNormAffineFunction`` etc., incl. the ``memory_efficient`` flag),
+backed by ``csrc/layer_norm_cuda_kernel.cu`` in the reference.
+
+Semantics (all paths):
+- statistics and normalization computed in **f32** regardless of input dtype
+  (the reference's "Mixed" = fp32-params/fp16-IO classes fall out of this:
+  pass bf16/f16 ``x`` with f32 ``weight``);
+- output dtype == input dtype; weight/bias grads in the weight's dtype;
+- ``memory_efficient=True`` saves the forward *output* + rstd instead of the
+  input + mean, recovering ``xhat`` in the backward (trades one divide for
+  an activation buffer, exactly the reference's flag).
+
+Dispatch: Pallas TPU kernels (:mod:`apex_tpu.ops.pallas.layer_norm`) when on
+TPU and the normalized size is lane-aligned; XLA-fused jnp otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops import _dispatch
+
+__all__ = [
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+    "fused_rms_norm",
+    "fused_rms_norm_affine",
+]
+
+Shape = Union[int, Sequence[int]]
+
+
+def _normalized_size(normalized_shape: Shape) -> int:
+    if isinstance(normalized_shape, int):
+        return normalized_shape
+    return int(np.prod(tuple(normalized_shape)))
+
+
+def _pallas_eligible(hidden: int) -> bool:
+    return _dispatch.use_pallas() and hidden % 128 == 0 and hidden <= 65536
+
+
+# ---------------------------------------------------------------------------
+# jnp reference path (identical math to the Pallas kernels)
+# ---------------------------------------------------------------------------
+
+
+def _jnp_fwd(x2d, w, b, eps, rms):
+    xf = x2d.astype(jnp.float32)
+    if rms:
+        mu = jnp.zeros((xf.shape[0], 1), jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    else:
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        xc = xf - mu
+        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (xf - mu) * rstd
+    y = xhat * w.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(x2d.dtype), mu, rstd
+
+
+def _jnp_bwd(x2d, w, b, mu, rstd, g, rms, x_is_output):
+    xf = x2d.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    if x_is_output:
+        bf = b.astype(jnp.float32)
+        wsafe = jnp.where(wf == 0.0, 1.0, wf)
+        xhat = jnp.where(wf == 0.0, 0.0, (xf - bf) / wsafe)
+    else:
+        xhat = (xf - mu) * rstd
+    dyw = gf * wf
+    c2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    if rms:
+        dx = rstd * (dyw - xhat * c2)
+    else:
+        c1 = jnp.mean(dyw, axis=-1, keepdims=True)
+        dx = rstd * (dyw - c1 - xhat * c2)
+    dw = jnp.sum(gf * xhat, axis=0)
+    db = jnp.sum(gf, axis=0)
+    return dx.astype(x2d.dtype), dw, db
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp core over flattened (rows, hidden)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _norm2d(x2d, w, b, eps, rms, memory_efficient):
+    y, _, _ = _norm2d_fwd_impl(x2d, w, b, eps, rms)
+    return y
+
+
+def _norm2d_fwd_impl(x2d, w, b, eps, rms):
+    hidden = x2d.shape[-1]
+    if _pallas_eligible(hidden):
+        from apex_tpu.ops.pallas import layer_norm as _k
+
+        return _k.layer_norm_fwd(x2d, w, b, eps=eps, rms=rms)
+    return _jnp_fwd(x2d, w, b, eps, rms)
+
+
+def _norm2d_fwd(x2d, w, b, eps, rms, memory_efficient):
+    y, mu, rstd = _norm2d_fwd_impl(x2d, w, b, eps, rms)
+    if memory_efficient:
+        res = (y, w, b, None, rstd)
+    else:
+        res = (x2d, w, b, mu, rstd)
+    return y, res
+
+
+def _norm2d_bwd(eps, rms, memory_efficient, res, g):
+    x_or_y, w, b, mu, rstd = res
+    hidden = x_or_y.shape[-1]
+    if _pallas_eligible(hidden):
+        from apex_tpu.ops.pallas import layer_norm as _k
+
+        mu_in = mu if mu is not None else jnp.zeros_like(rstd)
+        dx, dw, db = _k.layer_norm_bwd(
+            x_or_y, w, b, mu_in, rstd, g, rms=rms, x_is_output=memory_efficient
+        )
+    else:
+        dx, dw, db = _jnp_bwd(
+            x_or_y, w, b, mu, rstd, g, rms, x_is_output=memory_efficient
+        )
+    return dx, dw.astype(w.dtype), db.astype(b.dtype)
+
+
+_norm2d.defvjp(_norm2d_fwd, _norm2d_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def _run(x, normalized_shape, w, b, eps, rms, memory_efficient):
+    shape_t = (
+        (normalized_shape,)
+        if isinstance(normalized_shape, int)
+        else tuple(normalized_shape)
+    )
+    hidden = _normalized_size(normalized_shape)
+    if tuple(x.shape[-len(shape_t):]) != shape_t:
+        raise ValueError(
+            f"normalized_shape {normalized_shape} does not match the trailing "
+            f"dimensions of input shape {x.shape}"
+        )
+    orig_shape = x.shape
+    x2d = x.reshape(-1, hidden)
+    if w is None:
+        w = jnp.ones((hidden,), jnp.float32)
+    else:
+        w = w.reshape(hidden)
+    if b is None:
+        b = jnp.zeros((hidden,), jnp.float32)
+    else:
+        b = b.reshape(hidden)
+    y = _norm2d(x2d, w, b, float(eps), bool(rms), bool(memory_efficient))
+    return y.reshape(orig_shape)
+
+
+def fused_layer_norm_affine(
+    x,
+    weight,
+    bias,
+    normalized_shape: Shape,
+    eps: float = 1e-6,
+    memory_efficient: bool = False,
+):
+    """≙ apex/normalization/fused_layer_norm.py :: fused_layer_norm_affine."""
+    return _run(x, normalized_shape, weight, bias, eps, False, memory_efficient)
+
+
+def fused_layer_norm(
+    x,
+    normalized_shape: Shape,
+    eps: float = 1e-6,
+    memory_efficient: bool = False,
+):
+    """Non-affine LayerNorm (≙ fused_layer_norm)."""
+    return _run(x, normalized_shape, None, None, eps, False, memory_efficient)
+
+
+def fused_rms_norm_affine(
+    x,
+    weight,
+    normalized_shape: Shape,
+    eps: float = 1e-6,
+    memory_efficient: bool = False,
+):
+    """≙ apex/normalization/fused_layer_norm.py :: fused_rms_norm_affine."""
+    return _run(x, normalized_shape, weight, None, eps, True, memory_efficient)
+
+
+def fused_rms_norm(
+    x,
+    normalized_shape: Shape,
+    eps: float = 1e-6,
+    memory_efficient: bool = False,
+):
+    """Non-affine RMSNorm (≙ fused_rms_norm)."""
+    return _run(x, normalized_shape, None, None, eps, True, memory_efficient)

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — the ``csrc/`` analog of this framework.
+
+Each module provides raw forward/backward kernels; dtype policy, custom_vjp
+wiring, and jnp fallbacks live in the parent :mod:`apex_tpu.ops` modules.
+"""

@@ -1,0 +1,177 @@
+"""Pallas TPU kernels for fused LayerNorm / RMSNorm forward + backward.
+
+TPU-native equivalent of the reference's ``csrc/layer_norm_cuda_kernel.cu``
+(:: ``cuApplyLayerNorm``, ``cuApplyRMSNorm``, ``cuComputePartGradGammaBeta``,
+``cuComputeGradInput``).  Where the CUDA kernels do a warp-shuffle Welford
+reduction per row, the TPU kernels tile rows into VMEM blocks and let the VPU
+reduce along lanes; statistics are computed in f32 regardless of I/O dtype
+(the reference's "Mixed" classes).
+
+Layout: input is pre-flattened to ``(rows, hidden)``; ``hidden`` must be a
+multiple of 128 (lane width) for the Pallas path — callers fall back to the
+jnp path otherwise.  Gamma/beta gradients are produced as per-block partial
+sums ``(num_blocks, hidden)`` (≙ ``cuComputePartGradGammaBeta``) and reduced
+by the caller.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._dispatch import pallas_interpret
+
+_VMEM_BUDGET_PER_BUF = 360_000  # bytes of f32 per row-block buffer (heuristic)
+
+
+def _block_rows(rows: int, hidden: int) -> int:
+    br = (_VMEM_BUDGET_PER_BUF // max(hidden, 1)) // 8 * 8
+    br = max(8, min(256, br))
+    return min(br, max(8, (rows + 7) // 8 * 8))
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps, rms):
+    x = x_ref[...].astype(jnp.float32)
+    if rms:
+        mu = jnp.zeros((x.shape[0], 1), jnp.float32)
+        var = jnp.mean(x * x, axis=-1, keepdims=True)
+    else:
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        xc = x - mu
+        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mu) * rstd
+    w = w_ref[...].astype(jnp.float32)
+    b = b_ref[...].astype(jnp.float32)
+    y = xhat * w + b
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = mu
+    rstd_ref[...] = rstd
+
+
+def _ln_bwd_kernel(
+    x_ref,
+    w_ref,
+    b_ref,
+    mu_ref,
+    rstd_ref,
+    g_ref,
+    dx_ref,
+    dwp_ref,
+    dbp_ref,
+    *,
+    rows,
+    block_rows,
+    rms,
+    x_is_output,
+):
+    i = pl.program_id(0)
+    xw = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    rstd = rstd_ref[...]
+    if x_is_output:
+        # memory_efficient: recover xhat from the saved output y = xhat*w + b.
+        b = b_ref[...].astype(jnp.float32)
+        wsafe = jnp.where(w == 0.0, 1.0, w)
+        xhat = jnp.where(w == 0.0, 0.0, (xw - b) / wsafe)
+    else:
+        mu = mu_ref[...]
+        xhat = (xw - mu) * rstd
+    dyw = g * w
+    c2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    if rms:
+        dx = rstd * (dyw - xhat * c2)
+    else:
+        c1 = jnp.mean(dyw, axis=-1, keepdims=True)
+        dx = rstd * (dyw - c1 - xhat * c2)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+    # Per-block partial gamma/beta grads; mask grid-padding rows.  Partials
+    # are written into sublane row 0 of an (1, 8, hidden) block — TPU block
+    # shapes need the last two dims divisible by (8, 128).
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, xhat.shape, 0) + i * block_rows
+    valid = row_ids < rows
+    gm = jnp.where(valid, g, 0.0)
+    xhm = jnp.where(valid, xhat, 0.0)
+    hidden = xhat.shape[-1]
+    zeros7 = jnp.zeros((1, 7, hidden), jnp.float32)
+    dw_part = jnp.sum(gm * xhm, axis=0, keepdims=True)
+    db_part = jnp.sum(gm, axis=0, keepdims=True)
+    dwp_ref[...] = jnp.concatenate([dw_part[None], zeros7], axis=1)
+    dbp_ref[...] = jnp.concatenate([db_part[None], zeros7], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "rms"))
+def layer_norm_fwd(x2d, w, b, *, eps: float, rms: bool):
+    """Returns (y, mu, rstd); mu/rstd are f32 of shape (rows, 1)."""
+    rows, hidden = x2d.shape
+    br = _block_rows(rows, hidden)
+    grid = (pl.cdiv(rows, br),)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps, rms=rms),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(x2d, w.reshape(1, hidden), b.reshape(1, hidden))
+
+
+@functools.partial(jax.jit, static_argnames=("rms", "x_is_output"))
+def layer_norm_bwd(x2d, w, b, mu, rstd, g, *, rms: bool, x_is_output: bool):
+    """Returns (dx, dw, db); dw/db are f32 of shape (hidden,).
+
+    ``x_is_output=True`` is the memory_efficient path: ``x2d`` holds the saved
+    forward *output* and xhat is recovered in-kernel (≙ the reference's
+    ``memory_efficient`` template parameter).
+    """
+    rows, hidden = x2d.shape
+    br = _block_rows(rows, hidden)
+    nblocks = pl.cdiv(rows, br)
+    kernel = functools.partial(
+        _ln_bwd_kernel,
+        rows=rows,
+        block_rows=br,
+        rms=rms,
+        x_is_output=x_is_output,
+    )
+    dx, dwp, dbp = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
+            jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
+        ],
+        interpret=pallas_interpret(),
+    )(x2d, w.reshape(1, hidden), b.reshape(1, hidden), mu, rstd, g)
+    return dx, jnp.sum(dwp[:, 0, :], axis=0), jnp.sum(dbp[:, 0, :], axis=0)

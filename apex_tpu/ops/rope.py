@@ -1,0 +1,117 @@
+"""Fused rotary position embedding (RoPE) forward/backward.
+
+Capability parity with
+``apex/transformer/functional/fused_rope.py`` ::
+``fused_apply_rotary_pos_emb`` / ``fused_apply_rotary_pos_emb_cached``,
+backed by ``csrc/megatron/fused_rotary_positional_embedding_cuda.cu``.
+
+Layout follows the reference (Megatron ``sbhd``): ``t`` is
+``(seq, batch, heads, head_dim)`` and ``freqs`` is ``(seq, 1, 1, rot_dim)``
+with ``rot_dim <= head_dim``; only the first ``rot_dim`` channels rotate,
+the tail passes through.  The rotation uses the "rotate_half" convention:
+
+    y = t * cos(freqs) + rotate_half(t) * sin(freqs)
+
+The backward is the exact transpose of the (linear-in-t) rotation:
+``dt = g * cos + rotate_half^T(sin * g)`` with
+``rotate_half^T(x) = (x2, -x1)`` — expressed via ``custom_vjp`` so autograd
+never differentiates through cos/sin.  All math is fused by XLA into a
+single elementwise cluster; there is no HBM-roundtrip win for a Pallas
+kernel here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "rotate_half",
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+]
+
+
+def rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate((-x2, x1), axis=-1)
+
+
+def _apply(t, cos_, sin_):
+    rot_dim = cos_.shape[-1]
+    if rot_dim > t.shape[-1]:
+        raise ValueError(
+            f"rotary dim {rot_dim} exceeds head dim {t.shape[-1]}"
+        )
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    tf = t_rot.astype(jnp.float32)
+    out = tf * cos_ + rotate_half(tf) * sin_
+    out = out.astype(t.dtype)
+    if t_pass.shape[-1] == 0:
+        return out
+    return jnp.concatenate((out, t_pass), axis=-1)
+
+
+def _transpose_apply(g, cos_, sin_):
+    """dt = cos ⊙ g + rotate_half^T(sin ⊙ g);  rotate_half^T(x) = (x2, -x1).
+
+    The forward output (and hence the cotangent ``g``) carries ``t.dtype``,
+    so the input grad is cast to ``g.dtype``.
+    """
+    tdtype = g.dtype
+    rot_dim = cos_.shape[-1]
+    g_rot, g_pass = g[..., :rot_dim], g[..., rot_dim:]
+    gf = g_rot.astype(jnp.float32)
+    sg = sin_ * gf
+    sg1, sg2 = jnp.split(sg, 2, axis=-1)
+    dt = gf * cos_ + jnp.concatenate((sg2, -sg1), axis=-1)
+    dt = dt.astype(tdtype)
+    if g_pass.shape[-1] != 0:
+        dt = jnp.concatenate((dt, g_pass.astype(tdtype)), axis=-1)
+    return dt
+
+
+@jax.custom_vjp
+def fused_apply_rotary_pos_emb(t, freqs):
+    """≙ fused_apply_rotary_pos_emb (non-cached: freqs in radians)."""
+    cos_ = jnp.cos(freqs).astype(jnp.float32)
+    sin_ = jnp.sin(freqs).astype(jnp.float32)
+    return _apply(t, cos_, sin_)
+
+
+def _rope_fwd(t, freqs):
+    cos_ = jnp.cos(freqs).astype(jnp.float32)
+    sin_ = jnp.sin(freqs).astype(jnp.float32)
+    return _apply(t, cos_, sin_), (cos_, sin_)
+
+
+def _rope_bwd(res, g):
+    cos_, sin_ = res
+    return _transpose_apply(g, cos_, sin_), None
+
+
+fused_apply_rotary_pos_emb.defvjp(_rope_fwd, _rope_bwd)
+
+
+@jax.custom_vjp
+def fused_apply_rotary_pos_emb_cached(t, cos_, sin_):
+    """≙ fused_apply_rotary_pos_emb_cached (precomputed cos/sin tables).
+
+    Gradients flow to ``t`` only; the tables are treated as constants (their
+    cotangents are None), matching the reference kernel.
+    """
+    return _apply(t, cos_.astype(jnp.float32), sin_.astype(jnp.float32))
+
+
+def _rope_cached_fwd(t, cos_, sin_):
+    cos_f = cos_.astype(jnp.float32)
+    sin_f = sin_.astype(jnp.float32)
+    return _apply(t, cos_f, sin_f), (cos_f, sin_f)
+
+
+def _rope_cached_bwd(res, g):
+    cos_f, sin_f = res
+    return _transpose_apply(g, cos_f, sin_f), None, None
+
+
+fused_apply_rotary_pos_emb_cached.defvjp(_rope_cached_fwd, _rope_cached_bwd)

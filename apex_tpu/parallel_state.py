@@ -152,7 +152,16 @@ def initialize_model_parallel(
             device_array = mesh_utils.create_device_mesh(
                 (dp, pp, tp), devices=devices
             )
-        except Exception:
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"mesh_utils.create_device_mesh failed ({type(e).__name__}: {e});"
+                " falling back to naive device ordering — tp groups may span"
+                " non-adjacent chips, degrading collective bandwidth",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             device_array = np.asarray(devices).reshape(dp, pp, tp)
     mesh = Mesh(device_array, _AXIS_ORDER)
     _STATE = _ParallelState(

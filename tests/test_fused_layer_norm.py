@@ -1,0 +1,210 @@
+"""≙ tests/L0/run_fused_layer_norm/test_fused_layer_norm.py.
+
+Golden = unfused jnp composition of the same math (the reference compares
+against torch.nn.LayerNorm and a manual RMSNorm), across shapes, dtypes,
+affine flags, and memory_efficient; gradients compared against autodiff of
+the unfused reference.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import ops
+from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+
+
+def ref_layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) / jnp.sqrt(var + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def ref_rms_norm(x, w, eps):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf / jnp.sqrt(ms + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else dict(
+        rtol=2e-5, atol=2e-5
+    )
+
+
+SHAPES = [(16, 64), (4, 7, 96), (3, 1, 2, 160)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("memory_efficient", [False, True])
+def test_layer_norm_affine_fwd_bwd(shape, dtype, memory_efficient):
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    hidden = shape[-1]
+    x = jax.random.normal(k1, shape, dtype)
+    w = (1.0 + 0.1 * jax.random.normal(k2, (hidden,))).astype(jnp.float32)
+    b = (0.1 * jax.random.normal(k3, (hidden,))).astype(jnp.float32)
+    eps = 1e-5
+
+    fused = ops.fused_layer_norm_affine(x, w, b, hidden, eps, memory_efficient)
+    ref = ref_layer_norm(x, w, b, eps)
+    np.testing.assert_allclose(
+        np.asarray(fused, np.float32), np.asarray(ref, np.float32), **tol(dtype)
+    )
+
+    def loss_fused(x, w, b):
+        y = ops.fused_layer_norm_affine(x, w, b, hidden, eps, memory_efficient)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def loss_ref(x, w, b):
+        y = ref_layer_norm(x, w, b, eps)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32),
+            np.asarray(r, np.float32),
+            **tol(dtype),
+        )
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("memory_efficient", [False, True])
+def test_rms_norm_affine_fwd_bwd(dtype, memory_efficient):
+    key = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    shape, hidden = (8, 33, 128), 128
+    x = jax.random.normal(k1, shape, dtype)
+    w = (1.0 + 0.1 * jax.random.normal(k2, (hidden,))).astype(jnp.float32)
+    eps = 1e-6
+
+    fused = ops.fused_rms_norm_affine(x, w, hidden, eps, memory_efficient)
+    ref = ref_rms_norm(x, w, eps)
+    np.testing.assert_allclose(
+        np.asarray(fused, np.float32), np.asarray(ref, np.float32), **tol(dtype)
+    )
+
+    gf = jax.grad(
+        lambda x, w: jnp.sum(
+            ops.fused_rms_norm_affine(x, w, hidden, eps, memory_efficient)
+            .astype(jnp.float32) ** 2
+        ),
+        argnums=(0, 1),
+    )(x, w)
+    gr = jax.grad(
+        lambda x, w: jnp.sum(ref_rms_norm(x, w, eps).astype(jnp.float32) ** 2),
+        argnums=(0, 1),
+    )(x, w)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(r, np.float32), **tol(dtype)
+        )
+
+
+def test_non_affine_variants():
+    x = jax.random.normal(jax.random.PRNGKey(2), (10, 48))
+    np.testing.assert_allclose(
+        np.asarray(ops.fused_layer_norm(x, 48)),
+        np.asarray(ref_layer_norm(x, None, None, 1e-6)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ops.fused_rms_norm(x, 48)),
+        np.asarray(ref_rms_norm(x, None, 1e-6)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_multidim_normalized_shape():
+    x = jax.random.normal(jax.random.PRNGKey(3), (5, 4, 6))
+    w = jnp.ones((4, 6))
+    b = jnp.zeros((4, 6))
+    got = ops.fused_layer_norm_affine(x, w, b, (4, 6), 1e-5)
+    ref = ref_layer_norm(x.reshape(5, 24), w.reshape(24), b.reshape(24), 1e-5)
+    np.testing.assert_allclose(
+        np.asarray(got).reshape(5, 24), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("memory_efficient", [False, True])
+@pytest.mark.parametrize("rms", [False, True])
+def test_pallas_kernel_matches_jnp_path(memory_efficient, rms):
+    """Run the Pallas kernels in interpret mode on CPU; must match jnp path."""
+    key = jax.random.PRNGKey(4)
+    k1, k2, k3 = jax.random.split(key, 3)
+    shape, hidden = (33, 256), 256  # odd rows exercise grid remainder masking
+    x = jax.random.normal(k1, shape, jnp.float32)
+    w = 1.0 + 0.1 * jax.random.normal(k2, (hidden,))
+    b = 0.1 * jax.random.normal(k3, (hidden,))
+
+    if rms:
+        f = lambda x, w, b: ops.fused_rms_norm_affine(  # noqa: E731
+            x, w, hidden, 1e-5, memory_efficient
+        )
+    else:
+        f = lambda x, w, b: ops.fused_layer_norm_affine(  # noqa: E731
+            x, w, b, hidden, 1e-5, memory_efficient
+        )
+
+    def run():
+        y = f(x, w, b)
+        g = jax.grad(lambda *a: jnp.sum(f(*a) ** 2), argnums=(0, 1, 2))(x, w, b)
+        return y, g
+
+    ops.set_use_pallas(False)
+    try:
+        y_ref, g_ref = run()
+    finally:
+        ops.set_use_pallas(None)
+    ops.set_use_pallas(True)  # interpret mode on CPU
+    try:
+        y_pl, g_pl = run()
+    finally:
+        ops.set_use_pallas(None)
+
+    np.testing.assert_allclose(
+        np.asarray(y_pl), np.asarray(y_ref), rtol=1e-5, atol=1e-5
+    )
+    for a, r in zip(g_pl, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_flax_modules():
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 64))
+    ln = FusedLayerNorm(64)
+    params = ln.init(jax.random.PRNGKey(0), x)
+    y = ln.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y),
+        np.asarray(ref_layer_norm(x, jnp.ones(64), jnp.zeros(64), 1e-5)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    rn = FusedRMSNorm(64, elementwise_affine=False)
+    params = rn.init(jax.random.PRNGKey(0), x)
+    y = rn.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y),
+        np.asarray(ref_rms_norm(x, None, 1e-5)),
+        rtol=1e-5,
+        atol=1e-5,
+    )

@@ -1,0 +1,77 @@
+"""≙ tests/L0/run_transformer/test_fused_softmax.py — vs unfused composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import ops
+
+
+def ref_scaled_masked(x, mask, scale):
+    xs = x.astype(jnp.float32) * scale
+    if mask is not None:
+        xs = jnp.where(mask, -10000.0, xs)
+    return jax.nn.softmax(xs, axis=-1).astype(x.dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("scale", [1.0, 0.125])
+def test_scaled_softmax(dtype, scale):
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 16), dtype)
+    got = ops.scaled_softmax(x, scale)
+    ref = ref_scaled_masked(x, None, scale)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=1e-2
+        if dtype == jnp.bfloat16 else 1e-6,
+    )
+    g_got = jax.grad(lambda x: jnp.sum(ops.scaled_softmax(x, scale) ** 2))(x)
+    g_ref = jax.grad(lambda x: jnp.sum(ref_scaled_masked(x, None, scale) ** 2))(x)
+    np.testing.assert_allclose(
+        np.asarray(g_got, np.float32),
+        np.asarray(g_ref, np.float32),
+        atol=1e-2 if dtype == jnp.bfloat16 else 1e-5,
+    )
+
+
+def test_scaled_masked_softmax():
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (2, 4, 8, 16))
+    mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.3, (2, 1, 8, 16))
+    scale = 0.5
+    got = ops.scaled_masked_softmax(x, mask, scale)
+    ref = ref_scaled_masked(x, mask, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+    # masked positions get (near-)zero probability
+    assert float(jnp.max(jnp.where(mask, got, 0.0))) < 1e-4
+
+    g_got = jax.grad(
+        lambda x: jnp.sum(ops.scaled_masked_softmax(x, mask, scale) ** 2)
+    )(x)
+    g_ref = jax.grad(lambda x: jnp.sum(ref_scaled_masked(x, mask, scale) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), atol=1e-5)
+
+
+def test_scaled_upper_triang_masked_softmax():
+    x = jax.random.normal(jax.random.PRNGKey(3), (6, 16, 16))
+    scale = 0.25
+    got = ops.scaled_upper_triang_masked_softmax(x, scale)
+    causal = jnp.triu(jnp.ones((16, 16), bool), k=1)[None]
+    ref = ref_scaled_masked(x, causal, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+    # row 0 attends only to position 0
+    np.testing.assert_allclose(np.asarray(got[:, 0, 0]), 1.0, atol=1e-4)
+
+    g_got = jax.grad(
+        lambda x: jnp.sum(ops.scaled_upper_triang_masked_softmax(x, scale) ** 2)
+    )(x)
+    g_ref = jax.grad(lambda x: jnp.sum(ref_scaled_masked(x, causal, scale) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), atol=1e-5)
+
+
+def test_generic_alias():
+    x = jax.random.normal(jax.random.PRNGKey(4), (3, 5, 7))
+    mask = jax.random.bernoulli(jax.random.PRNGKey(5), 0.2, (3, 5, 7))
+    got = ops.generic_scaled_masked_softmax(x, mask, 2.0)
+    ref = ref_scaled_masked(x, mask, 2.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)

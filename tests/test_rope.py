@@ -1,0 +1,72 @@
+"""Fused RoPE vs unfused reference (incl. autodiff-vs-custom_vjp grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import ops
+
+
+def ref_rope(t, freqs):
+    rot_dim = freqs.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    tf = t_rot.astype(jnp.float32)
+    out = tf * jnp.cos(freqs) + ops.rotate_half(tf) * jnp.sin(freqs)
+    return jnp.concatenate((out.astype(t.dtype), t_pass), axis=-1)
+
+
+def make_freqs(seq, rot_dim, duplicated=True):
+    inv = 1.0 / (10000 ** (jnp.arange(0, rot_dim, 2) / rot_dim))
+    ang = jnp.outer(jnp.arange(seq), inv)  # (seq, rot_dim/2)
+    if duplicated:
+        emb = jnp.concatenate((ang, ang), axis=-1)
+    else:
+        # deliberately non-duplicated halves: exercises the exact-transpose bwd
+        emb = jnp.concatenate((ang, 2.0 * ang), axis=-1)
+    return emb[:, None, None, :]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("rot_frac", [1.0, 0.5])
+@pytest.mark.parametrize("duplicated", [True, False])
+def test_rope_fwd_bwd(dtype, rot_frac, duplicated):
+    seq, b, h, d = 12, 2, 3, 16
+    rot_dim = int(d * rot_frac)
+    t = jax.random.normal(jax.random.PRNGKey(0), (seq, b, h, d), dtype)
+    freqs = make_freqs(seq, rot_dim, duplicated)
+
+    got = ops.fused_apply_rotary_pos_emb(t, freqs)
+    ref = ref_rope(t, freqs)
+    atol = 2e-2 if dtype == jnp.bfloat16 else 1e-6
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=atol
+    )
+
+    g_got = jax.grad(
+        lambda t: jnp.sum(
+            ops.fused_apply_rotary_pos_emb(t, freqs).astype(jnp.float32) ** 2
+        )
+    )(t)
+    g_ref = jax.grad(
+        lambda t: jnp.sum(ref_rope(t, freqs).astype(jnp.float32) ** 2)
+    )(t)
+    np.testing.assert_allclose(
+        np.asarray(g_got, np.float32), np.asarray(g_ref, np.float32), atol=atol
+    )
+
+
+def test_rope_cached():
+    seq, b, h, d = 8, 2, 2, 8
+    t = jax.random.normal(jax.random.PRNGKey(1), (seq, b, h, d))
+    freqs = make_freqs(seq, d)
+    cos_, sin_ = jnp.cos(freqs), jnp.sin(freqs)
+    got = ops.fused_apply_rotary_pos_emb_cached(t, cos_, sin_)
+    ref = ref_rope(t, freqs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+    g_got = jax.grad(
+        lambda t: jnp.sum(ops.fused_apply_rotary_pos_emb_cached(t, cos_, sin_) ** 2)
+    )(t)
+    g_ref = jax.grad(lambda t: jnp.sum(ref_rope(t, freqs) ** 2))(t)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), atol=1e-5)
