@@ -1,0 +1,28 @@
+"""Fused optimizers — ≙ apex/optimizers + apex/contrib/clip_grad + LARC.
+
+Two API shapes per optimizer:
+- lowercase factory (``fused_adam(...)``) → ``optax.GradientTransformation``
+  for functional training loops;
+- CamelCase class (``FusedAdam(params, ...)``) → apex-shaped stateful
+  wrapper with a jitted ``.step(grads, params)``.
+"""
+
+from apex_tpu.optimizers.clip_grad import clip_grad_norm  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import (  # noqa: F401
+    FusedAdagrad,
+    fused_adagrad,
+)
+from apex_tpu.optimizers.fused_adam import FusedAdam, fused_adam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
+    FusedNovoGrad,
+    fused_novograd,
+)
+from apex_tpu.optimizers.fused_sgd import FusedSGD, fused_sgd  # noqa: F401
+from apex_tpu.optimizers.larc import LARC, larc  # noqa: F401
+from apex_tpu.optimizers.multi_tensor import (  # noqa: F401
+    axpby,
+    global_norm,
+    per_tensor_norm,
+    scale_with_overflow_check,
+)

@@ -1,0 +1,52 @@
+"""Fused gradient clipping — ≙ apex/contrib/clip_grad/clip_grad.py ::
+``clip_grad_norm_`` (drop-in for ``torch.nn.utils.clip_grad_norm_`` built on
+``multi_tensor_l2norm`` + ``multi_tensor_scale``).
+
+Functional: returns the clipped tree and the pre-clip total norm (the
+reference returns the norm and scales in place).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.multi_tensor import global_norm
+
+__all__ = ["clip_grad_norm"]
+
+
+def clip_grad_norm(
+    grads: Any, max_norm: float, norm_type: float = 2.0
+) -> Tuple[Any, jax.Array]:
+    """Clip a gradient pytree to ``max_norm`` total norm.
+
+    ``norm_type=2`` uses the fused global L2 norm; ``inf`` uses max-abs
+    (≙ the reference's non-fused fallback path for other norm types).
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == 2.0:
+        total = global_norm(grads)
+    elif norm_type == float("inf"):
+        total = (
+            jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in leaves]))
+            if leaves
+            else jnp.zeros((), jnp.float32)
+        )
+    else:
+        total = (
+            sum(
+                jnp.sum(jnp.abs(x.astype(jnp.float32)) ** norm_type)
+                for x in leaves
+            )
+            ** (1.0 / norm_type)
+            if leaves
+            else jnp.zeros((), jnp.float32)
+        )
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda x: (x.astype(jnp.float32) * scale).astype(x.dtype), grads
+    )
+    return clipped, total

@@ -1,0 +1,103 @@
+"""Fused Adagrad — ≙ apex/optimizers/fused_adagrad.py :: FusedAdagrad.
+
+Backed in the reference by ``csrc/multi_tensor_adagrad.cu`` ::
+``AdagradFunctor``:
+
+    h  += g²
+    p  -= lr · g / (√h + eps)   [+ lr·wd·p  decoupled if adagrad_w_mode,
+                                 else wd folded into g first]
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_adagrad", "FusedAdagrad"]
+
+
+class FusedAdagradState(NamedTuple):
+    count: jax.Array
+    sum: Any
+
+
+def fused_adagrad(
+    learning_rate: Union[float, optax.Schedule] = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+    *,
+    state_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    def init(params):
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=state_dtype), params
+            ),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params for the update")
+        count = state.count + 1
+        # schedules are evaluated at the 0-based step (optax convention)
+        lr = (
+            learning_rate(state.count)
+            if callable(learning_rate)
+            else learning_rate
+        )
+        tm = jax.tree_util.tree_map
+
+        def eff_grad(g, p):
+            gf = g.astype(jnp.float32)
+            if not adagrad_w_mode and weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)
+            return gf
+
+        gf = tm(eff_grad, grads, params)
+        h_new = tm(lambda h, g: h + g * g, state.sum, gf)
+
+        def upd(g, h, p):
+            u = g / (jnp.sqrt(h) + eps)
+            if adagrad_w_mode and weight_decay != 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)
+            return (-lr * u).astype(p.dtype)
+
+        updates = tm(upd, gf, h_new, params)
+        return updates, FusedAdagradState(count=count, sum=h_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedAdagrad:
+    """apex-shaped stateful wrapper."""
+
+    def __init__(
+        self,
+        params,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+    ):
+        self.tx = fused_adagrad(
+            learning_rate=lr,
+            eps=eps,
+            weight_decay=weight_decay,
+            adagrad_w_mode=adagrad_w_mode,
+        )
+        self.state = self.tx.init(params)
+
+        def _step(g, s, p):
+            updates, ns = self.tx.update(g, s, p)
+            return optax.apply_updates(p, updates), ns
+
+        self._step = jax.jit(_step)
+
+    def step(self, grads, params):
+        params, self.state = self._step(grads, self.state, params)
+        return params

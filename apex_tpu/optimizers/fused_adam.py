@@ -1,0 +1,132 @@
+"""Fused Adam / AdamW — ≙ apex/optimizers/fused_adam.py :: FusedAdam.
+
+Backed in the reference by ``csrc/multi_tensor_adam.cu`` :: ``AdamFunctor``
+with ``ADAM_MODE_0`` (L2: grad += wd*p before the moments) and
+``ADAM_MODE_1`` (AdamW: decoupled decay added to the update) selected by
+``adam_w_mode``.  One jitted pytree update = one XLA program = the
+launch-amortization the multi-tensor kernel bought on GPU.
+
+State (m, v) is kept in f32 by default regardless of param dtype (the
+reference runs fp32 master params through this optimizer under amp O2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_adam", "FusedAdam"]
+
+ScalarOrSchedule = Union[float, optax.Schedule]
+
+
+class FusedAdamState(NamedTuple):
+    count: jax.Array  # int32 step counter (1-based after first update)
+    m: Any
+    v: Any
+
+
+def _lr_at(lr: ScalarOrSchedule, prev_count):
+    """Evaluate a schedule at the 0-based step (optax convention: the first
+    update sees lr(0)), or pass a constant through."""
+    return lr(prev_count) if callable(lr) else lr
+
+
+def fused_adam(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    adam_w_mode: bool = True,
+    bias_correction: bool = True,
+    *,
+    state_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    """optax-style fused Adam(W) matching the reference kernel's math."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=state_dtype)  # noqa: E731
+        return FusedAdamState(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree_util.tree_map(zeros, params),
+            v=jax.tree_util.tree_map(zeros, params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam requires params for the update")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, state.count)
+        cf = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - beta1**cf
+            bc2 = 1.0 - beta2**cf
+        else:
+            bc1 = bc2 = 1.0
+
+        tm = jax.tree_util.tree_map
+
+        def eff_grad(g, p):
+            gf = g.astype(jnp.float32)
+            if not adam_w_mode and weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)  # ADAM_MODE_0
+            return gf
+
+        gf = tm(eff_grad, grads, params)
+        m_new = tm(lambda m, g: beta1 * m + (1.0 - beta1) * g, state.m, gf)
+        v_new = tm(lambda v, g: beta2 * v + (1.0 - beta2) * g * g, state.v, gf)
+
+        def upd(m, v, p):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if adam_w_mode and weight_decay != 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)  # ADAM_MODE_1
+            return (-lr * u).astype(p.dtype)
+
+        updates = tm(upd, m_new, v_new, params)
+        return updates, FusedAdamState(count=count, m=m_new, v=v_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedAdam:
+    """apex-shaped stateful wrapper (``FusedAdam(params).step(grads)``)."""
+
+    def __init__(
+        self,
+        params,
+        lr: float = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        adam_w_mode: bool = True,
+        bias_correction: bool = True,
+        amsgrad: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        self.tx = fused_adam(
+            learning_rate=lr,
+            beta1=betas[0],
+            beta2=betas[1],
+            eps=eps,
+            weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode,
+            bias_correction=bias_correction,
+        )
+        self.state = self.tx.init(params)
+        self._step = jax.jit(
+            lambda g, s, p: _apply(self.tx, g, s, p)
+        )
+
+    def step(self, grads, params):
+        params, self.state = self._step(grads, self.state, params)
+        return params
+
+
+def _apply(tx, grads, state, params):
+    updates, new_state = tx.update(grads, state, params)
+    return optax.apply_updates(params, updates), new_state

@@ -1,0 +1,159 @@
+"""Fused LAMB — ≙ apex/optimizers/fused_lamb.py :: FusedLAMB.
+
+Backed in the reference by ``csrc/multi_tensor_lamb.cu`` ::
+``LAMBStage1Functor`` / ``LAMBStage2Functor`` with the global grad norm from
+``multi_tensor_l2norm`` (SURVEY.md §3.2 traces the full call stack).  The
+exact semantics reproduced here:
+
+1. global_grad_norm = sqrt(Σ‖g‖²) over **all** params;
+2. stage 1 — grads divided by ``clipped_ratio =
+   max(global_grad_norm / max_grad_norm, 1)``; moments
+   ``m ← β₁m + (1-β₁ if grad_averaging else 1)·g``,
+   ``v ← β₂v + (1-β₂)·g²`` with optional bias correction;
+   update ``u = m̂/(√v̂ + eps) + wd·p`` (decoupled/AdamW style when
+   ``adam_w_mode``, else L2 into the grad);
+3. stage 2 — per-tensor trust ratio ``r = ‖p‖/‖u‖`` applied only when both
+   norms are nonzero, and — unless ``use_nvlamb`` — only for params with
+   nonzero weight decay; ``p ← p − lr·r·u``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers.multi_tensor import global_norm
+
+__all__ = ["fused_lamb", "FusedLAMB"]
+
+ScalarOrSchedule = Union[float, optax.Schedule]
+
+
+class FusedLAMBState(NamedTuple):
+    count: jax.Array
+    m: Any
+    v: Any
+
+
+def fused_lamb(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    bias_correction: bool = True,
+    grad_averaging: bool = True,
+    adam_w_mode: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+    *,
+    state_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=state_dtype)  # noqa: E731
+        return FusedLAMBState(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree_util.tree_map(zeros, params),
+            v=jax.tree_util.tree_map(zeros, params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params for the update")
+        count = state.count + 1
+        # schedules are evaluated at the 0-based step (optax convention)
+        lr = (
+            learning_rate(state.count)
+            if callable(learning_rate)
+            else learning_rate
+        )
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - beta1**cf if bias_correction else 1.0
+        bc2 = 1.0 - beta2**cf if bias_correction else 1.0
+        beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+        # global grad-norm clip (stage 1 preamble)
+        gnorm = global_norm(grads)
+        clip_ratio = jnp.where(
+            (max_grad_norm > 0.0) & (gnorm > max_grad_norm),
+            gnorm / max_grad_norm,
+            1.0,
+        )
+        tm = jax.tree_util.tree_map
+
+        def eff_grad(g, p):
+            gf = g.astype(jnp.float32) / clip_ratio
+            if not adam_w_mode and weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)
+            return gf
+
+        gf = tm(eff_grad, grads, params)
+        m_new = tm(lambda m, g: beta1 * m + beta3 * g, state.m, gf)
+        v_new = tm(lambda v, g: beta2 * v + (1.0 - beta2) * g * g, state.v, gf)
+
+        def upd(m, v, p):
+            pf = p.astype(jnp.float32)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if adam_w_mode and weight_decay != 0.0:
+                u = u + weight_decay * pf
+            # stage 2: per-tensor trust ratio
+            w_norm = jnp.sqrt(jnp.sum(pf * pf))
+            u_norm = jnp.sqrt(jnp.sum(u * u))
+            ratio = jnp.where(
+                (w_norm > 0.0) & (u_norm > 0.0), w_norm / u_norm, 1.0
+            )
+            if not use_nvlamb and weight_decay == 0.0:
+                ratio = 1.0  # vanilla LAMB skips adaptation for wd==0 groups
+            return (-lr * ratio * u).astype(p.dtype)
+
+        updates = tm(upd, m_new, v_new, params)
+        return updates, FusedLAMBState(count=count, m=m_new, v=v_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedLAMB:
+    """apex-shaped stateful wrapper."""
+
+    def __init__(
+        self,
+        params,
+        lr: float = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        bias_correction: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        self.tx = fused_lamb(
+            learning_rate=lr,
+            beta1=betas[0],
+            beta2=betas[1],
+            eps=eps,
+            weight_decay=weight_decay,
+            bias_correction=bias_correction,
+            grad_averaging=grad_averaging,
+            adam_w_mode=adam_w_mode,
+            max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb,
+        )
+        self.state = self.tx.init(params)
+
+        def _step(g, s, p):
+            updates, ns = self.tx.update(g, s, p)
+            return optax.apply_updates(p, updates), ns
+
+        self._step = jax.jit(_step)
+
+    def step(self, grads, params):
+        params, self.state = self._step(grads, self.state, params)
+        return params

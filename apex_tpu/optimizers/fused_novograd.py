@@ -1,0 +1,131 @@
+"""Fused NovoGrad — ≙ apex/optimizers/fused_novograd.py :: FusedNovoGrad.
+
+Backed in the reference by ``csrc/multi_tensor_novograd.cu`` ::
+``NovoGradFunctor`` with a **per-tensor** (layer-wise) second moment:
+
+    v_t  = β₂·v_{t-1} + (1-β₂)·‖g_t‖²        (scalar per tensor;
+                                              first step: v_1 = ‖g_1‖²
+                                              unless init_zero)
+    u    = g_t / (√v_t + eps)  [+ wd·p  if reg_inside_moment]
+    m_t  = β₁·m_{t-1} + (1-β₁ if grad_averaging else 1)·u
+    p   -= lr · (m_t [+ wd·p  if not reg_inside_moment])
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_novograd", "FusedNovoGrad"]
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jax.Array
+    m: Any
+    v: Any  # scalar per tensor
+
+
+def fused_novograd(
+    learning_rate: Union[float, optax.Schedule] = 1e-3,
+    beta1: float = 0.95,
+    beta2: float = 0.98,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = True,
+    init_zero: bool = False,
+    reg_inside_moment: bool = False,
+    *,
+    state_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    def init(params):
+        return FusedNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=state_dtype), params
+            ),
+            v=jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), state_dtype), params
+            ),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params for the update")
+        count = state.count + 1
+        # schedules are evaluated at the 0-based step (optax convention)
+        lr = (
+            learning_rate(state.count)
+            if callable(learning_rate)
+            else learning_rate
+        )
+        beta3 = (1.0 - beta1) if grad_averaging else 1.0
+        first = (count == 1).astype(jnp.float32)
+        tm = jax.tree_util.tree_map
+
+        def new_v(v, g):
+            gn2 = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            ema = beta2 * v + (1.0 - beta2) * gn2
+            if init_zero:
+                return ema
+            return first * gn2 + (1.0 - first) * ema
+
+        v_new = tm(new_v, state.v, grads)
+
+        def new_m(m, g, v, p):
+            u = g.astype(jnp.float32) / (jnp.sqrt(v) + eps)
+            if reg_inside_moment and weight_decay != 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)
+            return beta1 * m + beta3 * u
+
+        m_new = tm(new_m, state.m, grads, v_new, params)
+
+        def upd(m, p):
+            u = m
+            if not reg_inside_moment and weight_decay != 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)
+            return (-lr * u).astype(p.dtype)
+
+        updates = tm(upd, m_new, params)
+        return updates, FusedNovoGradState(count=count, m=m_new, v=v_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedNovoGrad:
+    """apex-shaped stateful wrapper."""
+
+    def __init__(
+        self,
+        params,
+        lr: float = 1e-3,
+        betas=(0.95, 0.98),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        grad_averaging: bool = True,
+        init_zero: bool = False,
+        reg_inside_moment: bool = False,
+    ):
+        self.tx = fused_novograd(
+            learning_rate=lr,
+            beta1=betas[0],
+            beta2=betas[1],
+            eps=eps,
+            weight_decay=weight_decay,
+            grad_averaging=grad_averaging,
+            init_zero=init_zero,
+            reg_inside_moment=reg_inside_moment,
+        )
+        self.state = self.tx.init(params)
+
+        def _step(g, s, p):
+            updates, ns = self.tx.update(g, s, p)
+            return optax.apply_updates(p, updates), ns
+
+        self._step = jax.jit(_step)
+
+    def step(self, grads, params):
+        params, self.state = self._step(grads, self.state, params)
+        return params

@@ -1,0 +1,130 @@
+"""Fused SGD — ≙ apex/optimizers/fused_sgd.py :: FusedSGD.
+
+Backed in the reference by ``csrc/multi_tensor_sgd_kernel.cu`` ::
+``SGDFunctor`` (momentum/dampening/nesterov/weight-decay over tensor lists;
+the fp16-model+fp32-master list variants are the amp integration, which here
+lives in :mod:`apex_tpu.amp` instead).  Matches ``torch.optim.SGD`` math:
+
+    d = g + wd*p
+    buf = momentum*buf + (1-dampening)*d         (first step: buf = d)
+    update = d + momentum*buf   if nesterov else buf
+    p -= lr * update
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_sgd", "FusedSGD"]
+
+
+class FusedSGDState(NamedTuple):
+    count: jax.Array
+    momentum_buf: Any
+
+
+def fused_sgd(
+    learning_rate: Union[float, optax.Schedule] = 1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    *,
+    state_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires momentum > 0 and zero dampening")
+
+    def init(params):
+        if momentum == 0.0:
+            buf = None
+        else:
+            buf = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=state_dtype), params
+            )
+        return FusedSGDState(count=jnp.zeros((), jnp.int32), momentum_buf=buf)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        # schedules are evaluated at the 0-based step (optax convention)
+        lr = (
+            learning_rate(state.count)
+            if callable(learning_rate)
+            else learning_rate
+        )
+        tm = jax.tree_util.tree_map
+
+        def eff_grad(g, p):
+            d = g.astype(jnp.float32)
+            if weight_decay != 0.0:
+                d = d + weight_decay * p.astype(jnp.float32)
+            return d
+
+        if params is None:
+            if weight_decay != 0.0:
+                raise ValueError("fused_sgd with weight_decay requires params")
+            d = tm(lambda g: g.astype(jnp.float32), grads)
+        else:
+            d = tm(eff_grad, grads, params)
+
+        # updates are applied to the params, so they carry the *param* dtype
+        # (bf16 grads must not truncate fp32 master-weight updates)
+        out_tree = params if params is not None else grads
+
+        if momentum == 0.0:
+            updates = tm(lambda di, o: (-lr * di).astype(o.dtype), d, out_tree)
+            return updates, FusedSGDState(count=count, momentum_buf=None)
+
+        first = (count == 1).astype(jnp.float32)
+
+        def new_buf(buf, di):
+            # first step: buf = d (torch semantics), else EMA with dampening
+            return first * di + (1.0 - first) * (
+                momentum * buf + (1.0 - dampening) * di
+            )
+
+        buf_new = tm(new_buf, state.momentum_buf, d)
+        if nesterov:
+            upd = tm(lambda di, b: di + momentum * b, d, buf_new)
+        else:
+            upd = buf_new
+        updates = tm(lambda u, o: (-lr * u).astype(o.dtype), upd, out_tree)
+        return updates, FusedSGDState(count=count, momentum_buf=buf_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedSGD:
+    """apex-shaped stateful wrapper."""
+
+    def __init__(
+        self,
+        params,
+        lr: float = 1e-3,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+    ):
+        self.tx = fused_sgd(
+            learning_rate=lr,
+            momentum=momentum,
+            dampening=dampening,
+            weight_decay=weight_decay,
+            nesterov=nesterov,
+        )
+        self.state = self.tx.init(params)
+
+        def _step(g, s, p):
+            updates, ns = self.tx.update(g, s, p)
+            return optax.apply_updates(p, updates), ns
+
+        self._step = jax.jit(_step)
+
+    def step(self, grads, params):
+        params, self.state = self._step(grads, self.state, params)
+        return params

@@ -1,0 +1,100 @@
+"""LARC — layer-wise adaptive rate clipping.
+
+≙ ``apex/parallel/LARC.py`` :: ``LARC`` (trust_coefficient, clip mode, eps).
+The reference wraps a torch optimizer and rescales ``p.grad`` in-place before
+the inner ``step``; here it is an optax transformation chained *before* the
+inner optimizer:
+
+    local_lr = trust_coefficient · ‖p‖ / (‖g‖ + wd·‖p‖ + eps)
+    clip:     g ← g · min(local_lr / lr, 1)
+    scale:    g ← g · local_lr
+
+Params with ‖p‖ == 0 or ‖g‖ == 0 pass through unscaled (reference guard).
+The reference folds the wrapped group's weight decay into the gradient
+before scaling and zeroes it for the inner step; pass the same
+``weight_decay`` here and set the inner optimizer's decay to 0 to match.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["larc", "LARC"]
+
+
+class LARCState(NamedTuple):
+    count: jax.Array
+
+
+def larc(
+    learning_rate: Union[float, optax.Schedule],
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    def init(params):
+        del params
+        return LARCState(count=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params for the update")
+        # current-step lr, as the reference reads the group's live lr
+        lr = (
+            learning_rate(state.count)
+            if callable(learning_rate)
+            else learning_rate
+        )
+
+        def leaf(g, p):
+            gf = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(pf * pf))
+            g_norm = jnp.sqrt(jnp.sum(gf * gf))
+            local_lr = (
+                trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + eps)
+            )
+            if clip:
+                scale = jnp.minimum(local_lr / lr, 1.0)
+            else:
+                scale = local_lr
+            adapted = (gf + weight_decay * pf) * scale
+            # zero param or zero grad: pass through untouched (reference
+            # applies both the wd fold-in and the scaling only inside the
+            # nonzero-norms branch)
+            active = (p_norm > 0.0) & (g_norm > 0.0)
+            return jnp.where(active, adapted, gf).astype(g.dtype)
+
+        out = jax.tree_util.tree_map(leaf, grads, params)
+        return out, LARCState(count=state.count + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+class LARC:
+    """apex-shaped wrapper: ``LARC(inner_tx, lr).init/update`` like optax."""
+
+    def __init__(
+        self,
+        optimizer: optax.GradientTransformation,
+        learning_rate: float,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+    ):
+        self.tx = optax.chain(
+            larc(learning_rate, trust_coefficient, clip, eps, weight_decay),
+            optimizer,
+        )
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.tx.update(grads, state, params)

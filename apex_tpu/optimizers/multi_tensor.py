@@ -1,0 +1,84 @@
+"""Cross-tensor reductions — the ``multi_tensor_apply`` analog.
+
+The reference batches many tensors into single CUDA kernel launches
+(``csrc/multi_tensor_apply.cuh`` :: ``multi_tensor_apply<depth>``,
+``csrc/amp_C_frontend.cpp`` :: ``multi_tensor_l2norm``/``multi_tensor_scale``
+etc.) purely to amortize launch overhead.  Under ``jit`` a whole-pytree
+update is already a single XLA program, so the launch-amortization property
+is free; what this module provides is the reference's *cross-tensor reduction
+semantics* — global and per-tensor L2 norms, inf/nan detection fused into
+scaling (the ``noop_flag`` convention dynamic loss scaling relies on) — as
+fused jnp reductions over pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "global_norm",
+    "per_tensor_norm",
+    "scale_with_overflow_check",
+    "axpby",
+]
+
+PyTree = Any
+
+
+def global_norm(tree: PyTree) -> jax.Array:
+    """sqrt(Σ‖leaf‖²) over all leaves, accumulated in f32.
+
+    ≙ ``amp_C.multi_tensor_l2norm(..., per_tensor=False)`` + the host-side
+    sqrt(Σ partial²) in ``FusedLAMB.step``.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(sq)
+
+
+def per_tensor_norm(tree: PyTree) -> PyTree:
+    """‖leaf‖₂ per leaf (f32 scalars), same treedef.
+
+    ≙ ``amp_C.multi_tensor_l2norm(..., per_tensor=True)`` (the LAMB
+    trust-ratio input).
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)))), tree
+    )
+
+
+def scale_with_overflow_check(
+    tree: PyTree, scale, out_dtype: Optional[jnp.dtype] = None
+) -> Tuple[PyTree, jax.Array]:
+    """``out = tree * scale`` plus a fused inf/nan flag.
+
+    ≙ ``csrc/multi_tensor_scale_kernel.cu`` :: ``ScaleFunctor`` — the amp
+    unscale primitive: one pass that both scales and writes ``noop_flag``
+    when any element is non-finite.  Returns ``(scaled_tree, found_inf)``
+    with ``found_inf`` a f32 scalar in {0.0, 1.0}.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flags = []
+    out = []
+    for x in leaves:
+        xf = x.astype(jnp.float32)
+        flags.append(jnp.logical_not(jnp.all(jnp.isfinite(xf))))
+        y = xf * scale
+        out.append(y.astype(out_dtype) if out_dtype is not None else y.astype(x.dtype))
+    found_inf = jnp.any(jnp.stack(flags)).astype(jnp.float32) if flags else jnp.zeros((), jnp.float32)
+    return jax.tree_util.tree_unflatten(treedef, out), found_inf
+
+
+def axpby(a, x_tree: PyTree, b, y_tree: PyTree, out_dtype=None) -> PyTree:
+    """``a*x + b*y`` leafwise — ≙ multi_tensor_axpby (master-grad merge)."""
+
+    def f(x, y):
+        r = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return r.astype(out_dtype if out_dtype is not None else x.dtype)
+
+    return jax.tree_util.tree_map(f, x_tree, y_tree)

@@ -1,0 +1,345 @@
+"""≙ tests/L0/run_optimizers/test_fused_optimizer.py + test_lamb.py.
+
+Golden for Adam/AdamW/SGD/Adagrad = torch.optim on CPU (the reference
+compares its fused CUDA optimizers against torch.optim the same way);
+golden for LAMB = a pure-numpy reference implementing the documented
+stage1/stage2 semantics (the reference tests against a python RefLAMB).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import optimizers as opt
+
+
+def make_params(seed=0, shapes=((7, 9), (33,), (4, 5, 6))):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(*s).astype(np.float32) for s in shapes]
+
+
+def run_jax(tx, params_np, grads_seq):
+    params = [jnp.asarray(p) for p in params_np]
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state, grads):
+        updates, state = tx.update(grads, state, params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return new_params, state
+
+    for g in grads_seq:
+        params, state = step(params, state, [jnp.asarray(x) for x in g])
+    return [np.asarray(p) for p in params]
+
+
+def run_torch(opt_cls, params_np, grads_seq, **kw):
+    params = [torch.tensor(p, requires_grad=True) for p in params_np]
+    o = opt_cls(params, **kw)
+    for g in grads_seq:
+        for p, gi in zip(params, g):
+            p.grad = torch.tensor(gi)
+        o.step()
+    return [p.detach().numpy() for p in params]
+
+
+def grad_seq(n_steps, shapes=((7, 9), (33,), (4, 5, 6)), seed=100):
+    rng = np.random.RandomState(seed)
+    return [
+        [rng.randn(*s).astype(np.float32) for s in shapes]
+        for _ in range(n_steps)
+    ]
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_adam_l2_mode_vs_torch(wd):
+    p0, gs = make_params(), grad_seq(5)
+    got = run_jax(
+        opt.fused_adam(1e-2, weight_decay=wd, adam_w_mode=False), p0, gs
+    )
+    ref = run_torch(torch.optim.Adam, p0, gs, lr=1e-2, weight_decay=wd)
+    for a, r in zip(got, ref):
+        np.testing.assert_allclose(a, r, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_adamw_mode_vs_torch(wd):
+    p0, gs = make_params(), grad_seq(5)
+    got = run_jax(
+        opt.fused_adam(1e-2, weight_decay=wd, adam_w_mode=True), p0, gs
+    )
+    ref = run_torch(torch.optim.AdamW, p0, gs, lr=1e-2, weight_decay=wd)
+    for a, r in zip(got, ref):
+        np.testing.assert_allclose(a, r, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "momentum,nesterov,wd,dampening",
+    [(0.0, False, 0.0, 0.0), (0.9, False, 0.0, 0.0), (0.9, True, 0.01, 0.0),
+     (0.9, False, 0.1, 0.1)],
+)
+def test_sgd_vs_torch(momentum, nesterov, wd, dampening):
+    p0, gs = make_params(), grad_seq(6)
+    got = run_jax(
+        opt.fused_sgd(
+            1e-2,
+            momentum=momentum,
+            nesterov=nesterov,
+            weight_decay=wd,
+            dampening=dampening,
+        ),
+        p0,
+        gs,
+    )
+    ref = run_torch(
+        torch.optim.SGD,
+        p0,
+        gs,
+        lr=1e-2,
+        momentum=momentum,
+        nesterov=nesterov,
+        weight_decay=wd,
+        dampening=dampening,
+    )
+    for a, r in zip(got, ref):
+        np.testing.assert_allclose(a, r, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_adagrad_vs_torch(wd):
+    p0, gs = make_params(), grad_seq(5)
+    got = run_jax(opt.fused_adagrad(1e-2, weight_decay=wd), p0, gs)
+    ref = run_torch(torch.optim.Adagrad, p0, gs, lr=1e-2, weight_decay=wd)
+    for a, r in zip(got, ref):
+        np.testing.assert_allclose(a, r, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# LAMB vs pure-numpy reference (≙ test_lamb.py's RefLAMB)
+# ---------------------------------------------------------------------------
+
+
+def ref_lamb_steps(
+    params,
+    grads_seq,
+    lr,
+    betas=(0.9, 0.999),
+    eps=1e-6,
+    wd=0.01,
+    max_grad_norm=1.0,
+    use_nvlamb=False,
+    grad_averaging=True,
+    bias_correction=True,
+):
+    b1, b2 = betas
+    params = [p.copy().astype(np.float64) for p in params]
+    m = [np.zeros_like(p) for p in params]
+    v = [np.zeros_like(p) for p in params]
+    beta3 = (1 - b1) if grad_averaging else 1.0
+    for t, grads in enumerate(grads_seq, start=1):
+        gnorm = np.sqrt(sum(np.sum(np.square(g.astype(np.float64))) for g in grads))
+        clip = gnorm / max_grad_norm if (max_grad_norm > 0 and gnorm > max_grad_norm) else 1.0
+        bc1 = 1 - b1**t if bias_correction else 1.0
+        bc2 = 1 - b2**t if bias_correction else 1.0
+        for i, g in enumerate(grads):
+            gf = g.astype(np.float64) / clip
+            m[i] = b1 * m[i] + beta3 * gf
+            v[i] = b2 * v[i] + (1 - b2) * gf * gf
+            u = (m[i] / bc1) / (np.sqrt(v[i] / bc2) + eps)
+            if wd != 0:
+                u = u + wd * params[i]
+            wn = np.sqrt(np.sum(params[i] ** 2))
+            un = np.sqrt(np.sum(u**2))
+            ratio = wn / un if (wn > 0 and un > 0) else 1.0
+            if not use_nvlamb and wd == 0:
+                ratio = 1.0
+            params[i] = params[i] - lr * ratio * u
+    return [p.astype(np.float32) for p in params]
+
+
+@pytest.mark.parametrize("wd,use_nvlamb", [(0.01, False), (0.0, False), (0.0, True)])
+def test_lamb_vs_numpy_reference(wd, use_nvlamb):
+    p0, gs = make_params(), grad_seq(5)
+    got = run_jax(
+        opt.fused_lamb(1e-2, weight_decay=wd, use_nvlamb=use_nvlamb), p0, gs
+    )
+    ref = ref_lamb_steps(p0, gs, 1e-2, wd=wd, use_nvlamb=use_nvlamb)
+    for a, r in zip(got, ref):
+        np.testing.assert_allclose(a, r, rtol=1e-4, atol=1e-5)
+
+
+def test_lamb_grad_clipping_engages():
+    p0 = make_params()
+    big = [[g * 100 for g in gs] for gs in grad_seq(2)]
+    got = run_jax(opt.fused_lamb(1e-2), p0, big)
+    ref = ref_lamb_steps(p0, big, 1e-2)
+    for a, r in zip(got, ref):
+        np.testing.assert_allclose(a, r, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# NovoGrad: formula check (first-step v init) + convergence
+# ---------------------------------------------------------------------------
+
+
+def test_novograd_first_step_matches_formula():
+    p0 = [np.ones((4,), np.float32)]
+    g0 = [np.full((4,), 2.0, np.float32)]
+    tx = opt.fused_novograd(0.1, weight_decay=0.0, grad_averaging=False)
+    state = tx.init([jnp.asarray(p) for p in p0])
+    updates, state = tx.update(
+        [jnp.asarray(g) for g in g0], state, [jnp.asarray(p) for p in p0]
+    )
+    # v_1 = ||g||^2 = 16; m_1 = g/(sqrt(16)+eps) = 0.5; p -= 0.1*0.5
+    np.testing.assert_allclose(np.asarray(updates[0]), -0.05, rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "factory,steps",
+    [
+        (lambda: opt.fused_adam(0.05), 60),
+        # LAMB/NovoGrad take (near-)unit-norm steps regardless of grad
+        # magnitude, so they need more iterations on a quadratic bowl.
+        (lambda: opt.fused_lamb(0.1, weight_decay=0.01), 400),
+        (lambda: opt.fused_sgd(0.05, momentum=0.9), 60),
+        (lambda: opt.fused_novograd(0.05, beta1=0.9, beta2=0.99), 400),
+        (lambda: opt.fused_adagrad(0.5), 60),
+    ],
+    ids=["adam", "lamb", "sgd", "novograd", "adagrad"],
+)
+def test_quadratic_convergence(factory, steps):
+    tx = factory()
+    target = jnp.asarray(np.random.RandomState(0).randn(16).astype(np.float32))
+    params = {"w": jnp.zeros(16)}
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.sum((p["w"] - target) ** 2)
+        )(params)
+        updates, state = tx.update(grads, state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, state, loss
+
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < 0.1 * losses[0]
+
+
+# ---------------------------------------------------------------------------
+# LARC / clip_grad / multi_tensor
+# ---------------------------------------------------------------------------
+
+
+def test_larc_scales_gradients():
+    lr, tc = 0.1, 0.02
+    p = [jnp.full((10,), 2.0)]
+    g = [jnp.full((10,), 1.0)]
+    tx = opt.larc(learning_rate=lr, trust_coefficient=tc, clip=False)
+    state = tx.init(p)
+    scaled, _ = tx.update(g, state, p)
+    p_norm = np.sqrt(10 * 4.0)
+    g_norm = np.sqrt(10.0)
+    expect = tc * p_norm / (g_norm + 1e-8)
+    np.testing.assert_allclose(np.asarray(scaled[0]), expect, rtol=1e-5)
+
+    # clip mode caps the multiplier at local_lr/lr but never amplifies past 1
+    tx2 = opt.larc(learning_rate=lr, trust_coefficient=tc, clip=True)
+    scaled2, _ = tx2.update(g, tx2.init(p), p)
+    expect2 = min(expect / lr, 1.0)
+    np.testing.assert_allclose(np.asarray(scaled2[0]), expect2, rtol=1e-5)
+
+
+def test_larc_zero_param_passthrough():
+    p = [jnp.zeros((5,))]
+    g = [jnp.ones((5,))]
+    tx = opt.larc(learning_rate=0.1)
+    scaled, _ = tx.update(g, tx.init(p), p)
+    np.testing.assert_allclose(np.asarray(scaled[0]), 1.0)
+
+
+def test_clip_grad_norm():
+    g = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+    total = float(np.sqrt(3 * 16 + 4 * 9))
+    clipped, norm = opt.clip_grad_norm(g, max_norm=1.0)
+    np.testing.assert_allclose(float(norm), total, rtol=1e-5)
+    cn = opt.global_norm(clipped)
+    np.testing.assert_allclose(float(cn), 1.0, rtol=1e-4)
+    # under the limit: untouched
+    same, _ = opt.clip_grad_norm(g, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), 4.0, rtol=1e-5)
+
+
+def test_scale_with_overflow_check():
+    ok = {"a": jnp.ones((4,)), "b": jnp.full((2,), 2.0)}
+    scaled, flag = opt.scale_with_overflow_check(ok, 0.5)
+    assert float(flag) == 0.0
+    np.testing.assert_allclose(np.asarray(scaled["a"]), 0.5)
+    bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.ones((2,))}
+    _, flag = opt.scale_with_overflow_check(bad, 0.5)
+    assert float(flag) == 1.0
+    nan = {"a": jnp.array([1.0, jnp.nan]), "b": jnp.ones((2,))}
+    _, flag = opt.scale_with_overflow_check(nan, 0.5)
+    assert float(flag) == 1.0
+
+
+def test_per_tensor_norm():
+    t = {"x": jnp.full((4,), 2.0), "y": jnp.full((9,), 1.0)}
+    norms = opt.per_tensor_norm(t)
+    np.testing.assert_allclose(float(norms["x"]), 4.0, rtol=1e-6)
+    np.testing.assert_allclose(float(norms["y"]), 3.0, rtol=1e-6)
+
+
+def test_schedule_is_zero_based():
+    # first update must see lr(0), matching optax's schedule convention
+    seen = []
+
+    def sched(count):
+        seen.append(1)
+        return jnp.where(count == 0, 1.0, 0.0)
+
+    tx = opt.fused_sgd(learning_rate=sched)
+    p = [jnp.zeros((2,))]
+    g = [jnp.ones((2,))]
+    state = tx.init(p)
+    updates, state = tx.update(g, state, p)
+    np.testing.assert_allclose(np.asarray(updates[0]), -1.0)  # lr(0) == 1
+    updates, state = tx.update(g, state, p)
+    np.testing.assert_allclose(np.asarray(updates[0]), 0.0)  # lr(1) == 0
+
+
+def test_sgd_updates_carry_param_dtype():
+    # bf16 grads must not truncate fp32 master-weight updates
+    p = [jnp.ones((4,), jnp.float32)]
+    g = [jnp.full((4,), 1e-3, jnp.bfloat16)]
+    tx = opt.fused_sgd(1e-3, momentum=0.9)
+    updates, _ = tx.update(g, tx.init(p), p)
+    assert updates[0].dtype == jnp.float32
+
+
+def test_larc_zero_grad_passthrough_with_wd():
+    # frozen param (zero grad) must not receive a weight-decay pseudo-grad
+    p = [jnp.full((5,), 2.0)]
+    g = [jnp.zeros((5,))]
+    tx = opt.larc(learning_rate=0.1, weight_decay=0.01)
+    scaled, _ = tx.update(g, tx.init(p), p)
+    np.testing.assert_allclose(np.asarray(scaled[0]), 0.0)
+
+
+def test_class_wrappers():
+    params = [jnp.ones((8,))]
+    grads = [jnp.full((8,), 0.5)]
+    for cls in (opt.FusedAdam, opt.FusedLAMB, opt.FusedSGD, opt.FusedNovoGrad,
+                opt.FusedAdagrad):
+        o = cls(params, lr=0.01)
+        new_params = o.step(grads, params)
+        assert not np.allclose(np.asarray(new_params[0]), np.asarray(params[0]))
+        # second step uses advanced state
+        newer = o.step(grads, new_params)
+        assert int(o.state.count) == 2
+        assert newer[0].shape == (8,)
