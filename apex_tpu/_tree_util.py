@@ -1,0 +1,32 @@
+"""Shared pytree dtype-casting helpers used by amp, fp16_utils, parallel."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cast_floats", "to_f32", "cast_like"]
+
+
+def cast_floats(tree, dtype):
+    """Cast floating-point leaves to ``dtype``; other leaves untouched."""
+
+    def f(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(f, tree)
+
+
+def to_f32(tree):
+    """fp32 copies (master weights / master grads)."""
+    return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), tree)
+
+
+def cast_like(ref_tree, tree):
+    """Cast each leaf of ``tree`` to the dtype of the matching ``ref_tree``
+    leaf (master→model copy)."""
+    return jax.tree_util.tree_map(
+        lambda r, x: x.astype(r.dtype), ref_tree, tree
+    )

@@ -1,0 +1,15 @@
+"""Mixed precision — ≙ apex/amp (policies, loss scaling, master weights)."""
+
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpHandle,
+    AmpState,
+    initialize,
+    scale_loss,
+)
+from apex_tpu.amp.policy import Policy, Properties, opt_levels  # noqa: F401
+from apex_tpu.amp.scaler import (  # noqa: F401
+    DynamicLossScaler,
+    LossScaleState,
+    StaticLossScaler,
+    amp_update,
+)

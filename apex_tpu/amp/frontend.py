@@ -1,0 +1,165 @@
+"""amp.initialize-shaped frontend.
+
+≙ ``apex/amp/frontend.py`` :: ``initialize`` + ``apex/amp/handle.py`` ::
+``scale_loss`` / ``AmpHandle`` + ``state_dict`` plumbing
+(``apex/amp/_amp_state.py``).
+
+The reference mutates a torch model/optimizer in place; the JAX version is
+functional: ``initialize`` resolves an opt level to a :class:`Properties`,
+casts the params per ``cast_model_type``, and returns an :class:`AmpHandle`
+bundling the policy, the loss scaler, (optionally) fp32 master params, and a
+fused ``step`` that reproduces the patched-optimizer semantics (unscale →
+overflow check → apply-or-skip → scale update) in one jittable call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu._tree_util import cast_like, to_f32
+from apex_tpu.amp.policy import Policy, Properties, opt_levels
+from apex_tpu.amp.scaler import (
+    DynamicLossScaler,
+    LossScaleState,
+    StaticLossScaler,
+    amp_update,
+)
+
+__all__ = ["initialize", "AmpHandle", "AmpState", "scale_loss"]
+
+
+class AmpState(NamedTuple):
+    """Threaded training state: opt state + scaler state (+ f32 masters)."""
+
+    opt_state: Any
+    scaler_state: LossScaleState
+    master_params: Optional[Any]  # fp32 copies when properties.master_weights
+
+
+class AmpHandle:
+    def __init__(self, properties: Properties, tx: optax.GradientTransformation):
+        self.properties = properties
+        self.policy: Policy = properties.policy()
+        ls = properties.loss_scale
+        if ls == "dynamic":
+            self.scaler = DynamicLossScaler()
+        else:
+            self.scaler = StaticLossScaler(float(ls))
+        self.tx = tx
+
+    # -- state -----------------------------------------------------------
+    def init(self, params) -> AmpState:
+        master = None
+        if self.properties.master_weights:
+            master = to_f32(params)
+        opt_params = master if master is not None else params
+        return AmpState(
+            opt_state=self.tx.init(opt_params),
+            scaler_state=self.scaler.init(),
+            master_params=master,
+        )
+
+    # -- loss scaling ----------------------------------------------------
+    def scale_loss(self, loss, state: AmpState):
+        """≙ the `with amp.scale_loss(loss, opt) as scaled:` entry."""
+        return self.scaler.scale(loss, state.scaler_state)
+
+    # -- the patched optimizer.step --------------------------------------
+    def step(self, params, scaled_grads, state: AmpState):
+        """Returns (new_params, new_state, found_inf).
+
+        With master weights (O2): the fp32 masters take the update; model
+        params are re-cast from the masters (≙ master→model copy in
+        ``_process_optimizer``).  Without: params update in their own dtype.
+        """
+        if state.master_params is not None:
+            new_master, new_opt, new_scaler, found_inf = amp_update(
+                self.tx,
+                self.scaler,
+                scaled_grads,
+                state.opt_state,
+                state.master_params,
+                state.scaler_state,
+            )
+            new_params = cast_like(params, new_master)
+            return (
+                new_params,
+                AmpState(new_opt, new_scaler, new_master),
+                found_inf,
+            )
+        new_params, new_opt, new_scaler, found_inf = amp_update(
+            self.tx,
+            self.scaler,
+            scaled_grads,
+            state.opt_state,
+            params,
+            state.scaler_state,
+        )
+        return new_params, AmpState(new_opt, new_scaler, None), found_inf
+
+    # -- persistence (≙ amp.state_dict / load_state_dict) ----------------
+    def state_dict(self, state: AmpState) -> dict:
+        return {
+            "loss_scale": state.scaler_state.loss_scale,
+            "growth_tracker": state.scaler_state.growth_tracker,
+            "hysteresis": state.scaler_state.hysteresis,
+        }
+
+    def load_state_dict(self, state: AmpState, sd: dict) -> AmpState:
+        return state._replace(
+            scaler_state=LossScaleState(
+                loss_scale=jnp.asarray(sd["loss_scale"], jnp.float32),
+                growth_tracker=jnp.asarray(sd["growth_tracker"], jnp.int32),
+                hysteresis=jnp.asarray(sd["hysteresis"], jnp.int32),
+            )
+        )
+
+
+def initialize(
+    params,
+    tx: optax.GradientTransformation,
+    opt_level: str = "O1",
+    half_dtype=jnp.bfloat16,
+    cast_model_type=None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale: Union[float, str, None] = None,
+):
+    """≙ amp.initialize(model, optimizer, opt_level=..., **overrides).
+
+    Returns ``(cast_params, handle)``; per-kwarg overrides refine the opt
+    level exactly as the reference's ``initialize`` kwargs override its
+    ``opt_levels`` table.
+    """
+    levels = opt_levels(half_dtype)
+    if opt_level not in levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r} "
+            "(options are 'O0', 'O1', 'O2', 'O3')"
+        )
+    props = levels[opt_level]
+    overrides = {}
+    if cast_model_type is not None:
+        overrides["cast_model_type"] = cast_model_type
+    if keep_batchnorm_fp32 is not None:
+        overrides["keep_batchnorm_fp32"] = keep_batchnorm_fp32
+    if master_weights is not None:
+        overrides["master_weights"] = master_weights
+    if loss_scale is not None:
+        overrides["loss_scale"] = loss_scale
+    if overrides:
+        import dataclasses
+
+        props = dataclasses.replace(props, **overrides)
+    handle = AmpHandle(props, tx)
+    cast_params = handle.policy.cast_to_param(params)
+    return cast_params, handle
+
+
+def scale_loss(loss, handle: AmpHandle, state: AmpState):
+    """Free-function parity alias for ``amp.scale_loss``."""
+    return handle.scale_loss(loss, state)

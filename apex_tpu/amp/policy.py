@@ -1,0 +1,111 @@
+"""Precision policies — the O0–O3 opt-level table.
+
+≙ ``apex/amp/frontend.py`` :: ``opt_levels`` dict + ``Properties``.  The
+reference's per-op torch monkey-patching (O1) has no JAX analog — and needs
+none: under XLA the policy is applied *structurally*: parameters live in
+``param_dtype``, the model casts inputs/params to ``compute_dtype`` at entry
+(one ``policy.cast_to_compute`` call), and XLA keeps GEMMs in bf16 on the MXU
+while accumulating in f32.  ``keep_batchnorm_fp32`` maps to normalization
+layers computing statistics in f32 — which every op in
+:mod:`apex_tpu.ops` already does unconditionally.
+
+On TPU the native half dtype is **bfloat16**: its f32-range exponent makes
+loss scaling unnecessary, so O1/O2 default to ``loss_scale=1.0`` with bf16.
+``float16`` remains selectable (``half_dtype=jnp.float16``) together with the
+dynamic scaler for numerical-parity testing of the reference's fp16
+semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+
+from apex_tpu._tree_util import cast_floats
+
+__all__ = ["Properties", "opt_levels", "Policy"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """jmp-style dtype triple; the mechanical core of an opt level."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def cast_to_param(self, tree):
+        return cast_floats(tree, self.param_dtype)
+
+    def cast_to_compute(self, tree):
+        return cast_floats(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree):
+        return cast_floats(tree, self.output_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Properties:
+    """≙ apex/amp/frontend.py :: Properties (resolved opt-level config)."""
+
+    opt_level: str
+    cast_model_type: Optional[Any]  # O2/O3: params stored in half
+    compute_dtype: Any  # O1+: math in half (patch_torch_functions analog)
+    keep_batchnorm_fp32: bool
+    master_weights: bool
+    loss_scale: Union[float, str]  # number or "dynamic"
+
+    def policy(self) -> Policy:
+        param_dtype = self.cast_model_type or jnp.float32
+        return Policy(
+            param_dtype=param_dtype,
+            compute_dtype=self.compute_dtype,
+            output_dtype=jnp.float32,
+        )
+
+
+def opt_levels(half_dtype=jnp.bfloat16) -> dict:
+    """The O0–O3 table, parameterized by the half dtype.
+
+    With bf16 (TPU default) the dynamic-loss-scale defaults collapse to 1.0;
+    with fp16 they reproduce the reference's ("dynamic" for O1/O2, 1.0 for
+    O3).
+    """
+    fp16 = half_dtype == jnp.float16
+    dyn = "dynamic" if fp16 else 1.0
+    return {
+        "O0": Properties(
+            opt_level="O0",
+            cast_model_type=None,
+            compute_dtype=jnp.float32,
+            keep_batchnorm_fp32=False,
+            master_weights=False,
+            loss_scale=1.0,
+        ),
+        "O1": Properties(
+            opt_level="O1",
+            cast_model_type=None,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=True,
+            master_weights=False,
+            loss_scale=dyn,
+        ),
+        "O2": Properties(
+            opt_level="O2",
+            cast_model_type=half_dtype,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=True,
+            master_weights=True,
+            loss_scale=dyn,
+        ),
+        "O3": Properties(
+            opt_level="O3",
+            cast_model_type=half_dtype,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=False,
+            master_weights=False,
+            loss_scale=1.0,
+        ),
+    }

@@ -1,0 +1,152 @@
+"""Loss scalers — static and dynamic with hysteresis.
+
+≙ ``apex/amp/scaler.py`` :: ``LossScaler`` +
+``apex/fp16_utils/loss_scaler.py`` :: ``DynamicLossScaler`` + the device-side
+``csrc/update_scale_hysteresis.cu`` :: ``update_scale_hysteresis_cuda``.
+
+Everything is functional and jit-safe: the scaler owns no Python state; its
+state is a small pytree threaded through the step.  Overflow detection rides
+the fused scale pass (:func:`apex_tpu.optimizers.scale_with_overflow_check`,
+the ``noop_flag`` convention of ``multi_tensor_scale_kernel.cu``), and the
+conditional step-skip is a ``where``-select over the param/opt-state trees —
+no host sync, matching the reference's device-side ``noop`` design.
+
+Update rule (hysteresis semantics of ``update_scale_hysteresis.cu``):
+- overflow: ``hysteresis -= 1``; once exhausted, ``scale *= backoff_factor``
+  and the growth counter resets;
+- clean step: ``growth_tracker += 1``; at ``growth_interval`` consecutive
+  clean steps, ``scale *= growth_factor``, trackers reset, hysteresis
+  restored.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.multi_tensor import scale_with_overflow_check
+
+__all__ = ["LossScaleState", "DynamicLossScaler", "StaticLossScaler", "amp_update"]
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jax.Array  # f32 scalar
+    growth_tracker: jax.Array  # i32: consecutive clean steps
+    hysteresis: jax.Array  # i32: tolerated overflows before backoff
+
+
+class DynamicLossScaler:
+    """≙ LossScaler(loss_scale="dynamic") — 2**16 start, x2/2000, /2."""
+
+    def __init__(
+        self,
+        init_scale: float = 2.0**16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+        hysteresis: int = 1,
+        min_loss_scale: float = 1.0,
+        max_loss_scale: float = 2.0**24,
+    ):
+        self.init_scale = init_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.hysteresis = hysteresis
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            growth_tracker=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.hysteresis, jnp.int32),
+        )
+
+    def scale(self, loss, state: LossScaleState):
+        """≙ scale_loss ctx-mgr entry (apex/amp/handle.py :: scale_loss)."""
+        return loss * state.loss_scale.astype(loss.dtype)
+
+    def unscale(self, grads, state: LossScaleState) -> Tuple[Any, jax.Array]:
+        """Fused (1/scale)·grads + found_inf flag; grads emerge in f32.
+
+        Overflow detection and the divide run in f32 regardless of grad
+        dtype (the reference kernel reads fp16 grads but computes in f32).
+        """
+        return scale_with_overflow_check(
+            grads, 1.0 / state.loss_scale, out_dtype=jnp.float32
+        )
+
+    def update(self, state: LossScaleState, found_inf) -> LossScaleState:
+        """≙ update_scale_hysteresis_cuda (device-side, no host sync)."""
+        overflow = found_inf > 0.0
+        new_hyst = jnp.where(
+            overflow, jnp.maximum(state.hysteresis - 1, 0), state.hysteresis
+        )
+        do_backoff = overflow & (new_hyst <= 0)
+        backed_off = jnp.clip(
+            state.loss_scale * self.backoff_factor,
+            self.min_loss_scale,
+            self.max_loss_scale,
+        )
+        tracker = jnp.where(overflow, 0, state.growth_tracker + 1)
+        do_growth = jnp.logical_not(overflow) & (tracker >= self.growth_interval)
+        grown = jnp.clip(
+            state.loss_scale * self.growth_factor,
+            self.min_loss_scale,
+            self.max_loss_scale,
+        )
+        new_scale = jnp.where(
+            do_backoff, backed_off, jnp.where(do_growth, grown, state.loss_scale)
+        )
+        tracker = jnp.where(do_growth, 0, tracker)
+        # hysteresis restored after a successful backoff or growth
+        new_hyst = jnp.where(
+            do_backoff | do_growth, jnp.asarray(self.hysteresis, jnp.int32), new_hyst
+        )
+        return LossScaleState(
+            loss_scale=new_scale, growth_tracker=tracker, hysteresis=new_hyst
+        )
+
+
+class StaticLossScaler(DynamicLossScaler):
+    """≙ LossScaler(loss_scale=<const>) — fixed scale, still flags overflow."""
+
+    def __init__(self, loss_scale: float = 1.0):
+        super().__init__(init_scale=loss_scale)
+
+    def update(self, state: LossScaleState, found_inf) -> LossScaleState:
+        return state
+
+
+def amp_update(tx, scaler, scaled_grads, opt_state, params, scaler_state):
+    """One fused mixed-precision optimizer step with overflow skip.
+
+    ≙ the patched ``optimizer.step`` from
+    ``apex/amp/_process_optimizer.py`` :: ``_process_optimizer``: unscale,
+    check overflow, apply-or-skip, adjust the scale.  Returns
+    ``(new_params, new_opt_state, new_scaler_state, found_inf)``; on
+    overflow params and opt state are returned untouched (step skipped)
+    and only the scaler state moves — all branch-free on device.
+    """
+    grads, found_inf = scaler.unscale(scaled_grads, scaler_state)
+    # Re-align grad dtypes with the params so a generic optax tx whose state
+    # dtype follows its inputs (e.g. optax.adam over bf16 params) returns
+    # state of the same dtype it was initialized with — otherwise lax.scan
+    # carries mismatch.  The fused_* optimizers accumulate in f32 internally
+    # either way.
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, params
+    )
+    updates, new_opt_state = tx.update(grads, opt_state, params)
+    def sel(new, old):
+        return jnp.where(found_inf == 0.0, new, old)
+
+    new_params = jax.tree_util.tree_map(
+        lambda p, u: sel(p + u.astype(p.dtype), p), params, updates
+    )
+    new_opt_state = jax.tree_util.tree_map(sel, new_opt_state, opt_state)
+    new_scaler_state = scaler.update(scaler_state, found_inf)
+    return new_params, new_opt_state, new_scaler_state, found_inf

@@ -1,0 +1,125 @@
+"""Manual half-precision helpers — ≙ apex/fp16_utils.
+
+``apex/fp16_utils/fp16util.py`` :: ``network_to_half``, ``BN_convert_float``,
+``prep_param_lists``, ``master_params_to_model_params``,
+``model_grads_to_master_grads``, ``tofp16`` and
+``apex/fp16_utils/fp16_optimizer.py`` :: ``FP16_Optimizer`` (the pre-amp
+manual API).  Functional pytree equivalents; ``FP16_Optimizer`` wraps an
+optax transformation with master weights + a loss scaler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu._tree_util import cast_floats, cast_like, to_f32
+from apex_tpu.amp.scaler import DynamicLossScaler, StaticLossScaler, amp_update
+
+__all__ = [
+    "tofp16",
+    "network_to_half",
+    "prep_param_lists",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "FP16_Optimizer",
+]
+
+
+def tofp16(tree, half_dtype=jnp.bfloat16):
+    """Cast floating leaves to the half dtype (≙ tofp16 module cast)."""
+    return cast_floats(tree, half_dtype)
+
+
+def network_to_half(tree, half_dtype=jnp.bfloat16):
+    """≙ network_to_half (BN params staying fp32 is the caller's layout
+    choice here — normalization ops compute statistics in f32 regardless,
+    see apex_tpu.ops)."""
+    return tofp16(tree, half_dtype)
+
+
+def prep_param_lists(params) -> Tuple[Any, Any]:
+    """≙ prep_param_lists: returns (model_params, fp32 master copies)."""
+    return params, to_f32(params)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """≙ master_params_to_model_params: cast masters into the model dtypes."""
+    return cast_like(model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """≙ model_grads_to_master_grads: grads to f32 for the master update."""
+    return to_f32(model_grads)
+
+
+class FP16_Optimizer:
+    """≙ apex/fp16_utils/fp16_optimizer.py :: FP16_Optimizer.
+
+    Wraps an optax transformation: holds fp32 masters + scaler state, and
+    ``step`` runs unscale → overflow-skip → master update → model re-cast.
+
+    >>> opt = FP16_Optimizer(fused_adam(1e-3), static_loss_scale=128.0)
+    >>> state = opt.init(bf16_params)
+    >>> params, state, overflow = opt.step(bf16_params, scaled_grads, state)
+    """
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+    ):
+        self.tx = tx
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            if static_loss_scale is None:
+                raise ValueError(
+                    "static_loss_scale must be a number; pass "
+                    "dynamic_loss_scale=True for dynamic scaling"
+                )
+            self.loss_scaler = StaticLossScaler(float(static_loss_scale))
+
+    def init(self, model_params):
+        _, master = prep_param_lists(model_params)
+        return {
+            "master": master,
+            "opt": self.tx.init(master),
+            "scaler": self.loss_scaler.init(),
+        }
+
+    def scale_loss(self, loss, state):
+        return self.loss_scaler.scale(loss, state["scaler"])
+
+    def loss_scale(self, state):
+        """Current numeric loss scale (≙ the reference's ``loss_scale``
+        property; functional, so it reads the threaded state)."""
+        return state["scaler"].loss_scale
+
+    def step(self, model_params, scaled_grads, state):
+        master, new_opt, new_scaler, found_inf = amp_update(
+            self.tx,
+            self.loss_scaler,
+            scaled_grads,
+            state["opt"],
+            state["master"],
+            state["scaler"],
+        )
+        new_model = master_params_to_model_params(model_params, master)
+        return (
+            new_model,
+            {"master": master, "opt": new_opt, "scaler": new_scaler},
+            found_inf,
+        )
+
+    # ≙ FP16_Optimizer.state_dict / load_state_dict
+    def state_dict(self, state):
+        return state
+
+    def load_state_dict(self, _state, sd):
+        return sd

@@ -1,0 +1,206 @@
+"""≙ tests/L0/run_amp — opt-level matrix, loss scaling, overflow skip,
+checkpointing (state_dict round trip), master weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, fp16_utils
+from apex_tpu.optimizers import fused_adam, fused_sgd
+
+
+def toy_params():
+    return {
+        "w": jnp.asarray(np.random.RandomState(0).randn(8, 4), jnp.float32),
+        "b": jnp.zeros((4,), jnp.float32),
+    }
+
+
+def test_opt_levels_table():
+    levels = amp.opt_levels(jnp.float16)
+    assert levels["O0"].cast_model_type is None
+    assert levels["O0"].loss_scale == 1.0
+    assert levels["O1"].compute_dtype == jnp.float16
+    assert levels["O1"].loss_scale == "dynamic"
+    assert levels["O2"].master_weights
+    assert levels["O2"].cast_model_type == jnp.float16
+    assert levels["O3"].loss_scale == 1.0
+    # bf16 (TPU default): no dynamic scaling needed
+    bf = amp.opt_levels(jnp.bfloat16)
+    assert bf["O1"].loss_scale == 1.0
+    assert bf["O2"].cast_model_type == jnp.bfloat16
+
+
+def test_policy_casting():
+    p = amp.Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    tree = {"w": jnp.ones((2,), jnp.float32), "step": jnp.zeros((), jnp.int32)}
+    c = p.cast_to_compute(tree)
+    assert c["w"].dtype == jnp.bfloat16
+    assert c["step"].dtype == jnp.int32  # non-floats untouched
+
+
+def test_initialize_rejects_bad_level():
+    with pytest.raises(ValueError):
+        amp.initialize(toy_params(), fused_adam(1e-3), opt_level="O4")
+
+
+def test_dynamic_scaler_growth_and_backoff():
+    s = amp.DynamicLossScaler(
+        init_scale=1024.0, growth_interval=3, hysteresis=2
+    )
+    st = s.init()
+    one = jnp.zeros(())
+    inf = jnp.ones(())
+    # two clean steps: no growth yet
+    st = s.update(st, one)
+    st = s.update(st, one)
+    assert float(st.loss_scale) == 1024.0
+    # third clean step: growth fires
+    st = s.update(st, one)
+    assert float(st.loss_scale) == 2048.0
+    assert int(st.growth_tracker) == 0
+    # first overflow: hysteresis absorbs it, scale unchanged
+    st = s.update(st, inf)
+    assert float(st.loss_scale) == 2048.0
+    assert int(st.hysteresis) == 1
+    # second overflow: backoff fires, hysteresis restored
+    st = s.update(st, inf)
+    assert float(st.loss_scale) == 1024.0
+    assert int(st.hysteresis) == 2
+
+
+def test_scaler_min_max_clamps():
+    s = amp.DynamicLossScaler(
+        init_scale=2.0, hysteresis=1, min_loss_scale=1.0, growth_interval=1,
+        max_loss_scale=4.0,
+    )
+    st = s.init()
+    st = s.update(st, jnp.ones(()))  # 2 -> 1
+    st = s.update(st, jnp.ones(()))  # clamped at 1
+    assert float(st.loss_scale) == 1.0
+    st = s.update(st, jnp.zeros(()))  # 1 -> 2
+    st = s.update(st, jnp.zeros(()))  # 2 -> 4
+    st = s.update(st, jnp.zeros(()))  # clamped at 4
+    assert float(st.loss_scale) == 4.0
+
+
+def test_amp_update_skips_step_on_overflow():
+    tx = fused_sgd(0.1)
+    params = {"w": jnp.ones((4,))}
+    scaler = amp.DynamicLossScaler(init_scale=4.0, hysteresis=1)
+    sstate = scaler.init()
+    ostate = tx.init(params)
+    bad_grads = {"w": jnp.array([1.0, jnp.inf, 1.0, 1.0])}
+
+    new_params, new_ostate, new_sstate, found_inf = jax.jit(
+        lambda g, o, p, s: amp.amp_update(tx, scaler, g, o, p, s)
+    )(bad_grads, ostate, params, sstate)
+    assert float(found_inf) == 1.0
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 1.0)  # untouched
+    assert int(new_ostate.count) == int(ostate.count)  # opt state frozen
+    assert float(new_sstate.loss_scale) == 2.0  # backed off
+
+    good_grads = {"w": jnp.full((4,), 4.0)}  # scaled grads; unscale -> 1.0
+    new_params, new_ostate, _, found_inf = amp.amp_update(
+        tx, scaler, good_grads, ostate, params, sstate
+    )
+    assert float(found_inf) == 0.0
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 1.0 - 0.1)
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+def test_opt_level_end_to_end(opt_level):
+    """≙ L1 cross-product harness (minimal): all levels descend the loss."""
+    params0 = toy_params()
+    tx = fused_adam(5e-2)
+    params, handle = amp.initialize(
+        params0, tx, opt_level=opt_level, half_dtype=jnp.bfloat16
+    )
+    state = handle.init(params)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(2).randn(16, 4), jnp.float32)
+
+    def loss_fn(p):
+        cp = handle.policy.cast_to_compute(p)
+        cx = handle.policy.cast_to_compute(x)
+        pred = cx @ cp["w"] + cp["b"]
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        scaled = jax.tree_util.tree_map(
+            lambda g: handle.scale_loss(g, state), grads
+        )
+        params, state, _ = handle.step(params, scaled, state)
+        return params, state, loss
+
+    losses = []
+    for _ in range(40):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0]
+    if opt_level in ("O2", "O3"):
+        assert params["w"].dtype == jnp.bfloat16
+    if opt_level == "O2":
+        assert state.master_params["w"].dtype == jnp.float32
+
+
+def test_state_dict_roundtrip():
+    params, handle = amp.initialize(
+        toy_params(), fused_adam(1e-3), opt_level="O2", half_dtype=jnp.float16
+    )
+    state = handle.init(params)
+    sd = handle.state_dict(state)
+    assert float(sd["loss_scale"]) == 2.0**16
+    state2 = handle.load_state_dict(state, {"loss_scale": 42.0,
+                                            "growth_tracker": 7,
+                                            "hysteresis": 1})
+    assert float(state2.scaler_state.loss_scale) == 42.0
+    assert int(state2.scaler_state.growth_tracker) == 7
+
+
+def test_fp16_optimizer_end_to_end():
+    params = fp16_utils.network_to_half(toy_params())
+    assert params["w"].dtype == jnp.bfloat16
+    opt = fp16_utils.FP16_Optimizer(
+        fused_adam(5e-2), dynamic_loss_scale=True,
+        dynamic_loss_args=dict(init_scale=8.0),
+    )
+    state = opt.init(params)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.bfloat16)
+    y = jnp.asarray(np.random.RandomState(2).randn(16, 4), jnp.float32)
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            pred = (x @ p["w"] + p["b"]).astype(jnp.float32)
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        scaled = jax.tree_util.tree_map(
+            lambda g: opt.scale_loss(g, state), grads
+        )
+        params, state, overflow = opt.step(params, scaled, state)
+        return params, state, loss
+
+    losses = []
+    for _ in range(50):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0]
+    assert state["master"]["w"].dtype == jnp.float32
+    assert params["w"].dtype == jnp.bfloat16
+
+
+def test_helper_roundtrips():
+    p = toy_params()
+    model, master = fp16_utils.prep_param_lists(
+        fp16_utils.network_to_half(p)
+    )
+    assert master["w"].dtype == jnp.float32
+    back = fp16_utils.master_params_to_model_params(model, master)
+    assert back["w"].dtype == jnp.bfloat16
+    g32 = fp16_utils.model_grads_to_master_grads({"w": jnp.ones(3, jnp.bfloat16)})
+    assert g32["w"].dtype == jnp.float32
