@@ -20,13 +20,18 @@ def cast_floats(tree, dtype):
 
 
 def to_f32(tree):
-    """fp32 copies (master weights / master grads)."""
-    return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), tree)
+    """fp32 copies of floating leaves (master weights / master grads);
+    integer leaves (step counters etc.) pass through untouched."""
+    return cast_floats(tree, jnp.float32)
 
 
 def cast_like(ref_tree, tree):
-    """Cast each leaf of ``tree`` to the dtype of the matching ``ref_tree``
-    leaf (master→model copy)."""
-    return jax.tree_util.tree_map(
-        lambda r, x: x.astype(r.dtype), ref_tree, tree
-    )
+    """Cast each floating leaf of ``tree`` to the dtype of the matching
+    ``ref_tree`` leaf (master→model copy); non-float leaves untouched."""
+
+    def f(r, x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(r.dtype)
+        return x
+
+    return jax.tree_util.tree_map(f, ref_tree, tree)

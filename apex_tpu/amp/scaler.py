@@ -66,8 +66,13 @@ class DynamicLossScaler:
         )
 
     def scale(self, loss, state: LossScaleState):
-        """≙ scale_loss ctx-mgr entry (apex/amp/handle.py :: scale_loss)."""
-        return loss * state.loss_scale.astype(loss.dtype)
+        """≙ scale_loss ctx-mgr entry (apex/amp/handle.py :: scale_loss).
+
+        The multiply happens in f32: a 2**16 scale cast to fp16 would be
+        inf (fp16 max is 65504).  The scaled loss is returned in f32; its
+        gradients still arrive in each param's dtype.
+        """
+        return loss.astype(jnp.float32) * state.loss_scale
 
     def unscale(self, grads, state: LossScaleState) -> Tuple[Any, jax.Array]:
         """Fused (1/scale)·grads + found_inf flag; grads emerge in f32.
@@ -102,9 +107,13 @@ class DynamicLossScaler:
             do_backoff, backed_off, jnp.where(do_growth, grown, state.loss_scale)
         )
         tracker = jnp.where(do_growth, 0, tracker)
-        # hysteresis restored after a successful backoff or growth
+        # clean step or completed backoff: hysteresis restored to full (the
+        # reference kernel resets the tracker on every non-overflow step, so
+        # isolated rare overflows never accumulate into a backoff)
         new_hyst = jnp.where(
-            do_backoff | do_growth, jnp.asarray(self.hysteresis, jnp.int32), new_hyst
+            do_backoff | jnp.logical_not(overflow),
+            jnp.asarray(self.hysteresis, jnp.int32),
+            new_hyst,
         )
         return LossScaleState(
             loss_scale=new_scale, growth_tracker=tracker, hysteresis=new_hyst

@@ -1,0 +1,29 @@
+"""Data-parallel layer — ≙ apex/parallel.
+
+- :class:`DistributedDataParallel`, :func:`all_reduce_gradients`,
+  :class:`Reducer` (≙ apex/parallel/distributed.py);
+- :class:`SyncBatchNorm`, :func:`convert_syncbn_model`
+  (≙ optimized_sync_batchnorm*.py + csrc/syncbn);
+- :class:`LARC` (≙ apex/parallel/LARC.py — re-exported from optimizers);
+- :class:`DistributedFusedAdam` / :class:`DistributedFusedLAMB`
+  (≙ apex/contrib/optimizers ZeRO-sharded updates).
+
+``apex/parallel/multiproc.py`` (the one-node process spawner) has no
+analog: a single SPMD program drives every device, and multi-host jobs are
+launched by the cluster runtime (``jax.distributed.initialize``).
+"""
+
+from apex_tpu.optimizers.larc import LARC, larc  # noqa: F401
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    all_reduce_gradients,
+)
+from apex_tpu.parallel.distributed_fused_optimizers import (  # noqa: F401
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+)

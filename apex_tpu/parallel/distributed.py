@@ -1,0 +1,171 @@
+"""Data parallelism — ≙ apex/parallel/distributed.py.
+
+The reference's ``DistributedDataParallel`` flattens gradients into
+~``message_size`` buckets and overlaps NCCL all-reduce with backward via
+grad-accumulator hooks (SURVEY.md §3.3).  Under XLA none of that machinery
+exists or is needed: gradients of a jitted step are all-reduced with
+``psum`` over the ``dp`` mesh axis, and the XLA scheduler overlaps the
+collectives with remaining backward compute (the bucketing/ready-order
+capture is the compiler's job).  What this module keeps is the *semantics
+surface*: gradient averaging, predivide factors (for large world sizes where
+pre-division avoids overflow in half precision), a ``delay_allreduce``-style
+no-op escape, and the ``Reducer`` manual-reduction helper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+
+__all__ = ["all_reduce_gradients", "DistributedDataParallel", "Reducer"]
+
+
+def all_reduce_gradients(
+    grads: Any,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    gradient_average: bool = True,
+    gradient_predivide_factor: Optional[float] = None,
+):
+    """psum gradients over the data-parallel axis (call inside shard_map).
+
+    ≙ the flat_dist_call all-reduce + ``gradient_average`` /
+    ``gradient_predivide_factor`` handling in
+    apex/parallel/distributed.py :: DistributedDataParallel.
+    """
+    world = jax.lax.axis_size(axis_name)
+
+    def f(g):
+        gf = g
+        if gradient_predivide_factor is not None:
+            gf = gf / gradient_predivide_factor
+        gf = jax.lax.psum(gf, axis_name)
+        if gradient_average:
+            post = (
+                world / gradient_predivide_factor
+                if gradient_predivide_factor is not None
+                else world
+            )
+            gf = gf / post
+        return gf
+
+    return jax.tree_util.tree_map(f, grads)
+
+
+class DistributedDataParallel:
+    """Wraps a loss function for data-parallel training.
+
+    ≙ ``apex.parallel.DistributedDataParallel(model, message_size=...,
+    gradient_average=..., gradient_predivide_factor=...)``.  The
+    ``message_size``/``allreduce_trigger_params`` bucketing knobs have no
+    analog (XLA fuses and schedules collectives); ``delay_allreduce`` maps
+    to ``delay_allreduce=True`` → the wrapper skips the psum so the caller
+    reduces manually (e.g. once after gradient accumulation).
+
+    Usage::
+
+        ddp = DistributedDataParallel(loss_fn)
+        step = ddp.make_step(tx, mesh)           # jitted SPMD train step
+        params, opt_state, loss = step(params, opt_state, batch)
+
+    or, inside your own ``shard_map``::
+
+        loss, grads = ddp.value_and_grad(params, batch)
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        axis_name: str = ps.DATA_PARALLEL_AXIS,
+        gradient_average: bool = True,
+        gradient_predivide_factor: Optional[float] = None,
+        delay_allreduce: bool = False,
+    ):
+        self.loss_fn = loss_fn
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.delay_allreduce = delay_allreduce
+
+    def value_and_grad(self, params, *batch):
+        """Per-shard loss + dp-reduced grads; call inside shard_map.
+
+        Under jax's shard_map vma semantics, differentiating w.r.t.
+        *replicated* params already inserts the cross-shard psum in the
+        transpose (the bucketed all-reduce the reference implements by
+        hand).  The fast path therefore only divides for averaging.  The
+        ``delay_allreduce`` / predivide paths need genuinely *local* grads,
+        so params are marked varying (``pcast to='varying'``) first, which
+        suppresses the automatic psum.
+        """
+        if self.delay_allreduce or self.gradient_predivide_factor is not None:
+            params_v = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, self.axis_name, to="varying"),
+                params,
+            )
+            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, *batch)
+            if not self.delay_allreduce:
+                grads = all_reduce_gradients(
+                    grads,
+                    self.axis_name,
+                    self.gradient_average,
+                    self.gradient_predivide_factor,
+                )
+                loss = jax.lax.pmean(loss, self.axis_name)
+            return loss, grads
+        loss, grads = jax.value_and_grad(self.loss_fn)(params, *batch)
+        if self.gradient_average:
+            world = jax.lax.axis_size(self.axis_name)
+            grads = jax.tree_util.tree_map(lambda g: g / world, grads)
+            loss = jax.lax.pmean(loss, self.axis_name)
+        return loss, grads
+
+    def make_step(self, tx, mesh=None):
+        """Build a jitted SPMD train step: batch sharded over dp, params
+        replicated, grads psummed, optimizer applied identically on every
+        device."""
+        mesh = mesh or ps.get_mesh()
+
+        def _step(params, opt_state, batch):
+            loss, grads = self.value_and_grad(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates
+            )
+            return params, opt_state, loss
+
+        batch_spec = P(self.axis_name)
+        smapped = jax.shard_map(
+            _step,
+            mesh=mesh,
+            in_specs=(P(), P(), batch_spec),
+            out_specs=(P(), P(), P()),
+        )
+        return jax.jit(smapped)
+
+
+class Reducer:
+    """Manual-reduction helper — ≙ apex/parallel/distributed.py :: Reducer.
+
+    ``broadcast_params`` is a no-op under SPMD (all replicas trace the same
+    init); ``reduce`` psums a pytree on demand.
+    """
+
+    def __init__(self, axis_name: str = ps.DATA_PARALLEL_AXIS):
+        self.axis_name = axis_name
+
+    def broadcast_params(self, params):
+        return params  # replicated by construction
+
+    def reduce(self, tree, average: bool = True):
+        world = jax.lax.axis_size(self.axis_name)
+
+        def f(x):
+            s = jax.lax.psum(x, self.axis_name)
+            return s / world if average else s
+
+        return jax.tree_util.tree_map(f, tree)

@@ -1,0 +1,141 @@
+"""SyncBatchNorm — cross-replica batch normalization.
+
+≙ ``apex/parallel/optimized_sync_batchnorm.py`` (+ the device math in
+``csrc/syncbn.cpp`` / ``welford.cu``): per-replica statistics are combined
+across the data-parallel group before normalizing, so small per-device
+batches still see full-batch statistics.
+
+The CUDA path does a single-pass Welford per replica then a
+``welford_parallel`` combine of (mean, var, count) triples gathered over
+NCCL.  The TPU version computes per-replica (Σx, Σx², n) in f32 and psums
+them over the ``dp`` mesh axis — algebraically identical to the Welford
+combine for equal counts, and f32 accumulation covers the stability concern
+the two-pass trick addresses.  When no ``dp`` axis is bound (single device
+or GSPMD-only tracing), it degrades to plain BatchNorm exactly like the
+reference with ``world_size == 1``.
+
+``channel_last`` in the reference is a memory-format flag; here layouts are
+XLA's concern and the module just reduces over all non-channel axes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+
+__all__ = ["SyncBatchNorm", "convert_syncbn_model"]
+
+
+def _axis_bound(axis_name: str) -> bool:
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except (NameError, KeyError):
+        return False
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in for ``flax.linen.BatchNorm`` with dp-wide statistics.
+
+    Args mirror the reference module: ``momentum`` here is the running-stat
+    EMA decay (reference keeps torch's convention ``running = (1-m)*running
+    + m*batch``; pass ``momentum=0.1`` for identical updates),
+    ``use_running_average`` selects eval mode (≙ ``self.training`` flip).
+    """
+
+    features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    axis_name: str = ps.DATA_PARALLEL_AXIS
+    use_running_average: Optional[bool] = None
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        feat = self.features
+        if x.shape[-1] != feat:
+            raise ValueError(
+                f"SyncBatchNorm expects channels-last input with "
+                f"{feat} channels, got shape {x.shape}"
+            )
+        reduce_axes = tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((feat,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((feat,), jnp.float32)
+        )
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # per-replica partials
+            n_local = jnp.asarray(
+                xf.size // feat, jnp.float32
+            )
+            s1 = jnp.sum(xf, axis=reduce_axes)
+            s2 = jnp.sum(xf * xf, axis=reduce_axes)
+            if _axis_bound(self.axis_name):
+                # ≙ syncbn.welford_parallel combine over the DP group
+                n = jax.lax.psum(n_local, self.axis_name)
+                s1 = jax.lax.psum(s1, self.axis_name)
+                s2 = jax.lax.psum(s2, self.axis_name)
+            else:
+                n = n_local
+            mean = s1 / n
+            var = s2 / n - mean * mean
+            if not self.is_initializing():
+                m = self.momentum
+                # unbiased var for the running stat (torch/apex convention)
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
+                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased
+
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            scale = self.param(
+                "scale", nn.initializers.ones, (feat,), self.param_dtype
+            )
+            bias = self.param(
+                "bias", nn.initializers.zeros, (feat,), self.param_dtype
+            )
+            y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        return y.astype(self.dtype or x.dtype)
+
+
+def convert_syncbn_model(module: nn.Module) -> nn.Module:
+    """≙ apex/parallel/__init__.py :: convert_syncbn_model.
+
+    Flax modules are immutable definitions, so in-place conversion (the
+    torch approach: walk children, swap BatchNorm instances) cannot exist.
+    This helper instead rebuilds a module whose ``nn.BatchNorm`` fields are
+    replaced by :class:`SyncBatchNorm` when possible, and raises with
+    guidance otherwise — declare ``SyncBatchNorm`` directly in new models.
+    """
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            features=module.num_features
+            if hasattr(module, "num_features")
+            else module.__dict__.get("features"),
+            eps=module.epsilon,
+            momentum=1.0 - module.momentum,
+            affine=module.use_scale and module.use_bias,
+        )
+    raise TypeError(
+        "convert_syncbn_model can only convert a flax.linen.BatchNorm "
+        "instance; for composite models declare apex_tpu.parallel."
+        "SyncBatchNorm in the model definition instead (flax modules are "
+        "immutable)"
+    )

@@ -70,6 +70,29 @@ def test_dynamic_scaler_growth_and_backoff():
     assert int(st.hysteresis) == 2
 
 
+def test_hysteresis_restored_by_clean_step():
+    # isolated overflows separated by clean steps never trigger backoff
+    s = amp.DynamicLossScaler(init_scale=1024.0, hysteresis=2,
+                              growth_interval=1000)
+    st = s.init()
+    st = s.update(st, jnp.ones(()))  # overflow: hysteresis 2 -> 1
+    assert int(st.hysteresis) == 1
+    st = s.update(st, jnp.zeros(()))  # clean: restored to 2
+    assert int(st.hysteresis) == 2
+    st = s.update(st, jnp.ones(()))  # isolated overflow again: absorbed
+    assert float(st.loss_scale) == 1024.0
+
+
+def test_scale_multiplies_in_f32():
+    # 2**16 cast to fp16 would be inf; the multiply must happen in f32
+    s = amp.DynamicLossScaler(init_scale=2.0**16)
+    st = s.init()
+    scaled = s.scale(jnp.asarray(0.5, jnp.float16), st)
+    assert scaled.dtype == jnp.float32
+    assert np.isfinite(float(scaled))
+    np.testing.assert_allclose(float(scaled), 32768.0)
+
+
 def test_scaler_min_max_clamps():
     s = amp.DynamicLossScaler(
         init_scale=2.0, hysteresis=1, min_loss_scale=1.0, growth_interval=1,
