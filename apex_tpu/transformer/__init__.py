@@ -1,0 +1,43 @@
+"""Model-parallel layer — ≙ apex/transformer.
+
+- :mod:`apex_tpu.transformer.tensor_parallel` — TP/SP sharded layers,
+  collective mappings, vocab-parallel CE, RNG tracking, remat checkpoint;
+- :mod:`apex_tpu.transformer.pipeline_parallel` — 1F1B / interleaved
+  schedules, p2p exchange, microbatch calculator;
+- :mod:`apex_tpu.transformer.functional` — FusedScaleMaskSoftmax, RoPE;
+- :mod:`apex_tpu.transformer.amp` — model-parallel-aware GradScaler;
+- ``parallel_state`` is re-exported from the package root (the mesh
+  registry replaces process-group bookkeeping).
+"""
+
+from apex_tpu import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+    ModelType,
+)
+from apex_tpu.transformer.log_util import (  # noqa: F401
+    get_transformer_logger,
+    set_logging_level,
+)
+
+_LAZY = ("pipeline_parallel", "functional", "amp", "layers", "testing")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        try:
+            module = importlib.import_module(f"apex_tpu.transformer.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'apex_tpu.transformer' has no attribute {name!r}"
+            ) from e
+        globals()[name] = module
+        return module
+    raise AttributeError(
+        f"module 'apex_tpu.transformer' has no attribute {name!r}"
+    )

@@ -1,0 +1,3 @@
+"""≙ apex/transformer/amp — model-parallel-aware grad scaler."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler  # noqa: F401

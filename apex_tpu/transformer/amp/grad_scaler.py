@@ -1,0 +1,57 @@
+"""Grad scaler that agrees across the model-parallel group.
+
+≙ ``apex/transformer/amp/grad_scaler.py`` :: ``GradScaler`` — torch's
+scaler with ``found_inf`` all-reduced (MAX) over the tensor- and
+pipeline-parallel groups in ``_unscale_grads_``, so every model-parallel
+rank skips (or keeps) the same step even when only one shard overflowed.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.amp.scaler import DynamicLossScaler, LossScaleState
+
+__all__ = ["GradScaler"]
+
+
+class GradScaler(DynamicLossScaler):
+    """DynamicLossScaler whose overflow flag is synchronized over the
+    model-parallel axes (inside shard_map)."""
+
+    def __init__(
+        self,
+        init_scale: float = 2.0**16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+        hysteresis: int = 1,
+        model_parallel_axes: Sequence[str] = (
+            ps.TENSOR_PARALLEL_AXIS,
+            ps.PIPELINE_PARALLEL_AXIS,
+        ),
+    ):
+        super().__init__(
+            init_scale=init_scale,
+            growth_factor=growth_factor,
+            backoff_factor=backoff_factor,
+            growth_interval=growth_interval,
+            hysteresis=hysteresis,
+        )
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def _sync_found_inf(self, found_inf):
+        for ax in self.model_parallel_axes:
+            try:
+                found_inf = jax.lax.pmax(found_inf, ax)
+            except (NameError, KeyError):
+                continue  # axis not bound (e.g. single-device tests)
+        return found_inf
+
+    def unscale(self, grads, state: LossScaleState) -> Tuple[object, jax.Array]:
+        grads, found_inf = super().unscale(grads, state)
+        return grads, self._sync_found_inf(found_inf)

@@ -1,0 +1,9 @@
+"""≙ apex/transformer/functional — fused softmax + fused RoPE wrappers."""
+
+from apex_tpu.ops.rope import (  # noqa: F401
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+)
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+)

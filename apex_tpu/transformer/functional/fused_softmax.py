@@ -1,0 +1,82 @@
+"""FusedScaleMaskSoftmax — the attention-softmax front door.
+
+≙ ``apex/transformer/functional/fused_softmax.py`` ::
+``FusedScaleMaskSoftmax`` (dispatching to the
+``scaled_upper_triang_masked_softmax`` / ``scaled_masked_softmax`` /
+``scaled_softmax`` kernels with ``is_kernel_available`` heuristics).
+
+The CUDA kernels carried hard limits (fp16/bf16 only, seq ≤ 2048,
+divisibility constraints) that ``is_kernel_available`` guarded; the TPU
+ops have none, so the "kernel" path is always available and the flag
+surface (``scaled_masked_softmax_fusion``, ``softmax_in_fp32``) keeps its
+reference meaning: ``input_in_fp16/bf16`` + ``softmax_in_fp32`` controls
+whether the softmax itself runs in f32 (ours always computes the reduction
+in f32; the flag controls the *output* dtype).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.scaled_softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = ["FusedScaleMaskSoftmax"]
+
+
+class FusedScaleMaskSoftmax:
+    """Callable config object, matching the reference module's signature."""
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active")
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if self.scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """≙ the reference heuristic; TPU ops have no shape limits."""
+        return self.fusion
+
+    def __call__(self, x, mask=None):
+        scale = self.scale if self.scale is not None else 1.0
+        if self.mask_func is not None:
+            # ≙ the reference's unfused fallback: scale, apply the user's
+            # mask function (e.g. additive bias), then a plain softmax.
+            xs = x.astype(jnp.float32) * scale
+            xs = self.mask_func(xs, mask) if mask is not None else xs
+            y = jax.nn.softmax(xs, axis=-1).astype(x.dtype)
+        elif self.attn_mask_type == AttnMaskType.causal:
+            *lead, sq, sk = x.shape
+            y = scaled_upper_triang_masked_softmax(
+                x.reshape(-1, sq, sk), scale
+            ).reshape(*lead, sq, sk)
+        elif mask is not None:
+            y = scaled_masked_softmax(x, mask, scale)
+        else:
+            y = scaled_softmax(x, scale)
+        if self.softmax_in_fp32 and self.input_in_float16:
+            # reference: compute in fp32, cast back to the input half dtype
+            y = y.astype(x.dtype)
+        return y

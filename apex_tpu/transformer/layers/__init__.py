@@ -1,0 +1,16 @@
+"""≙ apex/transformer/layers — persist-LN selector.
+
+The reference's ``layer_norm.py`` picks contrib FastLayerNorm when built
+and the hidden size is in its supported table, else FusedLayerNorm.  The
+TPU Pallas LayerNorm covers all sizes, so the selector is the identity.
+"""
+
+from apex_tpu.normalization import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+)
+
+# ≙ transformer.layers.FastLayerNorm selector — same kernel underneath here
+FastLayerNorm = FusedLayerNorm

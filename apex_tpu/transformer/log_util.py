@@ -1,0 +1,19 @@
+"""≙ apex/transformer/log_util.py :: get_transformer_logger,
+set_logging_level."""
+
+import logging
+
+__all__ = ["get_transformer_logger", "set_logging_level"]
+
+_BASE = "apex_tpu.transformer"
+
+
+def get_transformer_logger(name: str = _BASE) -> logging.Logger:
+    if not name.startswith(_BASE):
+        name = f"{_BASE}.{name}"
+    return logging.getLogger(name)
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the transformer subsystem's log level (int or name)."""
+    logging.getLogger(_BASE).setLevel(verbosity)

