@@ -76,7 +76,8 @@ class FusedScaleMaskSoftmax:
             y = scaled_masked_softmax(x, mask, scale)
         else:
             y = scaled_softmax(x, scale)
-        if self.softmax_in_fp32 and self.input_in_float16:
-            # reference: compute in fp32, cast back to the input half dtype
-            y = y.astype(x.dtype)
+        # Every dispatch path above already computes the reduction in f32 and
+        # returns the input dtype, which is exactly the reference's
+        # softmax_in_fp32 + cast-back behavior; the flag is honored by
+        # construction rather than by a separate cast here.
         return y

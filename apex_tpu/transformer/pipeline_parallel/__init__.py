@@ -1,0 +1,20 @@
+"""Pipeline parallelism — ≙ apex/transformer/pipeline_parallel."""
+
+from apex_tpu.transformer.pipeline_parallel import (  # noqa: F401
+    p2p_communication,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (  # noqa: F401
+    get_current_global_batch_size,
+    get_kth_microbatch,
+    get_num_microbatches,
+    listify_model,
+    setup_microbatch_calculator,
+    split_batch_into_microbatches,
+    update_num_microbatches,
+)

@@ -1,0 +1,307 @@
+"""Pipeline fwd/bwd schedules — ≙ apex/transformer/pipeline_parallel/
+schedules/ (``forward_backward_no_pipelining``,
+``forward_backward_pipelining_without_interleaving`` [1F1B],
+``_forward_backward_pipelining_with_interleaving`` [virtual stages],
+dispatcher ``get_forward_backward_func``).
+
+Design (TPU-native, not a translation).  The reference hand-schedules
+warmup/steady/cooldown phases of explicit forward and backward calls with
+NCCL p2p edges per microbatch.  Under XLA the whole pipeline is **one
+traced program**: activations advance one stage per tick through
+``jax.lax.ppermute`` along the ``pp`` axis (lockstep), the tick loop is a
+``lax.scan``, and the backward schedule *falls out of ``jax.grad``* —
+XLA reverses the scan and the ppermutes, yielding the cooldown-mirrored
+grad flow without hand-scheduling.  Memory behavior equivalent to 1F1B's
+bounded live-activation window comes from rematerialization: each tick's
+stage compute is wrapped in ``jax.checkpoint`` (``remat=True``), so the
+backward recomputes per-tick activations instead of keeping all
+``nm + pp - 1`` of them live.
+
+Uniform-stage contract (SPMD): every pp rank runs the same
+``stage_fn(stage_params, x) -> y`` with activation-shaped ``x`` and ``y``
+(first-stage embedding / last-stage head live inside ``stage_fn`` gated on
+:func:`parallel_state.get_pipeline_model_parallel_rank`, or outside the
+pipeline).  ``loss_fn(y, target) -> scalar`` is evaluated on the last
+stage; it must return finite values for arbitrary finite activations (it
+is traced on every stage and masked).
+
+All schedules share one signature and return ``(losses, grads)`` where
+``losses`` is the per-microbatch loss vector (psum-shared across pp) and
+``grads`` matches ``params`` (``None`` when ``forward_only``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+__all__ = [
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "get_forward_backward_func",
+]
+
+_PP = ps.PIPELINE_PARALLEL_AXIS
+
+
+def _wrap_remat(fn, remat):
+    return jax.checkpoint(fn) if remat else fn
+
+
+# ---------------------------------------------------------------------------
+# no pipelining: sequential microbatches with grad accumulation
+# ---------------------------------------------------------------------------
+
+
+def forward_backward_no_pipelining(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params,
+    batch: Tuple[Any, Any],
+    *,
+    num_microbatches: int,
+    axis_name: str = _PP,
+    forward_only: bool = False,
+    remat: bool = False,
+):
+    """≙ fwd_bwd_no_pipelining.py — scan microbatches, accumulate grads."""
+    inputs, targets = batch
+    run = _wrap_remat(stage_fn, remat)
+
+    def mean_loss(params):
+        def body(carry, mb):
+            x, t = mb
+            loss = loss_fn(run(params, x), t)
+            return carry + loss, loss
+
+        total, losses = jax.lax.scan(
+            body, jnp.zeros((), jnp.float32), (inputs, targets)
+        )
+        return total / num_microbatches, losses
+
+    if forward_only:
+        _, losses = mean_loss(params)
+        return losses, None
+    (_, losses), grads = jax.value_and_grad(mean_loss, has_aux=True)(params)
+    return losses, grads
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (non-interleaved): lockstep tick loop over the pp axis
+# ---------------------------------------------------------------------------
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params,
+    batch: Tuple[Any, Any],
+    *,
+    num_microbatches: int,
+    axis_name: str = _PP,
+    forward_only: bool = False,
+    remat: bool = True,
+):
+    """≙ fwd_bwd_pipelining_without_interleaving.py (1F1B).
+
+    ``params`` are *this rank's stage* params (call inside shard_map with
+    e.g. a ``P('pp', ...)``-sharded stacked tree).  ``batch = (inputs,
+    targets)`` with leaves stacked ``(num_microbatches, ...)``; ``inputs``
+    must be activation-shaped (consumed by stage 0).
+    """
+    inputs, targets = batch
+    nm = num_microbatches
+    run = _wrap_remat(stage_fn, remat)
+
+    def pipeline_loss(params):
+        pp = jax.lax.axis_size(axis_name)
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == pp - 1
+        ticks = nm + pp - 1
+        h0 = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]), inputs)
+
+        def tick(carry, t):
+            h_recv, losses = carry
+            mb_idx = jnp.clip(t, 0, nm - 1)
+            inject = jax.tree_util.tree_map(lambda x: x[mb_idx], inputs)
+            x_in = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(is_first, a, b), inject, h_recv
+            )
+            y = run(params, x_in)
+            out_idx = t - (pp - 1)
+            valid = (out_idx >= 0) & (out_idx < nm) & is_last
+            tgt = jax.tree_util.tree_map(
+                lambda x: x[jnp.clip(out_idx, 0, nm - 1)], targets
+            )
+            loss = loss_fn(y, tgt)
+            losses = losses.at[jnp.clip(out_idx, 0, nm - 1)].add(
+                jnp.where(valid, loss, 0.0)
+            )
+            h_next = p2p.send_forward_recv_forward(y, axis_name)
+            return (h_next, losses), None
+
+        (_, losses), _ = jax.lax.scan(
+            tick, (h0, jnp.zeros((nm,), jnp.float32)), jnp.arange(ticks)
+        )
+        # Differentiate the LOCAL loss sum (nonzero only on the last stage):
+        # grads reach earlier stages through the reversed ppermutes.  Do NOT
+        # psum the differentiated scalar — under check_vma=False the psum
+        # transpose cannot prove the cotangent replicated and would re-psum,
+        # inflating grads by pp.  The shared per-microbatch losses are
+        # returned via aux (not differentiated), psum'd for reporting.
+        return jnp.sum(losses) / nm, jax.lax.psum(losses, axis_name)
+
+    if forward_only:
+        _, losses = pipeline_loss(params)
+        return losses, None
+    (_, losses), grads = jax.value_and_grad(pipeline_loss, has_aux=True)(
+        params
+    )
+    return losses, grads
+
+
+# ---------------------------------------------------------------------------
+# interleaved 1F1B (virtual pipeline stages)
+# ---------------------------------------------------------------------------
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params,
+    batch: Tuple[Any, Any],
+    *,
+    num_microbatches: int,
+    num_model_chunks: Optional[int] = None,
+    axis_name: str = _PP,
+    forward_only: bool = False,
+    remat: bool = True,
+):
+    """≙ fwd_bwd_pipelining_with_interleaving.py (virtual/interleaved 1F1B).
+
+    ``params`` hold this rank's ``num_model_chunks`` stage chunks stacked
+    on a leading axis (every leaf ``(vpp, ...)``): rank r owns virtual
+    stages ``r, r+pp, ..., r+(vpp-1)·pp``.  Routing per tick: slot k moves
+    rank r → r+1 (same chunk); the wrap rank pp-1 → rank 0 advances to
+    slot k+1 (the roll trick below), matching the virtual-stage walk.
+    """
+    inputs, targets = batch
+    nm = num_microbatches
+    if num_model_chunks is None:
+        num_model_chunks = ps.get_virtual_pipeline_model_parallel_world_size()
+    vpp = num_model_chunks
+    if vpp is None or vpp < 1:
+        raise ValueError("num_model_chunks (virtual pipeline size) required")
+    run = _wrap_remat(stage_fn, remat)
+
+    def pipeline_loss(params):
+        pp = jax.lax.axis_size(axis_name)
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == pp - 1
+        total_stages = pp * vpp
+        ticks = nm + total_stages - 1
+        act0 = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]), inputs)
+        # slot buffer: leading (vpp,) dim per leaf
+        buf0 = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (vpp,) + x.shape), act0
+        )
+
+        def tick(carry, t):
+            buf, losses = carry
+            outs = []
+            for k in range(vpp):  # static unroll over chunks
+                x_k = jax.tree_util.tree_map(lambda x: x[k], buf)
+                if k == 0:
+                    mb_idx = jnp.clip(t, 0, nm - 1)
+                    inject = jax.tree_util.tree_map(
+                        lambda x: x[mb_idx], inputs
+                    )
+                    injecting = is_first & (t < nm)
+                    x_k = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(injecting, a, b), inject, x_k
+                    )
+                chunk_params = jax.tree_util.tree_map(
+                    lambda x: x[k], params
+                )
+                outs.append(run(chunk_params, x_k))
+
+            # loss: last virtual stage = rank pp-1, chunk vpp-1
+            out_idx = t - (total_stages - 1)
+            valid = (out_idx >= 0) & (out_idx < nm) & is_last
+            tgt = jax.tree_util.tree_map(
+                lambda x: x[jnp.clip(out_idx, 0, nm - 1)], targets
+            )
+            loss = loss_fn(outs[-1], tgt)
+            losses = losses.at[jnp.clip(out_idx, 0, nm - 1)].add(
+                jnp.where(valid, loss, 0.0)
+            )
+
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *outs
+            )
+            received = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(
+                    x,
+                    axis_name,
+                    [(i, (i + 1) % pp) for i in range(pp)],
+                ),
+                stacked,
+            )
+            # rank 0 received from rank pp-1: those activations advance one
+            # chunk (slot k -> k+1); other ranks keep slot indices.
+            rolled = jax.tree_util.tree_map(
+                lambda x: jnp.roll(x, 1, axis=0), received
+            )
+            buf_next = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(is_first, a, b), rolled, received
+            )
+            return (buf_next, losses), None
+
+        (_, losses), _ = jax.lax.scan(
+            tick, (buf0, jnp.zeros((nm,), jnp.float32)), jnp.arange(ticks)
+        )
+        # local sum differentiated; psum only in aux (see 1F1B note above)
+        return jnp.sum(losses) / nm, jax.lax.psum(losses, axis_name)
+
+    if forward_only:
+        _, losses = pipeline_loss(params)
+        return losses, None
+    (_, losses), grads = jax.value_and_grad(pipeline_loss, has_aux=True)(
+        params
+    )
+    return losses, grads
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: Optional[int] = None,
+):
+    """≙ schedules/__init__.py :: get_forward_backward_func."""
+    if pipeline_model_parallel_size is None and ps.model_parallel_is_initialized():
+        pipeline_model_parallel_size = ps.get_pipeline_model_parallel_world_size()
+    if virtual_pipeline_model_parallel_size is None and ps.model_parallel_is_initialized():
+        virtual_pipeline_model_parallel_size = (
+            ps.get_virtual_pipeline_model_parallel_world_size()
+        )
+    if (pipeline_model_parallel_size or 1) <= 1:
+        return forward_backward_no_pipelining
+    if virtual_pipeline_model_parallel_size is not None:
+        return functools.partial(
+            forward_backward_pipelining_with_interleaving,
+            num_model_chunks=virtual_pipeline_model_parallel_size,
+        )
+    return forward_backward_pipelining_without_interleaving

@@ -98,6 +98,19 @@ def model_parallel_tpu_manual_seed(seed: int, tp_rank: Optional[int] = None):
     tracker.reset()
     tracker.add(_DEFAULT_RNG_TRACKER_NAME, seed)
     offset = seed + _MODEL_PARALLEL_SEED_OFFSET
+    if tp_rank is None and ps.model_parallel_is_initialized():
+        if ps.get_tensor_model_parallel_world_size() > 1:
+            import warnings
+
+            warnings.warn(
+                "model_parallel seed registered without a tp_rank while "
+                "tensor_model_parallel_size > 1: forked keys will be "
+                "IDENTICAL across tp ranks (unlike the reference's per-rank "
+                "offset). Fold the rank in at use sites with "
+                "to_per_rank_key(tracker.fork()), or pass tp_rank explicitly.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     tracker.add(
         _MODEL_PARALLEL_RNG_TRACKER_NAME,
         offset + (tp_rank if tp_rank is not None else 0),
@@ -128,6 +141,12 @@ def checkpoint(function, *args, **kwargs):
     stashed.  It is accepted both as the reference's *second positional*
     argument (``checkpoint(fn, False, *tensors)``) and as a keyword, so
     positionally-ported Megatron call sites keep working.
+
+    Caveat of that compatibility heuristic: a *leading Python-bool
+    argument of the checkpointed function itself* is indistinguishable
+    from the flag and will be stripped.  If your function genuinely takes
+    a leading bool, close over it (``checkpoint(partial(fn, True), x)``)
+    or call ``jax.checkpoint`` directly.
     """
     kwargs.pop("distribute_saved_activations", None)
     if args and isinstance(args[0], bool):

@@ -1,0 +1,298 @@
+"""≙ tests/L0/run_transformer/test_p2p_comm.py +
+test_pipeline_parallel_fwd_bwd.py + test_microbatches.py.
+
+Golden: the pipelined loss/grads must equal a sequential (non-pipelined)
+composition of the same stages on the same microbatches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+)
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    p2p_communication as p2p,
+    split_batch_into_microbatches,
+)
+
+D, MB, NM = 8, 4, 6
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def loss_fn(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def make_stages(n_stages, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(
+            rng.randn(n_stages, D, D) * 0.5, jnp.float32
+        ),
+        "b": jnp.asarray(rng.randn(n_stages, D) * 0.1, jnp.float32),
+    }
+
+
+def make_batch(seed=1):
+    rng = np.random.RandomState(seed)
+    inputs = jnp.asarray(rng.randn(NM, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(NM, MB, D), jnp.float32)
+    return inputs, targets
+
+
+def sequential_reference(stacked, inputs, targets, n_stages):
+    """Sequential mean loss over microbatches + grads wrt stacked params."""
+
+    def mean_loss(stacked):
+        def apply_all(x):
+            for s in range(n_stages):
+                p_s = jax.tree_util.tree_map(lambda v: v[s], stacked)
+                x = stage_fn(p_s, x)
+            return x
+
+        losses = jax.vmap(lambda x, t: loss_fn(apply_all(x), t))(
+            inputs, targets
+        )
+        return jnp.mean(losses), losses
+
+    (_, losses), grads = jax.value_and_grad(mean_loss, has_aux=True)(stacked)
+    return losses, grads
+
+
+# ---------------------------------------------------------------------------
+# p2p
+# ---------------------------------------------------------------------------
+
+
+def test_p2p_shifts(eight_devices):
+    mesh = ps.initialize_model_parallel(1, 8)  # pp=8
+
+    def f(x):
+        fwd = p2p.send_forward_recv_forward(x)
+        bwd = p2p.send_backward_recv_backward(x)
+        return fwd[None], bwd[None]
+
+    x = jnp.arange(8.0)
+    fwd, bwd = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P("pp"),), out_specs=P("pp"),
+            check_vma=False,
+        )
+    )(x)
+    # forward shift: rank r receives value from r-1; rank 0 gets zeros
+    np.testing.assert_allclose(
+        np.asarray(fwd).ravel(), [0, 0, 1, 2, 3, 4, 5, 6]
+    )
+    np.testing.assert_allclose(
+        np.asarray(bwd).ravel(), [1, 2, 3, 4, 5, 6, 7, 0]
+    )
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+
+def test_no_pipelining_matches_sequential():
+    stacked = make_stages(1)
+    inputs, targets = make_batch()
+    losses, grads = forward_backward_no_pipelining(
+        stage_fn,
+        loss_fn,
+        jax.tree_util.tree_map(lambda v: v[0], stacked),
+        (inputs, targets),
+        num_microbatches=NM,
+    )
+    ref_losses, ref_grads = sequential_reference(stacked, inputs, targets, 1)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]),
+        np.asarray(ref_grads["w"][0]),
+        rtol=1e-4,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("remat", [True, False])
+def test_1f1b_matches_sequential(eight_devices, remat):
+    pp = 4
+    mesh = ps.initialize_model_parallel(1, pp)  # dp=2 unused, pp=4
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn,
+            loss_fn,
+            params,
+            (inputs, targets),
+            num_microbatches=NM,
+            remat=remat,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[None], grads)
+        return losses, grads
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")),
+            check_vma=False,
+        )
+    )(stacked, inputs, targets)
+
+    ref_losses, ref_grads = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]),
+            np.asarray(ref_grads[k]),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+
+def test_1f1b_forward_only(eight_devices):
+    pp = 4
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=NM, forward_only=True,
+        )
+        assert grads is None
+        return losses
+
+    losses = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )(stacked, inputs, targets)
+    ref_losses, _ = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_interleaved_matches_sequential(eight_devices):
+    pp, vpp = 2, 2
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual)  # ordered by virtual stage
+    inputs, targets = make_batch()
+    # rank r holds chunks k at virtual stage k*pp + r:
+    # reshape (n_virtual, ...) -> (vpp, pp, ...), shard dim 1 over pp
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)  # (vpp,...)
+        losses, grads = forward_backward_pipelining_with_interleaving(
+            stage_fn,
+            loss_fn,
+            params,
+            (inputs, targets),
+            num_microbatches=NM,
+            num_model_chunks=vpp,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[:, None], grads)
+        return losses, grads
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(P(None, "pp"), P(), P()),
+            out_specs=(P(), P(None, "pp")),
+            check_vma=False,
+        )
+    )(regrouped, inputs, targets)
+
+    ref_losses, ref_grads = sequential_reference(
+        stacked, inputs, targets, n_virtual
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(n_virtual, *stacked[k].shape[1:])
+        np.testing.assert_allclose(
+            got, np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_get_forward_backward_func(eight_devices):
+    ps.initialize_model_parallel(1, 1)
+    assert get_forward_backward_func() is forward_backward_no_pipelining
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(1, 2)
+    assert (
+        get_forward_backward_func()
+        is forward_backward_pipelining_without_interleaving
+    )
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(1, 2, virtual_pipeline_model_parallel_size=2)
+    f = get_forward_backward_func()
+    assert f.func is forward_backward_pipelining_with_interleaving
+
+
+# ---------------------------------------------------------------------------
+# microbatch calculators
+# ---------------------------------------------------------------------------
+
+
+def test_constant_microbatches():
+    c = ConstantNumMicroBatches(64, 4, 2)
+    assert c.get() == 8
+    with pytest.raises(ValueError):
+        ConstantNumMicroBatches(65, 4, 2)
+
+
+def test_rampup_microbatches():
+    r = RampupBatchsizeNumMicroBatches(
+        start_batch_size=8,
+        batch_size_increment=8,
+        ramup_samples=100,
+        global_batch_size=32,
+        micro_batch_size=4,
+        data_parallel_size=1,
+    )
+    assert r.get_current_global_batch_size() == 8
+    r.update(60)
+    assert r.get_current_global_batch_size() == 16
+    r.update(200)
+    assert r.get_current_global_batch_size() == 32
+    assert r.get() == 8
+
+
+def test_split_batch_into_microbatches():
+    b = {"x": jnp.zeros((12, 3))}
+    out = split_batch_into_microbatches(b, 4)
+    assert out["x"].shape == (4, 3, 3)
+    with pytest.raises(ValueError):
+        split_batch_into_microbatches(b, 5)
