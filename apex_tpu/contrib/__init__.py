@@ -1,0 +1,28 @@
+"""Contrib parity layer — ≙ ``apex/contrib``.
+
+The reference gates each contrib feature behind a build flag
+(``setup.py --fmha --fast_multihead_attn ...``) and a try-import probe.
+Here every feature is pure JAX/Pallas and always importable; features whose
+substance is CUDA-specific plumbing with no TPU meaning (``nccl_p2p``,
+``nccl_allocator``, ``peer_memory`` as IPC pools, ``gpu_direct_storage``)
+are represented by their *capability* equivalents (ppermute halo exchange,
+XLA-managed buffers) or documented as not applicable — see each submodule.
+
+Submodules
+----------
+- multihead_attn — fused self/enc-dec attention (≙ apex/contrib/multihead_attn)
+- fmha          — packed/varlen flash attention (≙ apex/contrib/fmha)
+- xentropy      — fused softmax-CE (≙ apex/contrib/xentropy)
+- layer_norm    — FastLayerNorm (≙ apex/contrib/layer_norm)
+- group_norm    — (NHWC) GroupNorm + SiLU fusion (≙ apex/contrib/group_norm)
+- groupbn       — BatchNorm2d NHWC + ReLU/Add fusions (≙ apex/contrib/groupbn)
+- clip_grad     — fused clip_grad_norm_ (≙ apex/contrib/clip_grad)
+- optimizers    — ZeRO-sharded DistributedFusedAdam/LAMB (≙ contrib/optimizers)
+- focal_loss    — fused focal loss (≙ apex/contrib/focal_loss)
+- index_mul_2d  — fused gather-multiply (≙ apex/contrib/index_mul_2d)
+- transducer    — RNN-T joint + loss (≙ apex/contrib/transducer)
+- sparsity      — ASP 2:4 structured sparsity (≙ apex/contrib/sparsity)
+- bottleneck    — (spatial-parallel) ResNet bottleneck (≙ contrib/bottleneck)
+- peer_memory   — halo exchange over a mesh axis (≙ contrib/peer_memory)
+- conv_bias_relu — fused Conv+Bias(+ReLU/+Add) (≙ contrib/conv_bias_relu)
+"""

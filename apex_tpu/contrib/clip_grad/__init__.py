@@ -1,0 +1,7 @@
+"""≙ apex/contrib/clip_grad — fused clip_grad_norm_.
+
+Same flat-buffer fused global-norm + scale as the reference's
+``clip_grad_norm_`` built on ``multi_tensor_l2norm``/``multi_tensor_scale``.
+"""
+
+from apex_tpu.optimizers.clip_grad import clip_grad_norm_  # noqa: F401

@@ -1,0 +1,46 @@
+"""FMHA — packed/varlen flash attention ≙ ``apex/contrib/fmha``.
+
+The reference (`apex/contrib/fmha/fmha.py :: FMHAFun`) consumes an unpadded
+token-packed ``(total_tokens, 3, H, D)`` QKV with ``cu_seqlens`` prefix
+offsets, running fixed-seqlen flash kernels (128–512) per batch — the MLPerf
+BERT input pipeline.  On TPU, dynamic per-batch shapes defeat XLA, so the
+idiomatic equivalent keeps the batch padded to ``(B, S, 3, H, D)`` and masks
+padding keys inside the flash kernel via an additive bias built from
+``seqlens``; the arithmetic per valid token is identical and the padded
+positions are skipped by the online softmax (masked to -1e9).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import fmha_qkvpacked
+from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
+
+__all__ = ["fmha", "fmha_qkvpacked", "padding_bias_from_seqlens"]
+
+
+def padding_bias_from_seqlens(seqlens, max_seqlen):
+    """(B,) valid lengths → (B, 1, 1, S) additive key-padding bias."""
+    pos = jnp.arange(max_seqlen)
+    return jnp.where(
+        pos[None, :] < seqlens[:, None], 0.0, MASK_VALUE
+    )[:, None, None, :]
+
+
+def fmha(qkv, seqlens=None, *, causal=False, dropout_p=0.0, dropout_rng=None):
+    """≙ ``FMHAFun(qkv, cu_seqlens, ...)`` on a padded batch.
+
+    qkv: (B, S, 3, H, D); seqlens: optional (B,) int valid lengths.
+    Returns (B, S, H, D).  Query rows past ``seqlens`` see only masked
+    keys and therefore produce a uniform average of V (softmax over
+    constant masked scores) — garbage rows the caller masks downstream,
+    exactly as the reference's unpadded layout implies for tokens that do
+    not exist.
+    """
+    bias = None
+    if seqlens is not None:
+        bias = padding_bias_from_seqlens(seqlens, qkv.shape[1])
+    return fmha_qkvpacked(
+        qkv, bias, causal=causal, dropout_p=dropout_p, dropout_rng=dropout_rng
+    )

@@ -1,0 +1,11 @@
+"""≙ apex/contrib/layer_norm — FastLayerNorm.
+
+The reference's FastLayerNorm (`apex/contrib/layer_norm/layer_norm.py`,
+``ln_fwd_cuda_kernel.cu``) is a persistent-kernel LayerNorm for a fixed
+table of hidden sizes (768…65536).  The Pallas LayerNorm already tiles by
+hidden size (apex_tpu/ops/pallas/layer_norm.py :: _block_rows), so the
+"fast" path and the standard path are the same kernel here.
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm  # noqa: F401
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine  # noqa: F401

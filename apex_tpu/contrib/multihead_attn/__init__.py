@@ -1,0 +1,17 @@
+"""Fused multihead attention modules — ≙ ``apex/contrib/multihead_attn``.
+
+Reference surface (`apex/contrib/multihead_attn/self_multihead_attn.py`,
+``encdec_multihead_attn.py``): ``SelfMultiheadAttn`` / ``EncdecMultiheadAttn``
+with options ``bias``, ``include_norm_add`` (fused residual+LayerNorm),
+``mask_additive`` (additive vs boolean key-padding mask), ``dropout`` and two
+impls (``fast`` CUDA pipeline vs ``default`` torch).  The CUDA pipeline's
+fusion (QKV GEMM → scaled masked softmax → dropout → PV GEMM → out-proj) is
+realized here as: one fused QKV projection (single MXU GEMM) → Pallas flash
+attention (apex_tpu.ops.attention) → out projection, with the norm_add
+variant fusing the pre-LayerNorm via apex_tpu Pallas LayerNorm.
+"""
+
+from apex_tpu.contrib.multihead_attn.modules import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)

@@ -1,0 +1,14 @@
+"""≙ apex/contrib/optimizers — ZeRO-sharded distributed fused optimizers.
+
+``DistributedFusedAdam`` / ``DistributedFusedLamb``
+(`apex/contrib/optimizers/distributed_fused_adam.py`,
+``distributed_fused_lamb.py``): grads reduce-scattered over the DP axis,
+shard-local fused update, params all-gathered — implemented TPU-natively in
+:mod:`apex_tpu.parallel.distributed_fused_optimizers` (psum_scatter →
+update shard → all_gather inside one jitted step).
+"""
+
+from apex_tpu.parallel.distributed_fused_optimizers import (  # noqa: F401
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)

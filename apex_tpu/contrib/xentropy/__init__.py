@@ -1,0 +1,10 @@
+"""≙ apex/contrib/xentropy — fused softmax cross-entropy.
+
+Same op as :mod:`apex_tpu.ops.xentropy` (the reference likewise re-exports
+its xentropy_kernel.cu binding as ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``).
+"""
+
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

@@ -5,6 +5,11 @@ Every op computes statistics in f32, preserves I/O dtype, and ships a
 """
 
 from apex_tpu.ops._dispatch import set_use_pallas, use_pallas  # noqa: F401
+from apex_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    fmha_qkvpacked,
+    mha_reference,
+)
 from apex_tpu.ops.layer_norm import (  # noqa: F401
     fused_layer_norm,
     fused_layer_norm_affine,

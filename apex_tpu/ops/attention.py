@@ -1,0 +1,193 @@
+"""Flash attention — public API and dispatch.
+
+Capability parity with the reference's two fused-attention families:
+
+- ``apex/contrib/multihead_attn`` (``SelfMultiheadAttn``/``EncdecMultiheadAttn``
+  autograd functions: QKV GEMM + scaled [masked] softmax + dropout + PV GEMM),
+- ``apex/contrib/fmha`` (``fmha.py :: FMHAFun``, flash kernels for seq ≤ 512).
+
+Two numerically-identical implementations (see apex_tpu.ops._dispatch):
+
+- **jnp path** — plain composition XLA fuses; supports every feature incl.
+  attention dropout and differentiable bias; the correctness reference.
+- **Pallas path** — online-softmax flash kernel
+  (apex_tpu.ops.pallas.flash_attention), O(S) memory, used on TPU when
+  shapes are tile-friendly and dropout is off (dropout in the hot kernel is
+  deliberately unsupported: large-model training on TPU runs dropout-free,
+  and the jnp path covers parity testing of dropout semantics).
+
+Interface dtype rules mirror the reference: compute in f32 inside the
+kernel, outputs in the input dtype, logsumexp saved in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _dispatch
+from apex_tpu.ops.pallas import flash_attention as _pallas
+
+__all__ = ["flash_attention", "mha_reference", "fmha_qkvpacked"]
+
+_LANES = 128
+
+
+def _pallas_eligible(q, k, v, dropout_p):
+    if dropout_p > 0.0:
+        return False
+    sq, sk = q.shape[-2], k.shape[-2]
+    # Blocks are min(128, S); padding of partial tail blocks is not
+    # implemented — require multiples (the bench shapes 128/512 qualify).
+    if sq % min(128, sq) or sk % min(128, sk):
+        return False
+    if sq % 8 or sk % 8:
+        return False
+    return _dispatch.use_pallas()
+
+
+def _flatten_bh(x):
+    b, h, s, d = x.shape
+    return x.reshape(b * h, s, d)
+
+
+def _pad_head_dim(x):
+    d = x.shape[-1]
+    pad = (-d) % _LANES
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, bias, scale, causal):
+    o, _ = _flash_fwd(q, k, v, bias, scale, causal)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, scale, causal):
+    o, lse = _pallas.flash_fwd(q, k, v, bias, scale=scale, causal=causal)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_bwd(scale, causal, res, g):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv = _pallas.flash_bwd(
+        q, k, v, o, lse, g, bias, scale=scale, causal=causal
+    )
+    # Bias is the reference's *additive mask* — non-trainable there; the
+    # flash path returns a zero cotangent for it (use the jnp path for a
+    # trainable bias, e.g. relative position biases).
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def mha_reference(
+    q,
+    k,
+    v,
+    bias=None,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_rng=None,
+):
+    """Unfused attention in f32 — the golden composition the reference tests
+    fuse against (≙ the torch compositions in apex/contrib/test/fmha etc.).
+
+    Shapes: q (B,H,Sq,D), k/v (B,H,Sk,D), bias broadcastable to (B,H,Sq,Sk).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _pallas.MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_p > 0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    bias=None,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_rng=None,
+    bias_grad: bool = False,
+):
+    """Fused scaled-dot-product attention.
+
+    q (B,H,Sq,D); k,v (B,H,Sk,D); optional additive ``bias`` of rank ≤ 4
+    broadcastable to (B,H,Sq,Sk) (the reference's key-padding / additive
+    attention mask — non-trainable, and the flash path treats it as a
+    constant with zero cotangent).  For a *trainable* bias (e.g. relative
+    position biases) pass ``bias_grad=True``: that routes through the
+    unfused path, whose autodiff produces the bias gradient.  Returns
+    (B,H,Sq,D) in the input dtype.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if bias is not None and bias.ndim < 4:
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    if (bias is not None and bias_grad) or not _pallas_eligible(
+        q, k, v, dropout_p
+    ):
+        return mha_reference(
+            q, k, v, bias, causal=causal, scale=scale,
+            dropout_p=dropout_p, dropout_rng=dropout_rng,
+        )
+
+    b, h, sq, d = q.shape
+    qf = _pad_head_dim(_flatten_bh(q))
+    kf = _pad_head_dim(_flatten_bh(k))
+    vf = _pad_head_dim(_flatten_bh(v))
+    bias_f = None
+    if bias is not None:
+        sk = k.shape[-2]
+        bb, bh_, bsq, bsk = bias.shape
+        if (bsq, bsk) != (sq, sk):
+            bias = jnp.broadcast_to(bias, (bb, bh_, sq, sk))
+        if bb == 1 and bh_ == 1:
+            bias_f = bias.reshape(1, sq, sk)
+        else:
+            bias_f = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(b * h, sq, sk)
+        # The flash VJP returns a zero cotangent for bias (it is the
+        # reference's non-trainable mask); stop_gradient makes that
+        # explicit so a trainable bias reaching this path fails loudly in
+        # tests (zero grad) rather than appearing shape-dependent.
+        bias_f = jax.lax.stop_gradient(bias_f)
+    o = _flash(qf, kf, vf, bias_f, scale, causal)
+    return o[..., :d].reshape(b, h, sq, d)
+
+
+def fmha_qkvpacked(qkv, bias=None, *, causal=False, scale=None,
+                   dropout_p=0.0, dropout_rng=None):
+    """Packed-QKV entry point ≙ ``apex/contrib/fmha/fmha.py :: FMHAFun``
+    (input (B, S, 3, H, D) as produced by a fused QKV projection)."""
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    o = flash_attention(
+        q, k, v, bias, causal=causal, scale=scale,
+        dropout_p=dropout_p, dropout_rng=dropout_rng,
+    )
+    return jnp.moveaxis(o, 1, 2)  # (B, S, H, D)

@@ -1,0 +1,389 @@
+"""Pallas TPU flash attention — forward + backward kernels.
+
+TPU-native equivalent of the reference's fused-attention natives:
+``apex/contrib/csrc/multihead_attn/*.cu`` (strided-batched-GEMM + warp
+softmax + dropout pipeline) and ``apex/contrib/csrc/fmha/`` (fixed-seqlen
+flash kernels, seq ≤ 512).  Where those hand-schedule cuBLAS GEMMs and
+softmax kernels per architecture, the TPU version is a single online-softmax
+(flash) kernel family tiled for the MXU: never materializes the (Sq, Sk)
+score matrix in HBM, carries running (max, sum, acc) in VMEM scratch across
+the key-block grid dimension, and saves only the logsumexp for backward.
+
+Unlike the reference's fmha (seq ∈ {128,256,384,512} hardcoded per kernel),
+block shapes here are chosen at trace time and any Sq/Sk multiple of the
+block size works; long-context is handled above this kernel by ring/context
+parallelism (apex_tpu.transformer.context_parallel).
+
+Layout: q (BH, Sq, D), k/v (BH, Sk, D) with batch*heads pre-flattened and D
+pre-padded to a lane multiple (128) by the caller (apex_tpu.ops.attention).
+Bias, when present, is (BHb, Sq, Sk) with BHb ∈ {1, BH} — additive, applied
+after scaling, the same semantics as the reference's additive mask path
+(``apex/contrib/multihead_attn`` ``mask_additive`` mode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import pallas_interpret
+
+# Large negative finite (not -inf: keeps exp() well-defined in f32 after the
+# running-max subtraction, same trick as the reference's softmax kernels).
+MASK_VALUE = -1e9
+
+_LANES = 128
+
+
+def _causal_mask_block(i, j, bq, bk, offset):
+    # Bottom-right-aligned causal mask: query row r sees keys <= r + offset
+    # where offset = Sk - Sq (matches jnp.tril(..., k=sk-sq) in the
+    # reference composition; identical to the standard convention when
+    # Sq == Sk).
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+    return rows + offset >= cols
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale, causal, bq, bk, nk, offset,
+):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if causal:
+        i = pl.program_id(1)
+        s = jnp.where(_causal_mask_block(i, j, bq, bk, offset), s, MASK_VALUE)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        # MASK_VALUE is finite, so even a fully-masked row has p = 1 at its
+        # row max and l >= 1: no divide-by-zero, and such a row yields a
+        # uniform average of V — identical to the jnp reference (softmax of
+        # constant scores), not zeros.
+        l = l_ref[:, :1]
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)[None]
+        lse = m_ref[:, :1] + jnp.log(l)
+        # lse carries a broadcast 128-lane dim — Mosaic requires the last
+        # two block dims tile-aligned, so a (1, bq) row block is not
+        # lowerable; (bq, 128) is (same layout as jax's reference TPU
+        # flash attention).
+        lse_ref[...] = jnp.broadcast_to(lse, (bq, _LANES))[None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
+)
+def flash_fwd(q, k, v, bias, *, scale, causal, block_q=128, block_k=128):
+    """Returns (o, lse).  q (BH,Sq,D), k/v (BH,Sk,D).
+
+    lse is f32 (BH, Sq, 128) — the row logsumexp broadcast across a lane
+    dim so its blocks are TPU-tileable; consumers read lane 0.
+    """
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    grid = (bh, nq, nk)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        bias_b = bias.shape[0]
+        in_specs.append(
+            pl.BlockSpec(
+                (1, bq, bk),
+                lambda b, i, j, bb=bias_b: (0 if bb == 1 else b, i, j),
+            )
+        )
+        args.append(bias)
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            offset=sk - sq,
+        )
+    else:
+        kernel = functools.partial(
+            _fwd_kernel_nobias, scale=scale, causal=causal, bq=bq, bk=bk,
+            nk=nk, offset=sk - sq,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=pallas_interpret(),
+    )(*args)
+
+
+def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, acc, m, l, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if bias_blk is not None:
+        s = s + bias_blk
+    if causal:
+        mask = _causal_mask_block(i, j, bq, bk, offset)
+        s = jnp.where(mask, s, MASK_VALUE)
+    p = jnp.exp(s - lse)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    return p
+
+
+def _dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
+    *, scale, causal, bq, bk, nq, offset,
+):
+    i = pl.program_id(2)  # q-block index (inner loop)
+    j = pl.program_id(1)  # k-block index
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    delta = delta_ref[0][:, :1]
+    bias_blk = None if bias_ref is None else bias_ref[0].astype(jnp.float32)
+
+    p = _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset)
+    # dv += p^T @ do
+    dv_acc[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # dp = do @ v^T ; ds = p * (dp - delta)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    # dk += ds^T @ q * scale
+    dk_acc[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)[None]
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)[None]
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+    dq_ref, dq_acc,
+    *, scale, causal, bq, bk, nk, offset,
+):
+    i = pl.program_id(1)  # q-block index
+    j = pl.program_id(2)  # k-block index (inner loop)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    delta = delta_ref[0][:, :1]
+    bias_blk = None if bias_ref is None else bias_ref[0].astype(jnp.float32)
+
+    p = _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    dq_acc[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)[None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
+)
+def flash_bwd(
+    q, k, v, o, lse, do, bias, *, scale, causal, block_q=128, block_k=128
+):
+    """Returns (dq, dk, dv).  Recomputation backward: only lse was saved."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+
+    # delta_i = rowsum(do * o) — the softmax-jacobian correction term
+    # (≙ the reference bwd kernels' row reduction before the ds GEMM).
+    # Broadcast over a 128-lane dim like lse so blocks are tile-aligned.
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[
+            ..., None
+        ],
+        lse.shape,
+    )
+
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    k_spec_j = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    row_spec_i = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0))
+    common = [q, k, v, do, lse, delta]
+
+    def _bias_spec(order):
+        bias_b = bias.shape[0]
+        if order == "ji":
+            return pl.BlockSpec(
+                (1, bq, bk), lambda b, j, i, bb=bias_b: (0 if bb == 1 else b, i, j)
+            )
+        return pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j, bb=bias_b: (0 if bb == 1 else b, i, j)
+        )
+
+    # --- dk/dv: grid (BH, nk, nq), q innermost ---
+    in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, row_spec_i, row_spec_i]
+    args = list(common)
+    if bias is not None:
+        in_specs.append(_bias_spec("ji"))
+        args.append(bias)
+        dkdv_kernel = functools.partial(
+            _dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            offset=sk - sq,
+        )
+    else:
+        dkdv_kernel = functools.partial(
+            _dkdv_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            offset=sk - sq,
+        )
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=pallas_interpret(),
+    )(*args)
+
+    # --- dq: grid (BH, nq, nk), k innermost ---
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0))
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    args = list(common)
+    if bias is not None:
+        in_specs.append(_bias_spec("ij"))
+        args.append(bias)
+        dq_kernel = functools.partial(
+            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            offset=sk - sq,
+        )
+    else:
+        dq_kernel = functools.partial(
+            _dq_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            offset=sk - sq,
+        )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=pallas_interpret(),
+    )(*args)
+    return dq, dk, dv
+
+
+def _dkdv_nobias(q, k, v, do, lse, delta, dk, dv, dka, dva, **kw):
+    _dkdv_kernel(q, k, v, do, lse, delta, None, dk, dv, dka, dva, **kw)
+
+
+def _dq_nobias(q, k, v, do, lse, delta, dq, dqa, **kw):
+    _dq_kernel(q, k, v, do, lse, delta, None, dq, dqa, **kw)

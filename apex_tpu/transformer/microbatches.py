@@ -58,6 +58,8 @@ class RampupBatchsizeNumMicroBatches:
         self.micro_batch_times_data_parallel_size = (
             micro_batch_size * data_parallel_size
         )
+        if batch_size_increment <= 0:
+            raise ValueError("batch_size_increment must be positive")
         diff = global_batch_size - start_batch_size
         if diff < 0 or diff % batch_size_increment != 0:
             raise ValueError(

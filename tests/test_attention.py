@@ -1,0 +1,134 @@
+"""Flash attention vs unfused reference — ≙ apex/contrib/test/fmha and
+multihead_attn tests (fused kernel vs plain torch attention composition)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import _dispatch
+from apex_tpu.ops.attention import flash_attention, fmha_qkvpacked, mha_reference
+
+
+@pytest.fixture
+def force_pallas():
+    _dispatch.set_use_pallas(True)
+    yield
+    _dispatch.set_use_pallas(None)
+
+
+def _rand_qkv(key, b=2, h=2, sq=128, sk=128, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, sq, d), dtype)
+    k = jax.random.normal(kk, (b, h, sk, d), dtype)
+    v = jax.random.normal(kv, (b, h, sk, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(force_pallas, causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_bias(force_pallas):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1))
+    # key-padding-style additive mask: last 32 keys masked out for batch 1
+    bias = np.zeros((2, 1, 1, 128), np.float32)
+    bias[1, :, :, 96:] = -1e9
+    bias = jnp.asarray(np.broadcast_to(bias, (2, 1, 128, 128)))
+    out = flash_attention(q, k, v, bias)
+    ref = mha_reference(q, k, v, bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_shared_bias(force_pallas):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5))
+    bias = jax.random.normal(jax.random.PRNGKey(6), (1, 1, 128, 128))
+    out = flash_attention(q, k, v, bias)
+    ref = mha_reference(q, k, v, bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(force_pallas, causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), b=1, h=2, sq=128, sk=128, d=64)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_grads_with_bias(force_pallas):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), b=1, h=1)
+    bias = jax.random.normal(jax.random.PRNGKey(4), (1, 1, 128, 128)) * 0.1
+
+    gf = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, bias)))(q)
+    gr = jax.grad(lambda q: jnp.sum(mha_reference(q, k, v, bias)))(q)
+    np.testing.assert_allclose(gf, gr, atol=5e-4, rtol=5e-4)
+
+
+def test_cross_attention_shapes(force_pallas):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), sq=128, sk=256)
+    out = flash_attention(q, k, v)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_multi_block_long_seq(force_pallas):
+    # >1 block in both q and k (blocks are 128): exercises the online-softmax
+    # carry across the key grid dimension.
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), b=1, h=1, sq=256, sk=384)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_io(force_pallas):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(9), dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = mha_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref, atol=3e-2, rtol=3e-2
+    )
+
+
+def test_dropout_falls_back_and_runs():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(10))
+    rng = jax.random.PRNGKey(11)
+    out = flash_attention(q, k, v, dropout_p=0.5, dropout_rng=rng)
+    assert out.shape == q.shape
+    # dropout is a no-op in expectation direction check: zero-prob path equals ref
+    out0 = flash_attention(q, k, v, dropout_p=0.0)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(out0, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_fmha_qkvpacked(force_pallas):
+    b, s, h, d = 2, 128, 2, 64
+    qkv = jax.random.normal(jax.random.PRNGKey(12), (b, s, 3, h, d))
+    out = fmha_qkvpacked(qkv, causal=False)
+    assert out.shape == (b, s, h, d)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    ref = jnp.moveaxis(mha_reference(q, k, v), 1, 2)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_odd_seq_uses_reference_path():
+    # Non-tile-friendly seq length must still work (jnp fallback).
+    q, k, v = _rand_qkv(jax.random.PRNGKey(13), sq=37, sk=53)
+    out = flash_attention(q, k, v)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

@@ -1,0 +1,147 @@
+"""SelfMultiheadAttn / EncdecMultiheadAttn — ≙ apex/contrib/test/multihead_attn
+(fused module vs plain attention composition, norm_add and masking variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.fmha import fmha
+from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
+from apex_tpu.ops.attention import mha_reference
+
+S, B, E, H = 128, 2, 256, 4
+
+
+def _ref_self_attn(params, x, key_padding_mask=None, causal=False):
+    w = params["params"]["qkv_proj"]["kernel"]
+    wo = params["params"]["out_proj"]["kernel"]
+    qkv = x @ w
+    qkv = qkv.reshape(S, B, 3, H, E // H)
+    q, k, v = (jnp.transpose(qkv[:, :, i], (1, 2, 0, 3)) for i in range(3))
+    bias = None
+    if key_padding_mask is not None:
+        bias = jnp.where(key_padding_mask, -1e9, 0.0)[:, None, None, :]
+    o = mha_reference(q, k, v, bias, causal=causal, scale=(E // H) ** -0.5)
+    return jnp.transpose(o, (2, 0, 1, 3)).reshape(S, B, E) @ wo
+
+
+def test_self_attn_matches_reference():
+    mod = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, E))
+    params = mod.init(jax.random.PRNGKey(1), x)
+    out = mod.apply(params, x)
+    ref = _ref_self_attn(params, x)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_self_attn_key_padding_mask():
+    mod = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    x = jax.random.normal(jax.random.PRNGKey(2), (S, B, E))
+    params = mod.init(jax.random.PRNGKey(3), x)
+    kpm = np.zeros((B, S), bool)
+    kpm[1, 100:] = True  # mask out tail keys of batch 1
+    kpm = jnp.asarray(kpm)
+    out = mod.apply(params, x, kpm)
+    ref = _ref_self_attn(params, x, key_padding_mask=kpm)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_self_attn_causal():
+    mod = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    x = jax.random.normal(jax.random.PRNGKey(4), (S, B, E))
+    params = mod.init(jax.random.PRNGKey(5), x)
+    out = mod.apply(params, x, causal=True)
+    ref = _ref_self_attn(params, x, causal=True)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_self_attn_norm_add_residual():
+    mod = SelfMultiheadAttn(embed_dim=E, num_heads=H, include_norm_add=True)
+    x = jax.random.normal(jax.random.PRNGKey(6), (S, B, E))
+    params = mod.init(jax.random.PRNGKey(7), x)
+    out = mod.apply(params, x)
+    # zeroing the attention path must leave exactly the residual:
+    # out = attn(LN(x)) + x
+    assert out.shape == x.shape
+    ln = params["params"]
+    assert "lyr_nrm_gamma_weights" in ln
+    # check residual add: subtracting x gives the attn branch on LN(x)
+    mod_plain = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    import flax
+
+    plain_params = flax.core.freeze(
+        {"params": {k: v for k, v in params["params"].items()
+                    if k in ("qkv_proj", "out_proj")}}
+    )
+    from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+    lnx = fused_layer_norm_affine(
+        x, ln["lyr_nrm_gamma_weights"], ln["lyr_nrm_beta_weights"], (E,)
+    )
+    expect = mod_plain.apply(plain_params, lnx) + x
+    np.testing.assert_allclose(out, expect, atol=1e-4, rtol=1e-4)
+
+
+def test_self_attn_dropout_stochastic():
+    mod = SelfMultiheadAttn(embed_dim=E, num_heads=H, dropout=0.5)
+    x = jax.random.normal(jax.random.PRNGKey(8), (S, B, E))
+    params = mod.init(jax.random.PRNGKey(9), x)
+    o1 = mod.apply(params, x, deterministic=False,
+                   rngs={"dropout": jax.random.PRNGKey(10)})
+    o2 = mod.apply(params, x, deterministic=False,
+                   rngs={"dropout": jax.random.PRNGKey(11)})
+    assert not np.allclose(o1, o2)
+    # deterministic mode ignores dropout
+    od = mod.apply(params, x)
+    ref = _ref_self_attn(params, x)
+    np.testing.assert_allclose(od, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_encdec_attn():
+    mod = EncdecMultiheadAttn(embed_dim=E, num_heads=H)
+    q = jax.random.normal(jax.random.PRNGKey(12), (S, B, E))
+    kv = jax.random.normal(jax.random.PRNGKey(13), (S // 2, B, E))
+    params = mod.init(jax.random.PRNGKey(14), q, kv)
+    out = mod.apply(params, q, kv)
+    assert out.shape == (S, B, E)
+
+    wq = params["params"]["q_proj"]["kernel"]
+    wkv = params["params"]["kv_proj"]["kernel"]
+    wo = params["params"]["out_proj"]["kernel"]
+    d = E // H
+    qp = jnp.transpose((q @ wq).reshape(S, B, H, d), (1, 2, 0, 3))
+    kvp = (kv @ wkv).reshape(S // 2, B, 2, H, d)
+    kp, vp = (jnp.transpose(kvp[:, :, i], (1, 2, 0, 3)) for i in range(2))
+    ref = mha_reference(qp, kp, vp, scale=d ** -0.5)
+    ref = jnp.transpose(ref, (2, 0, 1, 3)).reshape(S, B, E) @ wo
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_fmha_varlen_masking():
+    b, s, h, d = 2, 128, 2, 64
+    qkv = jax.random.normal(jax.random.PRNGKey(15), (b, s, 3, h, d))
+    seqlens = jnp.array([128, 80])
+    out = fmha(qkv, seqlens)
+    # batch 0 (full length) must equal the unmasked computation
+    full = fmha(qkv)
+    np.testing.assert_allclose(out[0], full[0], atol=1e-5, rtol=1e-5)
+    # batch 1 rows < 80 must be independent of key positions >= 80
+    qkv_mut = qkv.at[1, 80:].set(123.0)
+    out_mut = fmha(qkv_mut, seqlens)
+    np.testing.assert_allclose(out[1, :80], out_mut[1, :80], atol=1e-5, rtol=1e-5)
+
+
+def test_grads_flow():
+    mod = SelfMultiheadAttn(embed_dim=E, num_heads=H, bias=True)
+    x = jax.random.normal(jax.random.PRNGKey(16), (S, B, E))
+    params = mod.init(jax.random.PRNGKey(17), x)
+
+    def loss(p):
+        return jnp.sum(mod.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+    gnorm = jax.tree_util.tree_reduce(
+        lambda a, l: a + jnp.sum(l ** 2), g, 0.0
+    )
+    assert np.isfinite(gnorm) and gnorm > 0
