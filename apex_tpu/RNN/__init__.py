@@ -1,0 +1,236 @@
+"""Fused RNN cells — ≙ ``apex/RNN/`` (``RNNBackend.py``, ``cells.py``,
+``models.py``; deprecated upstream, kept for capability parity).
+
+The reference fuses the per-timestep cell math into hand kernels; on TPU the
+idiomatic fusion vehicle is ``lax.scan`` — the cell body is traced once,
+XLA fuses the gate math into the two GEMMs, and the scan compiles to a
+single rolled loop (no per-step dispatch, the launch-amortization property
+the reference buys with CUDA).
+
+Models mirror the reference surface: ``RNNReLU``, ``RNNTanh``, ``LSTM``,
+``GRU``, ``mLSTM`` (multiplicative LSTM, models.py :: ``mLSTMRNNCell``).
+Layout is time-first ``(T, B, H)`` like the reference (torch RNN default).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RNNReLU", "RNNTanh", "LSTM", "GRU", "mLSTM"]
+
+
+def _dense(x, w, b=None):
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b
+    return y.astype(x.dtype)
+
+
+class _ScanRNNBase(nn.Module):
+    """Shared scan harness ≙ RNNBackend.py :: forward over time."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    # subclass contract
+    n_gates: int = 1
+
+    def _cell(self, carry, gates_x, layer_params):
+        raise NotImplementedError
+
+    def _init_carry(self, batch):
+        raise NotImplementedError
+
+    def _carry_output(self, carry):
+        raise NotImplementedError
+
+    @nn.compact
+    def __call__(self, x, initial_state=None):
+        """x: (T, B, input_size) → (outputs (T, B, H), final_state)."""
+        h = x.astype(self.dtype)
+        finals = []
+        for layer in range(self.num_layers):
+            din = self.input_size if layer == 0 else self.hidden_size
+            g = self.n_gates * self.hidden_size
+            w_ih = self.param(
+                f"w_ih_{layer}", nn.initializers.lecun_normal(), (din, g)
+            ).astype(self.dtype)
+            w_hh = self.param(
+                f"w_hh_{layer}", nn.initializers.orthogonal(), (self.hidden_size, g)
+            ).astype(self.dtype)
+            b_ih = (
+                self.param(f"b_ih_{layer}", nn.initializers.zeros, (g,)).astype(self.dtype)
+                if self.bias
+                else None
+            )
+            extra = self._layer_params(layer, din)
+            carry = (
+                self._init_carry(h.shape[1])
+                if initial_state is None
+                else jax.tree_util.tree_map(lambda s: s[layer], initial_state)
+            )
+            # Hoist the input GEMM out of the scan: one big (T·B, din)×(din, g)
+            # MXU matmul instead of T small ones.
+            gates_x = _dense(h, w_ih, b_ih)
+
+            def step(carry, gx, _w_hh=w_hh, _extra=extra):
+                carry = self._cell(carry, gx, (_w_hh, _extra))
+                return carry, self._carry_output(carry)
+
+            carry, out = jax.lax.scan(step, carry, gates_x)
+            finals.append(carry)
+            h = out
+        final_state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *finals)
+        return h, final_state
+
+    def _layer_params(self, layer, din):
+        return None
+
+
+class _ElmanBase(_ScanRNNBase):
+    n_gates: int = 1
+    activation: Callable = jax.nn.tanh
+
+    def _init_carry(self, batch):
+        return jnp.zeros((batch, self.hidden_size), self.dtype)
+
+    def _carry_output(self, carry):
+        return carry
+
+    def _cell(self, h, gx, params):
+        w_hh, _ = params
+        return type(self).activation(gx + _dense(h, w_hh))
+
+
+class RNNTanh(_ElmanBase):
+    """≙ apex.RNN.models.RNNTanh."""
+
+    activation: Callable = jax.nn.tanh
+
+
+class RNNReLU(_ElmanBase):
+    """≙ apex.RNN.models.RNNReLU."""
+
+    activation: Callable = jax.nn.relu
+
+
+class LSTM(_ScanRNNBase):
+    """≙ apex.RNN.models.LSTM — gate order (i, f, g, o) like torch."""
+
+    n_gates: int = 4
+
+    def _init_carry(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), self.dtype)
+        return (z, z)
+
+    def _carry_output(self, carry):
+        return carry[0]
+
+    def _cell(self, carry, gx, params):
+        w_hh, _ = params
+        h, c = carry
+        gates = gx + _dense(h, w_hh)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c)
+
+
+class GRU(_ScanRNNBase):
+    """≙ apex.RNN.models.GRU — gate order (r, z, n) like torch."""
+
+    n_gates: int = 3
+
+    def _init_carry(self, batch):
+        return jnp.zeros((batch, self.hidden_size), self.dtype)
+
+    def _carry_output(self, carry):
+        return carry
+
+    def _cell(self, h, gx, params):
+        w_hh, _ = params
+        gh = _dense(h, w_hh)
+        rx, zx, nx = jnp.split(gx, 3, axis=-1)
+        rh, zh, nh = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        return (1.0 - z) * n + z * h
+
+
+class mLSTM(_ScanRNNBase):
+    """Multiplicative LSTM — ≙ apex.RNN.cells :: mLSTMRNNCell.
+
+    ``m = (x·W_mx) ⊙ (h·W_mh)`` replaces ``h`` as the recurrent input to
+    the four LSTM gates.
+    """
+
+    n_gates: int = 4
+
+    def _layer_params(self, layer, din):
+        w_mx = self.param(
+            f"w_mx_{layer}", nn.initializers.lecun_normal(), (din, self.hidden_size)
+        ).astype(self.dtype)
+        w_mh = self.param(
+            f"w_mh_{layer}", nn.initializers.orthogonal(), (self.hidden_size, self.hidden_size)
+        ).astype(self.dtype)
+        return (w_mx, w_mh)
+
+    def _init_carry(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), self.dtype)
+        return (z, z)
+
+    def _carry_output(self, carry):
+        return carry[0]
+
+    @nn.compact
+    def __call__(self, x, initial_state=None):
+        # mLSTM needs the raw x per step (for the multiplicative path), so
+        # the scan carries (x_t, gx_t) pairs.
+        h = x.astype(self.dtype)
+        finals = []
+        for layer in range(self.num_layers):
+            din = self.input_size if layer == 0 else self.hidden_size
+            g = 4 * self.hidden_size
+            w_ih = self.param(
+                f"w_ih_{layer}", nn.initializers.lecun_normal(), (din, g)
+            ).astype(self.dtype)
+            w_hh = self.param(
+                f"w_hh_{layer}", nn.initializers.orthogonal(), (self.hidden_size, g)
+            ).astype(self.dtype)
+            b_ih = (
+                self.param(f"b_ih_{layer}", nn.initializers.zeros, (g,)).astype(self.dtype)
+                if self.bias
+                else None
+            )
+            w_mx, w_mh = self._layer_params(layer, din)
+            carry = (
+                self._init_carry(h.shape[1])
+                if initial_state is None
+                else jax.tree_util.tree_map(lambda s: s[layer], initial_state)
+            )
+            mx = _dense(h, w_mx)  # hoisted input-side GEMMs
+            gx = _dense(h, w_ih, b_ih)
+
+            def step(carry, inputs, _w_hh=w_hh, _w_mh=w_mh):
+                hprev, c = carry
+                mx_t, gx_t = inputs
+                m = mx_t * _dense(hprev, _w_mh)
+                gates = gx_t + _dense(m, _w_hh)
+                i, f, gg, o = jnp.split(gates, 4, axis=-1)
+                c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+                hnew = jax.nn.sigmoid(o) * jnp.tanh(c)
+                return (hnew, c), hnew
+
+            carry, out = jax.lax.scan(step, carry, (mx, gx))
+            finals.append(carry)
+            h = out
+        final_state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *finals)
+        return h, final_state

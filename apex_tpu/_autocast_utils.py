@@ -1,0 +1,32 @@
+"""Autocast helpers — ≙ ``apex/_autocast_utils.py`` :: ``_cast_if_autocast_enabled``.
+
+The reference checks ``torch.is_autocast_enabled()`` and casts extension
+inputs to the autocast dtype so hand kernels compose with native amp.  The
+JAX analog is explicit: ops take a :class:`~apex_tpu.amp.policy.Policy` (or
+a dtype) and cast their floating inputs to its compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+from apex_tpu._tree_util import cast_floats
+
+__all__ = ["_cast_if_autocast_enabled", "cast_inputs"]
+
+
+def cast_inputs(args: Sequence[Any], policy_or_dtype: Optional[Any]):
+    """Cast floating leaves of ``args`` to the policy's compute dtype.
+
+    ``policy_or_dtype`` may be a Policy, a dtype, or None (no-op), making
+    call sites read like the reference's ``_cast_if_autocast_enabled(*args)``.
+    """
+    if policy_or_dtype is None:
+        return tuple(args)
+    dtype = getattr(policy_or_dtype, "compute_dtype", policy_or_dtype)
+    return tuple(cast_floats(a, jnp.dtype(dtype)) for a in args)
+
+
+_cast_if_autocast_enabled = cast_inputs

@@ -32,11 +32,10 @@ def fmha(qkv, seqlens=None, *, causal=False, dropout_p=0.0, dropout_rng=None):
     """≙ ``FMHAFun(qkv, cu_seqlens, ...)`` on a padded batch.
 
     qkv: (B, S, 3, H, D); seqlens: optional (B,) int valid lengths.
-    Returns (B, S, H, D).  Query rows past ``seqlens`` see only masked
-    keys and therefore produce a uniform average of V (softmax over
-    constant masked scores) — garbage rows the caller masks downstream,
-    exactly as the reference's unpadded layout implies for tokens that do
-    not exist.
+    Returns (B, S, H, D).  The bias masks *keys* past ``seqlens``; query
+    rows past ``seqlens`` still attend (over the valid keys only) and
+    yield garbage values the caller masks downstream — exactly as the
+    reference's unpadded layout implies for tokens that do not exist.
     """
     bias = None
     if seqlens is not None:

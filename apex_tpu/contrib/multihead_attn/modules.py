@@ -18,7 +18,7 @@ from apex_tpu.ops.layer_norm import fused_layer_norm_affine
 from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
 
 
-def _padding_bias(key_padding_mask, mask_additive, dtype):
+def _padding_bias(key_padding_mask, mask_additive):
     """(B, Sk) mask → (B, 1, 1, Sk) additive bias.
 
     ``mask_additive=False``: boolean, True = masked (torch convention).
@@ -96,7 +96,7 @@ class SelfMultiheadAttn(nn.Module):
         q, k, v = (jnp.transpose(qkv[:, :, i], (1, 2, 0, 3)) for i in range(3))
 
         bias_ = _merge_attn_mask(
-            _padding_bias(key_padding_mask, self.mask_additive, q.dtype),
+            _padding_bias(key_padding_mask, self.mask_additive),
             attn_mask,
         )
 
@@ -161,7 +161,7 @@ class EncdecMultiheadAttn(nn.Module):
         k, v = (jnp.transpose(kv[:, :, i], (1, 2, 0, 3)) for i in range(2))
 
         bias_ = _merge_attn_mask(
-            _padding_bias(key_padding_mask, self.mask_additive, q.dtype),
+            _padding_bias(key_padding_mask, self.mask_additive),
             attn_mask,
         )
 

@@ -1,0 +1,86 @@
+"""Fused MLP — ≙ ``apex/mlp/mlp.py`` :: ``MLP`` / ``MlpFunction``.
+
+The reference chains cuBLAS GEMMs with hand-fused bias+ReLU/sigmoid epilogues
+(``csrc/mlp.cpp`` :: ``mlp_forward_cuda``/``mlp_backward_cuda``) and manages
+its own workspace.  On TPU the whole chain — GEMM, bias add, activation —
+is a single XLA fusion cluster landing on the MXU; the module below is the
+API-parity surface, and :func:`mlp_function` is the functional core
+(≙ ``MlpFunction.apply``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MLP", "mlp_function"]
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_function(
+    x: jax.Array,
+    weights: Sequence[jax.Array],
+    biases: Sequence[jax.Array],
+    activation: str = "relu",
+) -> jax.Array:
+    """(…, in) → (…, out) through len(weights) fused GEMM+bias+act stages.
+
+    Weights use the JAX layout ``(in, out)``; the activation is applied
+    after every layer *except the last* (reference semantics: ``MLP``
+    applies the nonlinearity between layers only).
+    """
+    if activation not in _ACTIVATIONS:
+        raise ValueError(f"activation must be one of {sorted(_ACTIVATIONS)}")
+    act = _ACTIVATIONS[activation]
+    h = x
+    last = len(weights) - 1
+    for i, w in enumerate(weights):
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32)
+        if biases and biases[i] is not None:
+            h = h + biases[i]
+        h = h.astype(x.dtype)
+        if i != last:
+            h = act(h)
+    return h
+
+
+class MLP(nn.Module):
+    """≙ apex.mlp.MLP(mlp_sizes, bias=True, activation='relu').
+
+    ``mlp_sizes`` lists every layer width *including* the input width,
+    exactly like the reference ctor.
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if len(self.mlp_sizes) < 2:
+            raise ValueError("mlp_sizes needs at least (in, out)")
+        if x.shape[-1] != self.mlp_sizes[0]:
+            raise ValueError(
+                f"input width {x.shape[-1]} != mlp_sizes[0]={self.mlp_sizes[0]}"
+            )
+        weights, biases = [], []
+        for i, (din, dout) in enumerate(zip(self.mlp_sizes[:-1], self.mlp_sizes[1:])):
+            weights.append(
+                self.param(f"kernel_{i}", self.kernel_init, (din, dout)).astype(self.dtype)
+            )
+            biases.append(
+                self.param(f"bias_{i}", nn.initializers.zeros, (dout,)).astype(self.dtype)
+                if self.bias
+                else None
+            )
+        return mlp_function(x.astype(self.dtype), weights, biases, self.activation)

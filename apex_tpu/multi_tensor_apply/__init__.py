@@ -1,0 +1,95 @@
+"""multi_tensor_apply — launch-amortization shim, TPU-native.
+
+≙ ``apex/multi_tensor_apply/multi_tensor_apply.py`` :: ``MultiTensorApply``
+and the global ``multi_tensor_applier`` instance, plus the ``apex_C``
+flatten/unflatten pair (``csrc/flatten_unflatten.cpp``).
+
+On GPU the point of ``multi_tensor_apply<depth>`` (csrc/multi_tensor_apply.cuh)
+is to pack pointers of many tensors into one kernel launch.  Under ``jit``
+a whole-pytree update already compiles to one XLA program, so the launch
+count is O(1) by construction; this module keeps the *interface* so code
+written against the reference's applier ports mechanically:
+
+    multi_tensor_applier(op, noop_flag_unused, tensor_lists, *args)
+
+``op`` here is any callable taking ``(*tensor_lists, *args)`` and returning
+updated lists; the ``chunk_size`` / overflow-buffer machinery is accepted and
+ignored (overflow detection lives in
+:func:`apex_tpu.optimizers.multi_tensor.scale_with_overflow_check`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.multi_tensor import (  # noqa: F401  (re-export)
+    axpby,
+    global_norm,
+    per_tensor_norm,
+    scale_with_overflow_check,
+)
+
+__all__ = [
+    "MultiTensorApply",
+    "multi_tensor_applier",
+    "flatten",
+    "unflatten",
+    "global_norm",
+    "per_tensor_norm",
+    "scale_with_overflow_check",
+    "axpby",
+]
+
+
+class MultiTensorApply:
+    """Callable shim ≙ MultiTensorApply.
+
+    ``chunk_size`` is stored for API parity only — XLA tiles loops itself.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists: Sequence[List[Any]], *args):
+        return op(*tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply()
+
+
+def flatten(tensors: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate a tensor list into one flat 1-D buffer.
+
+    ≙ ``apex_C.flatten`` (csrc/flatten_unflatten.cpp) — the DDP flat-bucket
+    primitive.  All inputs must share a dtype (as torch's
+    ``flatten_dense_tensors`` requires).
+    """
+    if not tensors:
+        return jnp.zeros((0,), jnp.float32)
+    dtypes = {jnp.dtype(t.dtype) for t in tensors}
+    if len(dtypes) != 1:
+        raise ValueError(f"flatten requires a uniform dtype, got {sorted(map(str, dtypes))}")
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
+    """Split a flat buffer back into views shaped like ``like``.
+
+    ≙ ``apex_C.unflatten``.
+    """
+    sizes = [int(t.size) for t in like]
+    total = sum(sizes)
+    if flat.size != total:
+        raise ValueError(f"flat buffer has {flat.size} elements, need {total}")
+    out = []
+    offset = 0
+    for t, n in zip(like, sizes):
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, n, 0).reshape(t.shape))
+        offset += n
+    return out

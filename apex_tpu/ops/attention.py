@@ -148,8 +148,15 @@ def flash_attention(
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if bias is not None and bias.ndim < 4:
-        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    if bias is not None:
+        if bias.ndim < 4:
+            bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        # Clamp the torch-convention -inf additive mask to the finite
+        # MASK_VALUE *before* dispatch, so the Pallas kernel (whose online
+        # softmax would NaN on a fully--inf block) and the jnp fallback
+        # (whose softmax would NaN on a fully--inf row) share semantics:
+        # a fully-masked row yields a uniform average of V on both paths.
+        bias = jnp.maximum(bias, _pallas.MASK_VALUE)
     if (bias is not None and bias_grad) or not _pallas_eligible(
         q, k, v, dropout_p
     ):
@@ -166,12 +173,19 @@ def flash_attention(
     if bias is not None:
         sk = k.shape[-2]
         bb, bh_, bsq, bsk = bias.shape
-        if (bsq, bsk) != (sq, sk):
-            bias = jnp.broadcast_to(bias, (bb, bh_, sq, sk))
-        if bb == 1 and bh_ == 1:
-            bias_f = bias.reshape(1, sq, sk)
+        if bsk != sk:
+            bias = jnp.broadcast_to(bias, (bb, bh_, bsq, sk))
+        # (G, RS, Sk) layout for the kernel (see pallas.flash_attention):
+        # a head-independent bias keeps G = bb (∈ {1, B}) and a
+        # query-independent (key-padding) bias keeps RS = 1, so the common
+        # (B, 1, 1, Sk) padding mask never materializes a (Sq, Sk) matrix
+        # — the kernel's index map folds b//(BH/G) and broadcasts the row.
+        if bh_ == 1:
+            bias_f = bias.reshape(bb, bsq, sk)
         else:
-            bias_f = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(b * h, sq, sk)
+            bias_f = jnp.broadcast_to(bias, (b, h, bsq, sk)).reshape(
+                b * h, bsq, sk
+            )
         # The flash VJP returns a zero cotangent for bias (it is the
         # reference's non-trainable mask); stop_gradient makes that
         # explicit so a trainable bias reaching this path fails loudly in

@@ -16,8 +16,11 @@ parallelism (apex_tpu.transformer.context_parallel).
 
 Layout: q (BH, Sq, D), k/v (BH, Sk, D) with batch*heads pre-flattened and D
 pre-padded to a lane multiple (128) by the caller (apex_tpu.ops.attention).
-Bias, when present, is (BHb, Sq, Sk) with BHb ∈ {1, BH} — additive, applied
-after scaling, the same semantics as the reference's additive mask path
+Bias, when present, is (G, RS, Sk) with G ∈ {1, B, BH} (BH % G == 0; the
+index map folds the flattened batch-head index as b // (BH/G)) and
+RS ∈ {1, Sq} — RS = 1 is the key-padding case, kept as a single row per
+batch so the (Sq, Sk) mask matrix is never materialized in HBM.  Additive,
+applied after scaling, same semantics as the reference's additive mask path
 (``apex/contrib/multihead_attn`` ``mask_additive`` mode).
 """
 
@@ -37,6 +40,28 @@ from apex_tpu.ops._dispatch import pallas_interpret
 MASK_VALUE = -1e9
 
 _LANES = 128
+
+
+def _bias_spec(bias, bh, bq, bk, order):
+    """BlockSpec for a (G, RS, Sk) bias (module docstring's layout).
+
+    ``order`` is the grid layout: "ij" = (b, qblock, kblock) grids
+    (forward, dq), "ji" = (b, kblock, qblock) (dk/dv).
+    """
+    g, rs, _ = bias.shape
+    if bh % g:
+        raise ValueError(f"bias batch group {g} must divide BH={bh}")
+    div = bh // g
+    rb = bq if rs != 1 else 1
+    if order == "ji":
+        return pl.BlockSpec(
+            (1, rb, bk),
+            lambda b, j, i, _d=div, _rb=rb: (b // _d, i if _rb != 1 else 0, j),
+        )
+    return pl.BlockSpec(
+        (1, rb, bk),
+        lambda b, i, j, _d=div, _rb=rb: (b // _d, i if _rb != 1 else 0, j),
+    )
 
 
 def _causal_mask_block(i, j, bq, bk, offset):
@@ -73,7 +98,12 @@ def _fwd_kernel(
     )
     s = s * scale
     if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
+        # Defense-in-depth clamp (the public API pre-clamps): a -inf bias
+        # would pin m_new at -inf and alpha = exp(-inf - -inf) = NaN would
+        # poison the whole row.  Clamped, the finite-MASK_VALUE invariant
+        # below holds for direct flash_fwd callers too.  bias_ref[0] is
+        # (bq, bk) or (1, bk) (key-padding row); broadcasting covers both.
+        s = s + jnp.maximum(bias_ref[0].astype(jnp.float32), MASK_VALUE)
     if causal:
         i = pl.program_id(1)
         s = jnp.where(_causal_mask_block(i, j, bq, bk, offset), s, MASK_VALUE)
@@ -131,13 +161,7 @@ def flash_fwd(q, k, v, bias, *, scale, causal, block_q=128, block_k=128):
     ]
     args = [q, k, v]
     if bias is not None:
-        bias_b = bias.shape[0]
-        in_specs.append(
-            pl.BlockSpec(
-                (1, bq, bk),
-                lambda b, i, j, bb=bias_b: (0 if bb == 1 else b, i, j),
-            )
-        )
+        in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
         kernel = functools.partial(
             _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
@@ -187,7 +211,9 @@ def _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset):
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     if bias_blk is not None:
-        s = s + bias_blk
+        # Same -inf clamp as the forward kernel, so the recomputed p
+        # matches it bit-for-bit.
+        s = s + jnp.maximum(bias_blk, MASK_VALUE)
     if causal:
         mask = _causal_mask_block(i, j, bq, bk, offset)
         s = jnp.where(mask, s, MASK_VALUE)
@@ -301,21 +327,11 @@ def flash_bwd(
     row_spec_i = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0))
     common = [q, k, v, do, lse, delta]
 
-    def _bias_spec(order):
-        bias_b = bias.shape[0]
-        if order == "ji":
-            return pl.BlockSpec(
-                (1, bq, bk), lambda b, j, i, bb=bias_b: (0 if bb == 1 else b, i, j)
-            )
-        return pl.BlockSpec(
-            (1, bq, bk), lambda b, i, j, bb=bias_b: (0 if bb == 1 else b, i, j)
-        )
-
     # --- dk/dv: grid (BH, nk, nq), q innermost ---
     in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, row_spec_i, row_spec_i]
     args = list(common)
     if bias is not None:
-        in_specs.append(_bias_spec("ji"))
+        in_specs.append(_bias_spec(bias, bh, bq, bk, "ji"))
         args.append(bias)
         dkdv_kernel = functools.partial(
             _dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
@@ -355,7 +371,7 @@ def flash_bwd(
     in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
     args = list(common)
     if bias is not None:
-        in_specs.append(_bias_spec("ij"))
+        in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
         dq_kernel = functools.partial(
             _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,

@@ -1,0 +1,102 @@
+"""Weight-norm reparameterization — ≙ ``apex/reparameterization/``
+(``weight_norm.py`` :: ``WeightNorm``, ``reparameterization.py`` ::
+``Reparameterization.apply``).
+
+The reference mutates torch modules in place, splitting ``weight`` into
+``weight_g`` (norm) and ``weight_v`` (direction) and recomputing
+``weight = g · v/‖v‖`` in a pre-forward hook.  Flax modules are immutable,
+so the TPU-native shape is (a) a wrapper module :class:`WeightNorm` that
+owns ``g``/``v`` params around any child, and (b) the pure param-tree
+transforms :func:`apply_weight_norm` / :func:`remove_weight_norm` that
+split/merge an existing checkpoint the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["WeightNorm", "apply_weight_norm", "remove_weight_norm", "compute_weight"]
+
+
+def _norm_keepdims(v: jax.Array, dim: Optional[int]) -> jax.Array:
+    """‖v‖₂ reduced over every axis except ``dim`` (torch _norm semantics)."""
+    v32 = v.astype(jnp.float32)
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v32)))
+    axes = tuple(a for a in range(v.ndim) if a != (dim % v.ndim))
+    return jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes, keepdims=True))
+
+
+def compute_weight(g: jax.Array, v: jax.Array, dim: Optional[int] = 0) -> jax.Array:
+    """``w = g · v/‖v‖`` — ≙ Reparameterization.compute_weight."""
+    return (g.astype(jnp.float32) * v.astype(jnp.float32) / _norm_keepdims(v, dim)).astype(
+        v.dtype
+    )
+
+
+def apply_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = 0) -> Any:
+    """Split every ``name`` leaf in a param tree into ``name_g``/``name_v``.
+
+    ≙ apply_weight_norm(module, name, dim) — checkpoint-level, not
+    module-level: feed the result to a model whose layers were wrapped in
+    :class:`WeightNorm`, or recombine with :func:`remove_weight_norm`.
+    """
+    if isinstance(params, dict):
+        out = {}
+        for k, sub in params.items():
+            if k == name and isinstance(sub, jax.Array):
+                out[f"{name}_g"] = _norm_keepdims(sub, dim).astype(sub.dtype)
+                out[f"{name}_v"] = sub
+            else:
+                out[k] = apply_weight_norm(sub, name, dim)
+        return out
+    return params
+
+
+def remove_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = 0) -> Any:
+    """Inverse of :func:`apply_weight_norm` — ≙ remove_weight_norm."""
+    if isinstance(params, dict):
+        out = {}
+        keys = set(params)
+        for k, sub in params.items():
+            if k == f"{name}_v" and f"{name}_g" in keys:
+                out[name] = compute_weight(params[f"{name}_g"], sub, dim)
+            elif k == f"{name}_g" and f"{name}_v" in keys:
+                continue
+            else:
+                out[k] = remove_weight_norm(sub, name, dim)
+        return out
+    return params
+
+
+class WeightNorm(nn.Module):
+    """Wrapper module computing ``w = g·v/‖v‖`` for a child's kernels.
+
+    Usage::
+
+        WeightNorm(nn.Dense(features=64))
+
+    Thin shim over :class:`flax.linen.WeightNorm` (same math as the
+    reference's pre-forward hook, applied functionally).  ``dim`` follows
+    torch semantics — the axis kept per-unit; flax Dense kernels are
+    ``(in, out)`` so the default ``dim=-1`` matches torch Linear's
+    ``dim=0`` over its ``(out, in)`` weights.
+    """
+
+    layer: nn.Module
+    dim: Optional[int] = -1
+    epsilon: float = 1e-12
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        inner = nn.WeightNorm(
+            self.layer,
+            epsilon=self.epsilon,
+            use_scale=True,
+            feature_axes=None if self.dim is None else self.dim,
+        )
+        return inner(*args, **kwargs)

@@ -132,3 +132,60 @@ def test_odd_seq_uses_reference_path():
     out = flash_attention(q, k, v)
     ref = mha_reference(q, k, v)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_neg_inf_bias_first_block_fully_masked(force_pallas):
+    """-inf additive bias (torch convention) on a whole leading key block.
+
+    Regression: with the first 128-key block fully masked at -inf, the
+    online softmax's running max stayed -inf and alpha = exp(-inf - -inf)
+    poisoned the row with NaN.  The kernel clamps bias to MASK_VALUE.
+    """
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), sq=256, sk=256)
+    bias = np.zeros((2, 1, 1, 256), np.float32)
+    bias[:, :, :, :128] = -np.inf  # left padding: whole first k-block masked
+    bias = jnp.asarray(np.broadcast_to(bias, (2, 1, 256, 256)))
+    out = flash_attention(q, k, v, bias)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    ref = mha_reference(q, k, v, jnp.maximum(bias, -1e9))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    # gradients stay finite too (bwd recompute uses the same clamp)
+    g = jax.grad(lambda q_: jnp.sum(flash_attention(q_, k, v, bias) ** 2))(q)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_neg_inf_bias_fallback_path_matches():
+    """The jnp fallback (non-tile-friendly S) must share the clamp
+    semantics: same -inf mask, S=120 routes to mha_reference internally."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), sq=120, sk=120)
+    bias = np.zeros((2, 1, 1, 120), np.float32)
+    bias[1, :, :, :60] = -np.inf
+    bias = jnp.asarray(bias)
+    out = flash_attention(q, k, v, bias)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_key_padding_bias_not_materialized(force_pallas):
+    """(B, 1, 1, Sk) key-padding bias stays a single row per batch on the
+    Pallas path (G=B, RS=1) — and matches the reference numerics."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(9), b=3, h=4, sq=256, sk=256)
+    bias = np.zeros((3, 1, 1, 256), np.float32)
+    bias[0, :, :, 200:] = -1e9
+    bias[2, :, :, 100:] = -1e9
+    bias = jnp.asarray(bias)
+    out = flash_attention(q, k, v, bias)
+    ref = mha_reference(q, k, v, bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda q_: jnp.sum(flash_attention(q_, k, v, bias) ** 2))(q)
+    gr = jax.grad(lambda q_: jnp.sum(mha_reference(q_, k, v, bias) ** 2))(q)
+    np.testing.assert_allclose(gf, gr, atol=5e-4, rtol=1e-3)
+
+
+def test_per_batch_full_bias_grouped(force_pallas):
+    """(B, 1, Sq, Sk) bias uses the grouped index map (G=B) — no H-fold."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(10), b=2, h=3, sq=128, sk=128)
+    bias = jax.random.normal(jax.random.PRNGKey(11), (2, 1, 128, 128))
+    out = flash_attention(q, k, v, bias)
+    ref = mha_reference(q, k, v, bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
