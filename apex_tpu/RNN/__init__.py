@@ -14,7 +14,7 @@ Layout is time-first ``(T, B, H)`` like the reference (torch RNN default).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable
 
 import flax.linen as nn
 import jax
@@ -31,7 +31,14 @@ def _dense(x, w, b=None):
 
 
 class _ScanRNNBase(nn.Module):
-    """Shared scan harness ≙ RNNBackend.py :: forward over time."""
+    """Shared scan harness ≙ RNNBackend.py :: forward over time.
+
+    Subclass contract: ``n_gates``, ``_cell(carry, scan_inputs, params)``,
+    ``_init_carry(batch)``, ``_carry_output(carry)``; optionally
+    ``_layer_params`` (extra per-layer weights) and ``_scan_inputs``
+    (what gets fed to the scan per step — default: the hoisted input GEMM,
+    one big (T·B, din)×(din, gates) MXU matmul instead of T small ones).
+    """
 
     input_size: int
     hidden_size: int
@@ -42,7 +49,7 @@ class _ScanRNNBase(nn.Module):
     # subclass contract
     n_gates: int = 1
 
-    def _cell(self, carry, gates_x, layer_params):
+    def _cell(self, carry, scan_inputs, layer_params):
         raise NotImplementedError
 
     def _init_carry(self, batch):
@@ -50,6 +57,12 @@ class _ScanRNNBase(nn.Module):
 
     def _carry_output(self, carry):
         raise NotImplementedError
+
+    def _layer_params(self, layer, din):
+        return None
+
+    def _scan_inputs(self, h, w_ih, b_ih, extra):
+        return _dense(h, w_ih, b_ih)
 
     @nn.compact
     def __call__(self, x, initial_state=None):
@@ -76,22 +89,17 @@ class _ScanRNNBase(nn.Module):
                 if initial_state is None
                 else jax.tree_util.tree_map(lambda s: s[layer], initial_state)
             )
-            # Hoist the input GEMM out of the scan: one big (T·B, din)×(din, g)
-            # MXU matmul instead of T small ones.
-            gates_x = _dense(h, w_ih, b_ih)
+            xs = self._scan_inputs(h, w_ih, b_ih, extra)
 
-            def step(carry, gx, _w_hh=w_hh, _extra=extra):
-                carry = self._cell(carry, gx, (_w_hh, _extra))
+            def step(carry, inp, _w_hh=w_hh, _extra=extra):
+                carry = self._cell(carry, inp, (_w_hh, _extra))
                 return carry, self._carry_output(carry)
 
-            carry, out = jax.lax.scan(step, carry, gates_x)
+            carry, out = jax.lax.scan(step, carry, xs)
             finals.append(carry)
             h = out
-        final_state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *finals)
+        final_state = jax.tree_util.tree_map(lambda *xs_: jnp.stack(xs_), *finals)
         return h, final_state
-
-    def _layer_params(self, layer, din):
-        return None
 
 
 class _ElmanBase(_ScanRNNBase):
@@ -106,7 +114,7 @@ class _ElmanBase(_ScanRNNBase):
 
     def _cell(self, h, gx, params):
         w_hh, _ = params
-        return type(self).activation(gx + _dense(h, w_hh))
+        return self.activation(gx + _dense(h, w_hh))
 
 
 class RNNTanh(_ElmanBase):
@@ -169,7 +177,8 @@ class mLSTM(_ScanRNNBase):
     """Multiplicative LSTM — ≙ apex.RNN.cells :: mLSTMRNNCell.
 
     ``m = (x·W_mx) ⊙ (h·W_mh)`` replaces ``h`` as the recurrent input to
-    the four LSTM gates.
+    the four LSTM gates; the scan consumes (mx_t, gates_x_t) pairs (both
+    input-side GEMMs hoisted out of the loop).
     """
 
     n_gates: int = 4
@@ -183,6 +192,10 @@ class mLSTM(_ScanRNNBase):
         ).astype(self.dtype)
         return (w_mx, w_mh)
 
+    def _scan_inputs(self, h, w_ih, b_ih, extra):
+        w_mx, _ = extra
+        return (_dense(h, w_mx), _dense(h, w_ih, b_ih))
+
     def _init_carry(self, batch):
         z = jnp.zeros((batch, self.hidden_size), self.dtype)
         return (z, z)
@@ -190,47 +203,13 @@ class mLSTM(_ScanRNNBase):
     def _carry_output(self, carry):
         return carry[0]
 
-    @nn.compact
-    def __call__(self, x, initial_state=None):
-        # mLSTM needs the raw x per step (for the multiplicative path), so
-        # the scan carries (x_t, gx_t) pairs.
-        h = x.astype(self.dtype)
-        finals = []
-        for layer in range(self.num_layers):
-            din = self.input_size if layer == 0 else self.hidden_size
-            g = 4 * self.hidden_size
-            w_ih = self.param(
-                f"w_ih_{layer}", nn.initializers.lecun_normal(), (din, g)
-            ).astype(self.dtype)
-            w_hh = self.param(
-                f"w_hh_{layer}", nn.initializers.orthogonal(), (self.hidden_size, g)
-            ).astype(self.dtype)
-            b_ih = (
-                self.param(f"b_ih_{layer}", nn.initializers.zeros, (g,)).astype(self.dtype)
-                if self.bias
-                else None
-            )
-            w_mx, w_mh = self._layer_params(layer, din)
-            carry = (
-                self._init_carry(h.shape[1])
-                if initial_state is None
-                else jax.tree_util.tree_map(lambda s: s[layer], initial_state)
-            )
-            mx = _dense(h, w_mx)  # hoisted input-side GEMMs
-            gx = _dense(h, w_ih, b_ih)
-
-            def step(carry, inputs, _w_hh=w_hh, _w_mh=w_mh):
-                hprev, c = carry
-                mx_t, gx_t = inputs
-                m = mx_t * _dense(hprev, _w_mh)
-                gates = gx_t + _dense(m, _w_hh)
-                i, f, gg, o = jnp.split(gates, 4, axis=-1)
-                c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
-                hnew = jax.nn.sigmoid(o) * jnp.tanh(c)
-                return (hnew, c), hnew
-
-            carry, out = jax.lax.scan(step, carry, (mx, gx))
-            finals.append(carry)
-            h = out
-        final_state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *finals)
-        return h, final_state
+    def _cell(self, carry, scan_inputs, params):
+        w_hh, (_, w_mh) = params
+        h, c = carry
+        mx_t, gx_t = scan_inputs
+        m = mx_t * _dense(h, w_mh)
+        gates = gx_t + _dense(m, w_hh)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c)

@@ -20,8 +20,7 @@ __all__ = ["_cast_if_autocast_enabled", "cast_inputs"]
 def cast_inputs(args: Sequence[Any], policy_or_dtype: Optional[Any]):
     """Cast floating leaves of ``args`` to the policy's compute dtype.
 
-    ``policy_or_dtype`` may be a Policy, a dtype, or None (no-op), making
-    call sites read like the reference's ``_cast_if_autocast_enabled(*args)``.
+    ``policy_or_dtype`` may be a Policy, a dtype, or None (no-op).
     """
     if policy_or_dtype is None:
         return tuple(args)
@@ -29,4 +28,9 @@ def cast_inputs(args: Sequence[Any], policy_or_dtype: Optional[Any]):
     return tuple(cast_floats(a, jnp.dtype(dtype)) for a in args)
 
 
-_cast_if_autocast_enabled = cast_inputs
+def _cast_if_autocast_enabled(*args, policy=None):
+    """Varargs form matching the reference's call shape
+    (``_cast_if_autocast_enabled(x, y, ...)``).  With no ``policy`` this is
+    the "autocast disabled" no-op; pass ``policy=`` (a Policy or dtype) for
+    the enabled behavior."""
+    return cast_inputs(args, policy)

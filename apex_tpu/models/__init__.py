@@ -1,0 +1,28 @@
+"""Reference models for the benchmark configs (BASELINE.md).
+
+- :mod:`apex_tpu.models.bert` — BERT-Large pretrain (north star, config #3)
+- :mod:`apex_tpu.models.gpt` — tensor-parallel GPT (config #5)
+- :mod:`apex_tpu.models.resnet` — ResNet-50 amp / DDP+SyncBN (configs #1, #2)
+"""
+
+from apex_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertEncoderCore,
+    BertForPreTraining,
+    BertLayer,
+    BertModel,
+    bert_large_config,
+    bert_pretrain_loss,
+)
+from apex_tpu.models.gpt import (  # noqa: F401
+    GptBlock,
+    GptConfig,
+    GptModel,
+    gpt_lm_loss,
+)
+from apex_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNetConfig,
+    resnet50,
+    resnet50_config,
+)

@@ -1,0 +1,393 @@
+"""BERT — the north-star benchmark model (BASELINE config #3).
+
+A Megatron-style BERT encoder built entirely from apex_tpu components, so
+the benchmark exercises the framework end to end:
+
+- embeddings: :class:`~apex_tpu.transformer.tensor_parallel.VocabParallelEmbedding`
+  (vocab row-sharded over tp) + learned position/type embeddings,
+- attention: Column/RowParallelLinear QKV/out projections around the Pallas
+  flash-attention kernel (heads sharded over tp),
+- MLP: the canonical Column(4H, gather=False) → GELU → Row(H) pair,
+- norms: fused LayerNorm (Pallas), post-LN like original BERT,
+- loss: vocab-parallel softmax cross-entropy (no logits gather).
+
+Reference analogs: ``apex/transformer/testing/standalone_bert.py`` (the
+reference's in-repo BERT fixture) and the Megatron BERT recipe its tensor/
+pipeline layers were built for (SURVEY §2.3, §6).
+
+Layout is Megatron's seq-first ``(S, B, H)`` so Megatron sequence
+parallelism (activations sharded along S between TP regions) composes: with
+``sequence_parallel=True`` every hidden tensor entering/leaving a layer is
+the local ``(S/tp, B, H)`` shard and the Column/Row layers all-gather /
+reduce-scatter at the boundaries (SURVEY §3.4).
+
+Weight tying: the MLM decoder reuses the word-embedding matrix.  Modules
+stay functional — tying happens in :func:`bert_pretrain_loss`, which reads
+the embedding shard out of the param tree (≙ Megatron sharing
+``word_embeddings.weight`` with the output layer through the embedding
+group).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import _tp_world, sharded_init
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    gather_from_sequence_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = [
+    "BertConfig",
+    "BertLayer",
+    "BertEncoderCore",
+    "BertModel",
+    "BertForPreTraining",
+    "bert_pretrain_loss",
+    "bert_large_config",
+]
+
+_TP = ps.TENSOR_PARALLEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    # compute dtype (params stay f32 — the grad-accum-fusion analog: wgrad
+    # cotangents land in f32 because params are f32; see tensor_parallel
+    # module docs)
+    dtype: Any = jnp.bfloat16
+    sequence_parallel: bool = False
+    remat: bool = False  # jax.checkpoint each layer (activation ckpt analog)
+
+
+def bert_large_config(**overrides) -> BertConfig:
+    """BERT-Large (≈336M params), the BASELINE.json north-star shape."""
+    return BertConfig(**overrides)
+
+
+class _LayerNorm(nn.Module):
+    size: int
+    eps: float
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("scale", nn.initializers.ones, (self.size,))
+        b = self.param("bias", nn.initializers.zeros, (self.size,))
+        return fused_layer_norm_affine(x, w, b, (self.size,), eps=self.eps)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias=None, *, deterministic=True):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        world = _tp_world(_TP)
+        heads_local = divide(cfg.num_heads, world)
+        head_dim = divide(h, cfg.num_heads)
+
+        qkv = ColumnParallelLinear(
+            h, 3 * h, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="qkv",
+        )(x)
+        s = qkv.shape[0]  # full sequence after the SP gather inside Column
+        b = qkv.shape[1]
+        # Global QKV column layout is (heads, 3, head_dim) — per-head
+        # interleaved, the Megatron convention — so column-sharding the
+        # output dim over tp hands each rank whole (q, k, v) triples for
+        # its heads and the math is tp-invariant.  (A (3, heads, d) layout
+        # would shard into "rank 0 owns q of all heads", breaking tp>1.)
+        qkv = qkv.reshape(s, b, heads_local, 3, head_dim)
+        q, k, v = (
+            jnp.transpose(qkv[:, :, :, i], (1, 2, 0, 3)) for i in range(3)
+        )
+        p = 0.0 if deterministic else cfg.attention_dropout
+        rng = self.make_rng("dropout") if p > 0.0 else None
+        ctx = flash_attention(
+            q, k, v, attention_bias, scale=head_dim**-0.5,
+            dropout_p=p, dropout_rng=rng,
+        )
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, heads_local * head_dim)
+        return RowParallelLinear(
+            h, h, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="out",
+        )(ctx)
+
+
+class BertMlp(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        y = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="fc1",
+        )(x)
+        y = jax.nn.gelu(y, approximate=True)
+        return RowParallelLinear(
+            cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="fc2",
+        )(y)
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer block (original BERT residual order)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias=None, *, deterministic=True):
+        cfg = self.cfg
+        attn = BertSelfAttention(cfg, name="attention")(
+            x, attention_bias, deterministic=deterministic
+        )
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=False)
+        x = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln_attn")(
+            x + attn
+        )
+        mlp = BertMlp(cfg, name="mlp")(x)
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            mlp = nn.Dropout(cfg.hidden_dropout)(mlp, deterministic=False)
+        return _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln_mlp")(
+            x + mlp
+        )
+
+
+class _BlockStep(nn.Module):
+    """One scan step: carry = hidden states; bias broadcast to all steps."""
+
+    cfg: BertConfig
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, x, attention_bias):
+        y = BertLayer(self.cfg, name="layer")(
+            x, attention_bias, deterministic=self.deterministic
+        )
+        return y, None
+
+
+class BertEncoderCore(nn.Module):
+    """A homogeneous stack of ``num_layers`` BertLayers.
+
+    Scanned over the layer dim (params stacked ``(L, ...)``) so 24 layers
+    trace once — XLA sees a rolled loop, keeping compile time flat in depth.
+    Also the pipeline-stage module: a pp stage is a BertEncoderCore with
+    ``num_layers = L/pp`` (homogeneous stages, the Megatron layout).
+    """
+
+    cfg: BertConfig
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, x, attention_bias=None, *, deterministic=True):
+        step = _BlockStep
+        if self.cfg.remat:
+            # activation checkpointing per layer ≙ tensor_parallel.random
+            # .checkpoint (recompute-in-backward; PRNG replay is automatic
+            # in JAX — keys are values, not stateful generators)
+            step = nn.remat(step, prevent_cse=False)
+        scanned = nn.scan(
+            step,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=self.num_layers,
+            in_axes=nn.broadcast,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        y, _ = scanned(self.cfg, deterministic, name="layers")(
+            x, attention_bias
+        )
+        return y
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, *, deterministic=True):
+        cfg = self.cfg
+        s, b = input_ids.shape  # seq-first (S, B)
+        word = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            sequence_parallel_enabled=False,  # LN below needs full rows first
+            dtype=cfg.dtype, name="word_embeddings",
+        )(input_ids)
+        pos_tab = self.param(
+            "position_embeddings",
+            nn.initializers.normal(stddev=0.02),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+        )
+        word = word + pos_tab[:s, None, :].astype(cfg.dtype)
+        if cfg.type_vocab_size:
+            tt = (
+                jnp.zeros_like(input_ids)
+                if token_type_ids is None
+                else token_type_ids
+            )
+            type_tab = self.param(
+                "token_type_embeddings",
+                nn.initializers.normal(stddev=0.02),
+                (cfg.type_vocab_size, cfg.hidden_size),
+            )
+            word = word + jnp.take(type_tab, tt, axis=0).astype(cfg.dtype)
+        out = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln")(word)
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            out = nn.Dropout(cfg.hidden_dropout)(out, deterministic=False)
+        if cfg.sequence_parallel:
+            # enter the SP regime: shard the sequence dim across tp
+            world = _tp_world(_TP)
+            if world > 1:
+                rank = jax.lax.axis_index(_TP)
+                chunk = out.shape[0] // world
+                out = jax.lax.dynamic_slice_in_dim(out, rank * chunk, chunk, 0)
+        return out
+
+
+class BertModel(nn.Module):
+    """Embeddings + encoder.  Returns (S[, /tp], B, H) sequence output."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self, input_ids, token_type_ids=None, attention_mask=None,
+        *, deterministic=True,
+    ):
+        cfg = self.cfg
+        bias = None
+        if attention_mask is not None:
+            # (B, S) with 1 = keep (BERT convention) → additive (B,1,1,S)
+            bias = jnp.where(
+                attention_mask.astype(bool), 0.0, -1e9
+            )[:, None, None, :].astype(jnp.float32)
+        x = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, deterministic=deterministic
+        )
+        return BertEncoderCore(cfg, cfg.num_layers, name="encoder")(
+            x, bias, deterministic=deterministic
+        )
+
+
+class BertForPreTraining(nn.Module):
+    """BERT + MLM transform + NSP pooler (heads' logits are computed in
+    :func:`bert_pretrain_loss` so the MLM decoder can tie to the embedding).
+    Returns ``(mlm_hidden, nsp_logits)``.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self, input_ids, token_type_ids=None, attention_mask=None,
+        *, deterministic=True,
+    ):
+        cfg = self.cfg
+        seq = BertModel(cfg, name="bert")(
+            input_ids, token_type_ids, attention_mask,
+            deterministic=deterministic,
+        )
+        if cfg.sequence_parallel and _tp_world(_TP) > 1:
+            seq = gather_from_sequence_parallel_region(seq)
+        # MLM transform: dense + GELU + LN (the BERT "cls/predictions"
+        # transform), kept replicated (H→H is small).
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(seq)
+        h = jax.nn.gelu(h, approximate=True)
+        h = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="mlm_ln")(h)
+        # vocab-sharded decoder bias (the tied decoder weight is read from
+        # the embedding table in bert_pretrain_loss)
+        per = divide(cfg.vocab_size, _tp_world(_TP))
+        mlm_bias = self.param(
+            "mlm_bias",
+            sharded_init(nn.initializers.zeros, (cfg.vocab_size,), 0),
+            (per,),
+        )
+        # NSP pooler on [CLS] (position 0)
+        pooled = jnp.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(seq[0])
+        )
+        nsp_logits = nn.Dense(2, dtype=cfg.dtype, name="nsp_head")(pooled)
+        return (h, mlm_bias), nsp_logits
+
+
+def bert_pretrain_loss(
+    params,
+    model: BertForPreTraining,
+    batch,
+    *,
+    deterministic: bool = True,
+    rngs: Optional[dict] = None,
+):
+    """MLM + NSP loss (the phase-1 pretraining objective).
+
+    ``batch``: dict with ``input_ids``/``token_type_ids``/``attention_mask``
+    (S-first ids (S, B) / mask (B, S)), ``mlm_labels`` (S, B; -1 = unmasked,
+    ignored), ``nsp_labels`` (B,).  MLM decoder weight is tied to
+    ``bert/embeddings/word_embeddings/weight`` (vocab-sharded ⇒ logits are
+    vocab-parallel and feed vocab_parallel_cross_entropy directly — no
+    logits gather, ≙ _VocabParallelCrossEntropy).
+    """
+    (h, mlm_bias), nsp_logits = model.apply(
+        params,
+        batch["input_ids"],
+        batch.get("token_type_ids"),
+        batch.get("attention_mask"),
+        deterministic=deterministic,
+        rngs=rngs,
+    )
+    embed = params["params"]["bert"]["embeddings"]["word_embeddings"]["weight"]
+    logits = (
+        jnp.matmul(
+            h.astype(model.cfg.dtype),
+            jnp.transpose(embed).astype(model.cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        + mlm_bias
+    )
+    labels = batch["mlm_labels"]
+    mask = (labels >= 0).astype(jnp.float32)
+    losses = vocab_parallel_cross_entropy(
+        logits.astype(jnp.float32), jnp.maximum(labels, 0)
+    )
+    mlm_loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    nsp_labels = batch.get("nsp_labels")
+    nsp_loss = 0.0
+    if nsp_labels is not None:
+        logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)
+        nsp_loss = -jnp.mean(
+            jnp.take_along_axis(logp, nsp_labels[:, None], axis=-1)
+        )
+    return mlm_loss + nsp_loss

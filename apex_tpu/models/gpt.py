@@ -1,0 +1,179 @@
+"""GPT — the tensor-parallel decoder block benchmark (BASELINE config #5).
+
+≙ ``apex/transformer/testing/standalone_gpt.py`` (the reference's GPT
+fixture) — a Megatron-style pre-LN causal decoder built from the same
+apex_tpu parts as BERT: Column/Row parallel projections, Pallas flash
+attention (causal), fused RoPE, fused LayerNorm, vocab-parallel CE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.models.bert import _LayerNorm
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import _tp_world
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    gather_from_sequence_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = ["GptConfig", "GptBlock", "GptModel", "gpt_lm_loss"]
+
+_TP = ps.TENSOR_PARALLEL_AXIS
+
+
+def _rope_cos_sin(seq_len: int, dim: int, base: float = 10000.0):
+    """Cached cos/sin tables (S, D) in the rotate_half (GPT-NeoX) layout
+    the fused RoPE kernel expects."""
+    inv = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    freqs = jnp.outer(jnp.arange(seq_len, dtype=jnp.float32), inv)
+    emb = jnp.concatenate((freqs, freqs), axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 12
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_seq_len: int = 2048
+    layer_norm_eps: float = 1e-5
+    rotary: bool = True
+    dtype: Any = jnp.bfloat16
+    sequence_parallel: bool = False
+    remat: bool = False
+
+
+class GptBlock(nn.Module):
+    """Pre-LN decoder block: x + attn(LN(x)); x + mlp(LN(x))."""
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        world = _tp_world(_TP)
+        heads_local = divide(cfg.num_heads, world)
+        head_dim = divide(h, cfg.num_heads)
+
+        y = _LayerNorm(h, cfg.layer_norm_eps, name="ln_attn")(x)
+        qkv = ColumnParallelLinear(
+            h, 3 * h, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="qkv",
+        )(y)
+        s, b = qkv.shape[0], qkv.shape[1]
+        # per-head-interleaved (heads, 3, head_dim) column layout — see
+        # BertSelfAttention: required for tp-invariant column sharding
+        qkv = qkv.reshape(s, b, heads_local, 3, head_dim)
+        q, k, v = (
+            jnp.transpose(qkv[:, :, :, i], (1, 2, 0, 3)) for i in range(3)
+        )
+        if cfg.rotary:
+            cos, sin = _rope_cos_sin(s, head_dim)
+            q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
+            k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
+        ctx = flash_attention(q, k, v, causal=True, scale=head_dim**-0.5)
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, heads_local * head_dim)
+        attn = RowParallelLinear(
+            h, h, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="out",
+        )(ctx)
+        x = x + attn
+
+        y = _LayerNorm(h, cfg.layer_norm_eps, name="ln_mlp")(x)
+        y = ColumnParallelLinear(
+            h, cfg.intermediate_size, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="fc1",
+        )(y)
+        y = jax.nn.gelu(y, approximate=True)
+        y = RowParallelLinear(
+            cfg.intermediate_size, h, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="fc2",
+        )(y)
+        return x + y
+
+
+class _GptStep(nn.Module):
+    cfg: GptConfig
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, x):
+        return GptBlock(self.cfg, name="block")(
+            x, deterministic=self.deterministic
+        ), None
+
+
+class GptModel(nn.Module):
+    """Embedding + scanned decoder stack + final LN.  Seq-first (S, B)."""
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic=True):
+        cfg = self.cfg
+        x = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype, name="word_embeddings",
+        )(input_ids)
+        if not cfg.rotary:
+            pos = self.param(
+                "position_embeddings",
+                nn.initializers.normal(stddev=0.02),
+                (cfg.max_seq_len, cfg.hidden_size),
+            )
+            x = x + pos[: x.shape[0], None, :].astype(cfg.dtype)
+        step = _GptStep
+        if cfg.remat:
+            step = nn.remat(step, prevent_cse=False)
+        scanned = nn.scan(
+            step,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.num_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = scanned(cfg, deterministic, name="layers")(x)
+        x = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln_f")(x)
+        if cfg.sequence_parallel and _tp_world(_TP) > 1:
+            x = gather_from_sequence_parallel_region(x)
+        return x
+
+
+def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
+    """Next-token CE with the decoder tied to the embedding (vocab-parallel
+    logits — no gather, ≙ vocab_parallel_cross_entropy usage in Megatron)."""
+    h = model.apply(params, input_ids, deterministic=deterministic)
+    embed = params["params"]["word_embeddings"]["weight"]
+    logits = jnp.matmul(
+        h.astype(model.cfg.dtype),
+        jnp.transpose(embed).astype(model.cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    # shift: predict token t+1 from position t
+    losses = vocab_parallel_cross_entropy(
+        logits[:-1].astype(jnp.float32), input_ids[1:]
+    )
+    return jnp.mean(losses)

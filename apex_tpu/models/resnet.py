@@ -1,0 +1,129 @@
+"""ResNet-50 — the ImageNet AMP / DDP+SyncBN benchmark model
+(BASELINE configs #1 and #2; ≙ ``examples/imagenet/main_amp.py``'s
+torchvision resnet50 + ``apex.parallel.SyncBatchNorm``).
+
+NHWC layout throughout (the TPU-native conv layout: channels on the lane
+dim feeds the MXU's convolution tiling directly; the reference's NCHW is a
+CUDA convention its groupbn/bottleneck contrib kernels then work around
+with "channels_last" variants).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["ResNetConfig", "ResNet", "resnet50", "resnet50_config"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    use_syncbn: bool = False  # dp-wide batch statistics (config #2)
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+
+def resnet50_config(**overrides) -> ResNetConfig:
+    return ResNetConfig(**overrides)
+
+
+class _Norm(nn.Module):
+    cfg: ResNetConfig
+    scale_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        # keep_batchnorm_fp32 semantics (amp O2): statistics in f32 always.
+        if self.cfg.use_syncbn:
+            # SyncBatchNorm keeps torch's momentum convention
+            # (running = (1-m)*running + m*batch) — flip flax's.
+            return SyncBatchNorm(
+                features=x.shape[-1],
+                use_running_average=not train,
+                momentum=1.0 - self.cfg.bn_momentum,
+                eps=self.cfg.bn_eps,
+                dtype=self.cfg.dtype,
+                scale_init=self.scale_init,
+            )(x)
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.cfg.bn_momentum,
+            epsilon=self.cfg.bn_eps,
+            dtype=self.cfg.dtype,
+            scale_init=self.scale_init,
+        )(x)
+
+
+class BottleneckBlock(nn.Module):
+    cfg: ResNetConfig
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = _Norm(cfg, name="bn1")(y, train)
+        y = nn.relu(y)
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            name="conv2",
+        )(y)
+        y = _Norm(cfg, name="bn2")(y, train)
+        y = nn.relu(y)
+        y = conv(4 * self.filters, (1, 1), name="conv3")(y)
+        # zero-init the last BN scale (the standard ResNet-50 recipe the
+        # reference example trains with: residual branch starts at identity)
+        y = _Norm(cfg, scale_init=nn.initializers.zeros, name="bn3")(y, train)
+        if residual.shape != y.shape:
+            residual = conv(
+                4 * self.filters, (1, 1),
+                strides=(self.strides, self.strides), name="downsample_conv",
+            )(residual)
+            residual = _Norm(cfg, name="downsample_bn")(residual, train)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Input (N, H, W, 3) → logits (N, num_classes)."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(
+            cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=cfg.dtype, name="conv_stem",
+        )(x)
+        x = _Norm(cfg, name="bn_stem")(x, train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    cfg,
+                    filters=cfg.width * 2**i,
+                    strides=2 if (j == 0 and i > 0) else 1,
+                    name=f"stage{i}_block{j}",
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in f32 (loss numerics)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+def resnet50(**overrides) -> ResNet:
+    return ResNet(resnet50_config(**overrides))

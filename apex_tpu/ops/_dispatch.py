@@ -28,6 +28,12 @@ def set_use_pallas(value: Optional[bool]) -> None:
     _FORCE = value
 
 
+def forced() -> Optional[bool]:
+    """The current force state (None = auto) — lets ops apply shape
+    heuristics only in auto mode while tests can still pin a path."""
+    return _FORCE
+
+
 def use_pallas() -> bool:
     if _FORCE is not None:
         return _FORCE

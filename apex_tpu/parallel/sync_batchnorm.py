@@ -56,6 +56,8 @@ class SyncBatchNorm(nn.Module):
     use_running_average: Optional[bool] = None
     dtype: Any = None
     param_dtype: Any = jnp.float32
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -106,10 +108,10 @@ class SyncBatchNorm(nn.Module):
         y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
         if self.affine:
             scale = self.param(
-                "scale", nn.initializers.ones, (feat,), self.param_dtype
+                "scale", self.scale_init, (feat,), self.param_dtype
             )
             bias = self.param(
-                "bias", nn.initializers.zeros, (feat,), self.param_dtype
+                "bias", self.bias_init, (feat,), self.param_dtype
             )
             y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
         return y.astype(self.dtype or x.dtype)

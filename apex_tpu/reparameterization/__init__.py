@@ -5,51 +5,82 @@
 The reference mutates torch modules in place, splitting ``weight`` into
 ``weight_g`` (norm) and ``weight_v`` (direction) and recomputing
 ``weight = g · v/‖v‖`` in a pre-forward hook.  Flax modules are immutable,
-so the TPU-native shape is (a) a wrapper module :class:`WeightNorm` that
-owns ``g``/``v`` params around any child, and (b) the pure param-tree
-transforms :func:`apply_weight_norm` / :func:`remove_weight_norm` that
-split/merge an existing checkpoint the same way.
+so the TPU-native shape is:
+
+- :class:`WeightNorm` — a wrapper module (thin shim over
+  ``flax.linen.WeightNorm``) computing ``g · v/‖v‖`` at apply time;
+- :func:`apply_weight_norm` / :func:`remove_weight_norm` — pure
+  *checkpoint-level* transforms splitting/merging a plain param tree the
+  torch way (``kernel`` ⇄ ``kernel_g``/``kernel_v``);
+- :func:`to_wrapper_params` — converts a plain (un-split) param tree of the
+  wrapped layer into the variable layout :class:`WeightNorm` expects, so a
+  checkpoint trained without weight norm can be loaded into a wrapped model.
+
+``dim`` convention: the axis kept per-unit.  Flax kernels are ``(in, out)``
+so the default ``dim=-1`` corresponds to torch Linear's ``dim=0`` over its
+``(out, in)`` weights.
 """
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["WeightNorm", "apply_weight_norm", "remove_weight_norm", "compute_weight"]
+__all__ = [
+    "WeightNorm",
+    "apply_weight_norm",
+    "remove_weight_norm",
+    "to_wrapper_params",
+    "compute_weight",
+]
+
+_ArrayTypes = (jax.Array, np.ndarray)
 
 
-def _norm_keepdims(v: jax.Array, dim: Optional[int]) -> jax.Array:
+def _norm_keepdims(v, dim: Optional[int]):
     """‖v‖₂ reduced over every axis except ``dim`` (torch _norm semantics)."""
-    v32 = v.astype(jnp.float32)
+    v32 = jnp.asarray(v).astype(jnp.float32)
     if dim is None:
         return jnp.sqrt(jnp.sum(jnp.square(v32)))
-    axes = tuple(a for a in range(v.ndim) if a != (dim % v.ndim))
+    axes = tuple(a for a in range(v32.ndim) if a != (dim % v32.ndim))
     return jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes, keepdims=True))
 
 
-def compute_weight(g: jax.Array, v: jax.Array, dim: Optional[int] = 0) -> jax.Array:
+def compute_weight(g, v, dim: Optional[int] = -1):
     """``w = g · v/‖v‖`` — ≙ Reparameterization.compute_weight."""
-    return (g.astype(jnp.float32) * v.astype(jnp.float32) / _norm_keepdims(v, dim)).astype(
-        v.dtype
-    )
+    v = jnp.asarray(v)
+    g32 = jnp.asarray(g).astype(jnp.float32)
+    if dim is not None and g32.ndim != v.ndim:
+        # feature-shaped g (flax scale layout) → broadcastable keepdims
+        shape = [1] * v.ndim
+        shape[dim % v.ndim] = v.shape[dim % v.ndim]
+        g32 = g32.reshape(shape)
+    return (g32 * v.astype(jnp.float32) / _norm_keepdims(v, dim)).astype(v.dtype)
 
 
-def apply_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = 0) -> Any:
+def _is_leaf(x) -> bool:
+    return isinstance(x, _ArrayTypes)
+
+
+def apply_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = -1) -> Any:
     """Split every ``name`` leaf in a param tree into ``name_g``/``name_v``.
 
-    ≙ apply_weight_norm(module, name, dim) — checkpoint-level, not
-    module-level: feed the result to a model whose layers were wrapped in
-    :class:`WeightNorm`, or recombine with :func:`remove_weight_norm`.
+    ≙ torch ``apply_weight_norm(module, name, dim)`` at checkpoint level.
+    The result round-trips through :func:`remove_weight_norm`; it is NOT
+    the :class:`WeightNorm` module's layout — use :func:`to_wrapper_params`
+    for that.  Accepts dict/FrozenDict trees with jax or numpy leaves.
     """
-    if isinstance(params, dict):
+    if isinstance(params, Mapping):
         out = {}
         for k, sub in params.items():
-            if k == name and isinstance(sub, jax.Array):
-                out[f"{name}_g"] = _norm_keepdims(sub, dim).astype(sub.dtype)
+            if k == name and _is_leaf(sub):
+                g = _norm_keepdims(sub, dim)
+                out[f"{name}_g"] = g.astype(jnp.asarray(sub).dtype)
                 out[f"{name}_v"] = sub
             else:
                 out[k] = apply_weight_norm(sub, name, dim)
@@ -57,9 +88,9 @@ def apply_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = 0)
     return params
 
 
-def remove_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = 0) -> Any:
+def remove_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = -1) -> Any:
     """Inverse of :func:`apply_weight_norm` — ≙ remove_weight_norm."""
-    if isinstance(params, dict):
+    if isinstance(params, Mapping):
         out = {}
         keys = set(params)
         for k, sub in params.items():
@@ -73,6 +104,31 @@ def remove_weight_norm(params: Any, name: str = "kernel", dim: Optional[int] = 0
     return params
 
 
+def to_wrapper_params(
+    plain_params: Mapping,
+    name: str = "kernel",
+    dim: Optional[int] = -1,
+) -> dict:
+    """Plain params of a layer → the :class:`WeightNorm` wrapper's layout.
+
+    ``{'params': {'kernel': w, 'bias': b}}`` becomes
+    ``{'params': {'layer': {...}, 'WeightNorm_0': {'layer/kernel/scale': g}}}``
+    with ``g = ‖w‖`` per kept-axis unit, so the wrapped module initially
+    computes exactly ``w`` (flax WeightNorm stores the un-normalized kernel
+    as the direction and normalizes at apply time).
+    """
+    inner = plain_params.get("params", plain_params)
+    scales = {}
+    for k, sub in inner.items():
+        if k == name and _is_leaf(sub):
+            g = _norm_keepdims(sub, dim)
+            scales[f"layer/{name}/scale"] = jnp.ravel(g).astype(
+                jnp.asarray(sub).dtype
+            )
+    out = {"layer": dict(inner), "WeightNorm_0": scales}
+    return {"params": out} if "params" in plain_params else out
+
+
 class WeightNorm(nn.Module):
     """Wrapper module computing ``w = g·v/‖v‖`` for a child's kernels.
 
@@ -81,10 +137,8 @@ class WeightNorm(nn.Module):
         WeightNorm(nn.Dense(features=64))
 
     Thin shim over :class:`flax.linen.WeightNorm` (same math as the
-    reference's pre-forward hook, applied functionally).  ``dim`` follows
-    torch semantics — the axis kept per-unit; flax Dense kernels are
-    ``(in, out)`` so the default ``dim=-1`` matches torch Linear's
-    ``dim=0`` over its ``(out, in)`` weights.
+    reference's pre-forward hook, applied functionally).  Load plain
+    checkpoints via :func:`to_wrapper_params`.
     """
 
     layer: nn.Module

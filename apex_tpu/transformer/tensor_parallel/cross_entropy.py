@@ -37,33 +37,52 @@ def vocab_parallel_cross_entropy(
     return loss
 
 
-def _partition_range(local_v, axis_name):
+def _world(axis_name):
+    """Axis size, degrading to 1 when the axis is unbound (unsharded use —
+    same fallback-and-registry-check contract as layers._tp_world)."""
+    from apex_tpu.transformer.tensor_parallel.layers import _tp_world
+
+    return _tp_world(axis_name)
+
+
+def _psum(x, axis_name, world):
+    return jax.lax.psum(x, axis_name) if world > 1 else x
+
+
+def _pmax(x, axis_name, world):
+    return jax.lax.pmax(x, axis_name) if world > 1 else x
+
+
+def _partition_range(local_v, axis_name, world):
+    if world == 1:
+        return 0, local_v
     rank = jax.lax.axis_index(axis_name)
     return VocabUtility.vocab_range_from_per_partition_vocab_size(
-        local_v, rank, jax.lax.axis_size(axis_name)
+        local_v, rank, world
     )
 
 
 def _fwd(logits, target, smoothing, axis_name):
+    world = _world(axis_name)
     lf = logits.astype(jnp.float32)
     local_v = lf.shape[-1]
     # global max over the tp group (numerical stability)
-    lmax = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
+    lmax = _pmax(jnp.max(lf, axis=-1), axis_name, world)
     lf = lf - lmax[..., None]
     exp = jnp.exp(lf)
-    sum_exp = jax.lax.psum(jnp.sum(exp, axis=-1), axis_name)
+    sum_exp = _psum(jnp.sum(exp, axis=-1), axis_name, world)
 
-    start, end = _partition_range(local_v, axis_name)
+    start, end = _partition_range(local_v, axis_name, world)
     in_range = (target >= start) & (target < end)
     local_idx = jnp.clip(target - start, 0, local_v - 1)
     pred = jnp.take_along_axis(lf, local_idx[..., None], axis=-1)[..., 0]
-    pred = jax.lax.psum(jnp.where(in_range, pred, 0.0), axis_name)
+    pred = _psum(jnp.where(in_range, pred, 0.0), axis_name, world)
 
     log_z = jnp.log(sum_exp)
     loss = log_z - pred
     if smoothing > 0.0:
-        vocab = local_v * jax.lax.axis_size(axis_name)
-        mean_logit = jax.lax.psum(jnp.sum(lf, axis=-1), axis_name) / vocab
+        vocab = local_v * world
+        mean_logit = _psum(jnp.sum(lf, axis=-1), axis_name, world) / vocab
         # loss = (1-s)*nll + s * mean over vocab of (log_z - logit_j)
         loss = (1.0 - smoothing) * loss + smoothing * (log_z - mean_logit)
     residuals = (exp, sum_exp, in_range, local_idx)
@@ -77,7 +96,7 @@ def _bwd(smoothing, axis_name, res, g):
     onehot = jax.nn.one_hot(local_idx, local_v, dtype=jnp.float32)
     onehot = onehot * in_range[..., None]
     if smoothing > 0.0:
-        vocab = local_v * jax.lax.axis_size(axis_name)
+        vocab = local_v * _world(axis_name)
         target_dist = (1.0 - smoothing) * onehot + smoothing / vocab
     else:
         target_dist = onehot

@@ -1,0 +1,123 @@
+"""Benchmark harness — BASELINE config #3 (north star).
+
+BERT-Large phase-1 pretraining step (seq 128) with FusedLAMB + fused
+LayerNorm + flash attention on the available TPU chip(s).  Prints ONE JSON
+line: {"metric", "value", "unit", "vs_baseline"}.
+
+MFU accounting per BASELINE.md: FLOPs/step = 6·N·T (N = param count,
+T = tokens/step), peak = per-chip bf16 peak × chips.  Timing discipline:
+K train steps inside one jitted ``lax.scan`` (donated params — no
+host↔device churn; the idiomatic TPU train loop), a device→host transfer
+of the final loss as the synchronization point, median over repeated
+chunks.  (Per-step ``block_until_ready`` is unreliable over the remote
+tunnel this environment routes the chip through, and per-call dispatch
+would dominate at ~150 ms; the scan chunk measures the device.)
+vs_baseline = MFU / 0.50 (the BASELINE.json target of ≥50% MFU).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# per-chip dense bf16 peak FLOP/s by device kind (public specs)
+_PEAK = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e (Trillium)
+}
+
+
+def _chip_peak(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for key, val in _PEAK.items():
+        if kind.startswith(key):
+            return val
+    return 197e12  # conservative default
+
+
+def main():
+    from apex_tpu.models import (
+        BertForPreTraining,
+        bert_large_config,
+        bert_pretrain_loss,
+    )
+    from apex_tpu.optimizers import fused_lamb
+
+    seq_len, batch = 128, 128
+    chunk, trials = 6, 3
+
+    cfg = bert_large_config(remat=True)
+    model = BertForPreTraining(cfg)
+    tx = fused_lamb(learning_rate=1e-3, weight_decay=0.01)
+
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(key, (seq_len, batch), 0, cfg.vocab_size)
+    batch_data = {
+        "input_ids": ids,
+        "token_type_ids": jnp.zeros_like(ids),
+        "attention_mask": jnp.ones((batch, seq_len), jnp.int32),
+        "mlm_labels": jnp.where(ids % 7 == 0, ids, -1),
+        "nsp_labels": jnp.zeros((batch,), jnp.int32),
+    }
+
+    params = model.init(jax.random.PRNGKey(1), ids)
+    opt_state = tx.init(params)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_chunk(params, opt_state, batch_data):
+        def body(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(
+                lambda p: bert_pretrain_loss(p, model, batch_data)
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=chunk
+        )
+        return params, opt_state, losses
+
+    # warmup (compile + one chunk)
+    params, opt_state, losses = train_chunk(params, opt_state, batch_data)
+    loss = float(losses[-1])
+
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        params, opt_state, losses = train_chunk(params, opt_state, batch_data)
+        loss = float(losses[-1])  # device->host: the sync point
+        times.append((time.perf_counter() - t0) / chunk)
+    times.sort()
+    step_time = times[len(times) // 2]  # median
+
+    tokens = seq_len * batch
+    flops = 6.0 * n_params * tokens
+    peak = sum(_chip_peak(d) for d in jax.devices())
+    mfu = flops / (step_time * peak)
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_large_lamb_mfu",
+                "value": round(mfu, 4),
+                "unit": "MFU (step_time_ms=%.1f, batch=%d, params=%dM, loss=%.3f)"
+                % (step_time * 1e3, batch, n_params // 1_000_000, loss),
+                "vs_baseline": round(mfu / 0.50, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -199,3 +199,59 @@ class TestWeightNorm:
         leaves = jax.tree_util.tree_leaves_with_path(params)
         names = {jax.tree_util.keystr(p) for p, _ in leaves}
         assert any("scale" in n for n in names), names
+
+
+class TestReviewRegressions:
+    def test_elman_activation_override_respected(self):
+        from apex_tpu.RNN import RNNTanh
+
+        m = RNNTanh(input_size=4, hidden_size=8, activation=jax.nn.relu)
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 2, 4)) * 10
+        params = m.init(jax.random.PRNGKey(1), x)
+        out, _ = m.apply(params, x)
+        # relu output is non-negative and unbounded; tanh would be in (-1, 1)
+        assert float(jnp.min(out)) >= 0.0
+        assert float(jnp.max(out)) > 1.0 or float(jnp.max(out)) == 0.0
+
+    def test_weight_norm_transforms_accept_numpy_and_frozen(self):
+        import flax.core
+
+        from apex_tpu.reparameterization import apply_weight_norm, remove_weight_norm
+
+        tree = flax.core.freeze(
+            {"layer": {"kernel": np.ones((4, 6), np.float32)}}
+        )
+        split = apply_weight_norm(tree)
+        assert "kernel_g" in split["layer"]
+        merged = remove_weight_norm(split)
+        np.testing.assert_allclose(
+            np.asarray(merged["layer"]["kernel"]), np.ones((4, 6)), rtol=1e-6
+        )
+
+    def test_to_wrapper_params_loads_plain_checkpoint(self):
+        import flax.linen as nn
+
+        from apex_tpu.reparameterization import WeightNorm, to_wrapper_params
+
+        dense = nn.Dense(features=6)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4))
+        plain = dense.init(jax.random.PRNGKey(1), x)
+        y_plain = dense.apply(plain, x)
+
+        wrapped = WeightNorm(dense)
+        wn_params = to_wrapper_params(plain)
+        y_wrapped = wrapped.apply(wn_params, x)
+        # initial wrapped output must equal the plain layer's output
+        np.testing.assert_allclose(
+            np.asarray(y_wrapped), np.asarray(y_plain), rtol=1e-5, atol=1e-5
+        )
+
+    def test_autocast_varargs_shape(self):
+        from apex_tpu._autocast_utils import _cast_if_autocast_enabled
+
+        x, y = jnp.ones((2, 2)), jnp.arange(3)
+        # no policy: identity (autocast disabled semantics)
+        ox, oy = _cast_if_autocast_enabled(x, y)
+        assert ox.dtype == jnp.float32 and oy.dtype == jnp.int32
+        ox, oy = _cast_if_autocast_enabled(x, y, policy=jnp.bfloat16)
+        assert ox.dtype == jnp.bfloat16 and oy.dtype == jnp.int32
