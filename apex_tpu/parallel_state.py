@@ -44,11 +44,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "DATA_PARALLEL_AXIS",
     "PIPELINE_PARALLEL_AXIS",
+    "CONTEXT_PARALLEL_AXIS",
     "TENSOR_PARALLEL_AXIS",
     "initialize_model_parallel",
     "model_parallel_is_initialized",
     "get_mesh",
     "get_data_parallel_world_size",
+    "get_context_parallel_world_size",
+    "get_context_parallel_rank",
     "get_tensor_model_parallel_world_size",
     "get_pipeline_model_parallel_world_size",
     "get_data_parallel_rank",
@@ -72,9 +75,15 @@ __all__ = [
 
 DATA_PARALLEL_AXIS = "dp"
 PIPELINE_PARALLEL_AXIS = "pp"
+CONTEXT_PARALLEL_AXIS = "cp"
 TENSOR_PARALLEL_AXIS = "tp"
 
-_AXIS_ORDER = (DATA_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS, TENSOR_PARALLEL_AXIS)
+_AXIS_ORDER = (
+    DATA_PARALLEL_AXIS,
+    PIPELINE_PARALLEL_AXIS,
+    CONTEXT_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+)
 
 
 @dataclasses.dataclass
@@ -83,6 +92,7 @@ class _ParallelState:
     data_parallel_size: int
     pipeline_model_parallel_size: int
     tensor_model_parallel_size: int
+    context_parallel_size: int = 1
     virtual_pipeline_model_parallel_size: Optional[int] = None
     # Virtual-pipeline rank is plain host state mutated by the interleaved
     # 1F1B scheduler, mirroring the reference's module-global
@@ -97,6 +107,7 @@ def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
+    context_parallel_size: int = 1,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
@@ -123,14 +134,16 @@ def initialize_model_parallel(
     n = len(devices)
     tp = int(tensor_model_parallel_size)
     pp = int(pipeline_model_parallel_size)
-    if tp < 1 or pp < 1:
+    cp = int(context_parallel_size)
+    if tp < 1 or pp < 1 or cp < 1:
         raise ValueError("parallel sizes must be >= 1")
-    if n % (tp * pp) != 0:
+    if n % (tp * pp * cp) != 0:
         raise RuntimeError(
             f"world size ({n}) is not divisible by tensor_model_parallel_size "
-            f"({tp}) x pipeline_model_parallel_size ({pp})"
+            f"({tp}) x pipeline_model_parallel_size ({pp}) x "
+            f"context_parallel_size ({cp})"
         )
-    dp = n // (tp * pp)
+    dp = n // (tp * pp * cp)
     if virtual_pipeline_model_parallel_size is not None:
         if pp < 2:
             raise RuntimeError(
@@ -140,7 +153,7 @@ def initialize_model_parallel(
     import numpy as np
 
     if explicit_devices:
-        device_array = np.asarray(devices).reshape(dp, pp, tp)
+        device_array = np.asarray(devices).reshape(dp, pp, cp, tp)
     else:
         # Topology-aware assignment: on a real TPU slice a naive reshape of
         # jax.devices() can place a tp group across non-adjacent chips;
@@ -150,7 +163,7 @@ def initialize_model_parallel(
 
         try:
             device_array = mesh_utils.create_device_mesh(
-                (dp, pp, tp), devices=devices
+                (dp, pp, cp, tp), devices=devices
             )
         except Exception as e:
             import warnings
@@ -162,13 +175,14 @@ def initialize_model_parallel(
                 RuntimeWarning,
                 stacklevel=2,
             )
-            device_array = np.asarray(devices).reshape(dp, pp, tp)
+            device_array = np.asarray(devices).reshape(dp, pp, cp, tp)
     mesh = Mesh(device_array, _AXIS_ORDER)
     _STATE = _ParallelState(
         mesh=mesh,
         data_parallel_size=dp,
         pipeline_model_parallel_size=pp,
         tensor_model_parallel_size=tp,
+        context_parallel_size=cp,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
         virtual_pipeline_model_parallel_rank=(
             0 if virtual_pipeline_model_parallel_size is not None else None
@@ -192,7 +206,7 @@ def _state() -> _ParallelState:
 
 
 def get_mesh() -> Mesh:
-    """The registered global mesh (axes ``dp``, ``pp``, ``tp``)."""
+    """The registered global mesh (axes ``dp``, ``pp``, ``cp``, ``tp``)."""
     return _state().mesh
 
 
@@ -207,6 +221,15 @@ def get_data_parallel_world_size() -> int:
 
 def get_tensor_model_parallel_world_size() -> int:
     return _state().tensor_model_parallel_size
+
+
+def get_context_parallel_world_size() -> int:
+    """Size of the ``cp`` axis (ring/context parallelism; 1 = disabled).
+
+    No reference analog: the reference has no context parallelism
+    (SURVEY §2.3 capability envelope) — this is the TPU-native extension
+    for long-context scaling over the ICI torus."""
+    return _state().context_parallel_size
 
 
 def get_pipeline_model_parallel_world_size() -> int:
@@ -239,6 +262,10 @@ def get_tensor_model_parallel_rank():
 
 def get_pipeline_model_parallel_rank():
     return _axis_index(PIPELINE_PARALLEL_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_index(CONTEXT_PARALLEL_AXIS)
 
 
 def get_tensor_model_parallel_src_rank():

@@ -1,0 +1,234 @@
+"""Context parallelism — long-context attention over a mesh axis.
+
+**No reference analog** (SURVEY §2.3: CP/ring/Ulysses are ABSENT in the
+reference — its max context is bounded by one device's memory).  This
+module is the TPU-native extension that makes long context first-class:
+
+- :func:`ring_attention` — blockwise ring attention (Liu et al. 2023) over
+  the ``cp`` mesh axis: q stays put, (k, v) blocks rotate ring-wise via
+  ``jax.lax.ppermute`` over ICI neighbors, and per-block flash results are
+  folded with the running online-softmax merge.  Sequence length scales
+  linearly with the ring size at O(S_local²) compute per hop; compute and
+  the permute overlap (XLA schedules the collective-permute concurrently
+  with the previous block's matmuls).
+- :func:`ulysses_attention` — DeepSpeed-Ulysses-style all-to-all: scatter
+  heads / gather sequence (``jax.lax.all_to_all``), run ordinary (flash)
+  attention on full sequences with H/cp local heads, all-to-all back.
+  Cheaper than the ring when H ≥ cp and sequence fits once gathered.
+
+Both are differentiable: Ulysses through ``all_to_all``'s transpose, the
+ring through the scanned ``ppermute`` (per-hop recompute via
+``jax.checkpoint`` — the standard ring-attention backward, so residual
+memory stays O(S_local) per hop rather than O(S²)).
+
+Layouts match the attention stack: q, k, v are ``(B, H, S_local, D)``
+shards, sequence split contiguously across the axis (rank r holds rows
+``[r·S_local, (r+1)·S_local)``), causal masking honors global positions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+_CP = ps.CONTEXT_PARALLEL_AXIS
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One (q-block × kv-block) flash block in f32: returns (o, lse).
+
+    o is the block-normalized output, lse the row logsumexp — exactly the
+    pair the online-softmax merge needs.  ``mask`` is an additive (Sq, Sk)
+    term or None.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ).astype(jnp.float32) * scale
+    if mask is not None:
+        s = s + mask
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # all-masked rows: keep exp well-defined (finite MASK_VALUE convention)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) / l
+    lse = (m + jnp.log(l))[..., 0]  # (B, H, Sq)
+    return o, lse
+
+
+def _tri_mask(s_local, dtype=jnp.float32):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+    return jnp.where(rows >= cols, 0.0, MASK_VALUE).astype(dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = _CP,
+):
+    """Blockwise ring attention over ``axis_name``.
+
+    q, k, v: ``(B, H, S_local, D)`` — this rank's contiguous sequence
+    chunk.  Returns ``(B, H, S_local, D)`` in q's dtype, equal (within
+    numerics) to full attention over the gathered sequence.
+
+    Causal mode skips the block compute entirely for hops whose kv chunk
+    lies in this rank's causal future (``lax.switch`` on the chunk order);
+    the permute still runs every hop, so the ring stays in lockstep.  Note
+    contiguous chunking makes causal work *imbalanced* across ranks (rank 0
+    computes 1 block, rank cp-1 computes cp) — the wall-clock cost per hop
+    is set by the busiest rank; a zigzag/striped layout would balance it
+    and is left as a further optimization.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    @jax.checkpoint
+    def hop(qf, kv, src):
+        """(o, lse) for this rank's q against the kv chunk from ``src``."""
+        kb, vb = kv
+        kb = kb.astype(jnp.float32)
+        if not causal:
+            return _block_attend(qf, kb, vb, scale, None)
+
+        def self_block(_):
+            return _block_attend(qf, kb, vb, scale, _tri_mask(s_local)[None, None])
+
+        def past_block(_):
+            return _block_attend(qf, kb, vb, scale, None)
+
+        def future_block(_):
+            # fully masked: zero mass — skip both einsums entirely
+            return (
+                jnp.zeros((b, h, s_local, d), jnp.float32),
+                jnp.full((b, h, s_local), -jnp.inf, jnp.float32),
+            )
+
+        branch = jnp.where(src == rank, 0, jnp.where(src < rank, 1, 2))
+        return jax.lax.switch(branch, [self_block, past_block, future_block], None)
+
+    def merge(carry, block):
+        acc, m, l = carry
+        o_b, lse_b = block
+        m_new = jnp.maximum(m, lse_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_b - m_new)  # block o_b is normalized: mass 1·β
+        l_new = l * alpha + beta
+        acc_new = (acc * (l * alpha)[..., None] + o_b * beta[..., None]) / l_new[
+            ..., None
+        ]
+        return acc_new, m_new, l_new
+
+    # hop 0 is always the self block — no permute needed before it, and it
+    # seeds the running max with a finite lse (so -inf skipped hops merge
+    # to exactly zero weight)
+    o0, lse0 = hop(qf, (k, v), rank)
+    carry = (o0, lse0, jnp.ones((b, h, s_local), jnp.float32))
+
+    def body(state, step):
+        kv, carry = state
+        # rotate FIRST: world-1 permutes total, none wasted on the last hop
+        kv = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), kv
+        )
+        src = (rank - step) % world
+        carry = merge(carry, hop(qf, kv, src))
+        return (kv, carry), None
+
+    if world > 1:
+        (_, carry), _ = jax.lax.scan(
+            body, ((k, v), carry), jnp.arange(1, world)
+        )
+    acc, _, _ = carry
+    return acc.astype(q.dtype)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    bias=None,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_rng=None,
+    axis_name: str = _CP,
+):
+    """All-to-all (Ulysses) sequence parallelism.
+
+    q, k, v: ``(B, H, S_local, D)`` with the FULL head count; requires
+    ``H % axis_size == 0``.  all-to-all → ``(B, H/cp, S, D)`` → ordinary
+    flash attention with H/cp local heads → all-to-all back to
+    ``(B, H, S_local, D)``.
+
+    ``bias``: only a head-independent key-padding bias of local shape
+    ``(B, 1, 1, S_local)`` is accepted (it is all-gathered along the
+    sequence to match the gathered scores); other shapes would need both
+    score dims reassembled and are rejected — precompute a global bias
+    and fold it into the model instead.
+
+    ``dropout_rng`` is folded with the cp rank so each rank's H/cp head
+    group draws an independent mask (statistically identical to unsharded
+    dropout, not bit-identical).
+    """
+    from apex_tpu.ops.attention import flash_attention
+
+    world = jax.lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % world:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({h}) divisible by the "
+            f"axis size ({world})"
+        )
+    if bias is not None:
+        if bias.ndim < 4:
+            bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        if bias.shape[1] != 1 or bias.shape[2] != 1:
+            raise ValueError(
+                "ulysses_attention only redistributes a key-padding bias "
+                f"of shape (B, 1, 1, S_local); got {bias.shape}"
+            )
+        bias = jax.lax.all_gather(bias, axis_name, axis=3, tiled=True)
+    if dropout_rng is not None:
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(axis_name)
+        )
+
+    def scatter_heads(x):
+        # (B, H, S_local, D) -> (B, H/cp, S, D): split heads, concat seq
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    o = flash_attention(
+        scatter_heads(q), scatter_heads(k), scatter_heads(v), bias,
+        causal=causal, scale=scale, dropout_p=dropout_p,
+        dropout_rng=dropout_rng,
+    )
+    return gather_heads(o)

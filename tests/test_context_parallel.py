@@ -1,0 +1,176 @@
+"""Context parallelism (ring + Ulysses) vs full-attention golden.
+
+No reference analog (the reference has no CP) — golden is
+:func:`apex_tpu.ops.attention.mha_reference` on the gathered sequence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.ops.attention import mha_reference
+from apex_tpu.transformer.context_parallel import (
+    ring_attention,
+    ulysses_attention,
+)
+
+B, H, S, D = 2, 4, 64, 16  # S is the GLOBAL sequence length
+
+
+def _qkv(key):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, S, D))
+    k = jax.random.normal(kk, (B, H, S, D))
+    v = jax.random.normal(kv, (B, H, S, D))
+    return q, k, v
+
+
+def _run_cp(fn, q, k, v, cp):
+    """Run fn inside shard_map with the seq dim sharded over cp."""
+    mesh = ps.initialize_model_parallel(context_parallel_size=cp)
+    out = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(None, None, "cp"), P(None, None, "cp"),
+                      P(None, None, "cp")),
+            out_specs=P(None, None, "cp"),
+            check_vma=False,
+        )
+    )(q, k, v)
+    ps.destroy_model_parallel()
+    return out
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("cp", [2, 4, 8])
+def test_ring_matches_full(eight_devices, causal, cp):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = _run_cp(
+        lambda q, k, v: ring_attention(q, k, v, causal=causal), q, k, v, cp
+    )
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_grads_match_full(eight_devices, causal):
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, causal=causal) ** 2)
+
+    def full_loss(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+
+    def f(q, k, v):
+        # each rank sums only its own q rows, so psum over cp rebuilds the
+        # full loss; /4 then matches the unsharded scale after the psum
+        # transpose duplicates the cotangent onto every rank
+        gq, gk, gv = jax.grad(
+            lambda args: jax.lax.psum(ring_loss(*args), "cp") / 4
+        )((q, k, v))
+        return gq, gk, gv
+
+    gq, gk, gv = jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(None, None, "cp"),) * 3,
+            out_specs=(P(None, None, "cp"),) * 3,
+            check_vma=False,
+        )
+    )(q, k, v)
+    ps.destroy_model_parallel()
+
+    rq, rk, rv = jax.grad(lambda args: full_loss(*args))((q, k, v))
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=5e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=5e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=5e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("cp", [2, 4])
+def test_ulysses_matches_full(eight_devices, causal, cp):
+    q, k, v = _qkv(jax.random.PRNGKey(2))
+    out = _run_cp(
+        lambda q, k, v: ulysses_attention(q, k, v, causal=causal), q, k, v, cp
+    )
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ulysses_head_divisibility(eight_devices):
+    mesh = ps.initialize_model_parallel(context_parallel_size=8)
+    q = jnp.ones((1, 4, 8, 16))  # 4 heads, cp=8 -> error
+
+    def f(q):
+        return ulysses_attention(q, q, q)
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P(None, None, "cp"),),
+                out_specs=P(None, None, "cp"), check_vma=False,
+            )
+        )(q)
+
+
+def test_cp_axis_in_registry(eight_devices):
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size=2, context_parallel_size=2,
+    )
+    assert ps.get_context_parallel_world_size() == 2
+    assert mesh.shape == {"dp": 2, "pp": 1, "cp": 2, "tp": 2}
+
+
+def test_ulysses_key_padding_bias(eight_devices):
+    """Local (B,1,1,S_local) bias is gathered to the global key axis."""
+    q, k, v = _qkv(jax.random.PRNGKey(3))
+    bias_global = np.zeros((B, 1, 1, S), np.float32)
+    bias_global[:, :, :, S // 2:] = -1e9
+    bias_global = jnp.asarray(bias_global)
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+
+    def f(q, k, v, bias_local):
+        return ulysses_attention(q, k, v, bias_local)
+
+    out = jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(None, None, "cp"),) * 3 + (P(None, None, None, "cp"),),
+            out_specs=P(None, None, "cp"),
+            check_vma=False,
+        )
+    )(q, k, v, bias_global)
+    ps.destroy_model_parallel()
+    ref = mha_reference(q, k, v, bias_global)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_full_bias(eight_devices):
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    q = jnp.ones((1, 4, 16, 16))
+    bias = jnp.zeros((1, 4, 16, 16))
+
+    def f(q, bias):
+        return ulysses_attention(q, q, q, bias)
+
+    with pytest.raises(ValueError, match="key-padding"):
+        jax.jit(
+            jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(P(None, None, "cp"), P(None, None, "cp")),
+                out_specs=P(None, None, "cp"), check_vma=False,
+            )
+        )(q, bias)

@@ -17,7 +17,8 @@ def test_initialize_and_sizes(eight_devices):
     assert ps.get_tensor_model_parallel_world_size() == 2
     assert ps.get_pipeline_model_parallel_world_size() == 2
     assert ps.get_data_parallel_world_size() == 2
-    assert mesh.shape == {"dp": 2, "pp": 2, "tp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 2, "cp": 1, "tp": 2}
+    assert ps.get_context_parallel_world_size() == 1
     ps.destroy_model_parallel()
     assert not ps.model_parallel_is_initialized()
 
