@@ -24,5 +24,10 @@ Submodules
 - sparsity      — ASP 2:4 structured sparsity (≙ apex/contrib/sparsity)
 - bottleneck    — (spatial-parallel) ResNet bottleneck (≙ contrib/bottleneck)
 - peer_memory   — halo exchange over a mesh axis (≙ contrib/peer_memory)
+- nccl_p2p      — neighbor send/recv via ppermute (≙ contrib/nccl_p2p)
 - conv_bias_relu — fused Conv+Bias(+ReLU/+Add) (≙ contrib/conv_bias_relu)
+- cudnn_gbn     — group BatchNorm, shared impl with groupbn (≙ contrib/cudnn_gbn)
+- nccl_allocator — documented no-op (≙ contrib/nccl_allocator; N/A on TPU)
+- gpu_direct_storage — documented N/A (≙ contrib/gpu_direct_storage)
+- openfold      — OpenFold kernels + DAP helpers (≙ contrib/openfold_triton)
 """

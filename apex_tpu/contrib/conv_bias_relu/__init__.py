@@ -1,0 +1,47 @@
+"""Fused Conv+Bias(+ReLU/+Add) — ≙ ``apex/contrib/conv_bias_relu``
+(``conv_bias_relu.py`` :: ``ConvBiasReLU``/``ConvBias``/``ConvBiasMaskReLU``,
+native cudnn-frontend runtime fusion ``conv_bias_relu.cpp``).
+
+XLA fuses conv epilogues on TPU the way cudnn_frontend's runtime fusion
+does on GPU, so these are thin functional wrappers over
+``jax.lax.conv_general_dilated`` in NHWC (TPU-native layout; the reference
+uses NHWC here too — its "channels_last" requirement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ConvBias", "ConvBiasReLU", "ConvBiasMaskReLU", "conv_bias"]
+
+
+def conv_bias(x, weight, bias, *, stride=1, padding=1):
+    """NHWC conv + bias.  weight: (KH, KW, Cin, Cout); bias (Cout,)."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    y = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    return (y + bias).astype(x.dtype)
+
+
+def ConvBias(x, weight, bias, padding=1, stride=1):
+    """≙ ConvBias.apply(x, weight, bias, padding, stride)."""
+    return conv_bias(x, weight, bias, stride=stride, padding=padding)
+
+
+def ConvBiasReLU(x, weight, bias, padding=1, stride=1):
+    """≙ ConvBiasReLU.apply — conv+bias with fused ReLU epilogue."""
+    return jax.nn.relu(conv_bias(x, weight, bias, stride=stride, padding=padding))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, padding=1, stride=1):
+    """≙ ConvBiasMaskReLU.apply — conv+bias, elementwise mask, ReLU."""
+    return jax.nn.relu(
+        conv_bias(x, weight, bias, stride=stride, padding=padding) * mask
+    )

@@ -1,0 +1,16 @@
+"""Group BatchNorm (cuDNN-backend flavor) — ≙ ``apex/contrib/cudnn_gbn``
+(``cudnn_gbn.py`` :: ``GroupBatchNorm2d``, native ``cudnn_gbn.cpp``/
+``norm_sample.cpp``).
+
+Functionally the same op as :mod:`apex_tpu.contrib.groupbn` (NHWC BN whose
+statistics are reduced across a device group, with the BN-Add-ReLU fused
+graph); the reference ships it twice because it has two native backends
+(hand CUDA vs cuDNN v8 graphs).  One TPU implementation serves both —
+re-exported here so either import path works.
+"""
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+__all__ = ["GroupBatchNorm2d", "BatchNorm2d_NHWC"]
+
+GroupBatchNorm2d = BatchNorm2d_NHWC

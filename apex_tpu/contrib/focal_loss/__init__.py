@@ -1,0 +1,78 @@
+"""Fused focal loss — ≙ ``apex/contrib/focal_loss``
+(``focal_loss.py`` :: ``focal_loss``, native ``focal_loss_cuda.cu`` ::
+``focal_loss_forward``; the SSD/detection training loss).
+
+One traced expression (XLA fuses the sigmoid/log/pow chain with the
+reduction, which is all the CUDA kernel does).  Matches the reference
+semantics: sigmoid focal loss over (anchors, classes) logits with integer
+targets where class 0 is background (mapped to the all-negative row),
+optional label smoothing, summed and normalized by ``num_positives``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["focal_loss", "sigmoid_focal_loss"]
+
+
+def sigmoid_focal_loss(
+    logits,
+    targets_one_hot,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    label_smoothing: float = 0.0,
+):
+    """Elementwise focal term: ``-α_t (1-p_t)^γ log(p_t)``.
+
+    logits/targets_one_hot: broadcastable (..., num_classes) with targets
+    in {0, 1} (floats allowed for smoothing).
+    """
+    lf = logits.astype(jnp.float32)
+    t = targets_one_hot.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        t = t * (1.0 - label_smoothing) + 0.5 * label_smoothing
+    p = jax.nn.sigmoid(lf)
+    ce = jnp.maximum(lf, 0.0) - lf * t + jnp.log1p(jnp.exp(-jnp.abs(lf)))
+    p_t = p * t + (1.0 - p) * (1.0 - t)
+    alpha_t = alpha * t + (1.0 - alpha) * (1.0 - t)
+    return alpha_t * jnp.power(1.0 - p_t, gamma) * ce
+
+
+def focal_loss(
+    cls_output,
+    cls_targets_at_level,
+    num_positives_sum,
+    num_real_classes: Optional[int] = None,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    label_smoothing: float = 0.0,
+):
+    """≙ focal_loss_cuda.focal_loss_forward.
+
+    cls_output: (..., C) logits; cls_targets_at_level: (...) int targets
+    with -1 = background-only anchor... following the reference: target
+    t >= 1 marks class t-1 positive, t == 0 all-negative, t == -1
+    ignored.  Returns the summed loss / num_positives_sum.
+    """
+    c = cls_output.shape[-1]
+    if num_real_classes is None:
+        num_real_classes = c
+    t = cls_targets_at_level.astype(jnp.int32)
+    one_hot = jax.nn.one_hot(t - 1, c, dtype=jnp.float32)
+    one_hot = jnp.where((t >= 1)[..., None], one_hot, 0.0)
+    per_elem = sigmoid_focal_loss(
+        cls_output, one_hot, alpha, gamma, label_smoothing
+    )
+    valid = (t >= 0).astype(jnp.float32)[..., None]
+    mask = jnp.concatenate(
+        [
+            jnp.ones((num_real_classes,), jnp.float32),
+            jnp.zeros((c - num_real_classes,), jnp.float32),
+        ]
+    )
+    total = jnp.sum(per_elem * valid * mask)
+    return total / jnp.maximum(num_positives_sum, 1.0)

@@ -1,0 +1,27 @@
+"""≙ ``apex/contrib/gpu_direct_storage`` (``gds.cpp`` :: cuFile tensor
+I/O) — **N/A on TPU, documented.**
+
+GDS DMA-transfers files directly into GPU memory via cuFile.  TPU hosts
+stage through host RAM by architecture (no NVMe→HBM DMA path is exposed);
+the idiomatic equivalent for checkpoint I/O is orbax/tensorstore async
+checkpointing, which overlaps device→host transfer with training steps.
+The functions below raise with that pointer rather than silently failing.
+"""
+
+from __future__ import annotations
+
+__all__ = ["load_data", "save_data"]
+
+_MSG = (
+    "GPUDirect Storage has no TPU analog (no NVMe-to-HBM DMA path). For "
+    "fast checkpoint I/O use orbax-checkpoint (async, tensorstore-backed), "
+    "which overlaps device-to-host transfer with compute."
+)
+
+
+def load_data(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def save_data(*args, **kwargs):
+    raise NotImplementedError(_MSG)

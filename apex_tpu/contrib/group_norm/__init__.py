@@ -1,0 +1,80 @@
+"""NHWC GroupNorm (+SiLU fusion) — ≙ ``apex/contrib/group_norm``
+(``group_norm.py`` :: ``GroupNorm``, native ``apex/contrib/csrc/group_norm/*.cu``).
+
+The reference hand-writes NHWC GroupNorm kernels (with optional fused
+swish) for diffusion workloads.  On TPU the layout is already NHWC and XLA
+fuses normalize+affine+SiLU into the surrounding elementwise chain, so this
+is a jnp composition with f32 statistics — the kernel table
+(``GN_SUPPORTED_CHANNELS``-style) is unnecessary: any channel count works.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GroupNorm", "group_norm"]
+
+_ACTS = {None: lambda x: x, "": lambda x: x, "silu": jax.nn.silu, "swish": jax.nn.silu}
+
+
+def group_norm(
+    x,
+    num_groups: int,
+    weight=None,
+    bias=None,
+    eps: float = 1e-5,
+    act: Optional[str] = None,
+):
+    """x: (..., C) channels-last.  Stats over (spatial..., C/G) per group."""
+    if act not in _ACTS:
+        raise ValueError(f"act must be one of {sorted(k or '' for k in _ACTS)}")
+    c = x.shape[-1]
+    if c % num_groups:
+        raise ValueError(f"channels {c} not divisible by num_groups {num_groups}")
+    orig_dtype = x.dtype
+    n = x.shape[0]
+    xf = x.astype(jnp.float32).reshape(n, -1, num_groups, c // num_groups)
+    mean = jnp.mean(xf, axis=(1, 3), keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=(1, 3), keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(x.shape)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return _ACTS[act](y).astype(orig_dtype)
+
+
+class GroupNorm(nn.Module):
+    """≙ apex.contrib.group_norm.GroupNorm(num_groups, num_channels, eps,
+    affine, act) — drop-in for torch.nn.GroupNorm plus the ``act`` fusion."""
+
+    num_groups: int
+    num_channels: int
+    eps: float = 1e-5
+    affine: bool = True
+    act: Optional[str] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.shape[-1] != self.num_channels:
+            raise ValueError(
+                f"expected channels-last input with {self.num_channels} "
+                f"channels, got {x.shape}"
+            )
+        w = b = None
+        if self.affine:
+            w = self.param(
+                "weight", nn.initializers.ones, (self.num_channels,),
+                self.param_dtype,
+            )
+            b = self.param(
+                "bias", nn.initializers.zeros, (self.num_channels,),
+                self.param_dtype,
+            )
+        return group_norm(x, self.num_groups, w, b, self.eps, self.act)

@@ -1,0 +1,27 @@
+"""Fused gather-multiply — ≙ ``apex/contrib/index_mul_2d``
+(``index_mul_2d.py``, native ``index_mul_2d_cuda.cu``).
+
+``out = in1[idx] * in2`` with the backward scattering grads back through
+the gather.  XLA fuses the gather into the multiply and autodiff produces
+the scatter-add the reference hand-writes, so this is one expression.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+def index_mul_2d(in1, in2, idx1):
+    """in1 (N, E); in2 (K, E); idx1 (K,) int → (K, E) = in1[idx1] * in2.
+
+    ≙ index_mul_2d_cuda (fwd + the implicit scatter-add backward for
+    repeated indices).
+    """
+    if in2.shape[0] != idx1.shape[0]:
+        raise ValueError(
+            f"in2 rows ({in2.shape[0]}) must match idx1 length "
+            f"({idx1.shape[0]})"
+        )
+    return jnp.take(in1, idx1, axis=0) * in2

@@ -1,0 +1,27 @@
+"""≙ ``apex/contrib/nccl_allocator`` (``NCCLAllocator.cpp`` ::
+``ncclMemAlloc``-backed pluggable allocator for NCCL user-buffer
+registration) — **N/A on TPU, by design.**
+
+The reference exists because NCCL ≥ 2.19 can skip internal staging copies
+when communication buffers are registered with it.  On TPU, XLA owns every
+device buffer and its collectives already read/write operand buffers
+directly over ICI — there is nothing to register and no allocator to
+plug.  ``init()`` and the ``nccl_mem`` context are provided as explicit
+no-ops so ported code runs unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["init", "nccl_mem"]
+
+
+def init(*args, **kwargs) -> None:
+    """No-op (XLA manages buffers; see module docstring)."""
+
+
+@contextlib.contextmanager
+def nccl_mem(*args, **kwargs):
+    """No-op context (≙ ``with nccl_allocator.nccl_mem(): ...``)."""
+    yield

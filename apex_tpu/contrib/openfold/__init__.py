@@ -1,0 +1,42 @@
+"""OpenFold kernels + DAP helpers — ≙ ``apex/contrib/openfold_triton``
+(``mha.py``, ``layer_norm.py``, ``dap.py``: Triton kernels + dynamic
+axial parallelism for AlphaFold2-style training).
+
+The reference's Triton kernels map onto pieces this framework already has
+(they are re-exported below so OpenFold-shaped code finds them in one
+place); DAP — sharding the pair representation's two axial dims across
+devices and swapping which axis is sharded between row- and
+column-attention — maps to two ``all_to_all`` helpers over a mesh axis,
+the same collective Ulysses uses.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.ops.attention import flash_attention as mha  # noqa: F401
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm_affine as layer_norm,
+)
+
+__all__ = ["mha", "layer_norm", "scatter_rows_gather_cols", "scatter_cols_gather_rows"]
+
+
+def scatter_rows_gather_cols(x, axis_name: str, row_axis: int = -3, col_axis: int = -2):
+    """DAP transition: (rows sharded) → (cols sharded).
+
+    ≙ dap.py's row↔col resharding between triangular/axial attention
+    blocks: one all-to-all instead of gather+slice.
+    """
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=col_axis % x.ndim,
+        concat_axis=row_axis % x.ndim, tiled=True,
+    )
+
+
+def scatter_cols_gather_rows(x, axis_name: str, row_axis: int = -3, col_axis: int = -2):
+    """Inverse DAP transition: (cols sharded) → (rows sharded)."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=row_axis % x.ndim,
+        concat_axis=col_axis % x.ndim, tiled=True,
+    )

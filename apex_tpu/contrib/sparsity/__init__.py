@@ -1,0 +1,116 @@
+"""ASP — automatic structured (2:4) sparsity — ≙ ``apex/contrib/sparsity``
+(``asp.py`` :: ``ASP``, ``sparse_masklib.py`` :: ``create_mask``,
+``permutation_lib.py``; native permutation-search kernels).
+
+Functional parity, documented delta: TPUs have no 2:4 sparse tensor cores,
+so the masks here buy model compression / sparse fine-tuning semantics
+(mask weights, keep masks applied through optimizer steps), not a matmul
+speedup.  The mask math matches the reference: for each group of 4
+consecutive weights **along the matmul reduction (input) dim**, keep the
+2 of largest magnitude.  Torch Linear weights are ``(out, in)`` so the
+reference prunes the last axis; flax kernels are ``(in, out)`` so here the
+input dim is axis ``-2`` — :func:`create_mask` takes the axis explicitly
+and :class:`ASP` picks it from the leaf name.  Channel-permutation search
+(the reference's accuracy-preserving trick) is out of scope — its kernels
+exist purely to make GPU sparse-TC constraints cheaper to satisfy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["create_mask", "ASP"]
+
+PyTree = Any
+
+
+def create_mask(weight, pattern: str = "m4n2_1d", axis: int = -1):
+    """2:4 mask along ``axis`` — ≙ sparse_masklib.create_mask.
+
+    Keeps the top-2 |w| in every aligned group of 4 along ``axis``
+    (which must have length divisible by 4).
+    """
+    if pattern not in ("m4n2_1d", "m4n2"):
+        raise ValueError(f"unsupported sparsity pattern {pattern!r}")
+    axis = axis % weight.ndim
+    w = jnp.moveaxis(weight, axis, -1)
+    k = w.shape[-1]
+    if k % 4:
+        raise ValueError(f"pruned axis length ({k}) must be divisible by 4")
+    mag = jnp.abs(w.astype(jnp.float32)).reshape(*w.shape[:-1], k // 4, 4)
+    # rank within each group; keep the two largest magnitudes
+    order = jnp.argsort(mag, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= 2).reshape(w.shape)
+    return jnp.moveaxis(mask, -1, axis)
+
+
+def _input_axis(path: str) -> int:
+    """The matmul reduction axis by layout convention: flax 'kernel' is
+    (in, out) → -2; torch-style 'weight' is (out, in) → -1."""
+    return -2 if "kernel" in path else -1
+
+
+def _default_allowed(path: str, leaf) -> bool:
+    """Prune 2-D+ matmul weights only (the reference whitelists Linear/Conv
+    weights with both dims >= 16 and skips biases/norms)."""
+    if leaf.ndim < 2:
+        return False
+    if leaf.shape[-1] < 16 or leaf.shape[-2] < 16:
+        return False
+    if "kernel" not in path and "weight" not in path:
+        return False
+    return leaf.shape[_input_axis(path)] % 4 == 0
+
+
+class ASP:
+    """≙ apex.contrib.sparsity.ASP — functional-state version.
+
+    Workflow (mirrors ``ASP.prune_trained_model(model, optimizer)``)::
+
+        masks = ASP.compute_sparse_masks(params)     # one-time mask search
+        params = ASP.apply_masks(params, masks)      # zero the pruned half
+        ...
+        grads = ASP.apply_masks(grads, masks)        # inside the train step
+        params = ASP.apply_masks(new_params, masks)  # keep update sparse
+
+    Non-pruned leaves carry a scalar ``True`` sentinel (not a full-size
+    mask): no memory held, and ``apply_masks`` passes them through
+    untouched.
+    """
+
+    @staticmethod
+    def compute_sparse_masks(
+        params: PyTree,
+        allowed: Optional[Callable[[str, Any], bool]] = None,
+        pattern: str = "m4n2_1d",
+    ) -> PyTree:
+        allowed = allowed or _default_allowed
+        flat = jax.tree_util.tree_leaves_with_path(params)
+
+        def mask_for(path, leaf):
+            name = jax.tree_util.keystr(path)
+            if allowed(name, leaf):
+                return create_mask(leaf, pattern, axis=_input_axis(name))
+            return jnp.asarray(True)  # scalar sentinel: leaf not pruned
+
+        masks = [mask_for(p, l) for p, l in flat]
+        treedef = jax.tree_util.tree_structure(params)
+        return jax.tree_util.tree_unflatten(treedef, masks)
+
+    @staticmethod
+    def apply_masks(tree: PyTree, masks: PyTree) -> PyTree:
+        return jax.tree_util.tree_map(
+            lambda x, m: x if m.ndim == 0 else x * m.astype(x.dtype),
+            tree,
+            masks,
+        )
+
+    @staticmethod
+    def prune_trained_model(params: PyTree, pattern: str = "m4n2_1d"):
+        """One-shot: returns (pruned_params, masks)."""
+        masks = ASP.compute_sparse_masks(params, pattern=pattern)
+        return ASP.apply_masks(params, masks), masks
