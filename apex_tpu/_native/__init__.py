@@ -1,0 +1,199 @@
+"""Native host-ops loader — builds ``host_ops.cpp`` on demand (g++, cached
+by source mtime) and binds it via ctypes; falls back to numpy
+implementations when no toolchain is available.
+
+≙ the reference's L0/L1 native split (``setup.py --cpp_ext`` building
+``apex_C``): the device side of this framework is XLA/Pallas, but host-side
+runtime work (flat-buffer assembly, input-pipeline corruption) is native
+C++ exactly where the reference's is.  ``NATIVE_AVAILABLE`` tells callers
+which path they got (every function is numerically identical either way —
+the MLM fallback replays the same splitmix64 stream in vectorized numpy).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "NATIVE_AVAILABLE",
+    "flatten_f32",
+    "unflatten_f32",
+    "mlm_mask_batch",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_ops.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+NATIVE_AVAILABLE = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get("APEX_TPU_NATIVE_CACHE")
+    if not d:
+        d = os.path.join(
+            tempfile.gettempdir(), f"apex_tpu_native_{os.getuid()}"
+        )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, NATIVE_AVAILABLE
+    if _LIB is not None:
+        return _LIB
+    so = os.path.join(_build_dir(), "libapex_tpu_host.so")
+    try:
+        if (
+            not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(_SRC)
+        ):
+            subprocess.run(
+                [
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    "-pthread", _SRC, "-o", so,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        lib = ctypes.CDLL(so)
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+    i64 = ctypes.c_int64
+    lib.apex_flatten_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(i64), i64,
+        ctypes.c_void_p, i64,
+    ]
+    lib.apex_unflatten_f32.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(i64), i64,
+        ctypes.POINTER(ctypes.c_void_p), i64,
+    ]
+    lib.apex_mlm_mask.argtypes = [
+        ctypes.c_void_p, i64, ctypes.c_uint64, ctypes.c_double,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p, i64,
+    ]
+    _LIB = lib
+    NATIVE_AVAILABLE = True
+    return lib
+
+
+def _nthreads() -> int:
+    return min(8, os.cpu_count() or 1)
+
+
+def flatten_f32(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate f32 host arrays into one flat buffer (threaded memcpy).
+
+    ≙ ``apex_C.flatten`` on the host side; pairs with a single
+    host→device transfer instead of one per tensor.
+    """
+    arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+    total = sum(a.size for a in arrays)
+    out = np.empty((total,), np.float32)
+    lib = _load()
+    if lib is None:
+        np.concatenate([a.ravel() for a in arrays], out=out)
+        return out
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.size for a in arrays])
+    lib.apex_flatten_f32(srcs, sizes, n, out.ctypes.data, _nthreads())
+    return out
+
+
+def unflatten_f32(
+    flat: np.ndarray, like: Sequence[np.ndarray]
+) -> List[np.ndarray]:
+    """Split a flat f32 buffer back into arrays shaped like ``like``."""
+    flat = np.ascontiguousarray(flat, dtype=np.float32)
+    sizes = [int(a.size) for a in like]
+    if flat.size != sum(sizes):
+        raise ValueError(
+            f"flat buffer has {flat.size} elements, need {sum(sizes)}"
+        )
+    outs = [np.empty(a.shape, np.float32) for a in like]
+    lib = _load()
+    if lib is None:
+        off = 0
+        for o, s in zip(outs, sizes):
+            o.ravel()[:] = flat[off : off + s]
+            off += s
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    csizes = (ctypes.c_int64 * n)(*sizes)
+    lib.apex_unflatten_f32(flat.ctypes.data, csizes, n, dsts, _nthreads())
+    return outs
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _u01(bits: np.ndarray) -> np.ndarray:
+    return (bits >> np.uint64(11)).astype(np.float64) / 9007199254740992.0
+
+
+def mlm_mask_batch(
+    ids: np.ndarray,
+    seed: int,
+    *,
+    mask_prob: float = 0.15,
+    mask_id: int = 103,
+    vocab_size: int = 30522,
+    special_floor: int = 1000,
+):
+    """BERT masked-LM corruption (80/10/10) — the input-pipeline hot loop.
+
+    ids: int32 array (any shape).  Returns (masked_ids, labels) with
+    labels = -1 at unselected positions.  Deterministic in (seed,
+    position) via a counter-based splitmix64 stream, so the native and
+    numpy paths produce bit-identical batches.
+    """
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    out_ids = np.empty_like(ids)
+    labels = np.empty_like(ids)
+    lib = _load()
+    if lib is not None:
+        lib.apex_mlm_mask(
+            ids.ctypes.data, ids.size, ctypes.c_uint64(seed),
+            float(mask_prob), np.int32(mask_id), np.int32(vocab_size),
+            np.int32(special_floor), out_ids.ctypes.data,
+            labels.ctypes.data, _nthreads(),
+        )
+        return out_ids, labels
+
+    # vectorized numpy replay of the identical stream
+    flat = ids.ravel()
+    idx = np.arange(flat.size, dtype=np.uint64)
+    r0 = _splitmix64(np.uint64(seed) ^ idx)
+    selectable = flat >= special_floor
+    selected = selectable & (_u01(r0) < mask_prob)
+    r1 = _splitmix64(r0)
+    action = _u01(r1)
+    r2 = _splitmix64(r1)
+    rand_tok = (
+        special_floor
+        + (_splitmix64(r2) % np.uint64(vocab_size - special_floor)).astype(
+            np.int32
+        )
+    )
+    out = flat.copy()
+    out[selected & (action < 0.8)] = mask_id
+    mid = selected & (action >= 0.8) & (action < 0.9)
+    out[mid] = rand_tok[mid]
+    lab = np.where(selected, flat, -1).astype(np.int32)
+    out_ids[...] = out.reshape(ids.shape)
+    labels[...] = lab.reshape(ids.shape)
+    return out_ids, labels

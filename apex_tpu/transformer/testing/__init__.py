@@ -1,0 +1,23 @@
+"""In-package distributed test harness — ≙ ``apex/transformer/testing``.
+
+The reference ships ``DistributedTestBase`` (spawns one NCCL process per
+GPU), ``commons`` (seeds, separators, toy layers) and standalone
+GPT/BERT fixtures for its pipeline tests.  The TPU analog is strictly
+simpler: a virtual CPU mesh replaces process spawning (§4 of SURVEY.md),
+and the standalone models are thin toy configs over
+:mod:`apex_tpu.models`.
+"""
+
+from apex_tpu.transformer.testing.commons import (  # noqa: F401
+    IdentityLayer,
+    cpu_mesh,
+    initialize_distributed,
+    print_separator,
+    set_random_seed,
+)
+from apex_tpu.transformer.testing.standalone_bert import (  # noqa: F401
+    bert_model_provider,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
+    gpt_model_provider,
+)

@@ -1,0 +1,101 @@
+"""≙ ``apex/transformer/testing/commons.py`` (``set_random_seed``,
+``print_separator``, ``initialize_distributed``, ``IdentityLayer``) and the
+world-size machinery of ``distributed_test_base.py``.
+
+Where ``DistributedTestBase`` spawns one NCCL process per GPU and skips
+below 2 GPUs, :func:`cpu_mesh` gives any world size on one host — set
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before the first
+backend use (tests/conftest.py does) and every DP/TP/PP/SP/CP test runs
+in CI with no hardware.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+from apex_tpu import parallel_state as ps
+
+__all__ = [
+    "set_random_seed",
+    "print_separator",
+    "initialize_distributed",
+    "cpu_mesh",
+    "IdentityLayer",
+]
+
+
+def set_random_seed(seed: int):
+    """≙ commons.set_random_seed — returns the JAX key (keys are values,
+    not global state; numpy's global RNG is seeded for host-side data)."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def print_separator(message: str):
+    """≙ commons.print_separator."""
+    print(f"\n{'-' * 31}\n{message:^31}\n{'-' * 31}", flush=True)
+
+
+def initialize_distributed(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    **kwargs,
+):
+    """≙ commons.initialize_distributed — on TPU there is no process-group
+    bootstrap; this just (re)builds the global mesh."""
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    return ps.initialize_model_parallel(
+        tensor_model_parallel_size=tensor_model_parallel_size,
+        pipeline_model_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size,
+        **kwargs,
+    )
+
+
+@contextlib.contextmanager
+def cpu_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    n_devices: Optional[int] = None,
+):
+    """Context manager: build a mesh (over the first ``n_devices``
+    devices), yield it, destroy on exit.  The standalone analog of the
+    conftest fixtures, usable from scripts."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    mesh = initialize_distributed(
+        tensor_model_parallel_size,
+        pipeline_model_parallel_size,
+        context_parallel_size,
+        devices=devices,
+    )
+    try:
+        yield mesh
+    finally:
+        ps.destroy_model_parallel()
+
+
+class IdentityLayer(nn.Module):
+    """≙ commons.IdentityLayer — a learnable tensor wrapped as a module
+    (used by the reference's mapping/grad tests)."""
+
+    shape: tuple
+    scale: float = 1.0
+
+    @nn.compact
+    def __call__(self):
+        w = self.param(
+            "weight",
+            lambda key, shape: self.scale * jax.random.normal(key, shape),
+            self.shape,
+        )
+        return w

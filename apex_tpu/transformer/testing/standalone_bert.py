@@ -1,0 +1,35 @@
+"""≙ ``apex/transformer/testing/standalone_bert.py`` — the minimal BERT
+fixture the reference's pipeline tests build (``bert_model_provider``).
+
+The real model lives in :mod:`apex_tpu.models.bert`; this provider pins a
+toy configuration with deterministic shapes, sized so every parallel mode
+(tp ≤ 8, pp ≤ 4, sp) divides evenly on the 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.models.bert import BertConfig, BertForPreTraining
+
+__all__ = ["bert_model_provider", "TEST_CONFIG"]
+
+TEST_CONFIG = dict(
+    vocab_size=128,
+    hidden_size=64,
+    num_layers=4,
+    num_heads=8,
+    intermediate_size=128,
+    max_position_embeddings=64,
+    dtype=jnp.float32,
+)
+
+
+def bert_model_provider(
+    sequence_parallel: bool = False, remat: bool = False, **overrides
+) -> BertForPreTraining:
+    cfg = BertConfig(
+        sequence_parallel=sequence_parallel, remat=remat,
+        **{**TEST_CONFIG, **overrides},
+    )
+    return BertForPreTraining(cfg)

@@ -1,0 +1,30 @@
+"""≙ ``apex/transformer/testing/standalone_gpt.py`` — the minimal GPT
+fixture (``gpt_model_provider``) over :mod:`apex_tpu.models.gpt`."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GptConfig, GptModel
+
+__all__ = ["gpt_model_provider", "TEST_CONFIG"]
+
+TEST_CONFIG = dict(
+    vocab_size=128,
+    hidden_size=64,
+    num_layers=4,
+    num_heads=8,
+    intermediate_size=128,
+    max_seq_len=64,
+    dtype=jnp.float32,
+)
+
+
+def gpt_model_provider(
+    sequence_parallel: bool = False, remat: bool = False, **overrides
+) -> GptModel:
+    cfg = GptConfig(
+        sequence_parallel=sequence_parallel, remat=remat,
+        **{**TEST_CONFIG, **overrides},
+    )
+    return GptModel(cfg)

@@ -1,0 +1,122 @@
+"""DCGAN with amp — ≙ ``examples/dcgan/main_amp.py``: TWO models and TWO
+optimizers under mixed precision, each with its own loss scaler (the
+reference's ``amp.initialize([netD, netG], [optD, optG], num_losses=3``
+pattern — per-loss scaling maps to per-handle states here).
+
+Synthetic data; sized to run anywhere:
+
+    python examples/dcgan/main_amp.py --steps 20
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "../.."))
+)
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu import amp
+
+
+class Generator(nn.Module):
+    ch: int = 32
+
+    @nn.compact
+    def __call__(self, z):
+        x = nn.Dense(4 * 4 * self.ch * 4)(z).reshape(z.shape[0], 4, 4, -1)
+        for mult in (4, 2, 1):
+            x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+            x = nn.ConvTranspose(
+                self.ch * mult, (4, 4), strides=(2, 2), padding="SAME"
+            )(x)
+        return jnp.tanh(nn.Conv(3, (3, 3), padding="SAME")(x))
+
+
+class Discriminator(nn.Module):
+    ch: int = 32
+
+    @nn.compact
+    def __call__(self, img):
+        x = img
+        for mult in (1, 2, 4):
+            x = nn.Conv(
+                self.ch * mult, (4, 4), strides=(2, 2), padding="SAME"
+            )(x)
+            x = nn.leaky_relu(x, 0.2)
+        return nn.Dense(1)(x.reshape(x.shape[0], -1))[:, 0]
+
+
+def bce(logits, label):
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * label + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--zdim", type=int, default=64)
+    p.add_argument("--opt-level", default="O1")
+    args = p.parse_args()
+
+    gen, disc = Generator(), Discriminator()
+    z0 = jnp.zeros((args.batch, args.zdim))
+    img0 = jnp.zeros((args.batch, 32, 32, 3))
+    g_params = gen.init(jax.random.PRNGKey(0), z0)["params"]
+    d_params = disc.init(jax.random.PRNGKey(1), img0)["params"]
+
+    txg, txd = optax.adam(2e-4, b1=0.5), optax.adam(2e-4, b1=0.5)
+    # two models, two optimizers, independent scaler state each
+    g_params, g_handle = amp.initialize(g_params, txg, opt_level=args.opt_level)
+    d_params, d_handle = amp.initialize(d_params, txd, opt_level=args.opt_level)
+    g_state, d_state = g_handle.init(g_params), d_handle.init(d_params)
+
+    @jax.jit
+    def d_step(d_params, d_state, g_params, real, z):
+        fake = gen.apply({"params": g_params}, z)
+
+        def loss(dp):
+            l_real = bce(disc.apply({"params": dp}, real), 1.0)
+            l_fake = bce(disc.apply({"params": dp}, jax.lax.stop_gradient(fake)), 0.0)
+            return l_real + l_fake
+
+        l, grads = jax.value_and_grad(
+            lambda dp: d_handle.scale_loss(loss(dp), d_state)
+        )(d_params)
+        d_params, d_state, _ = d_handle.step(d_params, grads, d_state)
+        return d_params, d_state, l
+
+    @jax.jit
+    def g_step(g_params, g_state, d_params, z):
+        def loss(gp):
+            fake = gen.apply({"params": gp}, z)
+            return bce(disc.apply({"params": d_params}, fake), 1.0)
+
+        l, grads = jax.value_and_grad(
+            lambda gp: g_handle.scale_loss(loss(gp), g_state)
+        )(g_params)
+        g_params, g_state, _ = g_handle.step(g_params, grads, g_state)
+        return g_params, g_state, l
+
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        real = jnp.asarray(rng.randn(args.batch, 32, 32, 3), jnp.float32)
+        z = jnp.asarray(rng.randn(args.batch, args.zdim), jnp.float32)
+        d_params, d_state, dl = d_step(d_params, d_state, g_params, real, z)
+        g_params, g_state, gl = g_step(g_params, g_state, d_params, z)
+        if i % 5 == 0:
+            print(f"step {i:3d}  D {float(dl):.4f}  G {float(gl):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
