@@ -24,6 +24,9 @@ __all__ = ["RNNReLU", "RNNTanh", "LSTM", "GRU", "mLSTM"]
 
 
 def _dense(x, w, b=None):
+    from apex_tpu.amp.lists import amp_cast
+
+    x, w, b = amp_cast("rnn_gemm", x, w, b)
     y = jnp.dot(x, w, preferred_element_type=jnp.float32)
     if b is not None:
         y = y + b

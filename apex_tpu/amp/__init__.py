@@ -1,5 +1,6 @@
 """Mixed precision — ≙ apex/amp (policies, loss scaling, master weights)."""
 
+from apex_tpu.amp import lists  # noqa: F401
 from apex_tpu.amp.frontend import (  # noqa: F401
     AmpHandle,
     AmpState,

@@ -68,6 +68,29 @@ class AmpHandle:
         """≙ the `with amp.scale_loss(loss, opt) as scaled:` entry."""
         return self.scaler.scale(loss, state.scaler_state)
 
+    # -- O1 per-op casting (≙ patch_torch_functions) ---------------------
+    def patch_functions(self):
+        """Context manager activating the per-op cast registry
+        (:mod:`apex_tpu.amp.lists`) with this handle's half dtype — the O1
+        patch-table semantics.  Wrap the traced forward:
+
+            with handle.patch_functions():
+                loss = loss_fn(params, batch)
+
+        O0/O2/O3 keep their whole-tree policies; per the reference's table
+        only O1 patches functions, so this raises on other levels to keep
+        opt-level semantics distinguishable.
+        """
+        if self.properties.opt_level != "O1":
+            raise RuntimeError(
+                "patch_functions() is the O1 mechanism (reference: "
+                "patch_torch_functions=True only at O1); current level is "
+                f"{self.properties.opt_level}"
+            )
+        from apex_tpu.amp import lists
+
+        return lists.o1_patch(self.properties.compute_dtype)
+
     # -- the patched optimizer.step --------------------------------------
     def step(self, params, scaled_grads, state: AmpState):
         """Returns (new_params, new_state, found_inf).

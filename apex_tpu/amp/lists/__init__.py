@@ -1,0 +1,45 @@
+"""Per-op AMP cast policy — the O1 patch-table semantics.
+
+≙ ``apex/amp/lists/{functional_overrides,torch_overrides,tensor_overrides}``:
+the reference's O1 monkey-patches every listed torch function so GEMM-class
+ops run in fp16, reduction/loss-class ops run in fp32, and multi-input ops
+promote to the widest input dtype.  The TPU-native analog patches nothing —
+this repo's public ops *consult* the active policy at trace time via
+:func:`amp_cast` at their entry, so the same op-category table is applied
+structurally inside jit.
+
+Activate with ``with amp.lists.o1_patch(half_dtype): ...`` around the traced
+forward (or via ``AmpHandle.patch_functions()``).  With no active policy
+every hook is an identity — zero cost and zero behavior change.
+
+Note the trace-time caveat (inherent to any O1 implementation over a traced
+runtime, and analogous to the reference patching process-globally at
+``amp.initialize`` time): a ``jit``-cached function keeps the policy it was
+traced under; activate the context before the first traced call.
+"""
+
+from apex_tpu.amp.lists._registry import (
+    CastPolicy,
+    active_policy,
+    amp_cast,
+    category,
+    o1_patch,
+    register,
+)
+from apex_tpu.amp.lists.functional_overrides import (
+    CASTS,
+    FP16_FUNCS,
+    FP32_FUNCS,
+)
+
+__all__ = [
+    "CastPolicy",
+    "active_policy",
+    "amp_cast",
+    "category",
+    "o1_patch",
+    "register",
+    "FP16_FUNCS",
+    "FP32_FUNCS",
+    "CASTS",
+]

@@ -1,0 +1,97 @@
+"""The op→category registry and the trace-time cast hook."""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "CastPolicy",
+    "register",
+    "category",
+    "o1_patch",
+    "active_policy",
+    "amp_cast",
+]
+
+# op name -> "half" | "fp32" | "promote"
+_CATEGORY: dict = {}
+
+_VALID = ("half", "fp32", "promote")
+
+
+def register(name: str, cat: str) -> None:
+    """Add/override an op's cast category (≙ editing the override lists)."""
+    if cat not in _VALID:
+        raise ValueError(f"category must be one of {_VALID}, got {cat!r}")
+    _CATEGORY[name] = cat
+
+
+def category(name: str) -> Optional[str]:
+    return _CATEGORY.get(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class CastPolicy:
+    """Active O1 policy: which dtype 'half' ops cast to."""
+
+    half_dtype: Any = jnp.bfloat16
+
+
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "apex_tpu_amp_op_policy", default=None
+)
+
+
+def active_policy() -> Optional[CastPolicy]:
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def o1_patch(half_dtype=jnp.bfloat16) -> Iterator[None]:
+    """Activate per-op casting (≙ ``patch_torch_functions=True``)."""
+    token = _ACTIVE.set(CastPolicy(half_dtype))
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+def _is_float_array(x) -> bool:
+    return isinstance(
+        x, (jax.Array, jnp.ndarray)
+    ) and jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def amp_cast(op_name: str, *arrays):
+    """Cast ``arrays`` per the active policy and ``op_name``'s category.
+
+    Identity when no policy is active or the op is unregistered.  Non-array
+    / non-float leaves (None, ints, bools) pass through untouched.  Returns
+    a single value for a single input, else a tuple.
+    """
+    pol = _ACTIVE.get()
+    cat = _CATEGORY.get(op_name)
+    if pol is None or cat is None:
+        return arrays[0] if len(arrays) == 1 else arrays
+
+    if cat == "half":
+        target = pol.half_dtype
+    elif cat == "fp32":
+        target = jnp.float32
+    else:  # promote: widest floating dtype among the inputs wins
+        floats = [jnp.result_type(a) for a in arrays if _is_float_array(a)]
+        target = jnp.result_type(*floats) if floats else None
+
+    def cast(x):
+        if target is not None and _is_float_array(x):
+            return x.astype(target)
+        return x
+
+    out = tuple(cast(a) for a in arrays)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,53 @@
+"""The op-category table for this framework's public ops.
+
+≙ ``apex/amp/lists/functional_overrides.py`` :: ``FP16_FUNCS`` /
+``FP32_FUNCS`` / ``CASTS``.  The reference lists torch.nn.functional names;
+here the names are this repo's op entry points (each calls
+``amp_cast(<name>, ...)`` on its tensor inputs).
+
+Categories follow the reference's rationale:
+- **half** (FP16_FUNCS): GEMM/conv-class compute — tensor-core (MXU) ops
+  where half precision is free accuracy-wise and 2x+ throughput;
+- **fp32** (FP32_FUNCS): reductions, losses, softmax/log/exp — ops whose
+  numerics degrade in half precision;
+- **promote** (CASTS): multi-input elementwise ops — widest input dtype
+  wins so mixed half/f32 operands don't silently truncate.
+"""
+
+from apex_tpu.amp.lists._registry import register
+
+# GEMM / conv class → half
+FP16_FUNCS = [
+    "attention",
+    "mlp",
+    "fused_dense",
+    "fused_dense_gelu_dense",
+    "conv_bias_relu",
+    "rnn_gemm",
+]
+
+# numerics-sensitive → fp32
+FP32_FUNCS = [
+    "layer_norm",
+    "rms_norm",
+    "scaled_softmax",
+    "scaled_masked_softmax",
+    "xentropy",
+    "focal_loss",
+    "group_norm",
+]
+
+# multi-input elementwise → promote to widest.  "add" has no single entry
+# point in this repo — it is the generic promote rule available to user
+# code via ``amp_cast("add", a, b)``.
+CASTS = [
+    "add",
+    "index_mul_2d",
+]
+
+for _name in FP16_FUNCS:
+    register(_name, "half")
+for _name in FP32_FUNCS:
+    register(_name, "fp32")
+for _name in CASTS:
+    register(_name, "promote")

@@ -18,6 +18,9 @@ __all__ = ["ConvBias", "ConvBiasReLU", "ConvBiasMaskReLU", "conv_bias"]
 
 def conv_bias(x, weight, bias, *, stride=1, padding=1):
     """NHWC conv + bias.  weight: (KH, KW, Cin, Cout); bias (Cout,)."""
+    from apex_tpu.amp.lists import amp_cast
+
+    x, weight, bias = amp_cast("conv_bias_relu", x, weight, bias)
     if isinstance(stride, int):
         stride = (stride, stride)
     if isinstance(padding, int):

@@ -31,6 +31,9 @@ def sigmoid_focal_loss(
     logits/targets_one_hot: broadcastable (..., num_classes) with targets
     in {0, 1} (floats allowed for smoothing).
     """
+    from apex_tpu.amp.lists import amp_cast
+
+    logits = amp_cast("focal_loss", logits)
     lf = logits.astype(jnp.float32)
     t = targets_one_hot.astype(jnp.float32)
     if label_smoothing > 0.0:

@@ -32,6 +32,9 @@ def group_norm(
     """x: (..., C) channels-last.  Stats over (spatial..., C/G) per group."""
     if act not in _ACTS:
         raise ValueError(f"act must be one of {sorted(k or '' for k in _ACTS)}")
+    from apex_tpu.amp.lists import amp_cast
+
+    x, weight, bias = amp_cast("group_norm", x, weight, bias)
     c = x.shape[-1]
     if c % num_groups:
         raise ValueError(f"channels {c} not divisible by num_groups {num_groups}")

@@ -24,4 +24,7 @@ def index_mul_2d(in1, in2, idx1):
             f"in2 rows ({in2.shape[0]}) must match idx1 length "
             f"({idx1.shape[0]})"
         )
+    from apex_tpu.amp.lists import amp_cast
+
+    in1, in2 = amp_cast("index_mul_2d", in1, in2)
     return jnp.take(in1, idx1, axis=0) * in2

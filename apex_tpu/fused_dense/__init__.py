@@ -29,6 +29,9 @@ def fused_dense_function(x, weight, bias=None):
 
     ``weight`` uses the JAX layout ``(in, out)``.
     """
+    from apex_tpu.amp.lists import amp_cast
+
+    x, weight, bias = amp_cast("fused_dense", x, weight, bias)
     y = jnp.dot(x, weight, preferred_element_type=jnp.float32)
     if bias is not None:
         y = y + bias
@@ -40,6 +43,11 @@ def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
 
     Uses tanh-approximate GELU, matching the reference kernel's polynomial.
     """
+    from apex_tpu.amp.lists import amp_cast
+
+    x, weight1, bias1, weight2, bias2 = amp_cast(
+        "fused_dense_gelu_dense", x, weight1, bias1, weight2, bias2
+    )
     h = jnp.dot(x, weight1, preferred_element_type=jnp.float32)
     if bias1 is not None:
         h = h + bias1

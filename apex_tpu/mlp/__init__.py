@@ -39,6 +39,12 @@ def mlp_function(
     """
     if activation not in _ACTIVATIONS:
         raise ValueError(f"activation must be one of {sorted(_ACTIVATIONS)}")
+    from apex_tpu.amp.lists import amp_cast
+
+    cast = amp_cast("mlp", x, *weights, *biases)
+    x = cast[0]
+    weights = cast[1 : 1 + len(weights)]
+    biases = cast[1 + len(weights) :]
     act = _ACTIVATIONS[activation]
     h = x
     last = len(weights) - 1

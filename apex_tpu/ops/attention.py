@@ -154,6 +154,9 @@ def flash_attention(
     unfused path, whose autodiff produces the bias gradient.  Returns
     (B,H,Sq,D) in the input dtype.
     """
+    from apex_tpu.amp.lists import amp_cast
+
+    q, k, v = amp_cast("attention", q, k, v)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if bias is not None:

@@ -147,6 +147,9 @@ _norm2d.defvjp(_norm2d_fwd, _norm2d_bwd)
 
 
 def _run(x, normalized_shape, w, b, eps, rms, memory_efficient):
+    from apex_tpu.amp.lists import amp_cast
+
+    x, w, b = amp_cast("rms_norm" if rms else "layer_norm", x, w, b)
     shape_t = (
         (normalized_shape,)
         if isinstance(normalized_shape, int)

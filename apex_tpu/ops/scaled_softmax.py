@@ -37,6 +37,12 @@ __all__ = [
 _MASK_FILL = -10000.0
 
 
+def _amp(name, x):
+    from apex_tpu.amp.lists import amp_cast
+
+    return amp_cast(name, x)
+
+
 def _softmax_fwd(x):
     xf = x.astype(jnp.float32)
     m = jnp.max(xf, axis=-1, keepdims=True)
@@ -53,9 +59,13 @@ def _softmax_bwd(y, g, scale):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _scaled_softmax_vjp(x, scale):
+    return _softmax_fwd(x * scale).astype(x.dtype)
+
+
 def scaled_softmax(x, scale):
     """softmax(x * scale) — ≙ ScaledSoftmax (scaled_softmax_cuda::fwd)."""
-    return _softmax_fwd(x * scale).astype(x.dtype)
+    return _scaled_softmax_vjp(_amp("scaled_softmax", x), scale)
 
 
 def _ss_fwd(x, scale):
@@ -67,18 +77,24 @@ def _ss_bwd(scale, y, g):
     return (_softmax_bwd(y, g, scale),)
 
 
-scaled_softmax.defvjp(_ss_fwd, _ss_bwd)
+_scaled_softmax_vjp.defvjp(_ss_fwd, _ss_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scaled_masked_softmax_vjp(x, mask, scale):
+    y, _ = _sms_fwd(x, mask, scale)
+    return y
+
+
 def scaled_masked_softmax(x, mask, scale):
     """softmax(mask_fill(x*scale)) over 4D (b, np, sq, sk).
 
     ≙ ScaledMaskedSoftmax (scaled_masked_softmax_cuda::fwd); ``mask`` is
     broadcastable boolean (b, 1, sq, sk), True = masked.
     """
-    y, _ = _sms_fwd(x, mask, scale)
-    return y
+    return _scaled_masked_softmax_vjp(
+        _amp("scaled_masked_softmax", x), mask, scale
+    )
 
 
 def _sms_fwd(x, mask, scale):
@@ -101,14 +117,18 @@ def _sms_bwd(scale, y, g):
     return _softmax_bwd(y, g, scale), None
 
 
-scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
+_scaled_masked_softmax_vjp.defvjp(_sms_fwd, _sms_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scaled_upper_triang_masked_softmax(x, scale):
-    """Causal softmax over (b, sq, sk) — ≙ ScaledUpperTriangMaskedSoftmax."""
+def _sutms_vjp(x, scale):
     y, _ = _sutms_fwd(x, scale)
     return y
+
+
+def scaled_upper_triang_masked_softmax(x, scale):
+    """Causal softmax over (b, sq, sk) — ≙ ScaledUpperTriangMaskedSoftmax."""
+    return _sutms_vjp(_amp("scaled_softmax", x), scale)
 
 
 def _causal_mask(sq, sk):
@@ -140,7 +160,7 @@ def _sutms_bwd(scale, y, g):
     return (_softmax_bwd(y, g, scale),)
 
 
-scaled_upper_triang_masked_softmax.defvjp(_sutms_fwd, _sutms_bwd)
+_sutms_vjp.defvjp(_sutms_fwd, _sutms_bwd)
 
 
 def generic_scaled_masked_softmax(x, mask, scale):

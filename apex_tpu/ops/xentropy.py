@@ -33,10 +33,16 @@ __all__ = ["softmax_cross_entropy_loss", "SoftmaxCrossEntropyLoss"]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, ignore_idx=-100):
-    """Per-example smoothed CE loss; logits (N, V), labels (N,) int."""
+def _xent_vjp(logits, labels, smoothing, ignore_idx):
     loss, _ = _xent_fwd(logits, labels, smoothing, ignore_idx)
     return loss
+
+
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, ignore_idx=-100):
+    """Per-example smoothed CE loss; logits (N, V), labels (N,) int."""
+    from apex_tpu.amp.lists import amp_cast
+
+    return _xent_vjp(amp_cast("xentropy", logits), labels, smoothing, ignore_idx)
 
 
 def _parts(logits, labels, smoothing):
@@ -76,7 +82,7 @@ def _xent_bwd(smoothing, ignore_idx, res, g):
     return dlogits.astype(logits.dtype), None
 
 
-softmax_cross_entropy_loss.defvjp(_xent_fwd, _xent_bwd)
+_xent_vjp.defvjp(_xent_fwd, _xent_bwd)
 
 
 class SoftmaxCrossEntropyLoss:

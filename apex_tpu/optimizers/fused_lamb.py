@@ -63,6 +63,10 @@ def fused_lamb(
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_lamb requires params for the update")
+        with jax.named_scope("fused_lamb_update"):
+            return _update(grads, state, params)
+
+    def _update(grads, state, params):
         count = state.count + 1
         # schedules are evaluated at the 0-based step (optax convention)
         lr = (

@@ -53,7 +53,8 @@ def all_reduce_gradients(
             gf = gf / post
         return gf
 
-    return jax.tree_util.tree_map(f, grads)
+    with jax.named_scope("ddp_allreduce"):
+        return jax.tree_util.tree_map(f, grads)
 
 
 class DistributedDataParallel:

@@ -49,9 +49,10 @@ def _shift(tree: Any, delta: int, axis_name: str, cyclic: bool = False):
         perm = [
             (i, i + delta) for i in range(n) if 0 <= i + delta < n
         ]
-    return jax.tree_util.tree_map(
-        lambda x: jax.lax.ppermute(x, axis_name, perm), tree
-    )
+    with jax.named_scope("pp_p2p_shift"):
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), tree
+        )
 
 
 def send_forward_recv_forward(x, axis_name: str = _PP, cyclic: bool = False):

@@ -1,0 +1,11 @@
+"""Shared utilities (profiling/tracing hooks)."""
+
+from apex_tpu.utils.profiling import (
+    annotate,
+    nvtx_range,
+    range_pop,
+    range_push,
+    trace,
+)
+
+__all__ = ["annotate", "nvtx_range", "range_push", "range_pop", "trace"]

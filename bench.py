@@ -17,6 +17,8 @@ vs_baseline = MFU / 0.50 (the BASELINE.json target of ≥50% MFU).
 
 from __future__ import annotations
 
+import argparse
+import contextlib
 import functools
 import json
 import time
@@ -43,7 +45,8 @@ def _chip_peak(device) -> float:
     return 197e12  # conservative default
 
 
-def main():
+def main(trace_dir: str | None = None):
+    import apex_tpu.utils
     from apex_tpu.models import (
         BertForPreTraining,
         bert_large_config,
@@ -92,12 +95,22 @@ def main():
     params, opt_state, losses = train_chunk(params, opt_state, batch_data)
     loss = float(losses[-1])
 
+    # optional profile of the steady-state window (VERDICT r1 item 5:
+    # ≙ the reference's nvtx bracketing; view in TensorBoard/Perfetto)
+    profile = (
+        apex_tpu.utils.trace(trace_dir)
+        if trace_dir
+        else contextlib.nullcontext()
+    )
     times = []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        params, opt_state, losses = train_chunk(params, opt_state, batch_data)
-        loss = float(losses[-1])  # device->host: the sync point
-        times.append((time.perf_counter() - t0) / chunk)
+    with profile:
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            params, opt_state, losses = train_chunk(
+                params, opt_state, batch_data
+            )
+            loss = float(losses[-1])  # device->host: the sync point
+            times.append((time.perf_counter() - t0) / chunk)
     times.sort()
     step_time = times[len(times) // 2]  # median
 
@@ -120,4 +133,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--trace",
+        metavar="DIR",
+        default=None,
+        help="collect a jax.profiler trace of the timed window into DIR",
+    )
+    main(trace_dir=ap.parse_args().trace)

@@ -227,3 +227,119 @@ def test_helper_roundtrips():
     assert back["w"].dtype == jnp.bfloat16
     g32 = fp16_utils.model_grads_to_master_grads({"w": jnp.ones(3, jnp.bfloat16)})
     assert g32["w"].dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Per-op O1 cast registry (amp.lists — ≙ apex/amp/lists/*_overrides)
+# ---------------------------------------------------------------------------
+
+
+def test_lists_categories():
+    assert amp.lists.category("attention") == "half"
+    assert amp.lists.category("layer_norm") == "fp32"
+    assert amp.lists.category("add") == "promote"
+    assert amp.lists.category("not_an_op") is None
+
+
+def test_o1_patch_half_ops_cast_down():
+    from apex_tpu.fused_dense import fused_dense_function
+    from apex_tpu.ops.attention import flash_attention
+
+    q = jnp.ones((1, 2, 8, 16), jnp.float32)
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 4), jnp.float32)
+    # no active policy: f32 stays f32
+    assert flash_attention(q, q, q).dtype == jnp.float32
+    assert fused_dense_function(x, w).dtype == jnp.float32
+    with amp.lists.o1_patch(jnp.bfloat16):
+        assert flash_attention(q, q, q).dtype == jnp.bfloat16
+        assert fused_dense_function(x, w).dtype == jnp.bfloat16
+
+
+def test_o1_patch_fp32_ops_cast_up():
+    from apex_tpu.ops.layer_norm import fused_layer_norm, fused_layer_norm_affine
+    from apex_tpu.ops.scaled_softmax import scaled_softmax
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+    x = jnp.ones((4, 128), jnp.bfloat16)
+    assert fused_layer_norm(x, 128).dtype == jnp.bfloat16
+    with amp.lists.o1_patch(jnp.bfloat16):
+        # the reference's FP32_FUNCS semantics: norm runs (and returns) f32
+        assert fused_layer_norm(x, 128).dtype == jnp.float32
+        # affine params are upcast too (the norm math sees f32 w/b even
+        # for bf16 inputs); cotangent dtype still follows the primal leaf
+        w = jnp.ones((128,), jnp.bfloat16)
+        b = jnp.zeros((128,), jnp.bfloat16)
+        y, vjp = jax.vjp(
+            lambda xx, ww, bb: fused_layer_norm_affine(xx, ww, bb, 128), x, w, b
+        )
+        assert y.dtype == jnp.float32
+        _, dw, db = vjp(jnp.ones_like(y))
+        assert dw.dtype == w.dtype and db.dtype == b.dtype
+        assert scaled_softmax(x, 1.0).dtype == jnp.float32
+        loss = softmax_cross_entropy_loss(x, jnp.zeros((4,), jnp.int32))
+        assert loss.dtype == jnp.float32
+
+
+def test_o1_promote_widest_wins():
+    with amp.lists.o1_patch(jnp.bfloat16):
+        a, b = amp.lists.amp_cast(
+            "add", jnp.ones((2,), jnp.bfloat16), jnp.ones((2,), jnp.float32)
+        )
+        assert a.dtype == jnp.float32
+        assert b.dtype == jnp.float32
+
+
+def test_o1_differs_from_o2():
+    """The VERDICT item: O1 is per-op (norm f32, gemm half); O2 is
+    whole-tree half.  Same input, different dtype outcomes."""
+    from apex_tpu.ops.layer_norm import fused_layer_norm
+
+    x32 = jnp.ones((4, 128), jnp.float32)
+    params = toy_params()
+    tx = fused_sgd(learning_rate=0.1)
+
+    # O2: params cast bf16 (whole-tree policy)
+    cast_params, handle2 = amp.initialize(
+        params, tx, opt_level="O2", half_dtype=jnp.bfloat16
+    )
+    assert cast_params["w"].dtype == jnp.bfloat16
+    o2_norm = fused_layer_norm(
+        handle2.policy.cast_to_compute(x32), 128
+    ).dtype  # O2: bf16 in, bf16 out
+
+    # O1: params stay f32; per-op registry governs compute dtypes
+    cast_params1, handle1 = amp.initialize(
+        params, tx, opt_level="O1", half_dtype=jnp.bfloat16
+    )
+    assert cast_params1["w"].dtype == jnp.float32
+    with handle1.patch_functions():
+        from apex_tpu.fused_dense import fused_dense_function
+
+        o1_norm = fused_layer_norm(x32, 128).dtype
+        o1_gemm = fused_dense_function(
+            x32, jnp.ones((128, 8), jnp.float32)
+        ).dtype
+    assert o2_norm == jnp.bfloat16
+    assert o1_norm == jnp.float32  # differs from O2
+    assert o1_gemm == jnp.bfloat16
+
+    # only O1 may patch functions (reference: patch_torch_functions table)
+    with pytest.raises(RuntimeError):
+        handle2.patch_functions()
+
+
+def test_registry_register_and_unregistered_passthrough():
+    from apex_tpu.amp.lists import _registry
+
+    amp.lists.register("my_custom_op", "half")
+    try:
+        with amp.lists.o1_patch(jnp.bfloat16):
+            y = amp.lists.amp_cast("my_custom_op", jnp.ones((2,), jnp.float32))
+            assert y.dtype == jnp.bfloat16
+            z = amp.lists.amp_cast("unknown_op", jnp.ones((2,), jnp.float32))
+            assert z.dtype == jnp.float32
+    finally:
+        del _registry._CATEGORY["my_custom_op"]
+    with pytest.raises(ValueError):
+        amp.lists.register("bad", "int8")

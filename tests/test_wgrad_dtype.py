@@ -1,0 +1,83 @@
+"""gradient_accumulation_fusion honesty tests (VERDICT r1 item 6).
+
+The tensor-parallel layers claim the reference's wgrad-accumulation fusion
+(``fused_weight_gradient_mlp_cuda`` :: wgrad GEMM accumulating into an fp32
+main_grad) *structurally*: f32 ``param_dtype`` + bf16 compute ``dtype`` ⇒
+the backward matmul produces the weight cotangent directly in f32 (MXU
+accumulates in f32; ``preferred_element_type`` keeps the output f32 — no
+bf16 round-trip of the wgrad).  These tests pin that claim to the jaxpr so
+flipping param/compute dtype handling breaks a test, not just a docstring.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+
+
+def _wgrad_dot_eqns(jaxpr, weight_shape):
+    """All dot_general eqns in (possibly nested) jaxprs producing the
+    weight-cotangent shape (either orientation)."""
+    found = []
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                shp = eqn.outvars[0].aval.shape
+                if shp in (weight_shape, weight_shape[::-1]):
+                    found.append(eqn)
+            for p in eqn.params.values():
+                if hasattr(p, "jaxpr"):  # ClosedJaxpr
+                    visit(p.jaxpr)
+                elif hasattr(p, "eqns"):  # Jaxpr
+                    visit(p)
+
+    visit(jaxpr.jaxpr)
+    return found
+
+
+@pytest.mark.parametrize("layer_cls", [ColumnParallelLinear, RowParallelLinear])
+def test_wgrad_is_f32_under_bf16_compute(layer_cls):
+    layer = layer_cls(64, 128, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 64), jnp.bfloat16)
+    params = layer.init(jax.random.PRNGKey(1), x)
+    w = params["params"]["weight"]
+    assert w.dtype == jnp.float32  # param_dtype default
+
+    def loss(p):
+        return jnp.sum(layer.apply(p, x).astype(jnp.float32) ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert grads["params"]["weight"].dtype == jnp.float32
+    assert grads["params"]["bias"].dtype == jnp.float32
+
+    # The jaxpr-level claim: the dot_general that *produces* the weight
+    # cotangent emits f32 directly (preferred_element_type=f32), i.e. the
+    # wgrad never exists as a bf16 tensor.
+    jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+    dots = _wgrad_dot_eqns(jaxpr, w.shape)
+    assert dots, "no wgrad dot_general found in the backward jaxpr"
+    for eqn in dots:
+        assert eqn.outvars[0].aval.dtype == jnp.float32
+        assert eqn.params["preferred_element_type"] == jnp.float32
+
+
+def test_wgrad_dtype_follows_param_dtype():
+    """The failing direction: flip param_dtype to bf16 and the f32-wgrad
+    property is gone — proving the test above actually guards something."""
+    layer = ColumnParallelLinear(
+        64, 128, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 64), jnp.bfloat16)
+    params = layer.init(jax.random.PRNGKey(1), x)
+    assert params["params"]["weight"].dtype == jnp.bfloat16
+
+    def loss(p):
+        return jnp.sum(layer.apply(p, x).astype(jnp.float32) ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert grads["params"]["weight"].dtype == jnp.bfloat16
