@@ -26,7 +26,8 @@ FP16_FUNCS = [
     "rnn_gemm",
 ]
 
-# numerics-sensitive → fp32
+# numerics-sensitive → fp32.  focal_loss carries no amp_cast hook: it
+# computes and returns f32 unconditionally (structurally fp32).
 FP32_FUNCS = [
     "layer_norm",
     "rms_norm",

@@ -31,9 +31,8 @@ def sigmoid_focal_loss(
     logits/targets_one_hot: broadcastable (..., num_classes) with targets
     in {0, 1} (floats allowed for smoothing).
     """
-    from apex_tpu.amp.lists import amp_cast
-
-    logits = amp_cast("focal_loss", logits)
+    # FP32_FUNCS category is structural here: math and return value are
+    # unconditionally f32 (no amp_cast hook needed).
     lf = logits.astype(jnp.float32)
     t = targets_one_hot.astype(jnp.float32)
     if label_smoothing > 0.0:

@@ -39,6 +39,8 @@ def mlp_function(
     """
     if activation not in _ACTIVATIONS:
         raise ValueError(f"activation must be one of {sorted(_ACTIVATIONS)}")
+    if not weights:
+        raise ValueError("mlp_function requires at least one weight matrix")
     from apex_tpu.amp.lists import amp_cast
 
     cast = amp_cast("mlp", x, *weights, *biases)
