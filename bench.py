@@ -75,18 +75,27 @@ def _emit(metric, value, unit, vs_baseline):
     )
 
 
-def _time_chunks(fn, carry, chunk, trials):
-    """Median per-step time of ``fn`` (a jitted scan chunk on ``carry``)."""
-    carry, sync = fn(*carry)  # warmup/compile
-    float(jnp.sum(sync))
+def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
+    """Per-step time of ``fn`` (a jitted scan chunk on ``carry``).
+
+    Warmup (compile + one chunk) runs BEFORE the optional ``profile``
+    context is entered, so a collected trace covers only steady state.
+    Returns ``(step_time, carry, last_sync)`` — last_sync is the final
+    synced scalar (the loss for the train benches: the cheap end-to-end
+    sanity signal recorded in the unit string).
+    """
+    carry, sync = fn(*carry)  # warmup/compile — outside the profile window
+    last = float(jnp.sum(sync))
     times = []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        carry, sync = fn(*carry)
-        float(jnp.sum(sync))  # device->host: the sync point
-        times.append((time.perf_counter() - t0) / chunk)
+    with profile if profile is not None else contextlib.nullcontext():
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            carry, sync = fn(*carry)
+            last = float(jnp.sum(sync))  # device->host: the sync point
+            times.append((time.perf_counter() - t0) / chunk)
     times.sort()
-    return times[len(times) // 2], carry
+    t = times[0] if reduce == "min" else times[len(times) // 2]
+    return t, carry, last
 
 
 # ---------------------------------------------------------------------------
@@ -138,15 +147,10 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3):
         )
         return (params, opt_state), losses[-1]
 
-    profile = (
-        apex_tpu.utils.trace(trace_dir)
-        if trace_dir
-        else contextlib.nullcontext()
+    profile = apex_tpu.utils.trace(trace_dir) if trace_dir else None
+    step_time, carry, loss = _time_chunks(
+        train_chunk, (params, opt_state), chunk, trials, profile=profile
     )
-    with profile:
-        step_time, carry = _time_chunks(
-            train_chunk, (params, opt_state), chunk, trials
-        )
     del carry
 
     tokens = seq_len * batch
@@ -156,8 +160,8 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3):
     _emit(
         "bert_large_lamb_mfu",
         round(mfu, 4),
-        "MFU (step_time_ms=%.1f, batch=%d, params=%dM)"
-        % (step_time * 1e3, batch, n_params // 1_000_000),
+        "MFU (step_time_ms=%.1f, batch=%d, params=%dM, loss=%.3f)"
+        % (step_time * 1e3, batch, n_params // 1_000_000, loss),
         round(mfu / 0.50, 4),
     )
 
@@ -190,8 +194,9 @@ def _resnet_step_fns(use_syncbn, batch, tx):
     return loss_fn, params, batch_stats, opt_state, model
 
 
-def bench_resnet50(batch=256, chunk=4, trials=3):
+def bench_resnet50(trace_dir=None, batch=256, chunk=4, trials=3):
     """BASELINE #1: single-device synthetic-ImageNet train step."""
+    import apex_tpu.utils
     from apex_tpu.optimizers import fused_sgd
 
     tx = fused_sgd(learning_rate=0.1, momentum=0.9)
@@ -215,22 +220,25 @@ def bench_resnet50(batch=256, chunk=4, trials=3):
         )
         return carry, losses[-1]
 
-    step_time, _ = _time_chunks(
-        train_chunk, (params, batch_stats, opt_state), chunk, trials
+    step_time, _, loss = _time_chunks(
+        train_chunk, (params, batch_stats, opt_state), chunk, trials,
+        profile=apex_tpu.utils.trace(trace_dir) if trace_dir else None,
     )
     _emit(
         "resnet50_imgs_per_sec",
         round(batch / step_time, 1),
-        "img/s (step_time_ms=%.1f, batch=%d, single device; reference "
-        "publishes no absolute number)" % (step_time * 1e3, batch),
+        "img/s (step_time_ms=%.1f, batch=%d, loss=%.3f, single device; "
+        "reference publishes no absolute number)"
+        % (step_time * 1e3, batch, loss),
         None,
     )
 
 
-def bench_ddp_syncbn(batch_per_replica=128, chunk=4, trials=3):
+def bench_ddp_syncbn(trace_dir=None, batch_per_replica=128, chunk=4, trials=3):
     """BASELINE #2: DDP ResNet-50 + SyncBatchNorm over every device."""
     from jax.sharding import Mesh, PartitionSpec as P
 
+    import apex_tpu.utils
     from apex_tpu import parallel_state as ps
     from apex_tpu.optimizers import fused_sgd
     from apex_tpu.parallel.distributed import all_reduce_gradients
@@ -276,16 +284,17 @@ def bench_ddp_syncbn(batch_per_replica=128, chunk=4, trials=3):
             out_specs=(P(), P()), check_vma=False,
         )(params, batch_stats, opt_state)
 
-    step_time, _ = _time_chunks(
-        train_chunk, (params, batch_stats, opt_state), chunk, trials
+    step_time, _, loss = _time_chunks(
+        train_chunk, (params, batch_stats, opt_state), chunk, trials,
+        profile=apex_tpu.utils.trace(trace_dir) if trace_dir else None,
     )
     ps.destroy_model_parallel()
     _emit(
         "ddp_syncbn_resnet50_imgs_per_sec",
         round(global_batch / step_time, 1),
-        "img/s (step_time_ms=%.1f, dp=%d, global_batch=%d, SyncBN; "
-        "reference publishes no absolute number)"
-        % (step_time * 1e3, dp, global_batch),
+        "img/s (step_time_ms=%.1f, dp=%d, global_batch=%d, loss=%.3f, "
+        "SyncBN; reference publishes no absolute number)"
+        % (step_time * 1e3, dp, global_batch, loss),
         None,
     )
 
@@ -295,9 +304,11 @@ def bench_ddp_syncbn(batch_per_replica=128, chunk=4, trials=3):
 # ---------------------------------------------------------------------------
 
 
-def bench_mha(batch=8, seq=2048, heads=16, head_dim=64, chunk=8, trials=3):
+def bench_mha(trace_dir=None, batch=8, seq=2048, heads=16, head_dim=64,
+              chunk=8, trials=3):
     """BASELINE #4: fused attention core vs the unfused composition, fwd+bwd
     (≙ the reference's multihead_attn speedup-vs-torch.nn plots)."""
+    import apex_tpu.utils
     from apex_tpu.ops.attention import flash_attention, mha_reference
 
     key = jax.random.PRNGKey(0)
@@ -323,10 +334,14 @@ def bench_mha(batch=8, seq=2048, heads=16, head_dim=64, chunk=8, trials=3):
             carry, _ = jax.lax.scan(body, (q, k, v), None, length=chunk)
             return carry, carry[0][0, 0, 0]
 
-        t, _ = _time_chunks(lambda *c: chunk_fn(*c), (q, k, v), chunk, trials)
+        t, _, _ = _time_chunks(
+            lambda *c: chunk_fn(*c), (q, k, v), chunk, trials,
+            profile=apex_tpu.utils.trace(trace_dir) if trace_dir else None,
+        )
         return t
 
     t_fused = timed(flash_attention)
+    trace_dir = None  # one trace (the fused pass) is enough
     t_unfused = timed(mha_reference)
     speedup = t_unfused / t_fused
     _emit(
@@ -344,10 +359,11 @@ def bench_mha(batch=8, seq=2048, heads=16, head_dim=64, chunk=8, trials=3):
 # ---------------------------------------------------------------------------
 
 
-def bench_tp_gpt(batch=8, seq=1024, chunk=4, trials=3):
+def bench_tp_gpt(trace_dir=None, batch=8, seq=1024, chunk=4, trials=3):
     """BASELINE #5: GPT block train step over a tp mesh of all devices."""
     from jax.sharding import Mesh, PartitionSpec as P
 
+    import apex_tpu.utils
     from apex_tpu import parallel_state as ps
     from apex_tpu.models.gpt import GptBlock, GptConfig
     from apex_tpu.optimizers import fused_adam
@@ -402,7 +418,7 @@ def bench_tp_gpt(batch=8, seq=1024, chunk=4, trials=3):
         )
         return losses[-1]
 
-    def timed(length):
+    def timed(length, profile=None):
         fn = jax.jit(
             jax.shard_map(
                 functools.partial(sharded_chunk, length),
@@ -415,20 +431,33 @@ def bench_tp_gpt(batch=8, seq=1024, chunk=4, trials=3):
             return (x,), fn(x)
 
         # total (init + length steps) time; per-step division happens in
-        # the subtraction below, so pass chunk=1 here
-        total, _ = _time_chunks(wrapped, (x,), 1, trials)
+        # the subtraction below, so pass chunk=1 here.  min (not median)
+        # over trials: the subtraction needs the noise floor of each.
+        total, _, _ = _time_chunks(
+            wrapped, (x,), 1, trials, profile=profile, reduce="min"
+        )
         return total
 
-    t_long = timed(2 * chunk)
+    t_long = timed(
+        2 * chunk,
+        profile=apex_tpu.utils.trace(trace_dir) if trace_dir else None,
+    )
     t_short = timed(chunk)
-    step_time = max(t_long - t_short, 1e-9) / chunk
     ps.destroy_model_parallel()
+    if t_long <= t_short:
+        # timing noise swamped the subtraction: report the conservative
+        # upper bound (init amortized over 2*chunk steps) and say so
+        step_time = t_long / (2 * chunk)
+        basis = "upper bound incl. per-call init: noisy subtraction"
+    else:
+        step_time = (t_long - t_short) / chunk
+        basis = "init-cancelled two-length measurement"
     _emit(
         "tp_gpt_block_step_ms",
         round(step_time * 1e3, 2),
-        "ms/step (tp=%d, seq=%d, batch=%d, h=%d, SP=%s; reference publishes "
-        "no absolute number)"
-        % (tp, seq, batch, cfg.hidden_size, tp > 1),
+        "ms/step (tp=%d, seq=%d, batch=%d, h=%d, SP=%s, %s; reference "
+        "publishes no absolute number)"
+        % (tp, seq, batch, cfg.hidden_size, tp > 1, basis),
         None,
     )
 
@@ -445,15 +474,10 @@ _CONFIGS = {
 def main(config="bert_lamb", trace_dir=None):
     if config == "all":
         for name, fn in _CONFIGS.items():
-            if name == "bert_lamb":
-                fn(trace_dir)
-            else:
-                fn()
+            # one trace (the headline config) per invocation
+            fn(trace_dir if name == "bert_lamb" else None)
         return
-    if config == "bert_lamb":
-        _CONFIGS[config](trace_dir)
-    else:
-        _CONFIGS[config]()
+    _CONFIGS[config](trace_dir)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,32 @@
+"""On-chip kernel parity suite (VERDICT r1 item 4).
+
+Unlike ``tests/`` (which pins the CPU backend and exercises Pallas kernels
+in *interpret* mode), this directory runs against the REAL TPU backend so
+the **Mosaic-compiled** kernels are what gets checked: a tiling/dtype/OOB
+divergence between compiled and interpret mode surfaces here, not as a
+silent numerics bug in the benchmark.
+
+Run on a TPU host:   python -m pytest tests_tpu/ -q
+On CPU every test SKIPS (visibly, not silently-passes).
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(
+            reason="compiled-Pallas parity needs the real TPU backend "
+            "(tests/ covers interpret mode on CPU)"
+        )
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _restore_dispatch():
+    from apex_tpu.ops import _dispatch
+
+    yield
+    _dispatch.set_use_pallas(None)
