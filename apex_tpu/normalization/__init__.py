@@ -23,11 +23,20 @@ from apex_tpu.ops.layer_norm import (
     fused_rms_norm_affine,
 )
 
+from apex_tpu.normalization.instance_norm import (  # noqa: E402
+    InstanceNorm3d,
+    InstanceNorm3dNVFuser,
+    instance_norm,
+)
+
 __all__ = [
     "FusedLayerNorm",
     "FusedRMSNorm",
     "MixedFusedLayerNorm",
     "MixedFusedRMSNorm",
+    "InstanceNorm3d",
+    "InstanceNorm3dNVFuser",
+    "instance_norm",
 ]
 
 Shape = Union[int, Sequence[int]]

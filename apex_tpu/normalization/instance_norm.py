@@ -1,0 +1,132 @@
+"""InstanceNorm3d — ≙ ``apex/normalization/instance_norm.py`` ::
+``InstanceNorm3dNVFuser``.
+
+The reference wraps an NVFuser-compiled instance-norm kernel for 5D
+``(N, C, D, H, W)`` inputs with optional affine and running stats.  On TPU
+the op is a per-(sample, channel) row reduction XLA fuses on its own —
+no hand kernel needed — so the value to reproduce is the *semantics*:
+
+- channels-LAST layout ``(N, D, H, W, C)`` (TPU-native; the reference's
+  ``channels_last`` ctor flag is the default here, and a
+  ``channels_first`` flag accepts torch-layout input for parity),
+- statistics over the spatial dims per (n, c), always computed in f32,
+- ``affine``: per-channel γ/β,
+- ``track_running_stats``: EMA of mean/var used at eval time (torch
+  momentum convention: ``running = (1-m)·running + m·batch``),
+- output dtype == input dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["instance_norm", "InstanceNorm3d", "InstanceNorm3dNVFuser"]
+
+
+def instance_norm(x, weight=None, bias=None, eps: float = 1e-5,
+                  mean=None, var=None):
+    """Functional instance norm over ``(N, *spatial, C)``.
+
+    Stats are per (sample, channel) over all spatial dims, in f32 —
+    unless precomputed ``mean``/``var`` (shape ``(N, C)`` or ``(C,)``)
+    are given (the eval-time running-stats path).
+    """
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim - 1))
+    if mean is None:
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+    else:
+        bshape = (
+            (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+            if mean.ndim == 2
+            else (1,) * (x.ndim - 1) + (x.shape[-1],)
+        )
+        mean = mean.astype(jnp.float32).reshape(bshape)
+        var = var.astype(jnp.float32).reshape(bshape)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+class InstanceNorm3d(nn.Module):
+    """Flax module ≙ ``InstanceNorm3dNVFuser(num_features, ...)``.
+
+    Call with ``use_running_average=False`` during training (default).
+    Running stats live in the ``batch_stats`` collection like flax BN.
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1  # torch convention
+    affine: bool = True
+    track_running_stats: bool = False
+    channels_first: bool = False  # accept torch (N, C, D, H, W) input
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        if self.channels_first:
+            x = jnp.moveaxis(x, 1, -1)
+        if x.shape[-1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} channels, got {x.shape[-1]}"
+            )
+        w = (
+            self.param("scale", nn.initializers.ones,
+                       (self.num_features,), self.param_dtype)
+            if self.affine
+            else None
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros,
+                       (self.num_features,), self.param_dtype)
+            if self.affine
+            else None
+        )
+        use_ra = bool(use_running_average) and self.track_running_stats
+        if self.track_running_stats:
+            ra_mean = self.variable(
+                "batch_stats", "mean",
+                lambda: jnp.zeros((self.num_features,), jnp.float32),
+            )
+            ra_var = self.variable(
+                "batch_stats", "var",
+                lambda: jnp.ones((self.num_features,), jnp.float32),
+            )
+        if use_ra:
+            y = instance_norm(
+                x, w, b, eps=self.eps,
+                mean=ra_mean.value, var=ra_var.value,
+            )
+        else:
+            y = instance_norm(x, w, b, eps=self.eps)
+            if self.track_running_stats and not self.is_initializing():
+                axes = tuple(range(1, x.ndim - 1))
+                xf = x.astype(jnp.float32)
+                n = 1
+                for a in axes:
+                    n *= x.shape[a]
+                bm = jnp.mean(jnp.mean(xf, axis=axes), axis=0)
+                # torch feeds the EMA the UNBIASED sample variance
+                bv = jnp.mean(jnp.var(xf, axis=axes), axis=0) * (
+                    n / max(n - 1, 1)
+                )
+                m = self.momentum
+                ra_mean.value = (1 - m) * ra_mean.value + m * bm
+                ra_var.value = (1 - m) * ra_var.value + m * bv
+        if self.channels_first:
+            y = jnp.moveaxis(y, -1, 1)
+        return y
+
+
+# reference-name alias (the NVFuser suffix names the CUDA codegen backend,
+# meaningless on TPU)
+InstanceNorm3dNVFuser = InstanceNorm3d

@@ -14,6 +14,10 @@ from apex_tpu.optimizers.fused_adagrad import (  # noqa: F401
 )
 from apex_tpu.optimizers.fused_adam import FusedAdam, fused_adam  # noqa: F401
 from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLamb,
+    fused_mixed_precision_lamb,
+)
 from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
     FusedNovoGrad,
     fused_novograd,

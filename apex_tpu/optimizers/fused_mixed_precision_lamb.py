@@ -1,0 +1,94 @@
+"""FusedMixedPrecisionLamb — LAMB with in-optimizer f32 master params.
+
+≙ ``apex/optimizers/fused_mixed_precision_lamb.py``: the reference variant
+keeps an fp32 master copy of fp16 model params *inside the optimizer*,
+runs the (multi_tensor) LAMB math on the masters, and writes the halved
+result back to the model params — so training code that owns only half
+params still gets full-precision accumulation.
+
+TPU-native shape: an ``optax.GradientTransformation`` whose state carries
+the f32 masters next to the LAMB moments.  ``update`` computes the LAMB
+step on the masters (f32, via :func:`apex_tpu.optimizers.fused_lamb`),
+advances them, and returns ``new_half(master) - param`` as the update so
+``optax.apply_updates`` leaves the model params exactly equal to the
+rounded masters — no drift between the two copies.
+
+When params are already f32 this degrades to plain :func:`fused_lamb`
+with an extra (pointless but harmless) master copy; prefer ``fused_lamb``
+then.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+__all__ = ["FusedMixedPrecisionLamb", "fused_mixed_precision_lamb"]
+
+
+class MixedPrecisionLambState(NamedTuple):
+    masters: Any  # f32 copies of the (possibly half) model params
+    inner: Any  # FusedLAMBState of the wrapped LAMB
+
+
+def fused_mixed_precision_lamb(*args, **kwargs) -> optax.GradientTransformation:
+    """Same signature as :func:`fused_lamb` (lr, betas, eps, weight_decay,
+    bias_correction, grad_averaging, adam_w_mode, max_grad_norm,
+    use_nvlamb, ...)."""
+    inner = fused_lamb(*args, **kwargs)
+
+    def to_f32(tree):
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32)
+            if jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            tree,
+        )
+
+    def init(params):
+        masters = to_f32(params)
+        return MixedPrecisionLambState(
+            masters=masters, inner=inner.init(masters)
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError(
+                "fused_mixed_precision_lamb requires params for the update"
+            )
+        with jax.named_scope("fused_mp_lamb_update"):
+            grads32 = to_f32(grads)
+            m_updates, inner_state = inner.update(
+                grads32, state.inner, state.masters
+            )
+            masters = jax.tree_util.tree_map(
+                jnp.add, state.masters, m_updates
+            )
+            # model param := round(master); emitted as a delta so
+            # optax.apply_updates / tree add reproduces it exactly
+            updates = jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype) - p, masters, params
+            )
+        return updates, MixedPrecisionLambState(
+            masters=masters, inner=inner_state
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedMixedPrecisionLamb:
+    """apex-shaped stateful wrapper (≙ the reference class ctor)."""
+
+    def __init__(self, params, **kwargs):
+        self._tx = fused_mixed_precision_lamb(**kwargs)
+        self.state = self._tx.init(params)
+        self._step = jax.jit(self._tx.update)
+
+    def step(self, grads, params):
+        updates, self.state = self._step(grads, self.state, params)
+        return jax.tree_util.tree_map(jnp.add, params, updates)

@@ -438,11 +438,12 @@ def bench_tp_gpt(trace_dir=None, batch=8, seq=1024, chunk=4, trials=3):
         )
         return total
 
-    t_long = timed(
-        2 * chunk,
-        profile=apex_tpu.utils.trace(trace_dir) if trace_dir else None,
-    )
+    t_long = timed(2 * chunk)
     t_short = timed(chunk)
+    if trace_dir:
+        # dedicated traced run — its time is NOT used, so profiler
+        # overhead cannot bias the init-cancelling subtraction below
+        timed(2 * chunk, profile=apex_tpu.utils.trace(trace_dir))
     ps.destroy_model_parallel()
     if t_long <= t_short:
         # timing noise swamped the subtraction: report the conservative

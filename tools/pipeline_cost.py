@@ -1,0 +1,180 @@
+"""Pipeline-schedule cost measurement (VERDICT r1 item 8).
+
+Measures, on the virtual CPU mesh, for pp in {2, 4}:
+
+- wall time per full fwd+bwd step of the lockstep pipeline
+  (``forward_backward_pipelining_without_interleaving``) with remat on
+  (the default) and off,
+- the same work under ``forward_backward_no_pipelining`` on one rank
+  (the whole L-layer model, nm microbatches) — the scaling baseline,
+- XLA's compile-time memory analysis (argument + temp bytes) for each,
+
+and prints a table plus derived efficiency vs the ideal-bubble model.
+Results + the schedule decision are recorded in
+``docs/pipeline-schedules.md``.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/pipeline_cost.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import functools
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+)
+
+HIDDEN = 512
+LAYERS = 8  # total; each pp stage runs LAYERS/pp of these
+NM = 8
+MB = 4  # microbatch rows
+SEQ = 128
+
+
+def make_stage_fn(n_layers):
+    """n_layers of (dense 4H + gelu + dense H) — a transformer-MLP-shaped
+    stage with enough FLOPs for timing to mean something."""
+
+    def stage_fn(params, x):
+        for i in range(n_layers):
+            w1, w2 = params[i]
+            h = jax.nn.gelu(x @ w1)
+            x = x + h @ w2
+        return x
+
+    return stage_fn
+
+
+def make_params(key, n_layers):
+    ks = jax.random.split(key, 2 * n_layers)
+    scale = 1.0 / (HIDDEN**0.5)
+    return [
+        (
+            jax.random.normal(ks[2 * i], (HIDDEN, 4 * HIDDEN), jnp.float32) * scale,
+            jax.random.normal(ks[2 * i + 1], (4 * HIDDEN, HIDDEN), jnp.float32) * scale,
+        )
+        for i in range(n_layers)
+    ]
+
+
+def loss_fn(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def timed(fn, args, reps=3):
+    out = jax.block_until_ready(fn(*args))  # compile+warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def mem_analysis(fn, args):
+    try:
+        c = jax.jit(fn).lower(*args).compile()
+        m = c.memory_analysis()
+        return (m.temp_size_in_bytes + m.output_size_in_bytes) / 1e6
+    except Exception:
+        return float("nan")
+
+
+def run_no_pipelining():
+    key = jax.random.PRNGKey(0)
+    params = make_params(key, LAYERS)
+    stage = make_stage_fn(LAYERS)
+    x = jax.random.normal(key, (NM, MB, SEQ, HIDDEN), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
+
+    def step(params, x, t):
+        losses, grads = forward_backward_no_pipelining(
+            stage, loss_fn, params, (x, t), num_microbatches=NM, remat=False
+        )
+        return jnp.sum(losses), sum(
+            jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
+        )
+
+    f = jax.jit(step)
+    wall, _ = timed(f, (params, x, t))
+    mem = mem_analysis(step, (params, x, t))
+    return wall, mem
+
+
+def run_lockstep(pp, remat):
+    devices = jax.devices()[:pp]
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size=pp, devices=devices
+    )
+    mesh = Mesh(devices, (ps.PIPELINE_PARALLEL_AXIS,))
+    stage = make_stage_fn(LAYERS // pp)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (NM, MB, SEQ, HIDDEN), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
+
+    def sharded_step(x, t):
+        rank = jax.lax.axis_index(ps.PIPELINE_PARALLEL_AXIS)
+        params = make_params(jax.random.fold_in(key, rank), LAYERS // pp)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage, loss_fn, params, (x, t), num_microbatches=NM, remat=remat
+        )
+        return jnp.sum(losses), sum(
+            jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
+        )
+
+    step = jax.shard_map(
+        sharded_step, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False,
+    )
+    f = jax.jit(step)
+    wall, _ = timed(f, (x, t))
+    mem = mem_analysis(step, (x, t))
+    ps.destroy_model_parallel()
+    return wall, mem
+
+
+def main():
+    base_wall, base_mem = run_no_pipelining()
+    print(
+        f"no_pipelining  (1 rank, L={LAYERS}, nm={NM}):"
+        f"  wall={base_wall*1e3:8.1f} ms  mem={base_mem:8.1f} MB"
+    )
+    print(
+        f"{'schedule':<28}{'pp':>4}{'remat':>7}{'wall ms':>10}"
+        f"{'mem MB':>9}{'speedup':>9}{'ideal':>7}{'eff':>7}"
+    )
+    for pp in (2, 4):
+        for remat in (True, False):
+            wall, mem = run_lockstep(pp, remat)
+            speed = base_wall / wall
+            # ideal bubble-limited speedup for pipelining nm microbatches
+            # over pp stages: pp * nm / (nm + pp - 1)
+            ideal = pp * NM / (NM + pp - 1)
+            print(
+                f"{'lockstep_1f1b':<28}{pp:>4}{str(remat):>7}"
+                f"{wall*1e3:>10.1f}{mem:>9.1f}{speed:>9.2f}{ideal:>7.2f}"
+                f"{speed/ideal:>7.2f}"
+            )
+
+
+if __name__ == "__main__":
+    main()
