@@ -25,12 +25,15 @@ def conv_bias(x, weight, bias, *, stride=1, padding=1):
         stride = (stride, stride)
     if isinstance(padding, int):
         padding = ((padding, padding), (padding, padding))
+    # No preferred_element_type=f32: conv accumulates f32 internally on
+    # TPU regardless (≙ cudnn's fp16-IO/f32-accumulate), and an explicit
+    # f32 output breaks the conv transpose under bf16 inputs (the f32
+    # cotangent can't enter the bf16 backward conv).
     y = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
     )
-    return (y + bias).astype(x.dtype)
+    return (y + bias.astype(y.dtype)).astype(x.dtype)
 
 
 def ConvBias(x, weight, bias, padding=1, stride=1):

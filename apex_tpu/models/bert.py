@@ -373,6 +373,7 @@ def bert_pretrain_loss(
     *,
     deterministic: bool = True,
     rngs: Optional[dict] = None,
+    mlm_loss_chunks: Optional[int] = None,
 ):
     """MLM + NSP loss (the phase-1 pretraining objective).
 
@@ -382,6 +383,12 @@ def bert_pretrain_loss(
     ``bert/embeddings/word_embeddings/weight`` (vocab-sharded ⇒ logits are
     vocab-parallel and feed vocab_parallel_cross_entropy directly — no
     logits gather, ≙ _VocabParallelCrossEntropy).
+
+    ``mlm_loss_chunks``: split the (S·B, V) logits matmul + cross entropy
+    into this many row chunks, each rematerialized in backward — the full
+    f32 logits tensor (2 GB at batch 128 / BERT-Large vocab) never exists;
+    peak is 1/chunks of it, for one extra decoder-matmul pass (~3% of
+    step FLOPs).  None/1 = unchunked.
     """
     (h, mlm_bias), nsp_logits = model.apply(
         params,
@@ -392,21 +399,42 @@ def bert_pretrain_loss(
         rngs=rngs,
     )
     embed = params["params"]["bert"]["embeddings"]["word_embeddings"]["weight"]
+    labels = batch["mlm_labels"]
     with jax.named_scope("mlm_logits_xent"):
-        logits = (
-            jnp.matmul(
-                h.astype(model.cfg.dtype),
-                jnp.transpose(embed).astype(model.cfg.dtype),
-                preferred_element_type=jnp.float32,
+        dec = jnp.transpose(embed).astype(model.cfg.dtype)
+
+        def rows_loss(h_rows, l_rows):
+            logits = (
+                jnp.matmul(
+                    h_rows.astype(model.cfg.dtype), dec,
+                    preferred_element_type=jnp.float32,
+                )
+                + mlm_bias
             )
-            + mlm_bias
-        )
-        labels = batch["mlm_labels"]
-        mask = (labels >= 0).astype(jnp.float32)
-        losses = vocab_parallel_cross_entropy(
-            logits.astype(jnp.float32), jnp.maximum(labels, 0)
-        )
-        mlm_loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            m = (l_rows >= 0).astype(jnp.float32)
+            losses = vocab_parallel_cross_entropy(
+                logits.astype(jnp.float32), jnp.maximum(l_rows, 0)
+            )
+            return jnp.sum(losses * m), jnp.sum(m)
+
+        nc = mlm_loss_chunks or 1
+        if nc > 1:
+            rows = labels.size
+            if rows % nc:
+                raise ValueError(
+                    f"mlm_loss_chunks={nc} must divide S*B={rows}"
+                )
+            hc = h.reshape(nc, rows // nc, h.shape[-1])
+            lc = labels.reshape(nc, rows // nc)
+            sums, counts = jax.lax.map(
+                lambda args: jax.checkpoint(rows_loss)(*args), (hc, lc)
+            )
+            mlm_loss = jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1.0)
+        else:
+            total, count = rows_loss(
+                h.reshape(-1, h.shape[-1]), labels.reshape(-1)
+            )
+            mlm_loss = total / jnp.maximum(count, 1.0)
 
     nsp_labels = batch.get("nsp_labels")
     nsp_loss = 0.0

@@ -70,6 +70,28 @@ class TestBert:
             for g in jax.tree_util.tree_leaves(grads)
         )
 
+    def test_chunked_mlm_loss_matches_unchunked(self):
+        """mlm_loss_chunks must not change values or grads — only memory."""
+        m = BertForPreTraining(BertConfig(**BERT_KW))
+        batch = _bert_batch()
+        params = m.init(jax.random.PRNGKey(0), batch["input_ids"])
+        l1, g1 = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m, batch)
+        )(params)
+        l4, g4 = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m, batch, mlm_loss_chunks=4)
+        )(params)
+        np.testing.assert_allclose(float(l1), float(l4), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-4, atol=2e-5,
+            ),
+            g1, g4,
+        )
+        with pytest.raises(ValueError):
+            bert_pretrain_loss(params, m, batch, mlm_loss_chunks=7)
+
     def test_tp_matches_unsharded(self, eight_devices):
         """sharded_init + per-head QKV layout ⇒ tp changes nothing."""
         l_tp = _sharded_bert_loss(sp=False)
