@@ -103,7 +103,11 @@ def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
 # ---------------------------------------------------------------------------
 
 
-def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3):
+def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
+                    cfg_kwargs=None, mlm_loss_chunks=8, emit=True):
+    """Returns (mfu, step_time, loss).  ``cfg_kwargs`` overrides the tuned
+    model config (tools/mfu_sweep.py reuses this function for its variants,
+    so sweep numbers and the headline stay comparable)."""
     import apex_tpu.utils
     from apex_tpu.models import (
         BertForPreTraining,
@@ -113,7 +117,21 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3):
     from apex_tpu.optimizers import fused_lamb
 
     seq_len = 128
-    cfg = bert_large_config(remat=True, remat_policy="dots")
+    # Measured on the v5e chip (tools/mfu_sweep.py): scan-over-layers spends
+    # ~1/3 of the step copying remat saves into (L, ...) stacked buffers
+    # (0.41 MFU); unrolling removes it (0.45); recomputing the attention
+    # core (drops the f32 (B,H,S,S) saves) + chunking the MLM loss (the
+    # 2 GB f32 logits never exist) reaches 0.53.
+    if cfg_kwargs is None:
+        # remat_prevent_cse=False on the unrolled path is deliberate: XLA
+        # keeps whichever forward activations fit HBM instead of honoring
+        # the full recompute (same values; 316 ms vs 371 ms measured) —
+        # the right trade on one chip at batch 128.
+        cfg_kwargs = dict(
+            remat=True, remat_policy="dots", scan_layers=False,
+            remat_attention=True, remat_prevent_cse=False,
+        )
+    cfg = bert_large_config(**cfg_kwargs)
     model = BertForPreTraining(cfg)
     tx = fused_lamb(learning_rate=1e-3, weight_decay=0.01)
 
@@ -136,7 +154,9 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3):
         def body(carry, _):
             params, opt_state = carry
             loss, grads = jax.value_and_grad(
-                lambda p: bert_pretrain_loss(p, model, batch_data)
+                lambda p: bert_pretrain_loss(
+                    p, model, batch_data, mlm_loss_chunks=mlm_loss_chunks
+                )
             )(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(jnp.add, params, updates)
@@ -157,13 +177,15 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3):
     flops = 6.0 * n_params * tokens
     peak = sum(_chip_peak(d) for d in jax.devices())
     mfu = flops / (step_time * peak)
-    _emit(
-        "bert_large_lamb_mfu",
-        round(mfu, 4),
-        "MFU (step_time_ms=%.1f, batch=%d, params=%dM, loss=%.3f)"
-        % (step_time * 1e3, batch, n_params // 1_000_000, loss),
-        round(mfu / 0.50, 4),
-    )
+    if emit:
+        _emit(
+            "bert_large_lamb_mfu",
+            round(mfu, 4),
+            "MFU (step_time_ms=%.1f, batch=%d, params=%dM, loss=%.3f)"
+            % (step_time * 1e3, batch, n_params // 1_000_000, loss),
+            round(mfu / 0.50, 4),
+        )
+    return mfu, step_time, loss
 
 
 # ---------------------------------------------------------------------------

@@ -92,6 +92,63 @@ class TestBert:
         with pytest.raises(ValueError):
             bert_pretrain_loss(params, m, batch, mlm_loss_chunks=7)
 
+    def test_unrolled_matches_scanned(self):
+        """scan_layers / remat_attention are pure layout+schedule knobs:
+        same params (modulo the (L, ...) stacking axis), same loss, same
+        grads as the scanned encoder."""
+        m_scan = BertForPreTraining(BertConfig(**BERT_KW))
+        m_unroll = BertForPreTraining(
+            BertConfig(
+                scan_layers=False, remat=True, remat_policy="dots",
+                remat_attention=True, **BERT_KW,
+            )
+        )
+        batch = _bert_batch()
+        params_s = m_scan.init(jax.random.PRNGKey(0), batch["input_ids"])
+
+        # restack the scanned (L, ...) params into per-layer trees
+        def to_unrolled(ps_tree):
+            enc = ps_tree["params"]["bert"]["encoder"]["layers"]["layer"]
+            L = BERT_KW["num_layers"]
+            out = dict(ps_tree["params"]["bert"]["encoder"])
+            del out["layers"]
+            for i in range(L):
+                out[f"layer_{i}"] = {
+                    "layer": jax.tree_util.tree_map(lambda x: x[i], enc)
+                }
+            new = jax.tree_util.tree_map(lambda x: x, ps_tree)  # copy
+            new["params"]["bert"]["encoder"] = out
+            return new
+
+        params_u = to_unrolled(
+            jax.tree_util.tree_map(lambda x: x, params_s)
+        )
+        # sanity: the unrolled model accepts the restacked tree
+        l_s, g_s = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m_scan, batch)
+        )(params_s)
+        l_u, g_u = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m_unroll, batch)
+        )(params_u)
+        np.testing.assert_allclose(float(l_s), float(l_u), rtol=1e-5)
+        # compare grads on the shared (non-encoder) subtrees and on the
+        # restacked encoder layers
+        np.testing.assert_allclose(
+            np.asarray(g_s["params"]["mlm_bias"]),
+            np.asarray(g_u["params"]["mlm_bias"]),
+            rtol=1e-4, atol=1e-6,
+        )
+        enc_s = g_s["params"]["bert"]["encoder"]["layers"]["layer"]
+        for i in range(BERT_KW["num_layers"]):
+            want = jax.tree_util.tree_map(lambda x: x[i], enc_s)
+            got = g_u["params"]["bert"]["encoder"][f"layer_{i}"]["layer"]
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+                ),
+                want, got,
+            )
+
     def test_tp_matches_unsharded(self, eight_devices):
         """sharded_init + per-head QKV layout ⇒ tp changes nothing."""
         l_tp = _sharded_bert_loss(sp=False)

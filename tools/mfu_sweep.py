@@ -1,0 +1,68 @@
+"""MFU experiment sweep for BASELINE #3 (BERT-Large + LAMB).
+
+A thin wrapper over ``bench.bench_bert_lamb`` (the headline harness) that
+varies {batch, remat, remat_policy, scan_layers, remat_attention,
+mlm_loss_chunks} — reusing the bench's batch construction and timing loop so
+sweep numbers stay comparable to the headline.
+
+Usage: python tools/mfu_sweep.py --only 256,True,dots,F,T,8 [--trace DIR]
+(fields: batch,remat,policy,scan,rattn,mlmc; trailing fields optional)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def run(batch, remat, remat_policy, scan_layers=True, remat_attention=False,
+        mlm_loss_chunks=None, prevent_cse=None, trace_dir=None):
+    cfg_kwargs = dict(
+        remat=remat, remat_policy=remat_policy, scan_layers=scan_layers,
+        remat_attention=remat_attention, remat_prevent_cse=prevent_cse,
+    )
+    label = (
+        f"batch={batch:4d} remat={remat!s:5} policy={remat_policy:5} "
+        f"scan={scan_layers!s:5} rattn={remat_attention!s:5} "
+        f"mlmc={mlm_loss_chunks} pcse={prevent_cse}"
+    )
+    try:
+        mfu, t, _loss = bench.bench_bert_lamb(
+            trace_dir=trace_dir, batch=batch, cfg_kwargs=cfg_kwargs,
+            mlm_loss_chunks=mlm_loss_chunks, emit=False,
+        )
+        print(f"{label} step={t * 1e3:7.1f}ms MFU={mfu:.4f}", flush=True)
+    except Exception as e:  # OOM / compile failure etc.
+        print(
+            f"{label} FAILED: {type(e).__name__}: {str(e)[:200]}", flush=True
+        )
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None)
+    ap.add_argument(
+        "--only", default=None,
+        help="batch,remat,policy,scan,rattn,mlmc[,pcse] "
+             "e.g. 256,True,dots,F,T,8,F",
+    )
+    args = ap.parse_args()
+    if args.only:
+        f = args.only.split(",")
+        run(
+            int(f[0]), f[1][0] in "Tt", f[2], trace_dir=args.trace,
+            scan_layers=f[3][0] in "Tt" if len(f) > 3 else True,
+            remat_attention=f[4][0] in "Tt" if len(f) > 4 else False,
+            mlm_loss_chunks=int(f[5]) if len(f) > 5 and f[5] != "0" else None,
+            prevent_cse=(f[6][0] in "Tt") if len(f) > 6 else None,
+        )
+    else:
+        # no args = exactly the headline: cfg_kwargs=None takes bench.py's
+        # tuned default config, so the numbers are directly comparable
+        mfu, t, _ = bench.bench_bert_lamb(trace_dir=args.trace, emit=False)
+        print(f"headline step={t * 1e3:7.1f}ms MFU={mfu:.4f}", flush=True)

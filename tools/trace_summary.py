@@ -1,0 +1,103 @@
+"""Summarize a jax.profiler trace directory: top device ops by total time.
+
+Usage: python tools/trace_summary.py /tmp/trace_dir [-n 30]
+
+Parses the Perfetto ``*.trace.json.gz`` the profiler writes and aggregates
+wall time per event name on the device tracks, so the 0.4x-MFU question
+("where do the milliseconds go?") has a terminal-native answer — no
+TensorBoard needed in this environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def load_trace(log_dir: str) -> dict:
+    paths = glob.glob(
+        os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    if not paths:
+        raise SystemExit(f"no *.trace.json.gz under {log_dir}")
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        return json.load(f)
+
+
+def summarize(trace: dict, top: int, like: str | None):
+    events = trace.get("traceEvents", [])
+    # pid -> process name; device tracks are named "/device:TPU:0" etc.
+    # One device pid carries several threads (XLA Modules spanning whole
+    # steps, XLA Ops with the individual kernels, …) — summing across all
+    # of them double-counts nested time, so keep only the op-level threads.
+    pnames = {}
+    tnames = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pnames[e["pid"]] = e["args"].get("name", "")
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tnames[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+    device_pids = {
+        pid
+        for pid, name in pnames.items()
+        if "TPU" in name or "device" in name.lower() or "GPU" in name
+    }
+    op_tids = {
+        key
+        for key, name in tnames.items()
+        if key[0] in device_pids and "Ops" in name
+    }
+    per_op = collections.Counter()
+    per_op_n = collections.Counter()
+    total = 0.0
+    tmin, tmax = float("inf"), 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        if op_tids and (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        # span covers ALL device op events (not just --like matches), so
+        # util stays meaningful under filtering
+        ts = e.get("ts", 0)
+        tmin = min(tmin, ts)
+        tmax = max(tmax, ts + e.get("dur", 0))
+        name = e.get("name", "?")
+        if like and like not in name:
+            continue
+        # control-flow wrappers (the scan While, the jit entry) span their
+        # whole contents — counting them double-counts every child op
+        if name.startswith(("while", "jit_", "body", "condition")) or (
+            name.isdigit()
+        ):
+            continue
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        per_op[name] += dur
+        per_op_n[name] += 1
+        total += dur
+    span = (tmax - tmin) / 1e3 if tmax > tmin else 0.0
+    # busy is summed across every device op-thread; normalize the span by
+    # the thread count so util is per-device average, not >100%
+    n_tracks = max(1, len(op_tids) if op_tids else len(device_pids))
+    print(f"device tracks: {sorted(pnames[p] for p in device_pids)}")
+    print(
+        f"busy={total:.1f}ms span={span:.1f}ms x{n_tracks} tracks "
+        f"util={100 * total / (span * n_tracks) if span else 0:.1f}%\n"
+    )
+    print(f"{'total_ms':>9} {'n':>6} {'avg_us':>8}  name")
+    for name, dur in per_op.most_common(top):
+        n = per_op_n[name]
+        print(f"{dur:9.2f} {n:6d} {dur / n * 1e3:8.1f}  {name[:110]}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log_dir")
+    ap.add_argument("-n", type=int, default=30)
+    ap.add_argument("--like", default=None, help="substring filter")
+    args = ap.parse_args()
+    summarize(load_trace(args.log_dir), args.n, args.like)
