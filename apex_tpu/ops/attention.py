@@ -40,19 +40,24 @@ def _pallas_eligible(q, k, v, dropout_p):
     if dropout_p > 0.0:
         return False
     sq, sk = q.shape[-2], k.shape[-2]
-    # Blocks are min(128, S); padding of partial tail blocks is not
-    # implemented — require multiples (the bench shapes 128/512 qualify).
+    # Blocks are auto-sized 128..512 with power-of-two fallback (see
+    # pallas.flash_attention._auto_block); partial tail blocks are not
+    # implemented, so S must be a multiple of min(128, S) — that
+    # guarantees a dividing block exists (the bench shapes qualify).
     if sq % min(128, sq) or sk % min(128, sk):
         return False
     if sq % 8 or sk % 8:
         return False
-    if _dispatch.forced() is None and q.shape[-1] % _LANES and sk < 1024:
-        # Auto mode: a head dim off the 128-lane grid gets padded inside
-        # the kernel (D=64 doubles the QK/PV FLOPs).  At short kv lengths
-        # the score matrix is small enough that XLA's fused unfused path
-        # wins; the flash kernel's O(S) memory only pays off at long S.
-        # Measured on v5e (BERT-Large, S=128, D=64): XLA 0.40 MFU vs
-        # padded-kernel 0.33.
+    if _dispatch.forced() is None and max(sq, sk) < 1024:
+        # Auto mode: when BOTH sequence dims are short the (Sq, Sk) score
+        # matrix is small and XLA's unfused composition wins — per-tile
+        # grid overhead dominates the flash kernel when each (B, H) slice
+        # is only a tile or two.  Measured on v5e BERT-Large (S=128,
+        # D=64): XLA 0.53 MFU vs kernel 0.39; at S=2048 the kernel is
+        # 1.7x FASTER (bench.py --config mha).  Either dim being long
+        # routes to the kernel: its O(S) memory (no materialized score
+        # matrix) is what keeps long-Sq/short-Sk cross-attention from
+        # OOMing regardless of which side is long.
         return False
     return _dispatch.use_pallas()
 
@@ -63,8 +68,16 @@ def _flatten_bh(x):
 
 
 def _pad_head_dim(x):
+    """Pad D only as far as Mosaic needs, not to a full 128-lane multiple.
+
+    The kernels block over the whole head dim (no D grid), and Mosaic
+    lowers an untiled trailing dim of any sublane-aligned size — so D = 64
+    stays 64 (half the QK/PV FLOPs and HBM traffic of padding to 128;
+    measured 2x end-to-end on the S=2048 MHA bench).  Only off-grid sizes
+    pad: to 8 below 128, to a lane multiple above.
+    """
     d = x.shape[-1]
-    pad = (-d) % _LANES
+    pad = (-d) % 8 if d <= _LANES else (-d) % _LANES
     if pad:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
     return x

@@ -15,7 +15,10 @@ block size works; long-context is handled above this kernel by ring/context
 parallelism (apex_tpu.transformer.context_parallel).
 
 Layout: q (BH, Sq, D), k/v (BH, Sk, D) with batch*heads pre-flattened and D
-pre-padded to a lane multiple (128) by the caller (apex_tpu.ops.attention).
+sublane-aligned by the caller (apex_tpu.ops.attention): D <= 128 is only
+padded to a multiple of 8 and the tile covers the whole head dim (D = 64
+stays 64 — half the FLOPs/HBM of lane-padding it); D > 128 pads to a lane
+multiple.
 Bias, when present, is (G, RS, Sk) with G ∈ {1, B, BH} (BH % G == 0; the
 index map folds the flattened batch-head index as b // (BH/G)) and
 RS ∈ {1, Sq} — RS = 1 is the key-padding case, kept as a single row per
@@ -41,6 +44,39 @@ MASK_VALUE = -1e9
 
 _LANES = 128
 
+
+def _dot_precision(dtype):
+    """MXU precision for the in-kernel f32 dots.
+
+    Inputs are cast to f32 before every dot; with DEFAULT precision the MXU
+    does single-pass bf16 multiplies — right for bf16 inputs (their
+    information fits), but for f32 inputs it loses ~8 mantissa bits vs the
+    XLA reference path (which decomposes f32 dots into multi-pass form).
+    HIGHEST matches the reference at f32; bf16 keeps the fast path.
+    """
+    return (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+
+
+def _auto_block(seq, d):
+    """Default tile size: large enough to amortize per-tile grid overhead.
+
+    At (128, 128) tiles a 2048-seq 128-batched-head causal case is ~33k
+    tiles whose fixed cost dominates (~2x slower than unfused XLA on v5e);
+    (512, 512) cuts the tile count 16x and is still < ~4 MB VMEM of f32
+    score/accumulator buffers for d <= 128.  Wider heads halve the tile to
+    keep VMEM bounded.  The kernels have no partial-tile masking, so the
+    tile must divide seq exactly — fall through to smaller powers of two.
+    """
+    cap = 512 if d <= 128 else 256
+    for b in (512, 256, 128):
+        if b <= cap and b <= seq and seq % b == 0:
+            return b
+    return seq  # seq < 128 (callers guarantee seq % min(128, seq) == 0)
 
 def _bias_spec(bias, bh, bq, bk, order):
     """BlockSpec for a (G, RS, Sk) bias (module docstring's layout).
@@ -79,10 +115,27 @@ def _causal_mask_block(i, j, bq, bk, offset):
 # ---------------------------------------------------------------------------
 
 
+def _causal_block_live(i, j, bq, bk, offset, include_fully_masked):
+    """Whether the (i, j) tile has any work under the causal mask.
+
+    A tile is dead when every (row, col) in it violates the mask; skipping
+    dead tiles halves the causal grid's compute (the reference's fmha
+    kernels get the same effect from their triangular loop bounds).
+    ``include_fully_masked`` additionally keeps tiles whose rows see NO key
+    at all (Sq > Sk bottom-right alignment) — those rows still produce the
+    uniform-average output / dv, so their tiles must run.
+    """
+    live = (i * bq + bq - 1 + offset) >= (j * bk)
+    if include_fully_masked:
+        live = live | ((i * bq + offset) < 0)
+    return live
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, bq, bk, nk, offset,
+    *, scale, causal, bq, bk, nk, offset, prec,
 ):
+    i = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -91,36 +144,47 @@ def _fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    live = (
+        _causal_block_live(i, j, bq, bk, offset, include_fully_masked=True)
+        if causal
+        else True
     )
-    s = s * scale
-    if bias_ref is not None:
-        # Defense-in-depth clamp (the public API pre-clamps): a -inf bias
-        # would pin m_new at -inf and alpha = exp(-inf - -inf) = NaN would
-        # poison the whole row.  Clamped, the finite-MASK_VALUE invariant
-        # below holds for direct flash_fwd callers too.  bias_ref[0] is
-        # (bq, bk) or (1, bk) (key-padding row); broadcasting covers both.
-        s = s + jnp.maximum(bias_ref[0].astype(jnp.float32), MASK_VALUE)
-    if causal:
-        i = pl.program_id(1)
-        s = jnp.where(_causal_mask_block(i, j, bq, bk, offset), s, MASK_VALUE)
 
-    m_prev = m_ref[:, :1]
-    l_prev = l_ref[:, :1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        s = s * scale
+        if bias_ref is not None:
+            # Defense-in-depth clamp (the public API pre-clamps): a -inf
+            # bias would pin m_new at -inf and alpha = exp(-inf - -inf) =
+            # NaN would poison the whole row.  Clamped, the finite-
+            # MASK_VALUE invariant below holds for direct flash_fwd callers
+            # too.  bias_ref[0] is (bq, bk) or (1, bk) (key-padding row);
+            # broadcasting covers both.
+            s = s + jnp.maximum(bias_ref[0].astype(jnp.float32), MASK_VALUE)
+        if causal:
+            s = jnp.where(
+                _causal_mask_block(i, j, bq, bk, offset), s, MASK_VALUE
+            )
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -141,7 +205,7 @@ def _fwd_kernel(
 @functools.partial(
     jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
 )
-def flash_fwd(q, k, v, bias, *, scale, causal, block_q=128, block_k=128):
+def flash_fwd(q, k, v, bias, *, scale, causal, block_q=None, block_k=None):
     """Returns (o, lse).  q (BH,Sq,D), k/v (BH,Sk,D).
 
     lse is f32 (BH, Sq, 128) — the row logsumexp broadcast across a lane
@@ -149,8 +213,8 @@ def flash_fwd(q, k, v, bias, *, scale, causal, block_q=128, block_k=128):
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = min(block_q, sq) if block_q else _auto_block(sq, d)
+    bk = min(block_k, sk) if block_k else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
     grid = (bh, nq, nk)
 
@@ -165,12 +229,12 @@ def flash_fwd(q, k, v, bias, *, scale, causal, block_q=128, block_k=128):
         args.append(bias)
         kernel = functools.partial(
             _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=sk - sq,
+            offset=sk - sq, prec=_dot_precision(q.dtype),
         )
     else:
         kernel = functools.partial(
             _fwd_kernel_nobias, scale=scale, causal=causal, bq=bq, bk=bk,
-            nk=nk, offset=sk - sq,
+            nk=nk, offset=sk - sq, prec=_dot_precision(q.dtype),
         )
 
     return pl.pallas_call(
@@ -206,27 +270,42 @@ def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, **kw):
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset):
+def _recompute_p(
+    q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset, prec, sk_total
+):
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=prec,
     ) * scale
     if bias_blk is not None:
         # Same -inf clamp as the forward kernel, so the recomputed p
         # matches it bit-for-bit.
         s = s + jnp.maximum(bias_blk, MASK_VALUE)
+    mask = None
     if causal:
         mask = _causal_mask_block(i, j, bq, bk, offset)
         s = jnp.where(mask, s, MASK_VALUE)
     p = jnp.exp(s - lse)
     if causal:
-        p = jnp.where(mask, p, 0.0)
-    return p
+        # FULLY-masked rows (Sq > Sk bottom-right-aligned causal: rows with
+        # row + offset < 0 see no keys) need exact handling: their saved
+        # lse is MASK_VALUE + log(Sk), which f32 rounds back to MASK_VALUE
+        # (ulp(1e9) = 64), so exp(s - lse) would give 1 instead of the true
+        # uniform 1/Sk and inflate dv by Sk x.  Substitute the closed form;
+        # rows with >= 1 real key are untouched (their lse is O(1) and the
+        # masked entries' exp underflow to exactly 0).  This matches the
+        # jnp reference, whose softmax over an all-MASK_VALUE row is
+        # exactly uniform and backprops that row's cotangent into dv.
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+        fully_masked = (row_ids + offset) < 0
+        p = jnp.where(fully_masked, 1.0 / sk_total, p)
+    return p, mask
 
 
 def _dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     dk_ref, dv_ref, dk_acc, dv_acc,
-    *, scale, causal, bq, bk, nq, offset,
+    *, scale, causal, bq, bk, nq, offset, prec, sk_total,
 ):
     i = pl.program_id(2)  # q-block index (inner loop)
     j = pl.program_id(1)  # k-block index
@@ -236,28 +315,50 @@ def _dkdv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]
-    delta = delta_ref[0][:, :1]
-    bias_blk = None if bias_ref is None else bias_ref[0].astype(jnp.float32)
+    # fully-masked q rows still contribute their uniform p to dv, so their
+    # tiles stay live (include_fully_masked=True)
+    live = (
+        _causal_block_live(i, j, bq, bk, offset, include_fully_masked=True)
+        if causal
+        else True
+    )
 
-    p = _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset)
-    # dv += p^T @ do
-    dv_acc[...] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    # dp = do @ v^T ; ds = p * (dp - delta)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta)
-    # dk += ds^T @ q * scale
-    dk_acc[...] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        bias_blk = (
+            None if bias_ref is None else bias_ref[0].astype(jnp.float32)
+        )
+
+        p, mask = _recompute_p(
+            q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset, prec,
+            sk_total,
+        )
+        # dv += p^T @ do
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        # dp = do @ v^T ; ds = p * (dp - delta)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        ds = p * (dp - delta)
+        if mask is not None:
+            # the causal mask is a where() on s: no gradient flows through
+            # the masked branch to q/k (dv, by contrast, takes the full p)
+            ds = jnp.where(mask, ds, 0.0)
+        # dk += ds^T @ q * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) * scale
 
     @pl.when(i == nq - 1)
     def _finalize():
@@ -268,7 +369,7 @@ def _dkdv_kernel(
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     dq_ref, dq_acc,
-    *, scale, causal, bq, bk, nk, offset,
+    *, scale, causal, bq, bk, nk, offset, prec, sk_total,
 ):
     i = pl.program_id(1)  # q-block index
     j = pl.program_id(2)  # k-block index (inner loop)
@@ -277,22 +378,41 @@ def _dq_kernel(
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]
-    delta = delta_ref[0][:, :1]
-    bias_blk = None if bias_ref is None else bias_ref[0].astype(jnp.float32)
-
-    p = _recompute_p(q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    # dq of a fully-masked row is exactly 0 (the mask's where() blocks the
+    # gradient), so those tiles are dead here — no include_fully_masked
+    live = (
+        _causal_block_live(i, j, bq, bk, offset, include_fully_masked=False)
+        if causal
+        else True
     )
-    ds = p * (dp - delta)
-    dq_acc[...] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        bias_blk = (
+            None if bias_ref is None else bias_ref[0].astype(jnp.float32)
+        )
+
+        p, mask = _recompute_p(
+            q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset, prec,
+            sk_total,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        ds = p * (dp - delta)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) * scale
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -303,13 +423,13 @@ def _dq_kernel(
     jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
 )
 def flash_bwd(
-    q, k, v, o, lse, do, bias, *, scale, causal, block_q=128, block_k=128
+    q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None
 ):
     """Returns (dq, dk, dv).  Recomputation backward: only lse was saved."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = min(block_q, sq) if block_q else _auto_block(sq, d)
+    bk = min(block_k, sk) if block_k else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
 
     # delta_i = rowsum(do * o) — the softmax-jacobian correction term
@@ -335,12 +455,12 @@ def flash_bwd(
         args.append(bias)
         dkdv_kernel = functools.partial(
             _dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            offset=sk - sq,
+            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
         )
     else:
         dkdv_kernel = functools.partial(
             _dkdv_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            offset=sk - sq,
+            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
         )
     dk, dv = pl.pallas_call(
         dkdv_kernel,
@@ -375,12 +495,12 @@ def flash_bwd(
         args.append(bias)
         dq_kernel = functools.partial(
             _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=sk - sq,
+            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
         )
     else:
         dq_kernel = functools.partial(
             _dq_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=sk - sq,
+            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
         )
     dq = pl.pallas_call(
         dq_kernel,

@@ -26,10 +26,31 @@ from apex_tpu.ops._dispatch import pallas_interpret
 
 _VMEM_BUDGET_PER_BUF = 360_000  # bytes of f32 per row-block buffer (heuristic)
 
+# Per-hidden-size tuned row-block sizes, ≙ the reference FastLayerNorm's
+# per-hidden-size kernel-traits table (apex/contrib/csrc/layer_norm/
+# ln_kernel_traits.h): measured on a v5e chip with tools/ln_tune.py
+# (rows=16384, bf16 I/O, fwd+bwd, serialized-scan timing; full table in
+# docs/normalization.md).  Spread across block sizes is ~3-45% (small
+# hidden sizes want the largest block; >=4096 is VMEM-capped lower).
+# Absent sizes fall back to the VMEM-budget heuristic below.
+_TUNED_BLOCK_ROWS: dict = {
+    768: 256,
+    1024: 256,
+    1536: 128,
+    2048: 256,
+    3072: 256,
+    4096: 64,
+    5120: 32,
+    6144: 64,
+    8192: 64,
+}
+
 
 def _block_rows(rows: int, hidden: int) -> int:
-    br = (_VMEM_BUDGET_PER_BUF // max(hidden, 1)) // 8 * 8
-    br = max(8, min(256, br))
+    br = _TUNED_BLOCK_ROWS.get(hidden)
+    if br is None:
+        br = (_VMEM_BUDGET_PER_BUF // max(hidden, 1)) // 8 * 8
+        br = max(8, min(256, br))
     return min(br, max(8, (rows + 7) // 8 * 8))
 
 
@@ -105,11 +126,14 @@ def _ln_bwd_kernel(
     dbp_ref[...] = jnp.concatenate([db_part[None], zeros7], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "rms"))
-def layer_norm_fwd(x2d, w, b, *, eps: float, rms: bool):
-    """Returns (y, mu, rstd); mu/rstd are f32 of shape (rows, 1)."""
+@functools.partial(jax.jit, static_argnames=("eps", "rms", "block_rows"))
+def layer_norm_fwd(x2d, w, b, *, eps: float, rms: bool, block_rows=None):
+    """Returns (y, mu, rstd); mu/rstd are f32 of shape (rows, 1).
+
+    ``block_rows`` overrides the tuned/heuristic row-block size (used by
+    tools/ln_tune.py to build ``_TUNED_BLOCK_ROWS``)."""
     rows, hidden = x2d.shape
-    br = _block_rows(rows, hidden)
+    br = block_rows or _block_rows(rows, hidden)
     grid = (pl.cdiv(rows, br),)
     return pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps, rms=rms),
@@ -133,8 +157,12 @@ def layer_norm_fwd(x2d, w, b, *, eps: float, rms: bool):
     )(x2d, w.reshape(1, hidden), b.reshape(1, hidden))
 
 
-@functools.partial(jax.jit, static_argnames=("rms", "x_is_output"))
-def layer_norm_bwd(x2d, w, b, mu, rstd, g, *, rms: bool, x_is_output: bool):
+@functools.partial(
+    jax.jit, static_argnames=("rms", "x_is_output", "block_rows")
+)
+def layer_norm_bwd(
+    x2d, w, b, mu, rstd, g, *, rms: bool, x_is_output: bool, block_rows=None
+):
     """Returns (dx, dw, db); dw/db are f32 of shape (hidden,).
 
     ``x_is_output=True`` is the memory_efficient path: ``x2d`` holds the saved
@@ -142,7 +170,7 @@ def layer_norm_bwd(x2d, w, b, mu, rstd, g, *, rms: bool, x_is_output: bool):
     ``memory_efficient`` template parameter).
     """
     rows, hidden = x2d.shape
-    br = _block_rows(rows, hidden)
+    br = block_rows or _block_rows(rows, hidden)
     nblocks = pl.cdiv(rows, br)
     kernel = functools.partial(
         _ln_bwd_kernel,

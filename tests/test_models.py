@@ -120,9 +120,7 @@ class TestBert:
             new["params"]["bert"]["encoder"] = out
             return new
 
-        params_u = to_unrolled(
-            jax.tree_util.tree_map(lambda x: x, params_s)
-        )
+        params_u = to_unrolled(params_s)
         # sanity: the unrolled model accepts the restacked tree
         l_s, g_s = jax.value_and_grad(
             lambda p: bert_pretrain_loss(p, m_scan, batch)

@@ -28,12 +28,18 @@ TOL = {jnp.bfloat16: dict(atol=2e-2, rtol=2e-2),
 
 
 def _both_paths(fn, *args):
-    _dispatch.set_use_pallas(True)
-    got = jax.jit(fn)(*args)
-    _dispatch.set_use_pallas(False)
-    want = jax.jit(fn)(*args)
-    _dispatch.set_use_pallas(None)
-    return got, want
+    # "highest" pins the XLA reference's f32 dots to true-f32 multi-pass
+    # form, matching the kernels' explicit f32 HIGHEST precision — at
+    # DEFAULT both sides do single-pass-bf16 mults with *different*
+    # summation structure, and f32 parity would be bf16-grade.  (bf16
+    # inputs are unaffected: their products are exact in f32 either way.)
+    with jax.default_matmul_precision("highest"):
+        _dispatch.set_use_pallas(True)
+        got = jax.jit(fn)(*args)
+        _dispatch.set_use_pallas(False)
+        want = jax.jit(fn)(*args)
+        _dispatch.set_use_pallas(None)
+        return got, want
 
 
 def _assert_close(got, want, dtype):
@@ -107,22 +113,39 @@ def _attn_loss(attn_fn, q, k, v, bias=None, **kw):
 def test_flash_attention_fwd_bwd(dtype, b, h, sq, sk, d, causal):
     q, k, v = _qkv(b, h, sq, sk, d, dtype)
 
-    # Pallas flash kernel (forced) vs the unfused f32 composition.
+    # Pallas flash kernel (forced) vs the unfused composition evaluated in
+    # FULL f32 — the ground truth.  Comparing same-dtype against the bf16
+    # reference would gate the kernel on the *reference's* noise: e.g. its
+    # softmax-backward suffers bf16 cancellation at single-visible-key rows
+    # (true gradient exactly 0, reference ~1e-1), where the kernel's
+    # closed-form delta is exact.  "highest" pins the f32 dots of both
+    # sides to true-f32 multi-pass MXU form.
     grad_fn = jax.value_and_grad(
         functools.partial(_attn_loss, flash_attention, causal=causal),
         argnums=(0, 1, 2),
     )
-    _dispatch.set_use_pallas(True)
-    got = jax.jit(grad_fn)(q, k, v)
-    _dispatch.set_use_pallas(None)
-    want = jax.jit(
-        jax.value_and_grad(
-            functools.partial(_attn_loss, mha_reference, causal=causal),
-            argnums=(0, 1, 2),
+    with jax.default_matmul_precision("highest"):
+        _dispatch.set_use_pallas(True)
+        got = jax.jit(grad_fn)(q, k, v)
+        _dispatch.set_use_pallas(None)
+        want = jax.jit(
+            jax.value_and_grad(
+                functools.partial(_attn_loss, mha_reference, causal=causal),
+                argnums=(0, 1, 2),
+            )
+        )(
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+            v.astype(jnp.float32),
         )
-    )(q, k, v)
-    # attention sums over S keys — scale tolerance with sqrt(Sk)
-    tol = {kk: vv * 4 for kk, vv in TOL[dtype].items()}
+    # measured-on-chip error vs f32 truth across this matrix: f32 <= 4e-4
+    # (causal dk worst: recompute + per-block accumulation order), bf16
+    # <= 4e-2; 2.5x headroom on each
+    tol = (
+        dict(atol=1e-3, rtol=1e-3)
+        if dtype == jnp.float32
+        else dict(atol=1e-1, rtol=1e-1)
+    )
     jax.tree_util.tree_map(
         lambda g, w: np.testing.assert_allclose(
             np.asarray(g, np.float32), np.asarray(w, np.float32), **tol
