@@ -9,8 +9,12 @@
   (≙ apex/contrib/optimizers ZeRO-sharded updates).
 
 ``apex/parallel/multiproc.py`` (the one-node process spawner) has no
-analog: a single SPMD program drives every device, and multi-host jobs are
-launched by the cluster runtime (``jax.distributed.initialize``).
+analog: a single SPMD program drives every device.  Multi-host jobs join
+the global runtime through :func:`initialize_distributed`
+(``apex_tpu.parallel.multihost`` — ≙ ``torch.distributed
+.init_process_group``), after which every mesh collective spans hosts;
+``initialize_model_parallel(dcn_data_parallel=True)`` lays dp across DCN
+and keeps model axes on ICI.
 """
 
 from apex_tpu.optimizers.larc import LARC, larc  # noqa: F401
@@ -22,6 +26,11 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
 from apex_tpu.parallel.distributed_fused_optimizers import (  # noqa: F401
     DistributedFusedAdam,
     DistributedFusedLAMB,
+)
+from apex_tpu.parallel.multihost import (  # noqa: F401
+    distributed_is_initialized,
+    finalize_distributed,
+    initialize_distributed,
 )
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,

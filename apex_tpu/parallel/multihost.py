@@ -1,0 +1,146 @@
+"""Multi-host bootstrap — ≙ ``torch.distributed.init_process_group`` +
+``apex/parallel/multiproc.py``'s role in the reference stack.
+
+The reference builds its communication world from NCCL process groups that
+every rank must join explicitly.  JAX is SPMD: each *host process* joins a
+single global runtime (``jax.distributed.initialize``), after which
+``jax.devices()`` returns the GLOBAL device list and every collective in
+this library (``psum`` / ``all_gather`` / ``psum_scatter`` / ``ppermute``
+over mesh axes) spans hosts automatically — ICI within a slice, DCN
+across slices.  There are no per-group objects to manage; the mesh axes of
+:func:`apex_tpu.parallel_state.initialize_model_parallel` play that role.
+
+Typical multi-host entry::
+
+    from apex_tpu.parallel import initialize_distributed
+    from apex_tpu import parallel_state as ps
+
+    initialize_distributed()                      # env-autodetected (TPU pods)
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size=8,             # tp inside a host: ICI
+        dcn_data_parallel=True,                   # dp outermost: across DCN
+    )
+
+On Cloud TPU the coordinator/process count/process id are discovered from
+the TPU metadata, so ``initialize_distributed()`` takes no arguments
+there; for CPU/GPU clusters pass them explicitly (≙ the reference's
+``init_method="env://"`` rendezvous).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+__all__ = [
+    "initialize_distributed",
+    "distributed_is_initialized",
+    "finalize_distributed",
+]
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> Tuple[int, int]:
+    """Join the global JAX runtime; returns ``(process_index, process_count)``.
+
+    ≙ ``torch.distributed.init_process_group(backend="nccl", ...)``.  Safe
+    to call unconditionally: a single-process run (no coordinator given,
+    no cluster env detected) is a no-op that reports ``(0, 1)``, so the
+    same training script works from one chip to a pod — the reference
+    needs its launcher to decide; here the runtime does.
+
+    Not to be confused with
+    ``apex_tpu.transformer.testing.commons.initialize_distributed`` (a
+    test-fixture shim that builds and returns a *Mesh*, mirroring the
+    reference's testing commons of the same name) — this one joins the
+    process runtime and returns rank info.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return jax.process_index(), jax.process_count()
+    # NOTE: jax.distributed.initialize must run before anything touches the
+    # XLA backend (even jax.devices/process_count), so the explicit path
+    # goes first and unconditionally.
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        _INITIALIZED = True
+    else:
+        try:
+            # Autodetect (TPU pod metadata / cluster env).  Raises when no
+            # cluster environment is present (the one-process case) or the
+            # backend is already live — both leave the runtime as-is.
+            jax.distributed.initialize()
+            _INITIALIZED = True
+        except Exception as e:
+            # Distinguish "no cluster env" (fine: single-process) from
+            # "cluster env present but the join failed" — the latter would
+            # otherwise silently degrade a pod job into N independent
+            # single-process runs training divergent copies.
+            import os
+
+            hints = [
+                k
+                for k in (
+                    "JAX_COORDINATOR_ADDRESS",
+                    "COORDINATOR_ADDRESS",
+                    "MEGASCALE_COORDINATOR_ADDRESS",
+                    "SLURM_JOB_NUM_NODES",
+                )
+                if os.environ.get(k)
+            ]
+            if hints:
+                import warnings
+
+                warnings.warn(
+                    "cluster environment detected "
+                    f"({', '.join(hints)}) but jax.distributed.initialize "
+                    f"failed ({type(e).__name__}: {e}); continuing "
+                    "SINGLE-process — multi-host collectives will NOT span "
+                    "hosts",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+    return jax.process_index(), jax.process_count()
+
+
+def distributed_is_initialized() -> bool:
+    """Whether this process joined a (multi-process) JAX runtime.
+
+    Deliberately does NOT touch the XLA backend (no ``jax.devices()`` /
+    ``process_count()``): the guard pattern ``if not
+    distributed_is_initialized(): initialize_distributed(...)`` must stay
+    legal, and backend init before ``jax.distributed.initialize`` is an
+    error.  Consults this module's flag plus the runtime's own client
+    state (covers users who called ``jax.distributed.initialize``
+    directly).
+    """
+    if _INITIALIZED:
+        return True
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        return _jax_distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def finalize_distributed() -> None:
+    """≙ ``torch.distributed.destroy_process_group`` (idempotent)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        try:
+            jax.distributed.shutdown()
+        finally:
+            _INITIALIZED = False

@@ -103,6 +103,29 @@ class _ParallelState:
 _STATE: Optional[_ParallelState] = None
 
 
+def _ici_device_mesh(dp, pp, cp, tp, devices):
+    """Topology-aware single-granule layout: on a real TPU slice a naive
+    reshape of jax.devices() can place a tp group across non-adjacent
+    chips; mesh_utils computes an ICI-friendly layout (innermost axis on
+    the tightest torus dimension)."""
+    import numpy as np
+    from jax.experimental import mesh_utils
+
+    try:
+        return mesh_utils.create_device_mesh((dp, pp, cp, tp), devices=devices)
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"mesh_utils.create_device_mesh failed ({type(e).__name__}: {e});"
+            " falling back to naive device ordering — tp groups may span"
+            " non-adjacent chips, degrading collective bandwidth",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return np.asarray(devices).reshape(dp, pp, cp, tp)
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -110,6 +133,7 @@ def initialize_model_parallel(
     context_parallel_size: int = 1,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
+    dcn_data_parallel: bool = False,
 ) -> Mesh:
     """Create and register the global ``(dp, pp, tp)`` mesh.
 
@@ -118,6 +142,16 @@ def initialize_model_parallel(
     reshapes ``jax.devices()`` into a named mesh.  ``dp`` is derived:
     ``n_devices // (tp * pp)``, with the same divisibility requirement the
     reference enforces.
+
+    ``dcn_data_parallel=True`` is the multi-slice layout (≙ the
+    reference's convention of putting the DP all-reduce on the
+    inter-node fabric and TP inside NVLink islands): the mesh is built
+    with ``mesh_utils.create_hybrid_device_mesh`` so that one dp
+    sub-axis of size ``jax.process_count()``-granularity spans DCN while
+    pp/cp/tp (and the rest of dp) stay on ICI.  Gradient psum over
+    ``dp`` then does a hierarchical reduce: ICI first, one DCN hop
+    last.  Ignored (with a warning) when the topology gives a single
+    slice or the hybrid construction is unavailable.
 
     Returns the mesh (also retrievable via :func:`get_mesh`).
     """
@@ -154,28 +188,39 @@ def initialize_model_parallel(
 
     if explicit_devices:
         device_array = np.asarray(devices).reshape(dp, pp, cp, tp)
-    else:
-        # Topology-aware assignment: on a real TPU slice a naive reshape of
-        # jax.devices() can place a tp group across non-adjacent chips;
-        # mesh_utils computes an ICI-friendly layout (innermost axis on the
-        # tightest torus dimension).
+    elif dcn_data_parallel:
+        # Multi-slice: split dp into (dcn_granules, dp_within) and ask
+        # mesh_utils for a hybrid mesh — model axes never cross DCN.
         from jax.experimental import mesh_utils
 
+        granules = len({d.process_index for d in devices})
         try:
-            device_array = mesh_utils.create_device_mesh(
-                (dp, pp, cp, tp), devices=devices
+            if granules == 1 or dp % granules != 0:
+                raise ValueError(
+                    f"dp={dp} not splittable over {granules} DCN granule(s)"
+                )
+            # process_is_granule matches the process_index-based granule
+            # count above (jax's default groups by slice_index, which CPU
+            # devices lack and which disagrees with this count on
+            # multi-host-per-slice pods)
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                (dp // granules, pp, cp, tp),
+                (granules, 1, 1, 1),
+                devices=devices,
+                process_is_granule=True,
             )
         except Exception as e:
             import warnings
 
             warnings.warn(
-                f"mesh_utils.create_device_mesh failed ({type(e).__name__}: {e});"
-                " falling back to naive device ordering — tp groups may span"
-                " non-adjacent chips, degrading collective bandwidth",
+                f"hybrid (DCN) mesh unavailable ({type(e).__name__}: {e}); "
+                "using the single-granule ICI layout",
                 RuntimeWarning,
                 stacklevel=2,
             )
-            device_array = np.asarray(devices).reshape(dp, pp, cp, tp)
+            device_array = _ici_device_mesh(dp, pp, cp, tp, devices)
+    else:
+        device_array = _ici_device_mesh(dp, pp, cp, tp, devices)
     mesh = Mesh(device_array, _AXIS_ORDER)
     _STATE = _ParallelState(
         mesh=mesh,

@@ -48,7 +48,11 @@ def initialize_distributed(
     **kwargs,
 ):
     """≙ commons.initialize_distributed — on TPU there is no process-group
-    bootstrap; this just (re)builds the global mesh."""
+    bootstrap; this just (re)builds the global mesh and returns it.
+
+    Distinct from :func:`apex_tpu.parallel.initialize_distributed` (the
+    multi-host runtime join, which returns rank info) — same reference-
+    parity name, different job; this one is a test fixture."""
     if ps.model_parallel_is_initialized():
         ps.destroy_model_parallel()
     return ps.initialize_model_parallel(
