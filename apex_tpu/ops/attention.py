@@ -31,7 +31,13 @@ import jax.numpy as jnp
 from apex_tpu.ops import _dispatch
 from apex_tpu.ops.pallas import flash_attention as _pallas
 
-__all__ = ["flash_attention", "mha_reference", "fmha_qkvpacked"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "mha_reference",
+    "mha_reference_with_lse",
+    "fmha_qkvpacked",
+]
 
 _LANES = 128
 
@@ -109,6 +115,21 @@ def _flash_bwd(scale, causal, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _scores(q, k, bias, causal, scale):
+    """Scaled (+bias, causal-masked) f32 score matrix — the shared head of
+    both unfused reference compositions."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _pallas.MASK_VALUE)
+    return s
+
+
 def mha_reference(
     q,
     k,
@@ -127,15 +148,7 @@ def mha_reference(
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if bias is not None:
-        s = s + bias.astype(jnp.float32)
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, _pallas.MASK_VALUE)
+    s = _scores(q, k, bias, causal, scale)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_p > 0.0:
         if dropout_rng is None:
@@ -190,9 +203,7 @@ def flash_attention(
         )
 
     b, h, sq, d = q.shape
-    qf = _pad_head_dim(_flatten_bh(q))
-    kf = _pad_head_dim(_flatten_bh(k))
-    vf = _pad_head_dim(_flatten_bh(v))
+    qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
     bias_f = None
     if bias is not None:
         sk = k.shape[-2]
@@ -217,6 +228,76 @@ def flash_attention(
         bias_f = jax.lax.stop_gradient(bias_f)
     o = _flash(qf, kf, vf, bias_f, scale, causal)
     return o[..., :d].reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_lse(q, k, v, scale, causal):
+    return _flash_lse_fwd(q, k, v, scale, causal)[0]
+
+
+def _flash_lse_fwd(q, k, v, scale, causal):
+    o, lse = _pallas.flash_fwd(q, k, v, None, scale=scale, causal=causal)
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(scale, causal, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _pallas.flash_bwd(
+        q, k, v, o, lse, do, None, scale=scale, causal=causal, dlse=dlse
+    )
+    return dq, dk, dv
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal=False, scale=None):
+    """Fused attention returning ``(o, lse)`` — both differentiable.
+
+    The building block for composed softmax schemes that need the row
+    logsumexp downstream: ring attention merges per-hop ``(o, lse)`` pairs
+    with the online-softmax rule and differentiates through the merge, so
+    the backward here consumes BOTH cotangents (see
+    ``pallas.flash_attention.flash_bwd``'s ``dlse`` folding).  No analog
+    in the reference — its fused MHA never exposes the softmax statistics.
+
+    q (B,H,Sq,D); k, v (B,H,Sk,D).  Returns o (B,H,Sq,D) in the input
+    dtype and lse f32 (B,H,Sq).  Uses the Pallas kernels whenever the
+    shape is eligible (interpret-mode off TPU), else a jnp composition
+    with identical semantics.
+    """
+    from apex_tpu.amp.lists import amp_cast
+
+    q, k, v = amp_cast("attention", q, k, v)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, h, sq, d = q.shape
+    if _pallas_eligible(q, k, v, 0.0):
+        qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
+        o, lse = _flash_lse(qf, kf, vf, scale, causal)
+        return (
+            o[..., :d].reshape(b, h, sq, d),
+            lse.reshape(b, h, sq),
+        )
+    return mha_reference_with_lse(q, k, v, causal=causal, scale=scale)
+
+
+def mha_reference_with_lse(q, k, v, *, causal=False, scale=None):
+    """jnp composition returning ``(o, lse)`` — the correctness reference
+    for :func:`flash_attention_with_lse` (numerics identical to
+    :func:`mha_reference` plus the row logsumexp)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = _scores(q, k, None, causal, scale)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", (p / l).astype(q.dtype), v
+    )
+    lse = (m + jnp.log(l))[..., 0]
+    return o, lse
 
 
 def fmha_qkvpacked(qkv, bias=None, *, causal=False, scale=None,

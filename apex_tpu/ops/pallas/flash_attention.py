@@ -423,9 +423,22 @@ def _dq_kernel(
     jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
 )
 def flash_bwd(
-    q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None
+    q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None,
+    dlse=None,
 ):
-    """Returns (dq, dk, dv).  Recomputation backward: only lse was saved."""
+    """Returns (dq, dk, dv).  Recomputation backward: only lse was saved.
+
+    ``dlse`` (f32, (BH, Sq)) is an optional cotangent for the forward's
+    logsumexp output — used by consumers that differentiate through lse
+    (ring attention's online-softmax merge).  The math folds it into the
+    existing kernels: with p = exp(s - lse),
+
+        ds_ij = p_ij * (dp_ij - delta_i) + p_ij * dlse_i
+              = p_ij * (dp_ij - (delta_i - dlse_i)),
+
+    so passing ``delta - dlse`` where the kernels expect delta yields the
+    dq/dk that include the lse contribution; dv = pᵀ do is lse-independent.
+    """
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = min(block_q, sq) if block_q else _auto_block(sq, d)
@@ -435,12 +448,12 @@ def flash_bwd(
     # delta_i = rowsum(do * o) — the softmax-jacobian correction term
     # (≙ the reference bwd kernels' row reduction before the ds GEMM).
     # Broadcast over a 128-lane dim like lse so blocks are tile-aligned.
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[
-            ..., None
-        ],
-        lse.shape,
+    delta_rows = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )
+    if dlse is not None:
+        delta_rows = delta_rows - dlse.astype(jnp.float32)
+    delta = jnp.broadcast_to(delta_rows[..., None], lse.shape)
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
     k_spec_j = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))

@@ -35,41 +35,29 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu import parallel_state as ps
-from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
 
 __all__ = ["ring_attention", "ulysses_attention"]
 
 _CP = ps.CONTEXT_PARALLEL_AXIS
 
 
-def _block_attend(q, k, v, scale, mask):
-    """One (q-block × kv-block) flash block in f32: returns (o, lse).
+def _block_attend(q, k, v, scale, *, causal=False):
+    """One (q-block × kv-block) flash block: returns (o (f32), lse).
 
     o is the block-normalized output, lse the row logsumexp — exactly the
-    pair the online-softmax merge needs.  ``mask`` is an additive (Sq, Sk)
-    term or None.
+    pair the online-softmax merge needs.  Dispatches through
+    ``flash_attention_with_lse`` (its backward consumes the lse cotangent
+    the merge produces): the Pallas kernel path — which never materializes
+    the (S_local, S_local) score matrix in HBM — is taken on TPU when
+    S_local >= 1024 (or the dispatch is forced); shorter hops use the jnp
+    composition, whose transient score block XLA wins on anyway at those
+    sizes (see ops.attention._pallas_eligible).  ``causal`` covers the
+    ring's diagonal (self) block.
     """
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ).astype(jnp.float32) * scale
-    if mask is not None:
-        s = s + mask
-    m = jnp.max(s, axis=-1, keepdims=True)
-    # all-masked rows: keep exp well-defined (finite MASK_VALUE convention)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    ) / l
-    lse = (m + jnp.log(l))[..., 0]  # (B, H, Sq)
-    return o, lse
+    from apex_tpu.ops.attention import flash_attention_with_lse
 
-
-def _tri_mask(s_local, dtype=jnp.float32):
-    rows = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
-    return jnp.where(rows >= cols, 0.0, MASK_VALUE).astype(dtype)
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal, scale=scale)
+    return o.astype(jnp.float32), lse
 
 
 def ring_attention(
@@ -107,15 +95,14 @@ def ring_attention(
     def hop(qf, kv, src):
         """(o, lse) for this rank's q against the kv chunk from ``src``."""
         kb, vb = kv
-        kb = kb.astype(jnp.float32)
         if not causal:
-            return _block_attend(qf, kb, vb, scale, None)
+            return _block_attend(qf, kb, vb, scale)
 
         def self_block(_):
-            return _block_attend(qf, kb, vb, scale, _tri_mask(s_local)[None, None])
+            return _block_attend(qf, kb, vb, scale, causal=True)
 
         def past_block(_):
-            return _block_attend(qf, kb, vb, scale, None)
+            return _block_attend(qf, kb, vb, scale)
 
         def future_block(_):
             # fully masked: zero mass — skip both einsums entirely

@@ -189,3 +189,61 @@ def test_per_batch_full_bias_grouped(force_pallas):
     out = flash_attention(q, k, v, bias)
     ref = mha_reference(q, k, v, bias)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+class TestFlashAttentionWithLse:
+    """flash_attention_with_lse: (o, lse) values AND the dlse backward
+    (the ring-attention merge differentiates through lse)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_values_match_reference(self, force_pallas, causal):
+        from apex_tpu.ops.attention import (
+            flash_attention_with_lse,
+            mha_reference_with_lse,
+        )
+
+        q, k, v = _rand_qkv(jax.random.PRNGKey(3))
+        o, lse = jax.jit(
+            lambda q, k, v: flash_attention_with_lse(q, k, v, causal=causal)
+        )(q, k, v)
+        _dispatch.set_use_pallas(False)
+        ow, lw = mha_reference_with_lse(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(ow), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(lw), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_include_lse_cotangent(self, force_pallas, causal):
+        """A loss that consumes BOTH outputs — the lse term exercises the
+        delta - dlse folding in flash_bwd."""
+        from apex_tpu.ops.attention import (
+            flash_attention_with_lse,
+            mha_reference_with_lse,
+        )
+
+        q, k, v = _rand_qkv(jax.random.PRNGKey(4))
+
+        def loss(fn, q, k, v):
+            o, lse = fn(q, k, v, causal=causal)
+            return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(
+                jnp.sin(lse)
+            )
+
+        got = jax.jit(
+            jax.grad(
+                lambda q, k, v: loss(flash_attention_with_lse, q, k, v),
+                argnums=(0, 1, 2),
+            )
+        )(q, k, v)
+        _dispatch.set_use_pallas(False)
+        want = jax.grad(
+            lambda q, k, v: loss(mha_reference_with_lse, q, k, v),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5
+            )
