@@ -22,11 +22,11 @@ __all__ = ["BatchNorm2d_NHWC"]
 
 
 def _axis_bound(axis_name: str) -> bool:
-    try:
-        jax.lax.axis_size(axis_name)
-        return True
-    except (NameError, KeyError):
-        return False
+    from apex_tpu.parallel_state import bound_axis_size
+
+    # bn_group > 1 needs a real (size > 1) mesh axis; a size-1 axis is
+    # mathematically the unbound case (psum over one device = identity)
+    return bound_axis_size(axis_name) > 1
 
 
 class BatchNorm2d_NHWC(nn.Module):

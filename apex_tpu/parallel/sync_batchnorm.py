@@ -32,11 +32,9 @@ __all__ = ["SyncBatchNorm", "convert_syncbn_model"]
 
 
 def _axis_bound(axis_name: str) -> bool:
-    try:
-        jax.lax.axis_size(axis_name)
-        return True
-    except (NameError, KeyError):
-        return False
+    from apex_tpu.parallel_state import bound_axis_size
+
+    return bound_axis_size(axis_name) > 1
 
 
 class SyncBatchNorm(nn.Module):

@@ -46,6 +46,9 @@ __all__ = [
     "PIPELINE_PARALLEL_AXIS",
     "CONTEXT_PARALLEL_AXIS",
     "TENSOR_PARALLEL_AXIS",
+    "EXPERT_PARALLEL_AXIS",
+    "get_expert_model_parallel_world_size",
+    "get_expert_model_parallel_rank",
     "initialize_model_parallel",
     "model_parallel_is_initialized",
     "get_mesh",
@@ -68,6 +71,7 @@ __all__ = [
     "set_virtual_pipeline_model_parallel_world_size",
     "destroy_model_parallel",
     "divide",
+    "bound_axis_size",
     "data_parallel_sharding",
     "named_sharding",
     "replicated_sharding",
@@ -77,6 +81,10 @@ DATA_PARALLEL_AXIS = "dp"
 PIPELINE_PARALLEL_AXIS = "pp"
 CONTEXT_PARALLEL_AXIS = "cp"
 TENSOR_PARALLEL_AXIS = "tp"
+# Expert parallelism rides the dp axis (Megatron's convention: the expert
+# group is carved from the data-parallel world; no extra mesh axis) — see
+# apex_tpu.transformer.moe.  The alias names the intent at call sites.
+EXPERT_PARALLEL_AXIS = DATA_PARALLEL_AXIS
 
 _AXIS_ORDER = (
     DATA_PARALLEL_AXIS,
@@ -286,6 +294,20 @@ def get_pipeline_model_parallel_world_size() -> int:
 # ---------------------------------------------------------------------------
 
 
+def bound_axis_size(axis: str) -> int:
+    """Size of ``axis`` if bound (inside shard_map over the mesh), else 1.
+
+    The shared probe for modules that degrade gracefully outside a mesh
+    (SyncBatchNorm, groupbn, SwitchMoe): jax raises NameError/KeyError for
+    an unbound name depending on the path, both meaning "no such axis
+    here".
+    """
+    try:
+        return jax.lax.axis_size(axis)
+    except (NameError, KeyError):
+        return 1
+
+
 def _axis_index(axis: str):
     try:
         return jax.lax.axis_index(axis)
@@ -295,6 +317,18 @@ def _axis_index(axis: str):
             "jax.shard_map over the global mesh (SPMD has no host-side rank); "
             "use the *_world_size helpers for host logic"
         ) from e
+
+
+def get_expert_model_parallel_world_size() -> int:
+    """Experts shard over the dp axis; its size is the ep world size.
+    (≙ Megatron's get_expert_model_parallel_world_size — absent in the
+    reference fork, provided here for the MoE extension.)"""
+    return _state().data_parallel_size
+
+
+def get_expert_model_parallel_rank():
+    """Traced ep rank (== dp rank) — call inside shard_map."""
+    return _axis_index(EXPERT_PARALLEL_AXIS)
 
 
 def get_data_parallel_rank():

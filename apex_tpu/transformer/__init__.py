@@ -23,7 +23,15 @@ from apex_tpu.transformer.log_util import (  # noqa: F401
     set_logging_level,
 )
 
-_LAZY = ("pipeline_parallel", "functional", "amp", "layers", "testing")
+_LAZY = (
+    "pipeline_parallel",
+    "functional",
+    "amp",
+    "layers",
+    "testing",
+    "moe",
+    "context_parallel",
+)
 
 
 def __getattr__(name):

@@ -1,0 +1,205 @@
+"""Expert parallelism — Switch-style Mixture-of-Experts over a mesh axis.
+
+**No reference analog** (SURVEY §2.3: EP/MoE is ABSENT in the reference —
+``parallel_state`` has no expert groups).  This module is the TPU-native
+extension that completes the parallelism envelope (dp/tp/sp/pp/cp/ep):
+
+- :class:`SwitchMoe` — a drop-in MoE FFN block: top-1 or top-2 router,
+  fixed expert capacity (static shapes — the XLA requirement), experts
+  sharded across ``expert_axis`` (Megatron's convention: the expert group
+  is carved out of the data-parallel world, so no new mesh axis is
+  needed), token dispatch via ``jax.lax.all_to_all``, and the Switch
+  auxiliary load-balancing loss.
+
+Dataflow per shard_map rank (T = local tokens, E = global experts,
+E_l = E / ep local experts, C = capacity per expert):
+
+    router logits (T, E) → dispatch one-hots (T, E, C)        [einsum form:
+    combine weights  (T, E, C)                 Mesh-TensorFlow/GShard MoE]
+    x (T, H) ──einsum──▶ (E, C, H) ──all_to_all(ep)──▶ (E_l, ep·C, H)
+        ──batched expert FFN (E_l,·,H)@(E_l,H,F)──▶ (E_l, ep·C, H)
+        ──all_to_all back──▶ (E, C, H) ──combine──▶ (T, H)
+
+The one-hot dispatch keeps every shape static and lowers to MXU-friendly
+einsums; overflow tokens beyond an expert's capacity are dropped (their
+combine weight is zero — the standard Switch behavior) and pass through
+the residual connection of the surrounding block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+
+__all__ = ["MoeConfig", "SwitchMoe", "moe_dispatch_combine"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    top_k: int = 1  # 1 = Switch, 2 = GShard-style top-2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    # router always computes in f32 (the Switch paper's stability rule);
+    # expert FFN computes in `dtype`
+    dtype: Any = jnp.bfloat16
+    # mesh axis the experts shard over; None = unsharded (single program).
+    # "dp" is the Megatron convention (expert group ⊂ data-parallel world).
+    expert_axis: Optional[str] = ps.DATA_PARALLEL_AXIS
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    return 1 if axis is None else ps.bound_axis_size(axis)
+
+
+def moe_dispatch_combine(router_probs, top_k, capacity):
+    """Dispatch/combine tensors from router probabilities.
+
+    router_probs f32 (T, E) (already softmaxed).  Returns
+    ``(dispatch (T, E, C) bool-as-float, combine (T, E, C) f32, aux)``:
+    position-in-expert is assigned by cumulative count in token order
+    (earlier tokens win capacity — the Switch rule), ``aux`` is the
+    load-balancing loss term  E · Σ_e f_e · P_e  (fraction routed ×
+    mean prob).
+    """
+    t, e = router_probs.shape
+    # top-k expert choices per token
+    _, expert_idx = jax.lax.top_k(router_probs, top_k)  # (T, K)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, K, E)
+
+    # aux loss uses the top-1 assignment fraction (Switch definition)
+    frac_routed = jnp.mean(onehot[:, 0, :], axis=0)  # (E,)
+    mean_prob = jnp.mean(router_probs, axis=0)  # (E,)
+    aux = e * jnp.sum(frac_routed * mean_prob)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # running per-expert fill counts across the K choices: a token's k-th
+    # choice sees capacity consumed by ALL tokens' earlier choices and by
+    # earlier tokens' k-th choice (exact GShard ordering for K <= 2)
+    fill = jnp.zeros((e,), jnp.float32)
+    for k in range(top_k):
+        oh = onehot[:, k, :]  # (T, E)
+        pos = jnp.cumsum(oh, axis=0) - oh + fill[None, :]  # (T, E)
+        keep = oh * (pos < capacity)
+        pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+        pos_oh = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
+        sel = keep[..., None] * pos_oh  # (T, E, C)
+        dispatch = dispatch + sel
+        gate = jnp.sum(router_probs * oh, axis=-1)  # (T,)
+        combine = combine + sel * gate[:, None, None]
+        fill = fill + jnp.sum(oh, axis=0)
+    if top_k == 2:
+        # renormalize the KEPT gates so they sum to 1 per token (GShard's
+        # top-2 rule); a token whose both choices overflowed keeps 0
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = jnp.where(
+            denom > 0.0, combine / jnp.maximum(denom, 1e-9), 0.0
+        )
+    return dispatch, combine, aux
+
+
+class SwitchMoe(nn.Module):
+    """MoE FFN block (router + sharded experts + dispatch/combine).
+
+    Input/output ``(S, B, H)`` (seq-first, matching the transformer
+    stack).  Returns ``(y, aux_loss)`` — add ``cfg.aux_loss_coef * aux``
+    to the training loss.  Expert weights are stored as the LOCAL shard
+    ``(E_l, ...)`` when ``cfg.expert_axis`` is bound (ep-degree-invariant
+    init: each rank folds its expert ids into the param key, so global
+    expert e has identical weights at any ep degree).
+    """
+
+    cfg: MoeConfig
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        s, b, h = x.shape
+        if h != cfg.hidden_size:
+            raise ValueError(f"hidden {h} != cfg.hidden_size {cfg.hidden_size}")
+        ep = _axis_size(cfg.expert_axis)
+        if cfg.num_experts % ep:
+            raise ValueError(
+                f"num_experts ({cfg.num_experts}) must be divisible by the "
+                f"expert axis size ({ep})"
+            )
+        e_local = cfg.num_experts // ep
+        tokens = s * b
+        capacity = int(cfg.capacity_factor * tokens / cfg.num_experts + 0.5)
+        capacity = max(capacity, 1)
+
+        xt = x.reshape(tokens, h)
+        # --- router (f32, replicated) ---------------------------------
+        router_w = self.param(
+            "router",
+            nn.initializers.normal(stddev=0.02),
+            (h, cfg.num_experts),
+            jnp.float32,
+        )
+        logits = xt.astype(jnp.float32) @ router_w
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, aux = moe_dispatch_combine(
+            probs, cfg.top_k, capacity
+        )
+
+        # --- expert weights: LOCAL shard, ep-degree-invariant init ----
+        def expert_init(fan_in, fan_out):
+            def init(key):
+                rank = 0
+                if ep > 1:
+                    rank = jax.lax.axis_index(cfg.expert_axis)
+                keys = jax.vmap(
+                    lambda i: jax.random.fold_in(key, rank * e_local + i)
+                )(jnp.arange(e_local))
+                w_init = nn.initializers.normal(stddev=fan_in**-0.5)
+                return jax.vmap(lambda k: w_init(k, (fan_in, fan_out)))(keys)
+
+            return init
+
+        w1 = self.param(
+            "w1", expert_init(h, cfg.ffn_hidden_size)
+        ).astype(cfg.dtype)
+        w2 = self.param(
+            "w2", expert_init(cfg.ffn_hidden_size, h)
+        ).astype(cfg.dtype)
+
+        # --- dispatch -> experts -> combine ---------------------------
+        ex = jnp.einsum(
+            "tec,th->ech", dispatch.astype(cfg.dtype), xt.astype(cfg.dtype)
+        )  # (E, C, H): this rank's C capacity slots for EVERY expert
+        if ep > 1:
+            # tiled all_to_all, expert axis split source-rank-major:
+            # (E, C, H) -> (E_l, ep*C, H) — each rank receives the slots
+            # routed to ITS experts from every expert-group peer (the
+            # received axis is source-rank major: peer r's block sits at
+            # [r*C, (r+1)*C))
+            ex = jax.lax.all_to_all(
+                ex, cfg.expert_axis, split_axis=0, concat_axis=1, tiled=True
+            )
+        hmid = jnp.einsum("ekh,ehf->ekf", ex, w1)
+        hmid = jax.nn.gelu(hmid, approximate=True)
+        ey = jnp.einsum("ekf,efh->ekh", hmid, w2)  # (E_l, ep*C, H)
+        if ep > 1:
+            # reverse: split the source-rank-major slot axis, concat on the
+            # expert axis in owner-rank order -> (E, C, H) globally
+            # expert-ordered, exactly what combine expects
+            ey = jax.lax.all_to_all(
+                ey, cfg.expert_axis, split_axis=1, concat_axis=0, tiled=True
+            )
+        y = jnp.einsum(
+            "tec,ech->th", combine.astype(cfg.dtype), ey
+        )
+        return y.reshape(s, b, h).astype(x.dtype), aux
