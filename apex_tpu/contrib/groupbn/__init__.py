@@ -22,11 +22,12 @@ __all__ = ["BatchNorm2d_NHWC"]
 
 
 def _axis_bound(axis_name: str) -> bool:
-    from apex_tpu.parallel_state import bound_axis_size
+    from apex_tpu.parallel_state import axis_is_bound
 
-    # bn_group > 1 needs a real (size > 1) mesh axis; a size-1 axis is
-    # mathematically the unbound case (psum over one device = identity)
-    return bound_axis_size(axis_name) > 1
+    # truly-bound check (size-1 axes included): the caller distinguishes
+    # "not in shard_map" from "bn_group != axis size", and a bound size-1
+    # axis must produce the latter, actionable, error
+    return axis_is_bound(axis_name)
 
 
 class BatchNorm2d_NHWC(nn.Module):

@@ -58,6 +58,14 @@ class GptConfig:
     dtype: Any = jnp.bfloat16
     sequence_parallel: bool = False
     remat: bool = False
+    # MoE: num_experts > 0 replaces the dense MLP with a SwitchMoe block
+    # (experts sharded over the dp/ep axis, apex_tpu.transformer.moe); the
+    # per-layer aux losses are sown into the "losses" collection and folded
+    # into gpt_lm_loss with moe_aux_coef.
+    num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
 
 class GptBlock(nn.Module):
@@ -100,17 +108,40 @@ class GptBlock(nn.Module):
         x = x + attn
 
         y = _LayerNorm(h, cfg.layer_norm_eps, name="ln_mlp")(x)
-        y = ColumnParallelLinear(
-            h, cfg.intermediate_size, gather_output=False,
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            dtype=cfg.dtype, name="fc1",
-        )(y)
-        y = jax.nn.gelu(y, approximate=True)
-        y = RowParallelLinear(
-            cfg.intermediate_size, h, input_is_parallel=True,
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            dtype=cfg.dtype, name="fc2",
-        )(y)
+        if cfg.num_experts:
+            from apex_tpu.transformer.moe import MoeConfig, SwitchMoe
+
+            # Routing happens on this rank's local (possibly SP-sharded)
+            # tokens; expert weights shard over dp/ep and are replicated
+            # across tp.  Without SP at tp > 1 the full sequence is routed
+            # identically on every tp rank (correct, redundant) — enable
+            # sequence_parallel to split that work.
+            # NOTE: the aux coefficient has ONE owner — gpt_lm_loss
+            # applies cfg.moe_aux_coef; SwitchMoe returns the raw aux.
+            y, aux = SwitchMoe(
+                MoeConfig(
+                    hidden_size=h,
+                    ffn_hidden_size=cfg.intermediate_size,
+                    num_experts=cfg.num_experts,
+                    top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    dtype=cfg.dtype,
+                ),
+                name="moe",
+            )(y)
+            self.sow("losses", "moe_aux", aux)
+        else:
+            y = ColumnParallelLinear(
+                h, cfg.intermediate_size, gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                dtype=cfg.dtype, name="fc1",
+            )(y)
+            y = jax.nn.gelu(y, approximate=True)
+            y = RowParallelLinear(
+                cfg.intermediate_size, h, input_is_parallel=True,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                dtype=cfg.dtype, name="fc2",
+            )(y)
         return x + y
 
 
@@ -150,7 +181,7 @@ class GptModel(nn.Module):
             step = nn.remat(step, prevent_cse=False)
         scanned = nn.scan(
             step,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "losses": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.num_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -164,8 +195,30 @@ class GptModel(nn.Module):
 
 def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
     """Next-token CE with the decoder tied to the embedding (vocab-parallel
-    logits — no gather, ≙ vocab_parallel_cross_entropy usage in Megatron)."""
-    h = model.apply(params, input_ids, deterministic=deterministic)
+    logits — no gather, ≙ vocab_parallel_cross_entropy usage in Megatron).
+
+    With ``cfg.num_experts > 0`` the per-layer MoE aux losses (sown into
+    the "losses" collection) are averaged and added with
+    ``cfg.moe_aux_coef``."""
+    aux_total = 0.0
+    if model.cfg.num_experts:
+        # Strip any "losses" collection that leaked into the variables
+        # (flax init returns sown collections): apply would APPEND fresh
+        # aux to the stale init-time values — double-counting — and the
+        # stale leaves would receive gradients/optimizer updates as if
+        # they were parameters.
+        variables = {k: v for k, v in params.items() if k != "losses"}
+        h, sown = model.apply(
+            variables, input_ids, deterministic=deterministic,
+            mutable=["losses"],
+        )
+        aux = jax.tree_util.tree_leaves(sown.get("losses", {}))
+        if aux:
+            aux_total = model.cfg.moe_aux_coef * sum(
+                jnp.mean(a) for a in aux
+            )
+    else:
+        h = model.apply(params, input_ids, deterministic=deterministic)
     embed = params["params"]["word_embeddings"]["weight"]
     logits = jnp.matmul(
         h.astype(model.cfg.dtype),
@@ -176,4 +229,4 @@ def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
     losses = vocab_parallel_cross_entropy(
         logits[:-1].astype(jnp.float32), input_ids[1:]
     )
-    return jnp.mean(losses)
+    return jnp.mean(losses) + aux_total

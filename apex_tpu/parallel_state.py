@@ -72,6 +72,7 @@ __all__ = [
     "destroy_model_parallel",
     "divide",
     "bound_axis_size",
+    "axis_is_bound",
     "data_parallel_sharding",
     "named_sharding",
     "replicated_sharding",
@@ -292,6 +293,16 @@ def get_pipeline_model_parallel_world_size() -> int:
 # ---------------------------------------------------------------------------
 # Ranks — traced values, valid inside shard_map over the global mesh.
 # ---------------------------------------------------------------------------
+
+
+def axis_is_bound(axis: str) -> bool:
+    """Whether ``axis`` is a bound mesh axis here (inside shard_map) —
+    regardless of its size (a bound size-1 axis is still bound)."""
+    try:
+        jax.lax.axis_size(axis)
+        return True
+    except (NameError, KeyError):
+        return False
 
 
 def bound_axis_size(axis: str) -> int:

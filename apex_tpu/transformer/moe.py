@@ -37,7 +37,12 @@ import jax.numpy as jnp
 
 from apex_tpu import parallel_state as ps
 
-__all__ = ["MoeConfig", "SwitchMoe", "moe_dispatch_combine"]
+__all__ = [
+    "MoeConfig",
+    "SwitchMoe",
+    "moe_dispatch_combine",
+    "sync_moe_gradients",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +116,40 @@ def moe_dispatch_combine(router_probs, top_k, capacity):
     return dispatch, combine, aux
 
 
+def sync_moe_gradients(grads, axis: str = ps.EXPERT_PARALLEL_AXIS,
+                       average: bool = True):
+    """Data-parallel gradient sync that understands expert sharding.
+
+    A plain ``psum``/``pmean`` over dp (apex_tpu.parallel's DDP) is WRONG
+    for an MoE model: expert weights are dp-SHARDED (rank r owns experts
+    ``[r·E_l, (r+1)·E_l)``), so an element-wise allreduce would mix the
+    gradients of DIFFERENT experts.  And it is also unnecessary — each
+    rank's experts already saw every rank's tokens through the all_to_all
+    dispatch, so their backward aggregates over the full global batch.
+    This helper reduces every leaf EXCEPT those whose path contains a
+    parameter named with SwitchMoe's ``expert_`` prefix.
+
+    Scaling: the backward ``all_to_all`` already delivers to each expert
+    owner the SUM over every rank's loss of that expert's gradient.  So
+    for the mean global objective (``average=True``, pmean on the other
+    leaves — DDP's gradient_average semantics) expert leaves are scaled
+    by ``1/axis_size`` to match; for the sum objective (``average=False``,
+    psum) they are left as the sum they already are.
+    """
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    reduce_ = jax.lax.pmean if average else jax.lax.psum
+    world = jax.lax.axis_size(axis)
+
+    def maybe_reduce(path, g):
+        for k in path:
+            if isinstance(k, DictKey) and str(k.key).startswith("expert_"):
+                return g / world if average else g
+        return reduce_(g, axis)
+
+    return tree_map_with_path(maybe_reduce, grads)
+
+
 class SwitchMoe(nn.Module):
     """MoE FFN block (router + sharded experts + dispatch/combine).
 
@@ -169,11 +208,13 @@ class SwitchMoe(nn.Module):
 
             return init
 
+        # the "expert_" prefix marks dp-SHARDED parameters — the contract
+        # sync_moe_gradients uses to exclude them from the dp grad psum
         w1 = self.param(
-            "w1", expert_init(h, cfg.ffn_hidden_size)
+            "expert_w1", expert_init(h, cfg.ffn_hidden_size)
         ).astype(cfg.dtype)
         w2 = self.param(
-            "w2", expert_init(cfg.ffn_hidden_size, h)
+            "expert_w2", expert_init(cfg.ffn_hidden_size, h)
         ).astype(cfg.dtype)
 
         # --- dispatch -> experts -> combine ---------------------------
