@@ -97,14 +97,21 @@ def _manager_options(max_to_keep, save_interval_steps):
 
 
 def latest_step(directory) -> Optional[int]:
-    """Newest step number under ``directory`` (None if empty)."""
-    with CheckpointManager(directory) as mgr:
-        return mgr.latest_step()
+    """Newest step number under ``directory`` (None if absent/empty).
+
+    Read-only and cheap: a plain directory scan — no manager is
+    constructed, and a missing directory is NOT created (a typo'd resume
+    path should look empty, not leave stray directories behind).
+    """
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
 
 
 def all_steps(directory):
-    with CheckpointManager(directory) as mgr:
-        return mgr.all_steps()
+    """Step numbers under ``directory`` (read-only; [] if absent)."""
+    if not os.path.isdir(_abspath(directory)):
+        return []
+    return sorted(_ocp().utils.checkpoint_steps(_abspath(directory)))
 
 
 class CheckpointManager:

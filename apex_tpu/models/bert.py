@@ -48,6 +48,7 @@ from apex_tpu.transformer.tensor_parallel import (
 )
 from apex_tpu.transformer.tensor_parallel.layers import _tp_world, sharded_init
 from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.utils import divide
@@ -124,14 +125,35 @@ def bert_large_config(**overrides) -> BertConfig:
     return BertConfig(**overrides)
 
 
+def _per_rank_dropout_rng(module: nn.Module, rank_local: bool):
+    """Dropout key, folded with the tp rank when the tensor is RANK-LOCAL
+    (SP sequence shard, or tp-sharded attention heads) — ≙ Megatron's
+    model-parallel RNG stream, which seeds dropout differently per tp rank
+    inside sharded regions.  For REPLICATED tensors the key must stay
+    identical across ranks (folding would desynchronize the replicated
+    activations), so ``rank_local=False`` returns the shared key.
+    """
+    rng = module.make_rng("dropout")
+    if rank_local and _tp_world(_TP) > 1:
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(_TP))
+    return rng
+
+
 class _LayerNorm(nn.Module):
     size: int
     eps: float
+    # True when this LN runs inside the sequence-parallel region: its
+    # params are tp-replicated but see only an S/tp shard per rank, so
+    # their grads need the tp psum (allreduce_sequence_parallel_gradients)
+    sequence_parallel: bool = False
 
     @nn.compact
     def __call__(self, x):
         w = self.param("scale", nn.initializers.ones, (self.size,))
         b = self.param("bias", nn.initializers.zeros, (self.size,))
+        if self.sequence_parallel:
+            ps.register_sequence_parallel_param(self.path + ("scale",))
+            ps.register_sequence_parallel_param(self.path + ("bias",))
         return fused_layer_norm_affine(x, w, b, (self.size,), eps=self.eps)
 
 
@@ -167,7 +189,9 @@ class BertSelfAttention(nn.Module):
             jnp.transpose(qkv[:, :, :, i], (1, 2, 0, 3)) for i in range(3)
         )
         p = 0.0 if deterministic else cfg.attention_dropout
-        rng = self.make_rng("dropout") if p > 0.0 else None
+        # q/k/v are head-SHARDED over tp: each rank's heads need their own
+        # dropout mask, so the key is rank-local whenever tp > 1
+        rng = _per_rank_dropout_rng(self, True) if p > 0.0 else None
 
         def core(q, k, v, bias):
             return flash_attention(
@@ -223,16 +247,26 @@ class BertLayer(nn.Module):
             x, attention_bias, deterministic=deterministic
         )
         if not deterministic and cfg.hidden_dropout > 0.0:
-            attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=False)
-        x = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln_attn")(
-            x + attn
-        )
+            # under SP the activations are sequence shards (rank-local
+            # masks); otherwise they are replicated (shared mask required)
+            attn = nn.Dropout(cfg.hidden_dropout)(
+                attn, deterministic=False,
+                rng=_per_rank_dropout_rng(self, cfg.sequence_parallel),
+            )
+        x = _LayerNorm(
+            cfg.hidden_size, cfg.layer_norm_eps,
+            sequence_parallel=cfg.sequence_parallel, name="ln_attn",
+        )(x + attn)
         mlp = BertMlp(cfg, name="mlp")(x)
         if not deterministic and cfg.hidden_dropout > 0.0:
-            mlp = nn.Dropout(cfg.hidden_dropout)(mlp, deterministic=False)
-        return _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln_mlp")(
-            x + mlp
-        )
+            mlp = nn.Dropout(cfg.hidden_dropout)(
+                mlp, deterministic=False,
+                rng=_per_rank_dropout_rng(self, cfg.sequence_parallel),
+            )
+        return _LayerNorm(
+            cfg.hidden_size, cfg.layer_norm_eps,
+            sequence_parallel=cfg.sequence_parallel, name="ln_mlp",
+        )(x + mlp)
 
 
 class _BlockStep(nn.Module):
@@ -309,39 +343,60 @@ class BertEmbeddings(nn.Module):
     def __call__(self, input_ids, token_type_ids=None, *, deterministic=True):
         cfg = self.cfg
         s, b = input_ids.shape  # seq-first (S, B)
+        sp = cfg.sequence_parallel and _tp_world(_TP) > 1
+        # Megatron's SP embedding order: the vocab-parallel lookup
+        # reduce-SCATTERS its psum along the sequence dim, so the SP
+        # regime starts here and pos/type/LN run on the S/tp shard.  (A
+        # full-seq embedding block followed by a slice would be WRONG, not
+        # just slower: the slice's backward zeroes other shards' cotangent
+        # rows, so cross-(seq-shard, vocab-shard) embedding-gradient
+        # contributions would be silently dropped — each rank's lookup
+        # only covers its own vocab rows.)
         word = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size,
-            sequence_parallel_enabled=False,  # LN below needs full rows first
+            sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype, name="word_embeddings",
         )(input_ids)
+        local_s = word.shape[0]  # S/tp under SP, S otherwise
+        start = 0
+        if sp:
+            start = jax.lax.axis_index(_TP) * local_s
+            ps.register_sequence_parallel_param(
+                self.path + ("position_embeddings",)
+            )
         pos_tab = self.param(
             "position_embeddings",
             nn.initializers.normal(stddev=0.02),
             (cfg.max_position_embeddings, cfg.hidden_size),
         )
-        word = word + pos_tab[:s, None, :].astype(cfg.dtype)
+        rows = jax.lax.dynamic_slice_in_dim(pos_tab, start, local_s, 0)
+        word = word + rows[:, None, :].astype(cfg.dtype)
         if cfg.type_vocab_size:
             tt = (
                 jnp.zeros_like(input_ids)
                 if token_type_ids is None
                 else token_type_ids
             )
+            if sp:
+                tt = jax.lax.dynamic_slice_in_dim(tt, start, local_s, 0)
+                ps.register_sequence_parallel_param(
+                    self.path + ("token_type_embeddings",)
+                )
             type_tab = self.param(
                 "token_type_embeddings",
                 nn.initializers.normal(stddev=0.02),
                 (cfg.type_vocab_size, cfg.hidden_size),
             )
             word = word + jnp.take(type_tab, tt, axis=0).astype(cfg.dtype)
-        out = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln")(word)
+        out = _LayerNorm(
+            cfg.hidden_size, cfg.layer_norm_eps,
+            sequence_parallel=cfg.sequence_parallel, name="ln",
+        )(word)
         if not deterministic and cfg.hidden_dropout > 0.0:
-            out = nn.Dropout(cfg.hidden_dropout)(out, deterministic=False)
-        if cfg.sequence_parallel:
-            # enter the SP regime: shard the sequence dim across tp
-            world = _tp_world(_TP)
-            if world > 1:
-                rank = jax.lax.axis_index(_TP)
-                chunk = out.shape[0] // world
-                out = jax.lax.dynamic_slice_in_dim(out, rank * chunk, chunk, 0)
+            out = nn.Dropout(cfg.hidden_dropout)(
+                out, deterministic=False,
+                rng=_per_rank_dropout_rng(self, sp),
+            )
         return out
 
 
@@ -388,13 +443,47 @@ class BertForPreTraining(nn.Module):
             input_ids, token_type_ids, attention_mask,
             deterministic=deterministic,
         )
-        if cfg.sequence_parallel and _tp_world(_TP) > 1:
-            seq = gather_from_sequence_parallel_region(seq)
+        sp = cfg.sequence_parallel and _tp_world(_TP) > 1
+        # NSP pooler on [CLS] (position 0).  Under SP the pooler is
+        # REPLICATED computation on the gathered sequence, so its gather
+        # must split (not reduce-scatter) the cotangent — the Megatron
+        # ``tensor_parallel_output_grad=False`` case; a reduce-scatter
+        # here would feed the encoder tp× the NSP gradient.
+        seq_full = (
+            gather_from_sequence_parallel_region(
+                seq, tensor_parallel_output_grad=False
+            )
+            if sp
+            else seq
+        )
+        pooled = jnp.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(
+                seq_full[0]
+            )
+        )
+        nsp_logits = nn.Dense(2, dtype=cfg.dtype, name="nsp_head")(pooled)
         # MLM transform: dense + GELU + LN (the BERT "cls/predictions"
-        # transform), kept replicated (H→H is small).
+        # transform).  Runs in the SP (sequence-sharded) layout — per-token
+        # math, so each rank transforms only its S/tp shard (Megatron's
+        # order) — then gathers for the vocab-sharded decoder matmul.  The
+        # gather's reduce-scatter backward sums the decoder's vocab-partial
+        # cotangents into the true per-shard cotangent; the transform's
+        # params sit between gather and matmul in the partial-cotangent
+        # region, hence the sequence-parallel grad marking.
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(seq)
         h = jax.nn.gelu(h, approximate=True)
-        h = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="mlm_ln")(h)
+        h = _LayerNorm(
+            cfg.hidden_size, cfg.layer_norm_eps,
+            sequence_parallel=sp, name="mlm_ln",
+        )(h)
+        if sp:
+            ps.register_sequence_parallel_param(
+                self.path + ("mlm_dense", "kernel")
+            )
+            ps.register_sequence_parallel_param(
+                self.path + ("mlm_dense", "bias")
+            )
+            h = gather_from_sequence_parallel_region(h)
         # vocab-sharded decoder bias (the tied decoder weight is read from
         # the embedding table in bert_pretrain_loss)
         per = divide(cfg.vocab_size, _tp_world(_TP))
@@ -403,11 +492,6 @@ class BertForPreTraining(nn.Module):
             sharded_init(nn.initializers.zeros, (cfg.vocab_size,), 0),
             (per,),
         )
-        # NSP pooler on [CLS] (position 0)
-        pooled = jnp.tanh(
-            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(seq[0])
-        )
-        nsp_logits = nn.Dense(2, dtype=cfg.dtype, name="nsp_head")(pooled)
         return (h, mlm_bias), nsp_logits
 
 
@@ -445,6 +529,16 @@ def bert_pretrain_loss(
     )
     embed = params["params"]["bert"]["embeddings"]["word_embeddings"]["weight"]
     labels = batch["mlm_labels"]
+    if not model.cfg.sequence_parallel and ps.axis_is_bound(_TP):
+        # ≙ Megatron's copy_to_tensor_model_parallel_region before the
+        # vocab-sharded logits matmul: identity forward, psum backward.
+        # The decoder cotangent d h = d logits_r @ W_r is PARTIAL per tp
+        # rank (each rank's vocab shard); without this psum every param
+        # between the loss and the next collective boundary (mlm
+        # transform, final layer norms, last-layer weights) silently gets
+        # partial/mixed gradients at tp > 1.  (Under SP the MLM gather's
+        # reduce-scatter backward performs this sum instead.)
+        h = copy_to_tensor_model_parallel_region(h)
     with jax.named_scope("mlm_logits_xent"):
         dec = jnp.transpose(embed).astype(model.cfg.dtype)
 

@@ -27,6 +27,7 @@ from apex_tpu.transformer.tensor_parallel import (
 )
 from apex_tpu.transformer.tensor_parallel.layers import _tp_world
 from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.utils import divide
@@ -81,7 +82,10 @@ class GptBlock(nn.Module):
         heads_local = divide(cfg.num_heads, world)
         head_dim = divide(h, cfg.num_heads)
 
-        y = _LayerNorm(h, cfg.layer_norm_eps, name="ln_attn")(x)
+        y = _LayerNorm(
+            h, cfg.layer_norm_eps,
+            sequence_parallel=cfg.sequence_parallel, name="ln_attn",
+        )(x)
         qkv = ColumnParallelLinear(
             h, 3 * h, gather_output=False,
             sequence_parallel_enabled=cfg.sequence_parallel,
@@ -107,7 +111,10 @@ class GptBlock(nn.Module):
         )(ctx)
         x = x + attn
 
-        y = _LayerNorm(h, cfg.layer_norm_eps, name="ln_mlp")(x)
+        y = _LayerNorm(
+            h, cfg.layer_norm_eps,
+            sequence_parallel=cfg.sequence_parallel, name="ln_mlp",
+        )(x)
         if cfg.num_experts:
             from apex_tpu.transformer.moe import MoeConfig, SwitchMoe
 
@@ -126,6 +133,7 @@ class GptBlock(nn.Module):
                     top_k=cfg.moe_top_k,
                     capacity_factor=cfg.moe_capacity_factor,
                     dtype=cfg.dtype,
+                    sequence_parallel=cfg.sequence_parallel,
                 ),
                 name="moe",
             )(y)
@@ -175,7 +183,16 @@ class GptModel(nn.Module):
                 nn.initializers.normal(stddev=0.02),
                 (cfg.max_seq_len, cfg.hidden_size),
             )
-            x = x + pos[: x.shape[0], None, :].astype(cfg.dtype)
+            start = 0
+            if cfg.sequence_parallel and _tp_world(_TP) > 1:
+                # x is the SP seq shard [rank·S/tp, (rank+1)·S/tp): slice
+                # the matching positions, and mark the table tp-partial
+                start = jax.lax.axis_index(_TP) * x.shape[0]
+                ps.register_sequence_parallel_param(
+                    self.path + ("position_embeddings",)
+                )
+            rows = jax.lax.dynamic_slice_in_dim(pos, start, x.shape[0], 0)
+            x = x + rows[:, None, :].astype(cfg.dtype)
         step = _GptStep
         if cfg.remat:
             step = nn.remat(step, prevent_cse=False)
@@ -187,7 +204,10 @@ class GptModel(nn.Module):
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
         x, _ = scanned(cfg, deterministic, name="layers")(x)
-        x = _LayerNorm(cfg.hidden_size, cfg.layer_norm_eps, name="ln_f")(x)
+        x = _LayerNorm(
+            cfg.hidden_size, cfg.layer_norm_eps,
+            sequence_parallel=cfg.sequence_parallel, name="ln_f",
+        )(x)
         if cfg.sequence_parallel and _tp_world(_TP) > 1:
             x = gather_from_sequence_parallel_region(x)
         return x
@@ -219,6 +239,14 @@ def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
             )
     else:
         h = model.apply(params, input_ids, deterministic=deterministic)
+    if not model.cfg.sequence_parallel and ps.axis_is_bound(_TP):
+        # ≙ Megatron's copy_to_tensor_model_parallel_region before the
+        # vocab-sharded logits matmul: identity fwd, psum bwd.  The
+        # decoder cotangent is partial per tp rank; without this psum,
+        # ln_f and the last layer's params get partial/mixed grads at
+        # tp > 1.  (Under SP the model-end gather's reduce-scatter
+        # backward performs the sum instead.)
+        h = copy_to_tensor_model_parallel_region(h)
     embed = params["params"]["word_embeddings"]["weight"]
     logits = jnp.matmul(
         h.astype(model.cfg.dtype),

@@ -70,6 +70,9 @@ __all__ = [
     "get_virtual_pipeline_model_parallel_world_size",
     "set_virtual_pipeline_model_parallel_world_size",
     "destroy_model_parallel",
+    "register_sequence_parallel_param",
+    "sequence_parallel_param_paths",
+    "clear_sequence_parallel_params",
     "divide",
     "bound_axis_size",
     "axis_is_bound",
@@ -434,6 +437,39 @@ def destroy_model_parallel() -> None:
     """≙ parallel_state.py :: destroy_model_parallel."""
     global _STATE
     _STATE = None
+    clear_sequence_parallel_params()
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel partial-gradient param registry.
+#
+# ≙ Megatron's ``param.sequence_parallel = True`` attribute marking: under
+# Megatron-style SP, params used inside the sequence-sharded region (layer
+# norms, RowParallelLinear biases, MoE router/experts, position embeddings)
+# are REPLICATED across tp but each rank computes their gradient from only
+# its S/tp sequence shard — the true gradient is the SUM over tp ranks.
+# Torch marks the parameter object; params here are plain arrays, so
+# modules register the param's tree path at trace time instead, and
+# ``allreduce_sequence_parallel_gradients`` (tensor_parallel.mappings)
+# psums exactly the registered paths.
+# ---------------------------------------------------------------------------
+
+_SEQUENCE_PARALLEL_PARAM_PATHS: set = set()
+
+
+def register_sequence_parallel_param(path) -> None:
+    """Mark the param at ``path`` (module path + param name, a tuple of
+    strings, excluding the "params" collection key) as having tp-partial
+    gradients under sequence parallelism."""
+    _SEQUENCE_PARALLEL_PARAM_PATHS.add(tuple(str(p) for p in path))
+
+
+def sequence_parallel_param_paths() -> frozenset:
+    return frozenset(_SEQUENCE_PARALLEL_PARAM_PATHS)
+
+
+def clear_sequence_parallel_params() -> None:
+    _SEQUENCE_PARALLEL_PARAM_PATHS.clear()
 
 
 # ---------------------------------------------------------------------------

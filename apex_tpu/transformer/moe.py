@@ -59,6 +59,11 @@ class MoeConfig:
     # mesh axis the experts shard over; None = unsharded (single program).
     # "dp" is the Megatron convention (expert group ⊂ data-parallel world).
     expert_axis: Optional[str] = ps.DATA_PARALLEL_AXIS
+    # True when this block runs inside the sequence-parallel region at
+    # tp > 1 (each tp rank routes only its S/tp tokens): router + expert
+    # params then carry tp-PARTIAL gradients and are registered for
+    # allreduce_sequence_parallel_gradients' tp psum.
+    sequence_parallel: bool = False
 
     def __post_init__(self):
         if self.top_k not in (1, 2):
@@ -69,7 +74,7 @@ def _axis_size(axis: Optional[str]) -> int:
     return 1 if axis is None else ps.bound_axis_size(axis)
 
 
-def moe_dispatch_combine(router_probs, top_k, capacity):
+def moe_dispatch_combine(router_probs, top_k, capacity, stats_axis=None):
     """Dispatch/combine tensors from router probabilities.
 
     router_probs f32 (T, E) (already softmaxed).  Returns
@@ -78,6 +83,12 @@ def moe_dispatch_combine(router_probs, top_k, capacity):
     (earlier tokens win capacity — the Switch rule), ``aux`` is the
     load-balancing loss term  E · Σ_e f_e · P_e  (fraction routed ×
     mean prob).
+
+    ``stats_axis``: mesh axis to pmean the aux statistics (f_e, P_e) over
+    before forming the product.  Used under sequence parallelism (each tp
+    rank routes an S/tp token shard): aux is quadratic in the stats, so
+    the mean of per-shard aux ≠ the global-batch aux — pmean'ing the
+    stats first recovers exactly the unsharded value.
     """
     t, e = router_probs.shape
     # top-k expert choices per token
@@ -87,7 +98,20 @@ def moe_dispatch_combine(router_probs, top_k, capacity):
     # aux loss uses the top-1 assignment fraction (Switch definition)
     frac_routed = jnp.mean(onehot[:, 0, :], axis=0)  # (E,)
     mean_prob = jnp.mean(router_probs, axis=0)  # (E,)
-    aux = e * jnp.sum(frac_routed * mean_prob)
+    if stats_axis is not None:
+        frac_routed = jax.lax.pmean(frac_routed, stats_axis)
+        mean_prob = jax.lax.pmean(mean_prob, stats_axis)
+        aux = e * jnp.sum(frac_routed * mean_prob)
+        # Per-rank gradient bookkeeping: pmean's VJP psums the cotangent
+        # across ranks, so each rank's aux backward already carries the
+        # FULL E·f̄ factor on its local-path derivative — tp× too much
+        # once the sequence-parallel grad sync psums the partials.  Scale
+        # the aux GRADIENT by 1/n (value unchanged) so that psum-of-
+        # partials equals the global-batch aux gradient exactly.
+        n = jax.lax.axis_size(stats_axis)
+        aux = aux / n + jax.lax.stop_gradient(aux * (1.0 - 1.0 / n))
+    else:
+        aux = e * jnp.sum(frac_routed * mean_prob)
 
     dispatch = jnp.zeros((t, e, capacity), jnp.float32)
     combine = jnp.zeros((t, e, capacity), jnp.float32)
@@ -117,7 +141,8 @@ def moe_dispatch_combine(router_probs, top_k, capacity):
 
 
 def sync_moe_gradients(grads, axis: str = ps.EXPERT_PARALLEL_AXIS,
-                       average: bool = True):
+                       average: bool = True,
+                       sequence_parallel_axis: Optional[str] = None):
     """Data-parallel gradient sync that understands expert sharding.
 
     A plain ``psum``/``pmean`` over dp (apex_tpu.parallel's DDP) is WRONG
@@ -135,6 +160,13 @@ def sync_moe_gradients(grads, axis: str = ps.EXPERT_PARALLEL_AXIS,
     leaves — DDP's gradient_average semantics) expert leaves are scaled
     by ``1/axis_size`` to match; for the sum objective (``average=False``,
     psum) they are left as the sum they already are.
+
+    With tensor parallelism AND ``sequence_parallel`` (each tp rank routes
+    only its S/tp tokens — set ``MoeConfig.sequence_parallel=True``), pass
+    ``sequence_parallel_axis="tp"``: router/expert/LN grads are then also
+    psum'd over tp via :func:`allreduce_sequence_parallel_gradients`
+    (they are tp-replicated params with tp-partial gradients; without the
+    reduction the replicated copies silently diverge).
     """
     from jax.tree_util import DictKey, tree_map_with_path
 
@@ -147,7 +179,16 @@ def sync_moe_gradients(grads, axis: str = ps.EXPERT_PARALLEL_AXIS,
                 return g / world if average else g
         return reduce_(g, axis)
 
-    return tree_map_with_path(maybe_reduce, grads)
+    grads = tree_map_with_path(maybe_reduce, grads)
+    if sequence_parallel_axis is not None:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            allreduce_sequence_parallel_gradients,
+        )
+
+        grads = allreduce_sequence_parallel_gradients(
+            grads, sequence_parallel_axis
+        )
+    return grads
 
 
 class SwitchMoe(nn.Module):
@@ -190,8 +231,13 @@ class SwitchMoe(nn.Module):
         )
         logits = xt.astype(jnp.float32) @ router_w
         probs = jax.nn.softmax(logits, axis=-1)
+        stats_axis = None
+        if cfg.sequence_parallel and ps.axis_is_bound(
+            ps.TENSOR_PARALLEL_AXIS
+        ):
+            stats_axis = ps.TENSOR_PARALLEL_AXIS
         dispatch, combine, aux = moe_dispatch_combine(
-            probs, cfg.top_k, capacity
+            probs, cfg.top_k, capacity, stats_axis=stats_axis
         )
 
         # --- expert weights: LOCAL shard, ep-degree-invariant init ----
@@ -216,6 +262,11 @@ class SwitchMoe(nn.Module):
         w2 = self.param(
             "expert_w2", expert_init(cfg.ffn_hidden_size, h)
         ).astype(cfg.dtype)
+        if cfg.sequence_parallel:
+            # under SP each tp rank routes a different S/tp token shard, so
+            # router/expert grads are tp-partial (sum over tp = true grad)
+            for name in ("router", "expert_w1", "expert_w2"):
+                ps.register_sequence_parallel_param(self.path + (name,))
 
         # --- dispatch -> experts -> combine ---------------------------
         ex = jnp.einsum(

@@ -17,6 +17,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     _reduce_scatter_along_first_dim,
     _split_along_first_dim,
     _split_along_last_dim,
+    allreduce_sequence_parallel_gradients,
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
     gather_from_tensor_model_parallel_region,

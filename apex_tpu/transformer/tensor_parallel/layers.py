@@ -275,6 +275,10 @@ class RowParallelLinear(nn.Module):
             if self.use_bias
             else None
         )
+        if bias is not None and self.sequence_parallel_enabled:
+            # bias is added AFTER the reduce-scatter, i.e. inside the SP
+            # region: tp-replicated param, per-rank S/tp-partial gradient
+            ps.register_sequence_parallel_param(self.path + ("bias",))
         if world > 1 and not self.input_is_parallel:
             x = scatter_to_tensor_model_parallel_region(x, self.axis_name)
         cdt = self.dtype or x.dtype

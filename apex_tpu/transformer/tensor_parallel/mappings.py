@@ -46,6 +46,7 @@ __all__ = [
     "scatter_to_sequence_parallel_region",
     "gather_from_sequence_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "allreduce_sequence_parallel_gradients",
 ]
 
 _TP = ps.TENSOR_PARALLEL_AXIS
@@ -134,13 +135,93 @@ scatter_to_sequence_parallel_region = _make_vjp(
     _gather_along_first_dim,
     "scatter_to_sequence_parallel_region",
 )
-gather_from_sequence_parallel_region = _make_vjp(
+_gather_from_sequence_parallel_region_rs_grad = _make_vjp(
     _gather_along_first_dim,
     _reduce_scatter_along_first_dim,
     "gather_from_sequence_parallel_region",
 )
+_gather_from_sequence_parallel_region_split_grad = _make_vjp(
+    _gather_along_first_dim,
+    _split_along_first_dim,
+    "gather_from_sequence_parallel_region_split_grad",
+)
+
+
+def gather_from_sequence_parallel_region(
+    x, axis_name=_TP, tensor_parallel_output_grad: bool = True
+):
+    """All-gather along the sequence dim (≙ the reference's
+    ``gather_from_sequence_parallel_region(input_,
+    tensor_parallel_output_grad=...)``).
+
+    ``tensor_parallel_output_grad`` selects the backward per how the
+    gathered output is consumed:
+
+    - True (default): the output feeds tensor-parallel computation whose
+      cotangents are PARTIAL per tp rank (e.g. a vocab-sharded logits
+      matmul) — backward reduce-scatters, summing the partials into the
+      true per-shard cotangent.
+    - False: the output feeds REPLICATED computation (every rank computes
+      the same full-sequence values, e.g. a replicated pooler/head) — the
+      cotangent is already the full gradient on every rank, so backward
+      just splits out this rank's slice; a reduce-scatter would
+      double-count it tp times.
+    """
+    if tensor_parallel_output_grad:
+        return _gather_from_sequence_parallel_region_rs_grad(x, axis_name)
+    return _gather_from_sequence_parallel_region_split_grad(x, axis_name)
 reduce_scatter_to_sequence_parallel_region = _make_vjp(
     _reduce_scatter_along_first_dim,
     _gather_along_first_dim,
     "reduce_scatter_to_sequence_parallel_region",
 )
+
+
+def allreduce_sequence_parallel_gradients(
+    grads, axis_name: str = ps.TENSOR_PARALLEL_AXIS
+):
+    """psum over tp the gradients of params marked sequence-parallel.
+
+    ≙ Megatron-LM's trainer-side ``allreduce_sequence_parallel_gradients``
+    (the reference library leaves this step to its caller; here it ships).
+    Under Megatron SP the params used inside the sequence-sharded region —
+    layer norms, RowParallelLinear biases, MoE router/experts, position
+    embeddings — are replicated across tp, but each rank's backward only
+    covers its S/tp sequence shard, so the true gradient is the SUM over
+    the tp axis.  Modules register those params' paths at trace time
+    (``parallel_state.register_sequence_parallel_param``); every other
+    leaf (tp-sharded weights, params outside the SP region) passes through
+    untouched.
+
+    Call inside shard_map, after backward and alongside the dp grad sync,
+    whenever the model ran with ``sequence_parallel=True`` at tp > 1.
+
+    Registry lifecycle contract: the path registry is process-global,
+    populated when the SP model is traced (init or first apply) and
+    cleared by ``parallel_state.destroy_model_parallel()``.  Two rules
+    follow: (1) trace the model before (or in the same jit as) the first
+    call of this helper — an empty registry makes it a silent no-op;
+    within one traced train step the loss forward always traces first, so
+    the normal pattern is safe; (2) when switching to a DIFFERENT model
+    in the same process, destroy/re-initialize the mesh first — stale
+    registered paths that collide with the new model's param tree would
+    psum gradients that are already complete.
+    """
+    marked = ps.sequence_parallel_param_paths()
+    if not marked:
+        return grads
+
+    def maybe_psum(path, g):
+        keys = tuple(
+            str(getattr(k, "key", k))
+            for k in path
+            if hasattr(k, "key") or isinstance(k, str)
+        )
+        if keys and keys[0] == "params":
+            keys = keys[1:]
+        if keys in marked:
+            return jax.lax.psum(g, axis_name)
+        return g
+
+    with jax.named_scope("sp_grad_allreduce"):
+        return jax.tree_util.tree_map_with_path(maybe_psum, grads)
