@@ -25,11 +25,13 @@ __all__ = [
     "flatten_f32",
     "unflatten_f32",
     "mlm_mask_batch",
+    "gather_rows",
 ]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "host_ops.cpp")
 _LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False  # cache build failure: don't re-spawn g++ per call
 NATIVE_AVAILABLE = False
 
 
@@ -44,9 +46,11 @@ def _build_dir() -> str:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _LIB, NATIVE_AVAILABLE
+    global _LIB, _LOAD_FAILED, NATIVE_AVAILABLE
     if _LIB is not None:
         return _LIB
+    if _LOAD_FAILED:
+        return None
     so = os.path.join(_build_dir(), "libapex_tpu_host.so")
     try:
         if (
@@ -64,6 +68,7 @@ def _load() -> Optional[ctypes.CDLL]:
             )
         lib = ctypes.CDLL(so)
     except (OSError, subprocess.SubprocessError):
+        _LOAD_FAILED = True  # the per-batch hot loops fall back instantly
         return None
 
     i64 = ctypes.c_int64
@@ -79,6 +84,9 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, i64, ctypes.c_uint64, ctypes.c_double,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_void_p, ctypes.c_void_p, i64,
+    ]
+    lib.apex_gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(i64), i64, i64, ctypes.c_void_p, i64,
     ]
     _LIB = lib
     NATIVE_AVAILABLE = True
@@ -132,6 +140,42 @@ def unflatten_f32(
     csizes = (ctypes.c_int64 * n)(*sizes)
     lib.apex_unflatten_f32(flat.ctypes.data, csizes, n, dsts, _nthreads())
     return outs
+
+
+def gather_rows(
+    base: np.ndarray, row_starts: np.ndarray, row_elems: int
+) -> np.ndarray:
+    """Assemble ``out[i] = base[row_starts[i] : row_starts[i]+row_elems]``
+    with a threaded native memcpy gather — the data-loader batch-assembly
+    hot loop (rows of a memory-mapped token file → one contiguous batch).
+
+    ``base``: 1-D array (typically ``np.memmap``); ``row_starts``: int64
+    ELEMENT offsets into ``base``.  Returns ``(len(row_starts), row_elems)``
+    in ``base.dtype``.
+    """
+    base = np.ascontiguousarray(base).ravel()
+    starts = np.ascontiguousarray(row_starts, dtype=np.int64)
+    if starts.size and (
+        starts.min() < 0 or starts.max() + row_elems > base.size
+    ):
+        raise IndexError(
+            f"row [{starts.min()}, {starts.max()} + {row_elems}) out of "
+            f"bounds for base of {base.size} elements"
+        )
+    out = np.empty((starts.size, row_elems), base.dtype)
+    lib = _load()
+    if lib is None:
+        for i, s in enumerate(starts):
+            out[i] = base[s : s + row_elems]
+        return out
+    item = base.dtype.itemsize
+    byte_offsets = (starts * item).astype(np.int64)
+    lib.apex_gather_rows(
+        base.ctypes.data,
+        byte_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        starts.size, row_elems * item, out.ctypes.data, _nthreads(),
+    )
+    return out
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:

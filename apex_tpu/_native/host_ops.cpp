@@ -62,6 +62,27 @@ void apex_unflatten_f32(const float* src, const int64_t* sizes, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// batch row gather (the data-loader hot loop: assemble a shuffled batch
+// from a memory-mapped token file into one contiguous host buffer)
+// ---------------------------------------------------------------------------
+
+void apex_gather_rows(const uint8_t* base, const int64_t* offsets,
+                      int64_t n_rows, int64_t row_bytes, uint8_t* dst,
+                      int64_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  for (int64_t t = 0; t < n_threads; ++t) {
+    workers.emplace_back([&, t]() {
+      for (int64_t i = t; i < n_rows; i += n_threads) {
+        std::memcpy(dst + i * row_bytes, base + offsets[i], row_bytes);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+// ---------------------------------------------------------------------------
 // masked-LM batch corruption (the BERT phase-1 input hot loop)
 // ---------------------------------------------------------------------------
 

@@ -1,0 +1,274 @@
+"""Input pipeline — memory-mapped token datasets, a sharded shuffling
+loader with native batch assembly, and a background device prefetcher.
+
+≙ the host-side input machinery the reference delegates to its examples
+and to DALI: ``examples/imagenet/main_amp.py :: data_prefetcher`` (CUDA
+side-stream prefetch overlapping H2D copies with compute) and the
+fixed-format record readers its MLPerf BERT recipes use.  On TPU the
+device side of a training job belongs to XLA; keeping the chip fed is
+ordinary host engineering, so the hot loops here are native C++
+(`apex_tpu._native`: threaded row gather, threaded MLM corruption) with
+numpy fallbacks, and the host→device overlap uses a background thread
+issuing ``jax.device_put`` ahead of consumption (the TPU analog of the
+prefetcher's side stream).
+
+Layout contract: a *token file* is a flat binary array of token ids
+(any integer dtype); samples are consecutive ``seq_len`` windows.  This
+is the standard packed-corpus format (GPT-style); record-structured data
+can be expressed as ``seq_len`` = record length.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu import _native
+
+__all__ = [
+    "TokenFileDataset",
+    "DataLoader",
+    "DevicePrefetcher",
+    "write_token_file",
+    "bert_mlm_batches",
+]
+
+
+def write_token_file(path, tokens: np.ndarray) -> None:
+    """Write a flat token array as a raw binary token file."""
+    np.ascontiguousarray(tokens).ravel().tofile(os.fspath(path))
+
+
+class TokenFileDataset:
+    """Memory-mapped view of a packed token file as fixed-length samples.
+
+    ``stride`` defaults to ``seq_len`` (disjoint windows); a smaller
+    stride yields overlapping windows (data augmentation for small
+    corpora).  The file is never read eagerly — samples are assembled by
+    the loader's native gather straight out of the page cache.
+    """
+
+    def __init__(self, path, seq_len: int, dtype=np.uint16,
+                 stride: Optional[int] = None):
+        if seq_len < 1:
+            raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        self.path = os.fspath(path)
+        self.seq_len = int(seq_len)
+        self.stride = self.seq_len if stride is None else int(stride)
+        if self.stride < 1:
+            raise ValueError(f"stride must be >= 1, got {stride}")
+        self.tokens = np.memmap(self.path, dtype=dtype, mode="r")
+        if self.tokens.size < self.seq_len:
+            raise ValueError(
+                f"{self.path}: {self.tokens.size} tokens < seq_len {seq_len}"
+            )
+        self.num_samples = (self.tokens.size - self.seq_len) // self.stride + 1
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if not 0 <= i < self.num_samples:
+            raise IndexError(i)
+        s = i * self.stride
+        return np.asarray(self.tokens[s : s + self.seq_len])
+
+    def sample_starts(self, indices: np.ndarray) -> np.ndarray:
+        return np.asarray(indices, np.int64) * self.stride
+
+
+class DataLoader:
+    """Sharded, shuffled, epoch-based batch loader with native assembly.
+
+    - ``shard=(rank, world)``: each rank sees a disjoint 1/world of every
+      epoch's shuffled order (the dp/host sharding contract; ≙ torch
+      DistributedSampler semantics the reference's examples rely on).
+    - Shuffle order is ``seed``- and epoch-deterministic across ranks, so
+      all ranks agree on the global permutation and slice it.
+    - ``drop_last=True`` keeps batch shapes static — the XLA requirement;
+      a partial trailing batch would trigger recompilation.
+    - Batches are gathered by the threaded native memcpy
+      (``_native.gather_rows``) into one contiguous ``(B, S)`` array.
+    """
+
+    def __init__(
+        self,
+        dataset: TokenFileDataset,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        shard: Tuple[int, int] = (0, 1),
+        drop_last: bool = True,
+    ):
+        rank, world = shard
+        if not 0 <= rank < world:
+            raise ValueError(f"shard rank {rank} not in [0, {world})")
+        if not drop_last:
+            raise NotImplementedError(
+                "drop_last=False would produce a ragged final batch; XLA "
+                "needs static shapes (pad at the dataset level instead)"
+            )
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.rank, self.world = rank, world
+        per_rank = len(dataset) // world
+        self.batches_per_epoch = per_rank // self.batch_size
+        if self.batches_per_epoch < 1:
+            raise ValueError(
+                f"dataset ({len(dataset)} samples / world {world}) too "
+                f"small for batch_size {batch_size}"
+            )
+
+    def epoch(self, epoch: int) -> Iterator[np.ndarray]:
+        """Yield this rank's ``(B, S)`` batches for one epoch."""
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch])
+            ).permutation(n)
+        else:
+            order = np.arange(n)
+        mine = order[self.rank :: self.world]
+        for b in range(self.batches_per_epoch):
+            idx = mine[b * self.batch_size : (b + 1) * self.batch_size]
+            starts = self.dataset.sample_starts(idx)
+            yield _native.gather_rows(
+                self.dataset.tokens, starts, self.dataset.seq_len
+            )
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Endless stream over epochs 0, 1, 2, ... (reshuffled each)."""
+        e = 0
+        while True:
+            yield from self.epoch(e)
+            e += 1
+
+
+class DevicePrefetcher:
+    """Background host→device prefetch (≙ ``data_prefetcher``'s CUDA
+    side-stream overlap in the reference's ImageNet example).
+
+    Wraps any iterator of (pytrees of) numpy arrays; a worker thread
+    stays ``depth`` batches ahead, issuing ``jax.device_put`` (optionally
+    with a ``device``/``Sharding``) so the transfer overlaps the step
+    running on-device.  Iterate it like the original loader; call
+    ``close()`` (or use as context manager) to stop the worker.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it, device=None, depth: int = 2):
+        import jax
+
+        self._jax = jax
+        self._device = device
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._src = iter(it)
+        self._worker = threading.Thread(target=self._fill, daemon=True)
+        self._worker.start()
+
+    def _fill(self):
+        try:
+            for batch in self._src:
+                if self._stop.is_set():
+                    return
+                if self._device is not None:
+                    batch = self._jax.device_put(batch, self._device)
+                else:
+                    batch = self._jax.device_put(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # surface worker errors to the consumer
+            self._q.put(e)
+            return
+        self._q.put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            # terminal: the worker exits after one sentinel — record the
+            # state so further next() calls don't block on an empty queue
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so a blocked put() can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._worker.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def bert_mlm_batches(
+    loader: DataLoader,
+    *,
+    seed: int = 0,
+    mask_prob: float = 0.15,
+    mask_id: int = 103,
+    vocab_size: int = 30522,
+    special_floor: int = 1000,
+    seq_first: bool = True,
+):
+    """Endless BERT phase-1 batches from a token loader.
+
+    Applies the native 80/10/10 MLM corruption (`_native.mlm_mask_batch`,
+    deterministic in (seed, step, position)) and emits the batch dict
+    ``bert_pretrain_loss`` consumes, seq-first by default.
+    """
+    step = 0
+    for tokens in loader:
+        ids = tokens.astype(np.int32)
+        masked, labels = _native.mlm_mask_batch(
+            ids,
+            (seed << 20) ^ step,
+            mask_prob=mask_prob,
+            mask_id=mask_id,
+            vocab_size=vocab_size,
+            special_floor=special_floor,
+        )
+        if seq_first:
+            masked, labels = masked.T, labels.T
+        b = tokens.shape[0]
+        yield {
+            "input_ids": masked,
+            "token_type_ids": np.zeros_like(masked),
+            "attention_mask": np.ones(
+                (b, masked.shape[0] if seq_first else masked.shape[1]),
+                np.int32,
+            ),
+            "mlm_labels": labels,
+            "nsp_labels": np.zeros((b,), np.int32),
+        }
+        step += 1

@@ -1,0 +1,178 @@
+"""Input pipeline: memmap dataset, sharded loader, native gather,
+device prefetcher, MLM batch stream (≙ the reference's data_prefetcher +
+input-side host loops, SURVEY §2.7 example row)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from apex_tpu import _native
+from apex_tpu.data import (
+    DataLoader,
+    DevicePrefetcher,
+    TokenFileDataset,
+    bert_mlm_batches,
+    write_token_file,
+)
+
+
+@pytest.fixture
+def token_file(tmp_path):
+    toks = np.arange(1000, 1000 + 4096, dtype=np.uint16)
+    p = tmp_path / "corpus.bin"
+    write_token_file(p, toks)
+    return p, toks
+
+
+class TestDataset:
+    def test_windows_and_len(self, token_file):
+        p, toks = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        assert len(ds) == 4096 // 128
+        np.testing.assert_array_equal(ds[0], toks[:128])
+        np.testing.assert_array_equal(ds[3], toks[3 * 128 : 4 * 128])
+        with pytest.raises(IndexError):
+            ds[len(ds)]
+
+    def test_overlapping_stride(self, token_file):
+        p, toks = token_file
+        ds = TokenFileDataset(p, seq_len=128, stride=64)
+        assert len(ds) == (4096 - 128) // 64 + 1
+        np.testing.assert_array_equal(ds[1], toks[64 : 64 + 128])
+
+    def test_too_small_raises(self, tmp_path):
+        p = tmp_path / "tiny.bin"
+        write_token_file(p, np.zeros(16, np.uint16))
+        with pytest.raises(ValueError):
+            TokenFileDataset(p, seq_len=128)
+
+    def test_zero_stride_raises(self, token_file):
+        p, _ = token_file
+        with pytest.raises(ValueError):
+            TokenFileDataset(p, seq_len=128, stride=0)
+
+
+class TestNativeGather:
+    def test_matches_python_indexing(self):
+        base = np.random.default_rng(0).integers(
+            0, 60000, size=10_000
+        ).astype(np.uint16)
+        starts = np.array([0, 128, 9872, 55, 4096], np.int64)
+        out = _native.gather_rows(base, starts, 128)
+        for i, s in enumerate(starts):
+            np.testing.assert_array_equal(out[i], base[s : s + 128])
+
+    def test_bounds_check(self):
+        base = np.zeros(100, np.uint16)
+        with pytest.raises(IndexError):
+            _native.gather_rows(base, np.array([90], np.int64), 64)
+        with pytest.raises(IndexError):
+            _native.gather_rows(base, np.array([-1], np.int64), 10)
+
+
+class TestLoader:
+    def test_sharding_partitions_epoch(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)  # 32 samples
+        seen = []
+        for rank in range(4):
+            dl = DataLoader(
+                ds, batch_size=2, seed=7, shard=(rank, 4)
+            )
+            assert dl.batches_per_epoch == 4
+            for batch in dl.epoch(0):
+                assert batch.shape == (2, 128)
+                seen.extend(batch[:, 0].tolist())
+        # every sample's first token is unique (windows are disjoint) —
+        # the 4 ranks together cover 32 distinct samples exactly once
+        assert len(seen) == 32 and len(set(seen)) == 32
+
+    def test_epoch_determinism_and_reshuffle(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        dl = DataLoader(ds, batch_size=4, seed=3)
+        a = np.concatenate(list(dl.epoch(0)))
+        b = np.concatenate(list(dl.epoch(0)))
+        c = np.concatenate(list(dl.epoch(1)))
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_endless_iter_crosses_epochs(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        dl = DataLoader(ds, batch_size=4, shard=(0, 1))
+        it = iter(dl)
+        batches = [next(it) for _ in range(dl.batches_per_epoch + 2)]
+        assert all(b.shape == (4, 128) for b in batches)
+
+    def test_bad_shard_and_small_dataset(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        with pytest.raises(ValueError):
+            DataLoader(ds, batch_size=2, shard=(4, 4))
+        with pytest.raises(ValueError):
+            DataLoader(ds, batch_size=64)  # 32 samples < one batch
+        with pytest.raises(NotImplementedError):
+            DataLoader(ds, batch_size=2, drop_last=False)
+
+
+class TestPrefetcher:
+    def test_yields_device_arrays_in_order(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        dl = DataLoader(ds, batch_size=4, shuffle=False)
+        direct = list(dl.epoch(0))
+        with DevicePrefetcher(dl.epoch(0), depth=3) as pf:
+            fetched = list(pf)
+        assert len(fetched) == len(direct)
+        for d, f in zip(direct, fetched):
+            assert isinstance(f, jax.Array)
+            np.testing.assert_array_equal(d, np.asarray(f))
+
+    def test_propagates_worker_error(self):
+        def bad():
+            yield np.zeros((2, 2))
+            raise RuntimeError("boom")
+
+        with DevicePrefetcher(bad(), depth=1) as pf:
+            next(pf)
+            with pytest.raises(RuntimeError, match="boom"):
+                while True:
+                    next(pf)
+
+    def test_close_stops_worker(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        pf = DevicePrefetcher(iter(DataLoader(ds, batch_size=2)), depth=1)
+        next(pf)
+        pf.close()
+        assert not pf._worker.is_alive()
+
+    def test_pytree_batches(self):
+        batches = [{"a": np.ones((2,)), "b": np.zeros((3,))}] * 3
+        with DevicePrefetcher(iter(batches)) as pf:
+            out = list(pf)
+        assert len(out) == 3 and isinstance(out[0]["a"], jax.Array)
+
+
+class TestMlmBatches:
+    def test_stream_shapes_and_corruption(self, token_file):
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        dl = DataLoader(ds, batch_size=4, seed=1)
+        it = bert_mlm_batches(
+            dl, seed=5, vocab_size=6000, mask_id=103, special_floor=1000
+        )
+        b = next(it)
+        assert b["input_ids"].shape == (128, 4)  # seq-first
+        assert b["mlm_labels"].shape == (128, 4)
+        assert b["attention_mask"].shape == (4, 128)
+        sel = b["mlm_labels"] >= 0
+        assert 0.05 < sel.mean() < 0.30  # ~15% selected
+        # at selected positions the label holds the ORIGINAL token
+        masked_frac = (b["input_ids"][sel] == 103).mean()
+        assert 0.6 < masked_frac < 0.95  # ~80% of selected -> [MASK]
+        # consecutive steps draw different masks
+        b2 = next(it)
+        assert not np.array_equal(b["mlm_labels"], b2["mlm_labels"])
