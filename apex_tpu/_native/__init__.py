@@ -22,6 +22,7 @@ import numpy as np
 
 __all__ = [
     "NATIVE_AVAILABLE",
+    "available",
     "flatten_f32",
     "unflatten_f32",
     "mlm_mask_batch",
@@ -91,6 +92,13 @@ def _load() -> Optional[ctypes.CDLL]:
     _LIB = lib
     NATIVE_AVAILABLE = True
     return lib
+
+
+def available() -> bool:
+    """Whether the native library is (or can be) loaded — triggers the
+    lazy build.  Prefer this over reading ``NATIVE_AVAILABLE`` at import
+    time, which snapshots the pre-build value."""
+    return _load() is not None
 
 
 def _nthreads() -> int:

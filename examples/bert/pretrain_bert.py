@@ -1,12 +1,15 @@
 """BERT-Large phase-1 pretraining — the north-star recipe (BASELINE #3).
 
-End-to-end: native-C++ masked-LM input pipeline
-(:func:`apex_tpu._native.mlm_mask_batch`), BERT-Large from
+End-to-end over the full framework stack: packed-corpus input pipeline
+(:mod:`apex_tpu.data`: memmap dataset → sharded loader → native-C++ MLM
+corruption → background device prefetch), BERT-Large from
 :mod:`apex_tpu.models`, FusedLAMB, bf16 compute with f32 params, data
-parallelism over the mesh, K steps per jitted scan chunk (minimal host
-round-trips).
+parallelism over the mesh with K steps per jitted scan chunk, and
+orbax-backed checkpoint/resume (:mod:`apex_tpu.checkpoint`).
 
     python examples/bert/pretrain_bert.py --steps 24 --batch 32
+    # resume from the newest checkpoint:
+    python examples/bert/pretrain_bert.py --ckpt-dir /tmp/ckpt --resume
     # tiny smoke on CPU:
     APEX_TPU_FORCE_CPU=1 python examples/bert/pretrain_bert.py --tiny
 """
@@ -19,6 +22,7 @@ sys.path.insert(
 )
 
 import argparse
+import tempfile
 import time
 
 if os.environ.get("APEX_TPU_FORCE_CPU"):
@@ -31,8 +35,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu import checkpoint as ckpt
 from apex_tpu import parallel_state as ps
-from apex_tpu._native import NATIVE_AVAILABLE, mlm_mask_batch
+from apex_tpu import _native
+from apex_tpu.data import (
+    DataLoader,
+    DevicePrefetcher,
+    TokenFileDataset,
+    bert_mlm_batches,
+    write_token_file,
+)
 from apex_tpu.models import BertConfig, BertForPreTraining, bert_pretrain_loss
 from apex_tpu.optimizers import fused_lamb
 from apex_tpu.parallel import all_reduce_gradients
@@ -45,27 +57,61 @@ def parse_args():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--chunk", type=int, default=4, help="steps per jit call")
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument(
+        "--data", default=None,
+        help="packed token file (uint16); default: synthesize a corpus",
+    )
+    p.add_argument("--ckpt-dir", default=None, help="checkpoint directory")
+    p.add_argument(
+        "--save-every", type=int, default=8, help="checkpoint every N steps"
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from the newest checkpoint in --ckpt-dir",
+    )
     p.add_argument("--tiny", action="store_true", help="toy config smoke run")
     return p.parse_args()
 
 
-def make_batch(args, cfg, seed):
-    """Host input pipeline: synthetic corpus + native MLM corruption."""
-    rng = np.random.RandomState(seed)
-    ids = rng.randint(1000, cfg.vocab_size, (args.seq_len, args.batch)).astype(
-        np.int32
+def corpus_path(args, cfg) -> str:
+    """--data, or a synthetic zipf corpus written once to a temp file —
+    either way the batches flow through the real memmap pipeline."""
+    if args.data:
+        return args.data
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"apex_tpu_synth_corpus_v{cfg.vocab_size}.bin",
     )
-    masked, labels = mlm_mask_batch(
-        ids, seed, mask_prob=0.15, mask_id=103, vocab_size=cfg.vocab_size,
-        special_floor=1000,
+    if not os.path.exists(path):
+        rng = np.random.default_rng(0)
+        toks = 1000 + (rng.zipf(1.3, size=2_000_000) % (cfg.vocab_size - 1000))
+        # atomic publish: an interrupted/concurrent writer must never
+        # leave a truncated file at the cached path
+        tmp = f"{path}.{os.getpid()}.tmp"
+        write_token_file(tmp, toks.astype(np.uint16))
+        os.replace(tmp, path)
+    return path
+
+
+def batch_stream(args, cfg, start_step=0):
+    """chunk-stacked batch dicts: each leaf (chunk, ...) for lax.scan.
+
+    ``start_step`` fast-forwards the deterministic stream so a resumed
+    run continues on the batches an uninterrupted run would have seen —
+    restoring params without advancing the data would silently retrain
+    on already-consumed batches.
+    """
+    ds = TokenFileDataset(corpus_path(args, cfg), seq_len=args.seq_len)
+    loader = DataLoader(ds, batch_size=args.batch, seed=1234)
+    stream = bert_mlm_batches(
+        loader, seed=42, mask_prob=0.15, mask_id=103,
+        vocab_size=cfg.vocab_size, special_floor=1000,
     )
-    return {
-        "input_ids": jnp.asarray(masked),
-        "token_type_ids": jnp.zeros((args.seq_len, args.batch), jnp.int32),
-        "attention_mask": jnp.ones((args.batch, args.seq_len), jnp.int32),
-        "mlm_labels": jnp.asarray(labels),
-        "nsp_labels": jnp.asarray(rng.randint(0, 2, (args.batch,))),
-    }
+    for _ in range(start_step):
+        next(stream)
+    while True:
+        chunk = [next(stream) for _ in range(args.chunk)]
+        yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *chunk)
 
 
 def main():
@@ -86,13 +132,34 @@ def main():
 
     model = BertForPreTraining(cfg)
     tx = fused_lamb(learning_rate=args.lr, weight_decay=0.01)
-    batch0 = make_batch(args, cfg, 0)
-    params = model.init(jax.random.PRNGKey(0), batch0["input_ids"])
+    ids0 = jnp.zeros((args.seq_len, args.batch), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0)
     opt_state = tx.init(params)
+    start_step = 0
+    if (
+        args.resume
+        and args.ckpt_dir
+        and ckpt.latest_step(args.ckpt_dir) is not None
+    ):
+        # restore replicated over the mesh (a concrete-array template
+        # would re-commit every leaf to device 0 and clash with shard_map)
+        rep = jax.sharding.NamedSharding(mesh, P())
+        tmpl = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(x), np.asarray(x).dtype, sharding=rep
+            ),
+            ckpt.snapshot_training_state(params, opt_state, step=0),
+        )
+        with ckpt.CheckpointManager(args.ckpt_dir) as mgr:
+            restored = mgr.restore(template=tmpl)
+        params, opt_state, start_step, _, _ = ckpt.restore_training_state(
+            restored
+        )
+        print(f"resumed from step {start_step} ({args.ckpt_dir})")
     n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
     print(
         f"BERT {n_params/1e6:.0f}M params | dp={dp} | "
-        f"native input pipeline: {NATIVE_AVAILABLE}"
+        f"native input pipeline: {_native.available()}"
     )
 
     def one_step(params, opt_state, batch):
@@ -133,20 +200,51 @@ def main():
         donate_argnums=(0, 1),
     )
 
-    t0 = time.perf_counter()
-    for c in range(args.steps // args.chunk):
-        batches = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs),
-            *[make_batch(args, cfg, c * args.chunk + i) for i in range(args.chunk)],
-        )
-        params, opt_state, losses = step(params, opt_state, batches)
+    n_chunks = max(0, (args.steps - start_step) // args.chunk)
+    if n_chunks == 0:
         print(
-            f"chunk {c}: loss {' '.join(f'{float(l):.3f}' for l in losses)}"
+            f"nothing to do: resumed step {start_step} >= --steps "
+            f"{args.steps} (or < one --chunk remaining)"
         )
+    mgr = (
+        ckpt.CheckpointManager(
+            args.ckpt_dir, max_to_keep=2, save_interval_steps=args.save_every
+        )
+        if args.ckpt_dir
+        else None
+    )
+    t0 = time.perf_counter()
+    losses = jnp.zeros((1,))
+    with DevicePrefetcher(
+        batch_stream(args, cfg, start_step), depth=2
+    ) as prefetch:
+        for c in range(n_chunks):
+            batches = next(prefetch)
+            params, opt_state, losses = step(params, opt_state, batches)
+            print(
+                f"chunk {c}: loss "
+                f"{' '.join(f'{float(l):.3f}' for l in losses)}"
+            )
+            if mgr is not None:
+                done = start_step + (c + 1) * args.chunk
+                mgr.save(
+                    done,
+                    ckpt.snapshot_training_state(
+                        params, opt_state, step=done
+                    ),
+                )
     jax.block_until_ready(losses)
+    if mgr is not None:
+        mgr.wait_until_finished()
+        print(f"checkpoints at steps {mgr.all_steps()} in {args.ckpt_dir}")
+        mgr.close()
     dt = time.perf_counter() - t0
-    steps_done = (args.steps // args.chunk) * args.chunk
-    print(f"{steps_done} steps in {dt:.1f}s = {dt / steps_done * 1e3:.0f} ms/step")
+    steps_done = n_chunks * args.chunk
+    if steps_done:
+        print(
+            f"{steps_done} steps in {dt:.1f}s = "
+            f"{dt / steps_done * 1e3:.0f} ms/step"
+        )
 
 
 if __name__ == "__main__":
