@@ -32,9 +32,18 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
 )
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
-__all__ = ["GptConfig", "GptBlock", "GptModel", "gpt_lm_loss"]
+__all__ = ["GptConfig", "GptBlock", "GptModel", "gpt_lm_loss",
+           "gpt_lm_loss_cp"]
 
 _TP = ps.TENSOR_PARALLEL_AXIS
+_CP = ps.CONTEXT_PARALLEL_AXIS
+
+
+def _cp_world(cfg) -> int:
+    """Bound cp-axis size when context parallelism is configured, else 1."""
+    if cfg.context_parallel and ps.axis_is_bound(_CP):
+        return jax.lax.axis_size(_CP)
+    return 1
 
 
 def _rope_cos_sin(seq_len: int, dim: int, base: float = 10000.0):
@@ -58,6 +67,17 @@ class GptConfig:
     rotary: bool = True
     dtype: Any = jnp.bfloat16
     sequence_parallel: bool = False
+    # Context parallelism (long-context attention over the cp mesh axis,
+    # apex_tpu.transformer.context_parallel): None, "ring" (ppermute'd KV
+    # blocks, O(S_local) memory) or "ulysses" (head<->sequence
+    # all-to-all).  The model's sequence inputs are then the cp rank's
+    # contiguous S/cp shard; RoPE/positions index GLOBAL positions.
+    # Mutually exclusive with sequence_parallel (the sequence dim is
+    # already sharded).  Gradients: treat cp like a data axis — pmean
+    # over cp alongside dp (every param's grad covers only local tokens'
+    # paths); use gpt_lm_loss_cp for the shifted next-token loss across
+    # shard boundaries.
+    context_parallel: Optional[str] = None
     remat: bool = False
     # MoE: num_experts > 0 replaces the dense MLP with a SwitchMoe block
     # (experts sharded over the dp/ep axis, apex_tpu.transformer.moe); the
@@ -67,6 +87,18 @@ class GptConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+
+    def __post_init__(self):
+        if self.context_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel must be None, 'ring' or 'ulysses', got "
+                f"{self.context_parallel!r}"
+            )
+        if self.context_parallel and self.sequence_parallel:
+            raise ValueError(
+                "context_parallel and sequence_parallel are mutually "
+                "exclusive: both shard the sequence dimension"
+            )
 
 
 class GptBlock(nn.Module):
@@ -98,11 +130,31 @@ class GptBlock(nn.Module):
         q, k, v = (
             jnp.transpose(qkv[:, :, :, i], (1, 2, 0, 3)) for i in range(3)
         )
+        cp = _cp_world(cfg)
         if cfg.rotary:
-            cos, sin = _rope_cos_sin(s, head_dim)
+            # under cp, s is the LOCAL shard: RoPE must use the global
+            # positions [rank*s, (rank+1)*s)
+            cos, sin = _rope_cos_sin(s * cp, head_dim)
+            if cp > 1:
+                off = jax.lax.axis_index(_CP) * s
+                cos = jax.lax.dynamic_slice_in_dim(cos, off, s, 0)
+                sin = jax.lax.dynamic_slice_in_dim(sin, off, s, 0)
             q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
             k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
-        ctx = flash_attention(q, k, v, causal=True, scale=head_dim**-0.5)
+        if cp > 1:
+            from apex_tpu.transformer.context_parallel import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            cp_attend = (
+                ring_attention
+                if cfg.context_parallel == "ring"
+                else ulysses_attention
+            )
+            ctx = cp_attend(q, k, v, causal=True, scale=head_dim**-0.5)
+        else:
+            ctx = flash_attention(q, k, v, causal=True, scale=head_dim**-0.5)
         ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, heads_local * head_dim)
         attn = RowParallelLinear(
             h, h, input_is_parallel=True,
@@ -191,6 +243,19 @@ class GptModel(nn.Module):
                 ps.register_sequence_parallel_param(
                     self.path + ("position_embeddings",)
                 )
+            elif _cp_world(cfg) > 1:
+                # cp shard: global positions [rank·S_local, ...); grads
+                # need no marking — cp is synced like a data axis (pmean).
+                # The global length must fit the table: dynamic_slice
+                # CLAMPS out-of-range starts, which would silently reuse
+                # the last rows on high ranks instead of failing.
+                cp = _cp_world(cfg)
+                if cp * x.shape[0] > cfg.max_seq_len:
+                    raise ValueError(
+                        f"global sequence cp*S_local = {cp}*{x.shape[0]} "
+                        f"exceeds max_seq_len ({cfg.max_seq_len})"
+                    )
+                start = jax.lax.axis_index(_CP) * x.shape[0]
             rows = jax.lax.dynamic_slice_in_dim(pos, start, x.shape[0], 0)
             x = x + rows[:, None, :].astype(cfg.dtype)
         step = _GptStep
@@ -213,6 +278,25 @@ class GptModel(nn.Module):
         return x
 
 
+def _tied_vocab_logits(params, model: GptModel, h, *, sp_gathered: bool):
+    """Vocab-parallel logits through the tied embedding decoder.
+
+    ``sp_gathered``: True when ``h`` arrived through a sequence-dim
+    gather whose reduce-scatter backward already sums the vocab-partial
+    cotangent — otherwise the Megatron ``copy_to`` boundary (identity
+    fwd / psum bwd) is inserted here so upstream params get full grads
+    at tp > 1.
+    """
+    if not sp_gathered and ps.axis_is_bound(_TP):
+        h = copy_to_tensor_model_parallel_region(h)
+    embed = params["params"]["word_embeddings"]["weight"]
+    return jnp.matmul(
+        h.astype(model.cfg.dtype),
+        jnp.transpose(embed).astype(model.cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
     """Next-token CE with the decoder tied to the embedding (vocab-parallel
     logits — no gather, ≙ vocab_parallel_cross_entropy usage in Megatron).
@@ -220,6 +304,11 @@ def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
     With ``cfg.num_experts > 0`` the per-layer MoE aux losses (sown into
     the "losses" collection) are averaged and added with
     ``cfg.moe_aux_coef``."""
+    if _cp_world(model.cfg) > 1:
+        raise ValueError(
+            "the sequence is context-parallel sharded: use gpt_lm_loss_cp "
+            "(the next-token shift crosses cp shard boundaries)"
+        )
     aux_total = 0.0
     if model.cfg.num_experts:
         # Strip any "losses" collection that leaked into the variables
@@ -239,22 +328,64 @@ def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
             )
     else:
         h = model.apply(params, input_ids, deterministic=deterministic)
-    if not model.cfg.sequence_parallel and ps.axis_is_bound(_TP):
-        # ≙ Megatron's copy_to_tensor_model_parallel_region before the
-        # vocab-sharded logits matmul: identity fwd, psum bwd.  The
-        # decoder cotangent is partial per tp rank; without this psum,
-        # ln_f and the last layer's params get partial/mixed grads at
-        # tp > 1.  (Under SP the model-end gather's reduce-scatter
-        # backward performs the sum instead.)
-        h = copy_to_tensor_model_parallel_region(h)
-    embed = params["params"]["word_embeddings"]["weight"]
-    logits = jnp.matmul(
-        h.astype(model.cfg.dtype),
-        jnp.transpose(embed).astype(model.cfg.dtype),
-        preferred_element_type=jnp.float32,
+    logits = _tied_vocab_logits(
+        params, model, h, sp_gathered=model.cfg.sequence_parallel
     )
     # shift: predict token t+1 from position t
     losses = vocab_parallel_cross_entropy(
         logits[:-1].astype(jnp.float32), input_ids[1:]
     )
     return jnp.mean(losses) + aux_total
+
+
+def gpt_lm_loss_cp(
+    params,
+    model: GptModel,
+    input_ids_local,
+    *,
+    axis_name: str = _CP,
+    deterministic: bool = True,
+):
+    """Next-token CE for a context-parallel-sharded sequence.
+
+    ``input_ids_local``: ``(S_local, B)`` — this cp rank's CONTIGUOUS
+    shard of the global sequence (rank r holds rows [r·S_local, ...)).
+    The next-token shift crosses shard boundaries: each rank's last
+    position predicts the NEXT rank's first token (fetched with one
+    ``ppermute``); the global last position has no target and is masked
+    on the last rank.  Returns the global-token-mean loss, replicated
+    over cp (summed with psum, so it equals the unsharded
+    :func:`gpt_lm_loss` value).  Gradient sync: treat cp like a data
+    axis — ``pmean`` gradients over cp (alongside dp) before the
+    optimizer step.
+    """
+    if model.cfg.num_experts:
+        raise NotImplementedError(
+            "MoE + context parallelism is not wired yet (the router's aux "
+            "statistics would need the cp-mean treatment SP gets)"
+        )
+    h = model.apply(params, input_ids_local, deterministic=deterministic)
+    # no SP under cp, so the copy_to boundary always applies at tp > 1
+    logits = _tied_vocab_logits(params, model, h, sp_gathered=False)
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    # target for local position i is local token i+1; for the last local
+    # position it is the next rank's FIRST token (one ring hop backwards)
+    first_next = jax.lax.ppermute(
+        input_ids_local[:1],
+        axis_name,
+        [((i + 1) % world, i) for i in range(world)],
+    )
+    targets = jnp.concatenate([input_ids_local[1:], first_next], axis=0)
+    losses = vocab_parallel_cross_entropy(
+        logits.astype(jnp.float32), targets
+    )  # (S_local, B)
+    valid = jnp.ones_like(losses)
+    # the global final position (last rank's last row) has no successor
+    last_rank = jnp.equal(rank, world - 1).astype(losses.dtype)
+    valid = valid.at[-1].set(1.0 - last_rank)
+    local_sum = jnp.sum(losses * valid)
+    local_count = jnp.sum(valid)
+    return jax.lax.psum(local_sum, axis_name) / jax.lax.psum(
+        local_count, axis_name
+    )
