@@ -186,6 +186,7 @@ class GptBlock(nn.Module):
                     capacity_factor=cfg.moe_capacity_factor,
                     dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
+                    context_parallel=bool(cfg.context_parallel),
                 ),
                 name="moe",
             )(y)
@@ -278,6 +279,35 @@ class GptModel(nn.Module):
         return x
 
 
+def _apply_with_moe_aux(params, model: GptModel, input_ids, deterministic):
+    """Model forward returning ``(h, aux_total)``.
+
+    For MoE configs this strips any "losses" collection that leaked into
+    the variables (flax init returns sown collections): apply would
+    APPEND fresh aux to the stale init-time values — double-counting —
+    and the stale leaves would receive gradients/optimizer updates as if
+    they were parameters.  The per-layer sown aux values are averaged and
+    scaled by ``cfg.moe_aux_coef``.
+    """
+    if not model.cfg.num_experts:
+        return (
+            model.apply(params, input_ids, deterministic=deterministic),
+            0.0,
+        )
+    variables = {k: v for k, v in params.items() if k != "losses"}
+    h, sown = model.apply(
+        variables, input_ids, deterministic=deterministic,
+        mutable=["losses"],
+    )
+    aux = jax.tree_util.tree_leaves(sown.get("losses", {}))
+    aux_total = (
+        model.cfg.moe_aux_coef * sum(jnp.mean(a) for a in aux)
+        if aux
+        else 0.0
+    )
+    return h, aux_total
+
+
 def _tied_vocab_logits(params, model: GptModel, h, *, sp_gathered: bool):
     """Vocab-parallel logits through the tied embedding decoder.
 
@@ -309,25 +339,7 @@ def gpt_lm_loss(params, model: GptModel, input_ids, *, deterministic=True):
             "the sequence is context-parallel sharded: use gpt_lm_loss_cp "
             "(the next-token shift crosses cp shard boundaries)"
         )
-    aux_total = 0.0
-    if model.cfg.num_experts:
-        # Strip any "losses" collection that leaked into the variables
-        # (flax init returns sown collections): apply would APPEND fresh
-        # aux to the stale init-time values — double-counting — and the
-        # stale leaves would receive gradients/optimizer updates as if
-        # they were parameters.
-        variables = {k: v for k, v in params.items() if k != "losses"}
-        h, sown = model.apply(
-            variables, input_ids, deterministic=deterministic,
-            mutable=["losses"],
-        )
-        aux = jax.tree_util.tree_leaves(sown.get("losses", {}))
-        if aux:
-            aux_total = model.cfg.moe_aux_coef * sum(
-                jnp.mean(a) for a in aux
-            )
-    else:
-        h = model.apply(params, input_ids, deterministic=deterministic)
+    h, aux_total = _apply_with_moe_aux(params, model, input_ids, deterministic)
     logits = _tied_vocab_logits(
         params, model, h, sp_gathered=model.cfg.sequence_parallel
     )
@@ -359,12 +371,10 @@ def gpt_lm_loss_cp(
     axis — ``pmean`` gradients over cp (alongside dp) before the
     optimizer step.
     """
-    if model.cfg.num_experts:
-        raise NotImplementedError(
-            "MoE + context parallelism is not wired yet (the router's aux "
-            "statistics would need the cp-mean treatment SP gets)"
-        )
-    h = model.apply(params, input_ids_local, deterministic=deterministic)
+    # aux values are cp-replicated (SwitchMoe pmeans its stats over cp)
+    h, aux_total = _apply_with_moe_aux(
+        params, model, input_ids_local, deterministic
+    )
     # no SP under cp, so the copy_to boundary always applies at tp > 1
     logits = _tied_vocab_logits(params, model, h, sp_gathered=False)
     world = jax.lax.axis_size(axis_name)
@@ -386,6 +396,7 @@ def gpt_lm_loss_cp(
     valid = valid.at[-1].set(1.0 - last_rank)
     local_sum = jnp.sum(losses * valid)
     local_count = jnp.sum(valid)
-    return jax.lax.psum(local_sum, axis_name) / jax.lax.psum(
+    ce = jax.lax.psum(local_sum, axis_name) / jax.lax.psum(
         local_count, axis_name
     )
+    return ce + aux_total

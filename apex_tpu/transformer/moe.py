@@ -64,17 +64,29 @@ class MoeConfig:
     # params then carry tp-PARTIAL gradients and are registered for
     # allreduce_sequence_parallel_gradients' tp psum.
     sequence_parallel: bool = False
+    # True under context parallelism (tokens sharded over the cp axis):
+    # aux stats are pmean'd over cp with grad scale 1.0 — cp gradients
+    # are synced with pmean (a data axis), not psum, so no rescale is
+    # needed and no param marking happens.  Mutually exclusive with
+    # sequence_parallel.
+    context_parallel: bool = False
 
     def __post_init__(self):
         if self.top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+        if self.sequence_parallel and self.context_parallel:
+            raise ValueError(
+                "sequence_parallel and context_parallel are mutually "
+                "exclusive (both shard the token dimension)"
+            )
 
 
 def _axis_size(axis: Optional[str]) -> int:
     return 1 if axis is None else ps.bound_axis_size(axis)
 
 
-def moe_dispatch_combine(router_probs, top_k, capacity, stats_axis=None):
+def moe_dispatch_combine(router_probs, top_k, capacity, stats_axis=None,
+                         stats_grad_scale=None):
     """Dispatch/combine tensors from router probabilities.
 
     router_probs f32 (T, E) (already softmaxed).  Returns
@@ -85,10 +97,23 @@ def moe_dispatch_combine(router_probs, top_k, capacity, stats_axis=None):
     mean prob).
 
     ``stats_axis``: mesh axis to pmean the aux statistics (f_e, P_e) over
-    before forming the product.  Used under sequence parallelism (each tp
-    rank routes an S/tp token shard): aux is quadratic in the stats, so
-    the mean of per-shard aux ≠ the global-batch aux — pmean'ing the
-    stats first recovers exactly the unsharded value.
+    before forming the product — used whenever tokens are SHARDED over an
+    axis (Megatron SP over tp; context parallelism over cp): aux is
+    quadratic in the stats, so the mean of per-shard aux ≠ the
+    global-batch aux; pmean'ing the stats first recovers exactly the
+    unsharded value.
+
+    ``stats_grad_scale``: per-rank scale applied to the aux GRADIENT
+    (value unchanged, via stop_gradient).  pmean's VJP psums the
+    cotangent across ranks, so each rank's aux backward carries the FULL
+    E·f̄ factor on its local-path derivative.  The right scale depends on
+    how the caller then syncs gradients over ``stats_axis``:
+
+    - psum sync (Megatron SP: allreduce_sequence_parallel_gradients):
+      scale 1/n, else the summed partials are n× the true gradient —
+      the default (``None`` → 1/axis_size);
+    - pmean sync (context parallelism treats cp as a data axis): scale
+      1.0 — the 1/n of the pmean already cancels the full factor.
     """
     t, e = router_probs.shape
     # top-k expert choices per token
@@ -102,14 +127,13 @@ def moe_dispatch_combine(router_probs, top_k, capacity, stats_axis=None):
         frac_routed = jax.lax.pmean(frac_routed, stats_axis)
         mean_prob = jax.lax.pmean(mean_prob, stats_axis)
         aux = e * jnp.sum(frac_routed * mean_prob)
-        # Per-rank gradient bookkeeping: pmean's VJP psums the cotangent
-        # across ranks, so each rank's aux backward already carries the
-        # FULL E·f̄ factor on its local-path derivative — tp× too much
-        # once the sequence-parallel grad sync psums the partials.  Scale
-        # the aux GRADIENT by 1/n (value unchanged) so that psum-of-
-        # partials equals the global-batch aux gradient exactly.
-        n = jax.lax.axis_size(stats_axis)
-        aux = aux / n + jax.lax.stop_gradient(aux * (1.0 - 1.0 / n))
+        scale = (
+            1.0 / jax.lax.axis_size(stats_axis)
+            if stats_grad_scale is None
+            else stats_grad_scale
+        )
+        if scale != 1.0:
+            aux = aux * scale + jax.lax.stop_gradient(aux * (1.0 - scale))
     else:
         aux = e * jnp.sum(frac_routed * mean_prob)
 
@@ -231,13 +255,19 @@ class SwitchMoe(nn.Module):
         )
         logits = xt.astype(jnp.float32) @ router_w
         probs = jax.nn.softmax(logits, axis=-1)
-        stats_axis = None
+        stats_axis, stats_grad_scale = None, None
         if cfg.sequence_parallel and ps.axis_is_bound(
             ps.TENSOR_PARALLEL_AXIS
         ):
-            stats_axis = ps.TENSOR_PARALLEL_AXIS
+            stats_axis = ps.TENSOR_PARALLEL_AXIS  # psum sync → 1/n scale
+        elif cfg.context_parallel and ps.axis_is_bound(
+            ps.CONTEXT_PARALLEL_AXIS
+        ):
+            stats_axis = ps.CONTEXT_PARALLEL_AXIS
+            stats_grad_scale = 1.0  # pmean sync cancels the factor
         dispatch, combine, aux = moe_dispatch_combine(
-            probs, cfg.top_k, capacity, stats_axis=stats_axis
+            probs, cfg.top_k, capacity, stats_axis=stats_axis,
+            stats_grad_scale=stats_grad_scale,
         )
 
         # --- expert weights: LOCAL shard, ep-degree-invariant init ----

@@ -132,6 +132,64 @@ def test_cp_with_tp_loss_matches(eight_devices):
     np.testing.assert_allclose(float(loss), loss_ref, rtol=1e-5)
 
 
+def test_cp_moe_gpt_matches_unsharded(eight_devices):
+    """MoE + cp: router/expert grads and loss (incl. cp-pmean'd aux
+    stats) match the unsharded model after sync_moe_gradients over dp +
+    pmean over cp.  capacity_factor=num_experts ⇒ no drops, so routing
+    is exactly equivalent."""
+    from apex_tpu.transformer.moe import sync_moe_gradients
+
+    kw = dict(KW, num_experts=8, moe_capacity_factor=8.0)
+    m = GptModel(GptConfig(context_parallel="ring", **kw))
+    ids = _ids()
+
+    def f(key, ids):
+        rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
+        local = jax.lax.dynamic_slice_in_dim(ids, rank * (S // CP), S // CP, 0)
+        params = m.init(key, local)
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_lm_loss_cp(p, m, local)
+        )(params)
+        grads = sync_moe_gradients(grads)  # dp (expert-aware)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, ps.CONTEXT_PARALLEL_AXIS), grads
+        )
+        g = grads["params"]["layers"]["block"]
+        e1 = jax.lax.all_gather(
+            g["moe"]["expert_w1"], ps.DATA_PARALLEL_AXIS, axis=1, tiled=True
+        )
+        return loss, g["moe"]["router"], e1, g["ln_mlp"]["scale"]
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=CP)
+    loss, router, e1, ln = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )(jax.random.PRNGKey(0), ids)
+    ps.destroy_model_parallel()
+
+    m_ref = GptModel(GptConfig(**kw))
+    params = m_ref.init(jax.random.PRNGKey(0), ids)
+    loss_ref, grads_ref = jax.value_and_grad(
+        lambda p: gpt_lm_loss(p, m_ref, ids)
+    )(params)
+    g = grads_ref["params"]["layers"]["block"]
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(router), np.asarray(g["moe"]["router"]),
+        err_msg="router", **TOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(e1), np.asarray(g["moe"]["expert_w1"]),
+        err_msg="expert_w1", **TOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ln), np.asarray(g["ln_mlp"]["scale"]),
+        err_msg="ln_mlp", **TOL,
+    )
+
+
 def test_config_validation():
     with pytest.raises(ValueError, match="mutually exclusive"):
         GptConfig(context_parallel="ring", sequence_parallel=True, **KW)
