@@ -34,6 +34,7 @@ __all__ = [
     "DataLoader",
     "DevicePrefetcher",
     "write_token_file",
+    "synthetic_token_corpus",
     "bert_mlm_batches",
 ]
 
@@ -41,6 +42,33 @@ __all__ = [
 def write_token_file(path, tokens: np.ndarray) -> None:
     """Write a flat token array as a raw binary token file."""
     np.ascontiguousarray(tokens).ravel().tofile(os.fspath(path))
+
+
+def synthetic_token_corpus(
+    path,
+    *,
+    vocab_size: int,
+    num_tokens: int = 1_000_000,
+    floor: int = 0,
+    zipf_a: float = 1.3,
+    seed: int = 0,
+) -> str:
+    """Write (once, atomically) a zipf-distributed synthetic token corpus.
+
+    Cached by existence at ``path``; the write goes to a pid-suffixed
+    temp name then ``os.replace``s into place, so an interrupted or
+    concurrent first run can never leave a truncated file behind.  Token
+    ids land in ``[floor, vocab_size)``.  Used by the examples when no
+    ``--data`` file is given.
+    """
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        rng = np.random.default_rng(seed)
+        toks = floor + (rng.zipf(zipf_a, size=num_tokens) % (vocab_size - floor))
+        tmp = f"{path}.{os.getpid()}.tmp"
+        write_token_file(tmp, toks.astype(np.uint16))
+        os.replace(tmp, path)
+    return path
 
 
 class TokenFileDataset:

@@ -43,7 +43,7 @@ from apex_tpu.data import (
     DevicePrefetcher,
     TokenFileDataset,
     bert_mlm_batches,
-    write_token_file,
+    synthetic_token_corpus,
 )
 from apex_tpu.models import BertConfig, BertForPreTraining, bert_pretrain_loss
 from apex_tpu.optimizers import fused_lamb
@@ -78,19 +78,15 @@ def corpus_path(args, cfg) -> str:
     either way the batches flow through the real memmap pipeline."""
     if args.data:
         return args.data
-    path = os.path.join(
-        tempfile.gettempdir(),
-        f"apex_tpu_synth_corpus_v{cfg.vocab_size}.bin",
+    return synthetic_token_corpus(
+        os.path.join(
+            tempfile.gettempdir(),
+            f"apex_tpu_synth_corpus_v{cfg.vocab_size}.bin",
+        ),
+        vocab_size=cfg.vocab_size,
+        num_tokens=2_000_000,
+        floor=1000,
     )
-    if not os.path.exists(path):
-        rng = np.random.default_rng(0)
-        toks = 1000 + (rng.zipf(1.3, size=2_000_000) % (cfg.vocab_size - 1000))
-        # atomic publish: an interrupted/concurrent writer must never
-        # leave a truncated file at the cached path
-        tmp = f"{path}.{os.getpid()}.tmp"
-        write_token_file(tmp, toks.astype(np.uint16))
-        os.replace(tmp, path)
-    return path
 
 
 def batch_stream(args, cfg, start_step=0):

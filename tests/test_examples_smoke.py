@@ -87,6 +87,39 @@ def test_bert_pretrain_tiny_smoke():
     _run_example("examples/bert/pretrain_bert.py", ["--tiny"])
 
 
+def test_gpt_train_tiny_smoke():
+    out = _run_example(
+        "examples/gpt/train_gpt.py",
+        ["--tiny", "--steps", "4", "--batch", "4", "--seq-len", "64"],
+    )
+    assert "chunk 0: loss" in out, out[-500:]
+
+
+def test_gpt_train_cp_ring_smoke():
+    """Context-parallel ring attention end-to-end in the example."""
+    out = _run_example(
+        "examples/gpt/train_gpt.py",
+        [
+            "--tiny", "--steps", "4", "--batch", "2", "--seq-len", "64",
+            "--context-parallel", "ring", "--cp", "2",
+        ],
+        n_devices=4,
+    )
+    assert "cp=2(ring)" in out, out[-500:]
+
+
+def test_gpt_train_tp_sp_moe_smoke():
+    out = _run_example(
+        "examples/gpt/train_gpt.py",
+        [
+            "--tiny", "--steps", "4", "--batch", "2", "--seq-len", "64",
+            "--tp", "2", "--sequence-parallel", "--num-experts", "4",
+        ],
+        n_devices=4,
+    )
+    assert "sp=True experts=4" in out, out[-500:]
+
+
 def test_bert_pretrain_checkpoint_resume(tmp_path):
     """Train 8 steps with checkpointing, resume to 16, and compare with
     an uninterrupted 16-step run: the resumed run must pick up at step 8

@@ -108,6 +108,7 @@ def _attn_loss(attn_fn, q, k, v, bias=None, **kw):
         (2, 4, 256, 256, 64, False),    # D=64 (padded inside the kernel)
         (1, 8, 128, 512, 128, False),   # enc-dec (Sq != Sk)
         (1, 8, 512, 256, 128, True),    # causal, bottom-right aligned
+        (1, 2, 4096, 4096, 128, True),  # long context (multi-KV-block path)
     ],
 )
 def test_flash_attention_fwd_bwd(dtype, b, h, sq, sk, d, causal):
