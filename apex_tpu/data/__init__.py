@@ -154,8 +154,10 @@ class DataLoader:
                 f"small for batch_size {batch_size}"
             )
 
-    def epoch(self, epoch: int) -> Iterator[np.ndarray]:
-        """Yield this rank's ``(B, S)`` batches for one epoch."""
+    def epoch(self, epoch: int, start: int = 0) -> Iterator[np.ndarray]:
+        """Yield this rank's ``(B, S)`` batches for one epoch, starting at
+        in-epoch batch index ``start`` (an index-level seek: skipped
+        batches are never gathered)."""
         n = len(self.dataset)
         if self.shuffle:
             order = np.random.default_rng(
@@ -164,19 +166,25 @@ class DataLoader:
         else:
             order = np.arange(n)
         mine = order[self.rank :: self.world]
-        for b in range(self.batches_per_epoch):
+        for b in range(start, self.batches_per_epoch):
             idx = mine[b * self.batch_size : (b + 1) * self.batch_size]
             starts = self.dataset.sample_starts(idx)
             yield _native.gather_rows(
                 self.dataset.tokens, starts, self.dataset.seq_len
             )
 
+    def iter_from(self, start_batch: int = 0) -> Iterator[np.ndarray]:
+        """Endless epoch stream seeked to global batch ``start_batch`` —
+        O(1) resume positioning (shuffle orders are (seed, epoch)-pure),
+        vs. generating and discarding ``start_batch`` batches."""
+        e, b = divmod(start_batch, self.batches_per_epoch)
+        while True:
+            yield from self.epoch(e, start=b)
+            e, b = e + 1, 0
+
     def __iter__(self) -> Iterator[np.ndarray]:
         """Endless stream over epochs 0, 1, 2, ... (reshuffled each)."""
-        e = 0
-        while True:
-            yield from self.epoch(e)
-            e += 1
+        return self.iter_from(0)
 
 
 class DevicePrefetcher:
@@ -203,6 +211,18 @@ class DevicePrefetcher:
         self._worker = threading.Thread(target=self._fill, daemon=True)
         self._worker.start()
 
+    def _put(self, item) -> bool:
+        """Enqueue with stop-aware timeout polling; False when stopped
+        (an unbounded blocking put could pin the worker forever if the
+        consumer abandons iteration without close())."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _fill(self):
         try:
             for batch in self._src:
@@ -212,18 +232,12 @@ class DevicePrefetcher:
                     batch = self._jax.device_put(batch, self._device)
                 else:
                     batch = self._jax.device_put(batch)
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(batch, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if self._stop.is_set():
+                if not self._put(batch):
                     return
         except BaseException as e:  # surface worker errors to the consumer
-            self._q.put(e)
+            self._put(e)
             return
-        self._q.put(self._DONE)
+        self._put(self._DONE)
 
     def __iter__(self):
         return self
@@ -268,15 +282,26 @@ def bert_mlm_batches(
     vocab_size: int = 30522,
     special_floor: int = 1000,
     seq_first: bool = True,
+    start_step: int = 0,
 ):
     """Endless BERT phase-1 batches from a token loader.
 
     Applies the native 80/10/10 MLM corruption (`_native.mlm_mask_batch`,
     deterministic in (seed, step, position)) and emits the batch dict
     ``bert_pretrain_loss`` consumes, seq-first by default.
+
+    ``start_step`` seeks the stream for resume: the loader is positioned
+    at that batch index (O(1), nothing gathered for skipped batches) and
+    the corruption seed counter starts there, so batch N of a resumed
+    stream is bit-identical to batch N of an uninterrupted one.
     """
-    step = 0
-    for tokens in loader:
+    step = start_step
+    src = (
+        loader.iter_from(start_step)
+        if hasattr(loader, "iter_from")
+        else iter(loader)
+    )
+    for tokens in src:
         ids = tokens.astype(np.int32)
         masked, labels = _native.mlm_mask_batch(
             ids,
@@ -289,6 +314,12 @@ def bert_mlm_batches(
         if seq_first:
             masked, labels = masked.T, labels.T
         b = tokens.shape[0]
+        # NSP labels: deterministic pseudo-random 0/1 per (seed, step) so
+        # the NSP head trains against a non-constant objective (an
+        # all-zeros label would let it collapse to a constant prediction)
+        nsp = np.random.default_rng(
+            np.random.SeedSequence([seed, step, 0x4E53])
+        ).integers(0, 2, size=(b,)).astype(np.int32)
         yield {
             "input_ids": masked,
             "token_type_ids": np.zeros_like(masked),
@@ -297,6 +328,6 @@ def bert_mlm_batches(
                 np.int32,
             ),
             "mlm_labels": labels,
-            "nsp_labels": np.zeros((b,), np.int32),
+            "nsp_labels": nsp,
         }
         step += 1

@@ -133,9 +133,11 @@ def _per_rank_dropout_rng(module: nn.Module, rank_local: bool):
     identical across ranks (folding would desynchronize the replicated
     activations), so ``rank_local=False`` returns the shared key.
     """
+    from apex_tpu.transformer.tensor_parallel.random import to_per_rank_key
+
     rng = module.make_rng("dropout")
     if rank_local and _tp_world(_TP) > 1:
-        rng = jax.random.fold_in(rng, jax.lax.axis_index(_TP))
+        rng = to_per_rank_key(rng)
     return rng
 
 
@@ -360,6 +362,16 @@ class BertEmbeddings(nn.Module):
         local_s = word.shape[0]  # S/tp under SP, S otherwise
         start = 0
         if sp:
+            # dynamic_slice CLAMPS an out-of-range start — guard the table
+            # size so a too-long sequence fails loudly instead of silently
+            # reusing the last position rows on high ranks
+            tp = _tp_world(_TP)
+            if tp * local_s > cfg.max_position_embeddings:
+                raise ValueError(
+                    f"global sequence tp*S_local = {tp}*{local_s} exceeds "
+                    f"max_position_embeddings "
+                    f"({cfg.max_position_embeddings})"
+                )
             start = jax.lax.axis_index(_TP) * local_s
             ps.register_sequence_parallel_param(
                 self.path + ("position_embeddings",)

@@ -239,7 +239,15 @@ class GptModel(nn.Module):
             start = 0
             if cfg.sequence_parallel and _tp_world(_TP) > 1:
                 # x is the SP seq shard [rank·S/tp, (rank+1)·S/tp): slice
-                # the matching positions, and mark the table tp-partial
+                # the matching positions, and mark the table tp-partial.
+                # Guard the table size: dynamic_slice CLAMPS out-of-range
+                # starts, which would silently reuse rows on high ranks.
+                tp = _tp_world(_TP)
+                if tp * x.shape[0] > cfg.max_seq_len:
+                    raise ValueError(
+                        f"global sequence tp*S_local = {tp}*{x.shape[0]} "
+                        f"exceeds max_seq_len ({cfg.max_seq_len})"
+                    )
                 start = jax.lax.axis_index(_TP) * x.shape[0]
                 ps.register_sequence_parallel_param(
                     self.path + ("position_embeddings",)

@@ -92,19 +92,18 @@ def corpus_path(args, cfg) -> str:
 def batch_stream(args, cfg, start_step=0):
     """chunk-stacked batch dicts: each leaf (chunk, ...) for lax.scan.
 
-    ``start_step`` fast-forwards the deterministic stream so a resumed
-    run continues on the batches an uninterrupted run would have seen —
-    restoring params without advancing the data would silently retrain
-    on already-consumed batches.
+    ``start_step`` seeks the deterministic stream (O(1), index-level) so
+    a resumed run continues on the batches an uninterrupted run would
+    have seen — restoring params without advancing the data would
+    silently retrain on already-consumed batches.
     """
     ds = TokenFileDataset(corpus_path(args, cfg), seq_len=args.seq_len)
     loader = DataLoader(ds, batch_size=args.batch, seed=1234)
     stream = bert_mlm_batches(
         loader, seed=42, mask_prob=0.15, mask_id=103,
         vocab_size=cfg.vocab_size, special_floor=1000,
+        start_step=start_step,
     )
-    for _ in range(start_step):
-        next(stream)
     while True:
         chunk = [next(stream) for _ in range(args.chunk)]
         yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *chunk)
@@ -141,8 +140,11 @@ def main():
         # would re-commit every leaf to device 0 and clash with shard_map)
         rep = jax.sharding.NamedSharding(mesh, P())
         tmpl = jax.tree_util.tree_map(
+            # .dtype/np.shape read metadata only — no device->host copy
+            # of the (large) params/optimizer leaves (jnp.result_type
+            # would also downcast the int64 step under disabled x64)
             lambda x: jax.ShapeDtypeStruct(
-                np.shape(x), np.asarray(x).dtype, sharding=rep
+                np.shape(x), x.dtype, sharding=rep
             ),
             ckpt.snapshot_training_state(params, opt_state, step=0),
         )

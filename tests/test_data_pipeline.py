@@ -98,6 +98,44 @@ class TestLoader:
         np.testing.assert_array_equal(a, b)
         assert not np.array_equal(a, c)
 
+    def test_iter_from_seeks_without_replay(self, token_file):
+        """iter_from(N) batch k == plain stream batch N+k (incl. across
+        the epoch boundary), with no gathers for the skipped prefix."""
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        dl = DataLoader(ds, batch_size=4, seed=9)  # 8 batches/epoch
+        import itertools
+
+        plain = list(itertools.islice(iter(dl), 12))
+        for start in (3, 8, 10):  # mid-epoch, boundary, next epoch
+            seeked = list(
+                itertools.islice(dl.iter_from(start), 12 - start)
+            )
+            for k, b in enumerate(seeked):
+                np.testing.assert_array_equal(b, plain[start + k])
+
+    def test_mlm_stream_start_step_matches(self, token_file):
+        """bert_mlm_batches(start_step=N) reproduces batch N of the
+        uninterrupted stream bit-exactly (loader seek + mask seed)."""
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+
+        def stream(start):
+            return bert_mlm_batches(
+                DataLoader(ds, batch_size=4, seed=2), seed=7,
+                vocab_size=6000, start_step=start,
+            )
+
+        import itertools
+
+        plain = list(itertools.islice(stream(0), 6))
+        resumed = list(itertools.islice(stream(4), 2))
+        for k in range(2):
+            for key in plain[0]:
+                np.testing.assert_array_equal(
+                    resumed[k][key], plain[4 + k][key], err_msg=key
+                )
+
     def test_endless_iter_crosses_epochs(self, token_file):
         p, _ = token_file
         ds = TokenFileDataset(p, seq_len=128)
