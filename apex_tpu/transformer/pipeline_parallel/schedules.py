@@ -189,9 +189,30 @@ def forward_backward_pipelining_with_interleaving(
 
     ``params`` hold this rank's ``num_model_chunks`` stage chunks stacked
     on a leading axis (every leaf ``(vpp, ...)``): rank r owns virtual
-    stages ``r, r+pp, ..., r+(vpp-1)·pp``.  Routing per tick: slot k moves
-    rank r → r+1 (same chunk); the wrap rank pp-1 → rank 0 advances to
-    slot k+1 (the roll trick below), matching the virtual-stage walk.
+    stages ``r, r+pp, ..., r+(vpp-1)·pp``.
+
+    Each tick computes exactly ONE chunk per rank (1/vpp of a full stage),
+    so a tick costs 1/vpp of a non-interleaved tick.  Microbatches are
+    processed in Megatron's round-robin order — groups of ``pp``
+    microbatches traverse chunk 0 on every rank, then chunk 1, ... — which
+    keeps every rank busy back-to-back in steady state.  At tick ``t``,
+    rank ``r`` computes, with ``u = t - r``:
+
+        group g     = u // (pp·vpp)
+        chunk c     = (u mod pp·vpp) // pp
+        microbatch  = g·pp + (u mod pp)
+
+    valid while ``0 <= u < nm·vpp``.  Total ticks = ``nm·vpp + pp - 1`` of
+    duration 1/vpp stage ⇒ wall ≈ ``nm + (pp-1)/vpp`` stage-times: the
+    fill/drain bubble is **(pp-1)/vpp** — the Megatron interleaving win —
+    vs the non-interleaved schedule's ``pp-1``.  Routing is a uniform
+    rank→rank+1 ``ppermute``: the wrap pp-1→0 lands exactly where chunk
+    ``c+1`` is scheduled next tick, and rank 0 overwrites the wrapped value
+    with a fresh microbatch whenever its scheduled chunk is 0.
+
+    Like the reference schedule, requires ``num_microbatches`` to be a
+    multiple of the pipeline size (SURVEY §2.3 interleaving row: Megatron
+    asserts ``num_microbatches % pipeline_parallel_size == 0``).
     """
     inputs, targets = batch
     nm = num_microbatches
@@ -204,70 +225,51 @@ def forward_backward_pipelining_with_interleaving(
 
     def pipeline_loss(params):
         pp = jax.lax.axis_size(axis_name)
+        if nm % pp != 0:
+            raise ValueError(
+                f"interleaved schedule requires num_microbatches ({nm}) to "
+                f"be a multiple of pipeline_parallel_size ({pp})"
+            )
         stage = jax.lax.axis_index(axis_name)
         is_first = stage == 0
         is_last = stage == pp - 1
-        total_stages = pp * vpp
-        ticks = nm + total_stages - 1
-        act0 = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]), inputs)
-        # slot buffer: leading (vpp,) dim per leaf
-        buf0 = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x, (vpp,) + x.shape), act0
-        )
+        cycle = pp * vpp
+        ticks = nm * vpp + pp - 1
+        h0 = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]), inputs)
 
         def tick(carry, t):
-            buf, losses = carry
-            outs = []
-            for k in range(vpp):  # static unroll over chunks
-                x_k = jax.tree_util.tree_map(lambda x: x[k], buf)
-                if k == 0:
-                    mb_idx = jnp.clip(t, 0, nm - 1)
-                    inject = jax.tree_util.tree_map(
-                        lambda x: x[mb_idx], inputs
-                    )
-                    injecting = is_first & (t < nm)
-                    x_k = jax.tree_util.tree_map(
-                        lambda a, b: jnp.where(injecting, a, b), inject, x_k
-                    )
-                chunk_params = jax.tree_util.tree_map(
-                    lambda x: x[k], params
-                )
-                outs.append(run(chunk_params, x_k))
+            h_recv, losses = carry
+            u = t - stage
+            w = jnp.mod(u, cycle)
+            chunk = w // pp
+            mb = jnp.floor_divide(u, cycle) * pp + jnp.mod(u, pp)
+            active = (u >= 0) & (u < nm * vpp)
+            mb_idx = jnp.clip(mb, 0, nm - 1)
 
-            # loss: last virtual stage = rank pp-1, chunk vpp-1
-            out_idx = t - (total_stages - 1)
-            valid = (out_idx >= 0) & (out_idx < nm) & is_last
-            tgt = jax.tree_util.tree_map(
-                lambda x: x[jnp.clip(out_idx, 0, nm - 1)], targets
+            injecting = is_first & (chunk == 0) & active
+            inject = jax.tree_util.tree_map(lambda x: x[mb_idx], inputs)
+            x_in = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(injecting, a, b), inject, h_recv
             )
-            loss = loss_fn(outs[-1], tgt)
-            losses = losses.at[jnp.clip(out_idx, 0, nm - 1)].add(
-                jnp.where(valid, loss, 0.0)
-            )
-
-            stacked = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs, axis=0), *outs
-            )
-            received = jax.tree_util.tree_map(
-                lambda x: jax.lax.ppermute(
-                    x,
-                    axis_name,
-                    [(i, (i + 1) % pp) for i in range(pp)],
+            chunk_params = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, chunk, 0, keepdims=False
                 ),
-                stacked,
+                params,
             )
-            # rank 0 received from rank pp-1: those activations advance one
-            # chunk (slot k -> k+1); other ranks keep slot indices.
-            rolled = jax.tree_util.tree_map(
-                lambda x: jnp.roll(x, 1, axis=0), received
-            )
-            buf_next = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(is_first, a, b), rolled, received
-            )
-            return (buf_next, losses), None
+            y = run(chunk_params, x_in)
+
+            # loss: last virtual stage = rank pp-1 running chunk vpp-1
+            finishing = is_last & (chunk == vpp - 1) & active
+            tgt = jax.tree_util.tree_map(lambda x: x[mb_idx], targets)
+            loss = loss_fn(y, tgt)
+            losses = losses.at[mb_idx].add(jnp.where(finishing, loss, 0.0))
+
+            h_next = p2p.send_forward_recv_forward(y, axis_name, cyclic=True)
+            return (h_next, losses), None
 
         (_, losses), _ = jax.lax.scan(
-            tick, (buf0, jnp.zeros((nm,), jnp.float32)), jnp.arange(ticks)
+            tick, (h0, jnp.zeros((nm,), jnp.float32)), jnp.arange(ticks)
         )
         # local sum differentiated; psum only in aux (see 1F1B note above)
         return jnp.sum(losses) / nm, jax.lax.psum(losses, axis_name)

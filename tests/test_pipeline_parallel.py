@@ -246,6 +246,78 @@ def test_interleaved_matches_sequential(eight_devices):
         )
 
 
+@pytest.mark.parametrize("pp,vpp,nm", [(2, 3, 4), (4, 2, 8), (2, 2, 2)])
+def test_interleaved_matches_sequential_configs(eight_devices, pp, vpp, nm):
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual, seed=pp * 10 + vpp)
+    rng = np.random.RandomState(2)
+    inputs = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, grads = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=nm, num_model_chunks=vpp,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[:, None], grads)
+        return losses, grads
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(P(None, "pp"), P(), P()),
+            out_specs=(P(), P(None, "pp")),
+            check_vma=False,
+        )
+    )(regrouped, inputs, targets)
+
+    ref_losses, ref_grads = sequential_reference(
+        stacked, inputs, targets, n_virtual
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(n_virtual, *stacked[k].shape[1:])
+        np.testing.assert_allclose(
+            got, np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_interleaved_rejects_indivisible_microbatches(eight_devices):
+    pp, vpp = 2, 2
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp * vpp)
+    rng = np.random.RandomState(3)
+    inputs = jnp.asarray(rng.randn(3, MB, D), jnp.float32)  # 3 % pp != 0
+    targets = jnp.asarray(rng.randn(3, MB, D), jnp.float32)
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, _ = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=3, num_model_chunks=vpp,
+        )
+        return losses
+
+    with pytest.raises(ValueError, match="multiple of pipeline"):
+        jax.jit(
+            jax.shard_map(
+                run, mesh=mesh,
+                in_specs=(P(None, "pp"), P(), P()), out_specs=P(),
+                check_vma=False,
+            )
+        )(regrouped, inputs, targets)
+
+
 def test_get_forward_backward_func(eight_devices):
     ps.initialize_model_parallel(1, 1)
     assert get_forward_backward_func() is forward_backward_no_pipelining

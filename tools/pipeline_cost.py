@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from apex_tpu import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
 )
 
@@ -152,28 +153,133 @@ def run_lockstep(pp, remat):
     return wall, mem
 
 
-def main():
-    base_wall, base_mem = run_no_pipelining()
-    print(
-        f"no_pipelining  (1 rank, L={LAYERS}, nm={NM}):"
-        f"  wall={base_wall*1e3:8.1f} ms  mem={base_mem:8.1f} MB"
+def run_interleaved(pp, vpp, remat, nm=NM):
+    devices = jax.devices()[:pp]
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size=pp, devices=devices
     )
-    print(
-        f"{'schedule':<28}{'pp':>4}{'remat':>7}{'wall ms':>10}"
+    mesh = Mesh(devices, (ps.PIPELINE_PARALLEL_AXIS,))
+    per_chunk = LAYERS // (pp * vpp)
+    stage = make_stage_fn(per_chunk)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (nm, MB, SEQ, HIDDEN), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
+
+    def sharded_step(x, t):
+        rank = jax.lax.axis_index(ps.PIPELINE_PARALLEL_AXIS)
+        chunks = [
+            make_params(jax.random.fold_in(key, rank + pp * k), per_chunk)
+            for k in range(vpp)
+        ]
+        params = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0), *chunks
+        )
+        losses, grads = forward_backward_pipelining_with_interleaving(
+            stage, loss_fn, params, (x, t),
+            num_microbatches=nm, num_model_chunks=vpp, remat=remat,
+        )
+        return jnp.sum(losses), sum(
+            jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
+        )
+
+    step = jax.shard_map(
+        sharded_step, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False,
+    )
+    f = jax.jit(step)
+    wall, _ = timed(f, (x, t))
+    mem = mem_analysis(step, (x, t))
+    ps.destroy_model_parallel()
+    return wall, mem
+
+
+def run_lockstep_nm(pp, nm, remat=True):
+    """Lockstep memory at large grad-accumulation nm (VERDICT r2 item 7)."""
+    devices = jax.devices()[:pp]
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size=pp, devices=devices
+    )
+    mesh = Mesh(devices, (ps.PIPELINE_PARALLEL_AXIS,))
+    stage = make_stage_fn(LAYERS // pp)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (nm, MB, SEQ, HIDDEN), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
+
+    def sharded_step(x, t):
+        rank = jax.lax.axis_index(ps.PIPELINE_PARALLEL_AXIS)
+        params = make_params(jax.random.fold_in(key, rank), LAYERS // pp)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage, loss_fn, params, (x, t), num_microbatches=nm, remat=remat
+        )
+        return jnp.sum(losses), sum(
+            jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
+        )
+
+    step = jax.shard_map(
+        sharded_step, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False,
+    )
+    mem = mem_analysis(step, (x, t))
+    ps.destroy_model_parallel()
+    return mem
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "all"
+    header = (
+        f"{'schedule':<28}{'pp':>4}{'vpp':>4}{'remat':>7}{'wall ms':>10}"
         f"{'mem MB':>9}{'speedup':>9}{'ideal':>7}{'eff':>7}"
     )
-    for pp in (2, 4):
-        for remat in (True, False):
-            wall, mem = run_lockstep(pp, remat)
-            speed = base_wall / wall
-            # ideal bubble-limited speedup for pipelining nm microbatches
-            # over pp stages: pp * nm / (nm + pp - 1)
-            ideal = pp * NM / (NM + pp - 1)
-            print(
-                f"{'lockstep_1f1b':<28}{pp:>4}{str(remat):>7}"
-                f"{wall*1e3:>10.1f}{mem:>9.1f}{speed:>9.2f}{ideal:>7.2f}"
-                f"{speed/ideal:>7.2f}"
-            )
+
+    if mode in ("all", "schedules", "lockstep", "interleaved"):
+        base_wall, base_mem = run_no_pipelining()
+        print(
+            f"no_pipelining  (1 rank, L={LAYERS}, nm={NM}):"
+            f"  wall={base_wall*1e3:8.1f} ms  mem={base_mem:8.1f} MB",
+            flush=True,
+        )
+        print(header, flush=True)
+
+    if mode in ("all", "schedules", "lockstep"):
+        for pp in (2, 4):
+            for remat in (True, False):
+                wall, mem = run_lockstep(pp, remat)
+                speed = base_wall / wall
+                # ideal bubble-limited speedup for pipelining nm microbatches
+                # over pp stages: pp * nm / (nm + pp - 1)
+                ideal = pp * NM / (NM + pp - 1)
+                print(
+                    f"{'lockstep_1f1b':<28}{pp:>4}{'-':>4}{str(remat):>7}"
+                    f"{wall*1e3:>10.1f}{mem:>9.1f}{speed:>9.2f}{ideal:>7.2f}"
+                    f"{speed/ideal:>7.2f}",
+                    flush=True,
+                )
+
+    if mode in ("all", "schedules", "interleaved"):
+        for pp, vpp in ((2, 2), (2, 4), (4, 2)):
+            for remat in (True, False):
+                wall, mem = run_interleaved(pp, vpp, remat)
+                speed = base_wall / wall
+                # ticks = nm*vpp + pp - 1 of duration 1/vpp stage:
+                # ideal speedup = pp*vpp*nm / (nm*vpp + pp - 1)
+                ideal = pp * vpp * NM / (NM * vpp + pp - 1)
+                print(
+                    f"{'interleaved':<28}{pp:>4}{vpp:>4}{str(remat):>7}"
+                    f"{wall*1e3:>10.1f}{mem:>9.1f}{speed:>9.2f}{ideal:>7.2f}"
+                    f"{speed/ideal:>7.2f}",
+                    flush=True,
+                )
+
+    if mode in ("all", "nm-sweep"):
+        print()
+        print("lockstep memory vs num_microbatches (remat=True):", flush=True)
+        print(f"{'pp':>4}{'nm':>6}{'mem MB':>10}{'mem/nm MB':>12}", flush=True)
+        for pp in (2, 4):
+            for nm in (8, 16, 32, 64):
+                mem = run_lockstep_nm(pp, nm)
+                print(f"{pp:>4}{nm:>6}{mem:>10.1f}{mem/nm:>12.2f}", flush=True)
 
 
 if __name__ == "__main__":
