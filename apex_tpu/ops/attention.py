@@ -42,17 +42,24 @@ __all__ = [
 _LANES = 128
 
 
-def _pallas_eligible(q, k, v, dropout_p):
+def _seq_pad(s: int) -> int:
+    """Rows of padding that make ``s`` kernel-tileable: below a full lane
+    block, to the f32 sublane quantum; above, to a 128 multiple so
+    ``_auto_block`` finds a dividing power-of-two tile."""
+    return (-s) % 8 if s < _LANES else (-s) % _LANES
+
+
+def _pallas_eligible(q, k, v, dropout_p, causal=False):
     if dropout_p > 0.0:
         return False
     sq, sk = q.shape[-2], k.shape[-2]
-    # Blocks are auto-sized 128..512 with power-of-two fallback (see
-    # pallas.flash_attention._auto_block); partial tail blocks are not
-    # implemented, so S must be a multiple of min(128, S) — that
-    # guarantees a dividing block exists (the bench shapes qualify).
-    if sq % min(128, sq) or sk % min(128, sk):
-        return False
-    if sq % 8 or sk % 8:
+    # Arbitrary S is handled by padding to the next tileable size with the
+    # padded keys masked at MASK_VALUE (≙ the reference's shape-general
+    # softmax kernels, SURVEY §2.4 generic_scaled_masked_softmax).  One
+    # corner stays on the jnp path: bottom-right causal with Sq > Sk AND a
+    # padded Sk — fully-masked rows there average V over the real Sk, which
+    # key-padding cannot express.
+    if causal and sk < sq and _seq_pad(sk):
         return False
     if _dispatch.forced() is None and max(sq, sk) < 1024:
         # Auto mode: when BOTH sequence dims are short the (Sq, Sk) score
@@ -89,26 +96,40 @@ def _pad_head_dim(x):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, bias, scale, causal):
-    o, _ = _flash_fwd(q, k, v, bias, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, scale, causal, causal_offset, bias_grad):
+    o, _ = _flash_fwd(q, k, v, bias, scale, causal, causal_offset, bias_grad)
     return o
 
 
-def _flash_fwd(q, k, v, bias, scale, causal):
-    o, lse = _pallas.flash_fwd(q, k, v, bias, scale=scale, causal=causal)
+def _flash_fwd(q, k, v, bias, scale, causal, causal_offset, bias_grad):
+    o, lse = _pallas.flash_fwd(
+        q, k, v, bias, scale=scale, causal=causal,
+        causal_offset=causal_offset,
+    )
     return o, (q, k, v, bias, o, lse)
 
 
-def _flash_bwd(scale, causal, res, g):
+def _flash_bwd(scale, causal, causal_offset, bias_grad, res, g):
     q, k, v, bias, o, lse = res
     dq, dk, dv = _pallas.flash_bwd(
-        q, k, v, o, lse, g, bias, scale=scale, causal=causal
+        q, k, v, o, lse, g, bias, scale=scale, causal=causal,
+        causal_offset=causal_offset,
     )
-    # Bias is the reference's *additive mask* — non-trainable there; the
-    # flash path returns a zero cotangent for it (use the jnp path for a
-    # trainable bias, e.g. relative position biases).
-    dbias = None if bias is None else jnp.zeros_like(bias)
+    if bias is None:
+        dbias = None
+    elif bias_grad:
+        # Trainable bias (≙ reference self_attn_bias backward): a third
+        # recompute pass reduces ds over the bias's broadcast group —
+        # see pallas.flash_attention.flash_dbias.
+        dbias = _pallas.flash_dbias(
+            q, k, v, o, lse, g, bias, scale=scale, causal=causal,
+            causal_offset=causal_offset,
+        )
+    else:
+        # Bias as the reference's *additive mask* — non-trainable there;
+        # zero cotangent.
+        dbias = jnp.zeros_like(bias)
     return dq, dk, dv, dbias
 
 
@@ -174,11 +195,14 @@ def flash_attention(
 
     q (B,H,Sq,D); k,v (B,H,Sk,D); optional additive ``bias`` of rank ≤ 4
     broadcastable to (B,H,Sq,Sk) (the reference's key-padding / additive
-    attention mask — non-trainable, and the flash path treats it as a
-    constant with zero cotangent).  For a *trainable* bias (e.g. relative
-    position biases) pass ``bias_grad=True``: that routes through the
-    unfused path, whose autodiff produces the bias gradient.  Returns
-    (B,H,Sq,D) in the input dtype.
+    attention mask — non-trainable by default, zero cotangent on the flash
+    path).  For a *trainable* bias (e.g. relative position biases) pass
+    ``bias_grad=True``: the flash path then runs a dedicated dbias kernel
+    (≙ the reference's self_attn_bias fused backward); the jnp fallback
+    differentiates naturally.  Arbitrary Sq/Sk are supported on the flash
+    path by padding to the next tileable size with padded keys masked out
+    (one corner excepted — see ``_pallas_eligible``).  Returns (B,H,Sq,D)
+    in the input dtype.
     """
     from apex_tpu.amp.lists import amp_cast
 
@@ -194,19 +218,23 @@ def flash_attention(
         # (whose softmax would NaN on a fully--inf row) share semantics:
         # a fully-masked row yields a uniform average of V on both paths.
         bias = jnp.maximum(bias, _pallas.MASK_VALUE)
-    if (bias is not None and bias_grad) or not _pallas_eligible(
-        q, k, v, dropout_p
-    ):
+    if not _pallas_eligible(q, k, v, dropout_p, causal):
         return mha_reference(
             q, k, v, bias, causal=causal, scale=scale,
             dropout_p=dropout_p, dropout_rng=dropout_rng,
         )
 
     b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    pad_q, pad_k = _seq_pad(sq), _seq_pad(sk)
     qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
     bias_f = None
     if bias is not None:
-        sk = k.shape[-2]
         bb, bh_, bsq, bsk = bias.shape
         if bsk != sk:
             bias = jnp.broadcast_to(bias, (bb, bh_, bsq, sk))
@@ -221,13 +249,35 @@ def flash_attention(
             bias_f = jnp.broadcast_to(bias, (b, h, bsq, sk)).reshape(
                 b * h, bsq, sk
             )
-        # The flash VJP returns a zero cotangent for bias (it is the
-        # reference's non-trainable mask); stop_gradient makes that
-        # explicit so a trainable bias reaching this path fails loudly in
-        # tests (zero grad) rather than appearing shape-dependent.
-        bias_f = jax.lax.stop_gradient(bias_f)
-    o = _flash(qf, kf, vf, bias_f, scale, causal)
-    return o[..., :d].reshape(b, h, sq, d)
+        if not bias_grad:
+            # Zero cotangent on this path; stop_gradient makes that
+            # explicit so an unintended trainable bias fails loudly in
+            # tests (zero grad) rather than appearing shape-dependent.
+            bias_f = jax.lax.stop_gradient(bias_f)
+        # Padded keys are masked at PAD_VALUE — strictly below the user
+        # bias's MASK_VALUE clamp, so a row whose real keys are ALL masked
+        # still averages V over the real keys only (padded keys underflow
+        # out of its softmax).  Padded q rows (sliced off below) get zero
+        # bias rows.  Both pads sit OUTSIDE the custom VJP, so autodiff
+        # slices the dbias back to the user's shape.
+        if pad_k:
+            bias_f = jnp.pad(
+                bias_f, ((0, 0), (0, 0), (0, pad_k)),
+                constant_values=_pallas.PAD_VALUE,
+            )
+        if bsq != 1 and pad_q:
+            bias_f = jnp.pad(bias_f, ((0, 0), (0, pad_q), (0, 0)))
+    elif pad_k:
+        # No user bias but padded keys: mask them via the cheap RS=1, G=1
+        # key-padding row (never materializes an (Sq, Sk) matrix).
+        bias_f = jnp.concatenate(
+            [
+                jnp.zeros((sk,), jnp.float32),
+                jnp.full((pad_k,), _pallas.PAD_VALUE, jnp.float32),
+            ]
+        ).reshape(1, 1, sk + pad_k)
+    o = _flash(qf, kf, vf, bias_f, scale, causal, sk - sq, bias_grad)
+    return o[:, :sq, :d].reshape(b, h, sq, d)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -273,7 +323,13 @@ def flash_attention_with_lse(q, k, v, *, causal=False, scale=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     b, h, sq, d = q.shape
-    if _pallas_eligible(q, k, v, 0.0):
+    # Aligned shapes only: the lse variant has no bias plumbing, so padded
+    # keys could not be masked out (ring attention's shards are aligned).
+    if (
+        not _seq_pad(sq)
+        and not _seq_pad(k.shape[-2])
+        and _pallas_eligible(q, k, v, 0.0, causal)
+    ):
         qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
         o, lse = _flash_lse(qf, kf, vf, scale, causal)
         return (

@@ -41,6 +41,13 @@ from apex_tpu.ops._dispatch import pallas_interpret
 # Large negative finite (not -inf: keeps exp() well-defined in f32 after the
 # running-max subtraction, same trick as the reference's softmax kernels).
 MASK_VALUE = -1e9
+# Strictly below MASK_VALUE: what padded-to-tile key columns carry.  A row
+# whose REAL keys are all masked at MASK_VALUE then still softmaxes to a
+# uniform average over the real keys only — exp(PAD_VALUE - MASK_VALUE)
+# underflows to exactly 0 — matching the unpadded reference.  The kernels'
+# defense clamp floors at PAD_VALUE (not MASK_VALUE) so the distinction
+# survives into the score matrix.
+PAD_VALUE = -1.5e9
 
 _LANES = 128
 
@@ -162,11 +169,12 @@ def _fwd_kernel(
         if bias_ref is not None:
             # Defense-in-depth clamp (the public API pre-clamps): a -inf
             # bias would pin m_new at -inf and alpha = exp(-inf - -inf) =
-            # NaN would poison the whole row.  Clamped, the finite-
-            # MASK_VALUE invariant below holds for direct flash_fwd callers
-            # too.  bias_ref[0] is (bq, bk) or (1, bk) (key-padding row);
-            # broadcasting covers both.
-            s = s + jnp.maximum(bias_ref[0].astype(jnp.float32), MASK_VALUE)
+            # NaN would poison the whole row.  Clamped, the finite-value
+            # invariant below holds for direct flash_fwd callers too.  The
+            # floor is PAD_VALUE (< MASK_VALUE) so padded key columns stay
+            # strictly below masked real keys.  bias_ref[0] is (bq, bk) or
+            # (1, bk) (key-padding row); broadcasting covers both.
+            s = s + jnp.maximum(bias_ref[0].astype(jnp.float32), PAD_VALUE)
         if causal:
             s = jnp.where(
                 _causal_mask_block(i, j, bq, bk, offset), s, MASK_VALUE
@@ -203,13 +211,21 @@ def _fwd_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
+    jax.jit,
+    static_argnames=("scale", "causal", "block_q", "block_k", "causal_offset"),
 )
-def flash_fwd(q, k, v, bias, *, scale, causal, block_q=None, block_k=None):
+def flash_fwd(
+    q, k, v, bias, *, scale, causal, block_q=None, block_k=None,
+    causal_offset=None,
+):
     """Returns (o, lse).  q (BH,Sq,D), k/v (BH,Sk,D).
 
     lse is f32 (BH, Sq, 128) — the row logsumexp broadcast across a lane
     dim so its blocks are TPU-tileable; consumers read lane 0.
+
+    ``causal_offset`` overrides the bottom-right alignment offset
+    (default ``Sk - Sq``) — callers that pad Sq/Sk to tile multiples pass
+    the UNPADDED ``sk - sq`` so valid rows keep their original mask.
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -217,6 +233,7 @@ def flash_fwd(q, k, v, bias, *, scale, causal, block_q=None, block_k=None):
     bk = min(block_k, sk) if block_k else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
     grid = (bh, nq, nk)
+    offset = causal_offset if causal_offset is not None else sk - sq
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -229,12 +246,12 @@ def flash_fwd(q, k, v, bias, *, scale, causal, block_q=None, block_k=None):
         args.append(bias)
         kernel = functools.partial(
             _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=sk - sq, prec=_dot_precision(q.dtype),
+            offset=offset, prec=_dot_precision(q.dtype),
         )
     else:
         kernel = functools.partial(
             _fwd_kernel_nobias, scale=scale, causal=causal, bq=bq, bk=bk,
-            nk=nk, offset=sk - sq, prec=_dot_precision(q.dtype),
+            nk=nk, offset=offset, prec=_dot_precision(q.dtype),
         )
 
     return pl.pallas_call(
@@ -280,7 +297,7 @@ def _recompute_p(
     if bias_blk is not None:
         # Same -inf clamp as the forward kernel, so the recomputed p
         # matches it bit-for-bit.
-        s = s + jnp.maximum(bias_blk, MASK_VALUE)
+        s = s + jnp.maximum(bias_blk, PAD_VALUE)
     mask = None
     if causal:
         mask = _causal_mask_block(i, j, bq, bk, offset)
@@ -420,11 +437,14 @@ def _dq_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
+    jax.jit,
+    static_argnames=(
+        "scale", "causal", "block_q", "block_k", "causal_offset",
+    ),
 )
 def flash_bwd(
     q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None,
-    dlse=None,
+    dlse=None, causal_offset=None,
 ):
     """Returns (dq, dk, dv).  Recomputation backward: only lse was saved.
 
@@ -438,12 +458,19 @@ def flash_bwd(
 
     so passing ``delta - dlse`` where the kernels expect delta yields the
     dq/dk that include the lse contribution; dv = pᵀ do is lse-independent.
+
+    ``causal_offset`` serves padded-shape callers: the causal alignment
+    uses the UNPADDED geometry (default: ``sk - sq``).  The fully-masked-
+    row closed form keeps ``sk`` itself — callers never pad Sk in the
+    Sq > Sk causal geometry where it applies (``_pallas_eligible``).
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = min(block_q, sq) if block_q else _auto_block(sq, d)
     bk = min(block_k, sk) if block_k else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    offset = causal_offset if causal_offset is not None else sk - sq
+    sk_total = sk
 
     # delta_i = rowsum(do * o) — the softmax-jacobian correction term
     # (≙ the reference bwd kernels' row reduction before the ds GEMM).
@@ -468,12 +495,12 @@ def flash_bwd(
         args.append(bias)
         dkdv_kernel = functools.partial(
             _dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
+            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
         )
     else:
         dkdv_kernel = functools.partial(
             _dkdv_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
+            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
         )
     dk, dv = pl.pallas_call(
         dkdv_kernel,
@@ -508,12 +535,12 @@ def flash_bwd(
         args.append(bias)
         dq_kernel = functools.partial(
             _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
+            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
         )
     else:
         dq_kernel = functools.partial(
             _dq_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=sk - sq, prec=_dot_precision(q.dtype), sk_total=sk,
+            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
         )
     dq = pl.pallas_call(
         dq_kernel,
@@ -536,3 +563,171 @@ def _dkdv_nobias(q, k, v, do, lse, delta, dk, dv, dka, dva, **kw):
 
 def _dq_nobias(q, k, v, do, lse, delta, dq, dqa, **kw):
     _dq_kernel(q, k, v, do, lse, delta, None, dq, dqa, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Bias gradient (trainable additive bias, e.g. relative-position biases)
+# ---------------------------------------------------------------------------
+
+
+def _dbias_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, dbias_ref,
+    acc_ref,
+    *, scale, causal, bq, bk, offset, prec, sk_total, inner_total, rs1, div,
+):
+    j = pl.program_id(2)
+    t = pl.program_id(3)
+    # rs1 folds (q-block, group-member) into the inner grid dim; the full
+    # per-row case keeps the q-block as its own (parallel) grid dim.
+    i = (t // div) if rs1 else pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # dbias = ds, and ds is 0 wherever the causal where() masks — including
+    # every entry of a fully-masked row — so dead tiles stay dead here.
+    live = (
+        _causal_block_live(i, j, bq, bk, offset, include_fully_masked=False)
+        if causal
+        else True
+    )
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        bias_blk = bias_ref[0].astype(jnp.float32)
+
+        p, mask = _recompute_p(
+            q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset, prec,
+            sk_total,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        ds = p * (dp - delta)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
+        if rs1:
+            # key-padding layout: dbias row g is the sum of ds over ALL q
+            # rows of every group member — keep a broadcast row accumulator
+            acc_ref[...] += jnp.broadcast_to(
+                jnp.sum(ds, axis=0, keepdims=True), acc_ref.shape
+            )
+        else:
+            acc_ref[...] += ds
+
+    @pl.when(t == inner_total - 1)
+    def _finalize():
+        if rs1:
+            dbias_ref[...] = acc_ref[:1].astype(dbias_ref.dtype)[None]
+        else:
+            dbias_ref[...] = acc_ref[...].astype(dbias_ref.dtype)[None]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "scale", "causal", "block_q", "block_k", "causal_offset",
+    ),
+)
+def flash_dbias(
+    q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None,
+    causal_offset=None,
+):
+    """Gradient of the additive bias: dbias (same (G, RS, Sk) layout).
+
+    ≙ the reference's trainable-bias fused MHA backward (SURVEY §2.6
+    multihead_attn :: self_attn_bias additive-bias variants) — there a
+    strided-batched GEMM epilogue accumulates ds into dbias; here a third
+    recompute pass reduces ds over the bias's broadcast group:
+
+        dbias[g, r, c] = Σ_{m ∈ group g} Σ_{rows folded by RS} ds[m·.., r, c]
+
+    with ds = p · (dp − delta), exactly the dk/dv kernels' recomputation.
+    The group reduction (BH/G members, and all Sq rows when RS = 1) runs
+    in the innermost "arbitrary" grid dim accumulating in VMEM scratch, so
+    nothing larger than the bias itself ever hits HBM.
+    """
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    g, rs, _ = bias.shape
+    if bh % g:
+        raise ValueError(f"bias batch group {g} must divide BH={bh}")
+    div = bh // g
+    bq = min(block_q, sq) if block_q else _auto_block(sq, d)
+    bk = min(block_k, sk) if block_k else _auto_block(sk, d)
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    offset = causal_offset if causal_offset is not None else sk - sq
+    rs1 = rs == 1
+
+    delta_rows = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+    delta = jnp.broadcast_to(delta_rows[..., None], lse.shape)
+
+    if rs1:
+        grid = (g, 1, nk, nq * div)
+        inner_total = nq * div
+
+        def bh_idx(b, _, j, t):
+            return (b * div + t % div, t // div, 0)
+
+        def row_idx(b, _, j, t):
+            return (b * div + t % div, t // div, 0)
+
+        bias_spec = pl.BlockSpec((1, 1, bk), lambda b, _, j, t: (b, 0, j))
+        out_spec = pl.BlockSpec((1, 1, bk), lambda b, _, j, t: (b, 0, j))
+        out_shape = jax.ShapeDtypeStruct((g, 1, sk), bias.dtype)
+        acc_shape = pltpu.VMEM((8, bk), jnp.float32)
+    else:
+        grid = (g, nq, nk, div)
+        inner_total = div
+
+        def bh_idx(b, i, j, t):
+            return (b * div + t, i, 0)
+
+        def row_idx(b, i, j, t):
+            return (b * div + t, i, 0)
+
+        bias_spec = pl.BlockSpec((1, bq, bk), lambda b, i, j, t: (b, i, j))
+        out_spec = pl.BlockSpec((1, bq, bk), lambda b, i, j, t: (b, i, j))
+        out_shape = jax.ShapeDtypeStruct((g, sq, sk), bias.dtype)
+        acc_shape = pltpu.VMEM((bq, bk), jnp.float32)
+
+    def k_idx(b, i, j, t):
+        return ((b * div + (t % div if rs1 else t)), j, 0)
+
+    kernel = functools.partial(
+        _dbias_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        offset=offset, prec=_dot_precision(q.dtype), sk_total=sk,
+        inner_total=inner_total, rs1=rs1, div=div,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), bh_idx),
+            pl.BlockSpec((1, bk, d), k_idx),
+            pl.BlockSpec((1, bk, d), k_idx),
+            pl.BlockSpec((1, bq, d), bh_idx),
+            pl.BlockSpec((1, bq, _LANES), row_idx),
+            pl.BlockSpec((1, bq, _LANES), row_idx),
+            bias_spec,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        scratch_shapes=[acc_shape],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary"
+            ),
+        ),
+        interpret=pallas_interpret(),
+    )(q, k, v, do, lse, delta, bias)

@@ -191,6 +191,145 @@ def test_per_batch_full_bias_grouped(force_pallas):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "bias_shape",
+    [
+        (1, 1, 128, 128),   # G=1,  RS=Sq (shared relative-position bias)
+        (2, 1, 128, 128),   # G=B,  RS=Sq
+        (2, 2, 128, 128),   # G=BH, RS=Sq (per-head bias)
+        (1, 2, 128, 128),   # broadcast B -> G=BH with B-sum unbroadcast
+        (1, 1, 1, 128),     # G=1,  RS=1  (shared key bias row)
+        (2, 1, 1, 128),     # G=B,  RS=1  (key-padding-style trainable)
+        (2, 2, 1, 128),     # G=BH, RS=1
+    ],
+)
+@pytest.mark.parametrize("causal", [False, True])
+def test_trainable_bias_grad_matches_reference(
+    force_pallas, bias_shape, causal
+):
+    """dbias through the flash path (dedicated dbias kernel) vs the jnp
+    composition, across every (G, RS) bias-group layout (VERDICT r2 #3;
+    ≙ the reference's self_attn_bias additive-bias backward)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(20), b=2, h=2, sq=128, sk=128)
+    bias = jax.random.normal(jax.random.PRNGKey(21), bias_shape) * 0.3
+
+    def loss_fused(bias, q):
+        return jnp.sum(
+            flash_attention(q, k, v, bias, causal=causal, bias_grad=True)
+            ** 2
+        )
+
+    def loss_ref(bias, q):
+        return jnp.sum(mha_reference(q, k, v, bias, causal=causal) ** 2)
+
+    db_f, dq_f = jax.grad(loss_fused, argnums=(0, 1))(bias, q)
+    db_r, dq_r = jax.grad(loss_ref, argnums=(0, 1))(bias, q)
+    assert db_f.shape == bias.shape
+    np.testing.assert_allclose(db_f, db_r, atol=5e-4, rtol=5e-4)
+    np.testing.assert_allclose(dq_f, dq_r, atol=5e-4, rtol=5e-4)
+    # the cotangent is genuinely nonzero — the parity is not vacuous
+    assert float(jnp.max(jnp.abs(db_f))) > 1e-6
+
+
+def test_trainable_bias_multiblock(force_pallas):
+    """dbias with a multi-block grid (Sq=Sk=256, blocks of 128) exercises
+    the scratch accumulation across the inner group dim."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(22), b=2, h=2, sq=256, sk=256)
+    bias = jax.random.normal(jax.random.PRNGKey(23), (1, 2, 256, 256)) * 0.3
+
+    db_f = jax.grad(
+        lambda b_: jnp.sum(
+            flash_attention(q, k, v, b_, causal=True, bias_grad=True) ** 2
+        )
+    )(bias)
+    db_r = jax.grad(
+        lambda b_: jnp.sum(mha_reference(q, k, v, b_, causal=True) ** 2)
+    )(bias)
+    np.testing.assert_allclose(db_f, db_r, atol=5e-4, rtol=5e-4)
+
+
+def test_nontrainable_bias_zero_grad_on_flash_path(force_pallas):
+    """Default (bias_grad=False) keeps the documented zero-cotangent
+    contract on the flash path."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(24), b=1, h=1)
+    bias = jax.random.normal(jax.random.PRNGKey(25), (1, 1, 128, 128))
+    db = jax.grad(
+        lambda b_: jnp.sum(flash_attention(q, k, v, b_) ** 2)
+    )(bias)
+    np.testing.assert_allclose(np.asarray(db), 0.0)
+
+
+@pytest.mark.parametrize("sq,sk", [(100, 100), (1000, 1000), (333, 259)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_arbitrary_seq_kernel_parity(force_pallas, sq, sk, causal):
+    """Arbitrary (non-tile-multiple) S runs the kernel via padding with
+    masked keys (VERDICT r2 #4) and matches the unfused reference."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(30), b=1, h=2, sq=sq, sk=sk)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("sq,sk", [(100, 100), (333, 259)])
+def test_arbitrary_seq_grads_parity(force_pallas, sq, sk):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(31), b=1, h=1, sq=sq, sk=sk)
+    gf = jax.grad(
+        lambda q_, k_, v_: jnp.sum(flash_attention(q_, k_, v_) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q_, k_, v_: jnp.sum(mha_reference(q_, k_, v_) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_arbitrary_seq_with_bias_parity(force_pallas):
+    """User bias + padding compose: padded key columns stay masked, bias
+    cotangent keeps the user's shape."""
+    sq = sk = 100
+    q, k, v = _rand_qkv(jax.random.PRNGKey(32), b=2, h=2, sq=sq, sk=sk)
+    bias = jax.random.normal(jax.random.PRNGKey(33), (2, 1, sq, sk)) * 0.3
+    out = flash_attention(q, k, v, bias)
+    ref = mha_reference(q, k, v, bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    db_f = jax.grad(
+        lambda b_: jnp.sum(
+            flash_attention(q, k, v, b_, bias_grad=True) ** 2
+        )
+    )(bias)
+    db_r = jax.grad(lambda b_: jnp.sum(mha_reference(q, k, v, b_) ** 2))(
+        bias
+    )
+    assert db_f.shape == bias.shape
+    np.testing.assert_allclose(db_f, db_r, atol=5e-4, rtol=5e-4)
+
+
+def test_fully_masked_row_with_padded_keys(force_pallas):
+    """A batch row whose key-padding bias masks EVERY real key, at an Sk
+    that needs tile padding: the output must average V over the REAL keys
+    (padded keys sit at PAD_VALUE < MASK_VALUE and underflow out), matching
+    the unpadded reference."""
+    sq = sk = 100  # pads to 104
+    q, k, v = _rand_qkv(jax.random.PRNGKey(35), b=2, h=1, sq=sq, sk=sk)
+    bias = np.zeros((2, 1, 1, sk), np.float32)
+    bias[1] = -np.inf  # batch 1: all real keys masked
+    bias = jnp.asarray(bias)
+    out = flash_attention(q, k, v, bias)
+    ref = mha_reference(q, k, v, jnp.maximum(bias, -1e9))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_causal_short_keys_unaligned_falls_back(force_pallas):
+    """The one documented jnp corner: causal, Sq > Sk, Sk needs padding —
+    fully-masked rows average V over the REAL Sk."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(34), b=1, h=1, sq=100, sk=50)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 class TestFlashAttentionWithLse:
     """flash_attention_with_lse: (o, lse) values AND the dlse backward
     (the ring-attention merge differentiates through lse)."""
