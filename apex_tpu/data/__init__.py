@@ -20,6 +20,7 @@ can be expressed as ``seq_len`` = record length.
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -61,13 +62,40 @@ def synthetic_token_corpus(
     ids land in ``[floor, vocab_size)``.  Used by the examples when no
     ``--data`` file is given.
     """
+    if vocab_size > 2**16:
+        raise ValueError(
+            f"vocab_size {vocab_size} exceeds the uint16 token format "
+            "(ids would silently truncate); use a wider-dtype corpus"
+        )
     path = os.fspath(path)
-    if not os.path.exists(path):
-        rng = np.random.default_rng(seed)
-        toks = floor + (rng.zipf(zipf_a, size=num_tokens) % (vocab_size - floor))
-        tmp = f"{path}.{os.getpid()}.tmp"
-        write_token_file(tmp, toks.astype(np.uint16))
-        os.replace(tmp, path)
+    meta_path = f"{path}.meta.json"
+    meta = {
+        "vocab_size": vocab_size, "num_tokens": num_tokens,
+        "floor": floor, "zipf_a": zipf_a, "seed": seed,
+    }
+    # The cache key is the full generation-parameter set, recorded in a
+    # sidecar (so explicit caller-chosen paths keep working).  A corpus
+    # file WITHOUT a sidecar (legacy cache, or a token file the user put
+    # at the cache path themselves) is reused as-is — the pre-sidecar
+    # contract; only a sidecar that parses and disagrees triggers
+    # regeneration.
+    if os.path.exists(path):
+        try:
+            with open(meta_path) as f:
+                recorded = json.load(f)
+        except (OSError, ValueError):
+            return path
+        if recorded == meta:
+            return path
+    rng = np.random.default_rng(seed)
+    toks = floor + (rng.zipf(zipf_a, size=num_tokens) % (vocab_size - floor))
+    tmp = f"{path}.{os.getpid()}.tmp"
+    write_token_file(tmp, toks.astype(np.uint16))
+    meta_tmp = f"{meta_path}.{os.getpid()}.tmp"
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+    os.replace(meta_tmp, meta_path)
     return path
 
 
@@ -243,9 +271,17 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
-        if self._stop.is_set():
-            raise StopIteration
-        item = self._q.get()
+        # Stop-aware polling get, mirroring _put: an untimed get could hang
+        # forever if close() (from another thread) drains the sentinel out
+        # from under us.
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                continue
         if item is self._DONE:
             # terminal: the worker exits after one sentinel — record the
             # state so further next() calls don't block on an empty queue
@@ -303,9 +339,14 @@ def bert_mlm_batches(
     )
     for tokens in src:
         ids = tokens.astype(np.int32)
+        # Full-64-bit (seed, step) mix: golden-ratio affine map is injective
+        # in step for a fixed seed and spreads seeds across the whole state
+        # space (a shifted-XOR scheme would alias once step exceeded the
+        # shift width).
+        mix = (seed * 0x9E3779B97F4A7C15 + step) & 0xFFFFFFFFFFFFFFFF
         masked, labels = _native.mlm_mask_batch(
             ids,
-            (seed << 20) ^ step,
+            mix,
             mask_prob=mask_prob,
             mask_id=mask_id,
             vocab_size=vocab_size,

@@ -110,6 +110,12 @@ class _ParallelState:
     # 1F1B scheduler, mirroring the reference's module-global
     # (parallel_state.py :: set_virtual_pipeline_model_parallel_rank).
     virtual_pipeline_model_parallel_rank: Optional[int] = None
+    # SP partial-grad param marks live ON the state object so that
+    # destroy/initialize cycles (and thus different models) can never
+    # share marks (advisor r2: process-global registry cross-contamination).
+    sequence_parallel_param_paths: set = dataclasses.field(
+        default_factory=set
+    )
 
 
 _STATE: Optional[_ParallelState] = None
@@ -245,6 +251,9 @@ def initialize_model_parallel(
             0 if virtual_pipeline_model_parallel_size is not None else None
         ),
     )
+    # Fresh mesh epoch ⇒ fresh SP registry: drop any meshless-era marks so
+    # they cannot bleed into this mesh's models.
+    _SEQUENCE_PARALLEL_PARAM_PATHS.clear()
     return mesh
 
 
@@ -452,24 +461,36 @@ def destroy_model_parallel() -> None:
 # modules register the param's tree path at trace time instead, and
 # ``allreduce_sequence_parallel_gradients`` (tensor_parallel.mappings)
 # psums exactly the registered paths.
+#
+# Scoping: marks are stored on the live ``_ParallelState`` when a mesh is
+# initialized — destroy/initialize cycles start with a clean registry, so
+# two models traced across cycles can never cross-contaminate.  The
+# module-level set only backs the meshless case (tp=1 unit tests) and is
+# cleared on both destroy AND initialize.
 # ---------------------------------------------------------------------------
 
 _SEQUENCE_PARALLEL_PARAM_PATHS: set = set()
+
+
+def _sp_registry() -> set:
+    if _STATE is not None:
+        return _STATE.sequence_parallel_param_paths
+    return _SEQUENCE_PARALLEL_PARAM_PATHS
 
 
 def register_sequence_parallel_param(path) -> None:
     """Mark the param at ``path`` (module path + param name, a tuple of
     strings, excluding the "params" collection key) as having tp-partial
     gradients under sequence parallelism."""
-    _SEQUENCE_PARALLEL_PARAM_PATHS.add(tuple(str(p) for p in path))
+    _sp_registry().add(tuple(str(p) for p in path))
 
 
 def sequence_parallel_param_paths() -> frozenset:
-    return frozenset(_SEQUENCE_PARALLEL_PARAM_PATHS)
+    return frozenset(_sp_registry())
 
 
 def clear_sequence_parallel_params() -> None:
-    _SEQUENCE_PARALLEL_PARAM_PATHS.clear()
+    _sp_registry().clear()
 
 
 # ---------------------------------------------------------------------------

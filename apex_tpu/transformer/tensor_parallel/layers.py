@@ -233,6 +233,12 @@ class RowParallelLinear(nn.Module):
     fwd: local GEMM then all-reduce (or reduce-scatter along seq under SP).
     ``input_is_parallel``: input already carries this rank's shard of the
     last dim (the usual case after a ColumnParallelLinear).
+
+    SP + ``skip_bias_add`` contract: the bias is registered for the
+    sequence-parallel gradient psum, so the caller MUST apply the returned
+    bias inside the sequence-sharded region (the Megatron
+    bias-dropout-add pattern).  Applying it after a gather back to full
+    sequence would double-count its gradient tp-fold.
     """
 
     input_size: int
@@ -277,7 +283,14 @@ class RowParallelLinear(nn.Module):
         )
         if bias is not None and self.sequence_parallel_enabled:
             # bias is added AFTER the reduce-scatter, i.e. inside the SP
-            # region: tp-replicated param, per-rank S/tp-partial gradient
+            # region: tp-replicated param, per-rank S/tp-partial gradient.
+            # This registration covers skip_bias_add=True as well, which
+            # CONTRACTS the caller to apply the returned bias inside the
+            # SP region (the Megatron bias-dropout-add convention; the
+            # mirrored reference marks param.sequence_parallel there too).
+            # Adding it outside the SP region (e.g. after a gather) would
+            # make the psum overcount that grad tp-fold — see the class
+            # docstring.
             ps.register_sequence_parallel_param(self.path + ("bias",))
         if world > 1 and not self.input_is_parallel:
             x = scatter_to_tensor_model_parallel_region(x, self.axis_name)

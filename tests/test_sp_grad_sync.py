@@ -401,3 +401,85 @@ def test_registry_cleared_on_destroy():
     assert ("a", "b") in ps.sequence_parallel_param_paths()
     ps.destroy_model_parallel()
     assert not ps.sequence_parallel_param_paths()
+
+
+def test_registry_scoped_to_mesh_epoch():
+    """Marks made under one mesh die with it; marks made before a mesh
+    init don't leak into it (advisor r2: cross-model contamination)."""
+    ps.destroy_model_parallel()
+    ps.register_sequence_parallel_param(("meshless", "w"))
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=1)
+    assert not ps.sequence_parallel_param_paths(), (
+        "meshless-era mark leaked into the fresh mesh epoch"
+    )
+    ps.register_sequence_parallel_param(("model_a", "scale"))
+    assert ("model_a", "scale") in ps.sequence_parallel_param_paths()
+    ps.destroy_model_parallel()
+    assert not ps.sequence_parallel_param_paths(), (
+        "mark survived destroy_model_parallel"
+    )
+
+
+def test_strict_raises_on_stale_registry():
+    """A registered path absent from the grad tree (renamed model / stale
+    registry) must raise, not silently skip the psum (VERDICT r2 item 6)."""
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    ps.register_sequence_parallel_param(("old_name", "scale"))
+    grads = {"params": {"new_name": {"scale": jnp.ones((4,))}}}
+
+    def f(grads):
+        return allreduce_sequence_parallel_gradients(grads)
+
+    with pytest.raises(ValueError, match="old_name/scale"):
+        jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                check_vma=False,
+            )
+        )(grads)
+    ps.destroy_model_parallel()
+
+
+def test_strict_false_allows_partial_tree():
+    """strict=False keeps the old permissive behavior for intentionally
+    partial trees (e.g. one pipeline stage's grads)."""
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    ps.register_sequence_parallel_param(("other_model", "scale"))
+    ps.register_sequence_parallel_param(("mine", "scale"))
+    grads = {"params": {"mine": {"scale": jnp.ones((4,))}}}
+
+    def f(grads):
+        return allreduce_sequence_parallel_gradients(grads, strict=False)
+
+    out = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+        )
+    )(grads)
+    # matched path is psum'd over tp=2
+    np.testing.assert_allclose(
+        np.asarray(out["params"]["mine"]["scale"]), 2.0 * np.ones((4,))
+    )
+    ps.destroy_model_parallel()
+
+
+def test_reregistration_on_retrace():
+    """destroy → re-initialize → re-trace repopulates the registry (the
+    lifecycle the docstring contracts): same model traced in a second mesh
+    epoch syncs correctly again."""
+    cfg = GptConfig(sequence_parallel=True, rotary=False, **GPT_KW)
+    m = GptModel(cfg)
+    ids = _ids()
+
+    def f(key, ids):
+        params = m.init(key, ids)
+        _, grads = jax.value_and_grad(lambda p: gpt_lm_loss(p, m, ids))(
+            params
+        )
+        grads = allreduce_sequence_parallel_gradients(grads)
+        return grads["params"]["ln_f"]["scale"]
+
+    first = _run_tp2(f, jax.random.PRNGKey(0), ids)
+    assert not ps.sequence_parallel_param_paths()  # epoch ended clean
+    second = _run_tp2(f, jax.random.PRNGKey(0), ids)
+    np.testing.assert_allclose(np.asarray(first), np.asarray(second))
