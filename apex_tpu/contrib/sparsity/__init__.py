@@ -10,9 +10,10 @@ consecutive weights **along the matmul reduction (input) dim**, keep the
 2 of largest magnitude.  Torch Linear weights are ``(out, in)`` so the
 reference prunes the last axis; flax kernels are ``(in, out)`` so here the
 input dim is axis ``-2`` — :func:`create_mask` takes the axis explicitly
-and :class:`ASP` picks it from the leaf name.  Channel-permutation search
-(the reference's accuracy-preserving trick) is out of scope — its kernels
-exist purely to make GPU sparse-TC constraints cheaper to satisfy.
+and :class:`ASP` picks it from the leaf name.  The accuracy-preserving
+channel-permutation search (``permutation_lib.py``) ships as
+:mod:`.permutation` / :meth:`ASP.compute_permutations` — a greedy
+best-swap search, the reference's CPU strategy minus the CUDA speedups.
 """
 
 from __future__ import annotations
@@ -22,7 +23,21 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["create_mask", "ASP"]
+from apex_tpu.contrib.sparsity.permutation import (  # noqa: F401
+    apply_permutation,
+    invert_permutation,
+    permutation_retained_magnitude,
+    search_channel_permutation,
+)
+
+__all__ = [
+    "create_mask",
+    "ASP",
+    "search_channel_permutation",
+    "permutation_retained_magnitude",
+    "apply_permutation",
+    "invert_permutation",
+]
 
 PyTree = Any
 
@@ -114,3 +129,42 @@ class ASP:
         """One-shot: returns (pruned_params, masks)."""
         masks = ASP.compute_sparse_masks(params, pattern=pattern)
         return ASP.apply_masks(params, masks), masks
+
+    @staticmethod
+    def compute_permutations(
+        params: PyTree,
+        allowed: Optional[Callable[[str, Any], bool]] = None,
+        max_swaps: int = 10_000,
+    ) -> PyTree:
+        """≙ permutation_lib's search step: per prunable leaf, a channel
+        permutation of the input dim that the 2:4 mask will retain more
+        magnitude under (greedy best-swap; ``after >= before`` always).
+
+        Returns a pytree matching ``params`` whose prunable leaves hold
+        ``{"perm": ndarray, "axis": int, "before": float, "after": float}``
+        and other leaves ``None``.  Apply with
+        ``apply_permutation(leaf, entry["perm"], entry["axis"])`` — and,
+        to preserve the network function, apply the SAME permutation to
+        the producing layer's output channels (the reference walks the
+        torch graph to do this; a functional tree needs the caller to
+        name the pairing).
+        """
+        allowed = allowed or _default_allowed
+        flat = jax.tree_util.tree_leaves_with_path(params)
+
+        def perm_for(path, leaf):
+            name = jax.tree_util.keystr(path)
+            if not allowed(name, leaf):
+                return None
+            axis = _input_axis(name)
+            perm, before, after = search_channel_permutation(
+                leaf, axis=axis, max_swaps=max_swaps
+            )
+            return {
+                "perm": perm, "axis": axis,
+                "before": before, "after": after,
+            }
+
+        perms = [perm_for(p, l) for p, l in flat]
+        treedef = jax.tree_util.tree_structure(params)
+        return jax.tree_util.tree_unflatten(treedef, perms)

@@ -1,0 +1,153 @@
+"""Channel-permutation search for 2:4 sparsity — ≙ ``apex/contrib/
+sparsity/permutation_lib.py`` + ``csrc/permutation_search/`` (the
+"Channel Permutations for N:M Sparsity" accuracy-preserving step).
+
+The reference searches for a permutation of a weight's input channels
+that maximizes the magnitude RETAINED by the 2:4 mask: channels that
+land in the same group of 4 compete for the 2 kept slots, so grouping
+channels whose large entries fall on different rows preserves more
+magnitude.  Its CUDA kernels accelerate a bounded-exhaustive "stripe
+group" search; the documented CPU fallback is a greedy swap search —
+which is what this pure-numpy implementation provides (functional
+parity; the CUDA speedups exist purely to make big searches cheap).
+
+Algorithm (greedy best-swap):
+
+1. quality(g) = Σ_rows top2(|W|[row, channels of g]) for each group of 4.
+2. For every (channel i, channel j) in different groups, the gain of
+   swapping them is computable from only the two affected groups; all
+   candidate gains are evaluated vectorized via a (G, 4, C) replacement-
+   quality tensor.
+3. Apply the best positive swap, update the two affected groups'
+   entries, repeat until no swap helps (or ``max_swaps``).
+
+Like the reference, the permutation only preserves the network function
+if the producing layer's OUTPUT channels are permuted to match —
+``apply_permutation`` permutes the pruned weight's input axis, and the
+caller applies the same permutation to whatever feeds that axis (the
+reference automates this with torch-graph propagation; a functional
+param tree has no graph to walk, so the pairing is explicit here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "search_channel_permutation",
+    "permutation_retained_magnitude",
+    "apply_permutation",
+    "invert_permutation",
+]
+
+
+def _to_2d(weight, axis: int) -> np.ndarray:
+    w = np.moveaxis(np.asarray(weight, np.float32), axis, -1)
+    return np.abs(w.reshape(-1, w.shape[-1]))
+
+
+def permutation_retained_magnitude(weight, perm, axis: int = -1) -> float:
+    """Σ|w| kept by the 2:4 mask after permuting channels of ``axis``."""
+    mag = _to_2d(weight, axis)[:, np.asarray(perm)]
+    r, c = mag.shape
+    groups = mag.reshape(r, c // 4, 4)
+    top2 = np.sort(groups, axis=-1)[..., 2:]
+    return float(top2.sum())
+
+
+def _group_quality(mag: np.ndarray, channels: np.ndarray) -> np.ndarray:
+    """(G,) retained magnitude per group; ``channels`` is (G, 4)."""
+    g = mag[:, channels]                      # (R, G, 4)
+    return np.sort(g, axis=-1)[..., 2:].sum(axis=(0, 2))
+
+
+def _replacement_quality(mag: np.ndarray, channels: np.ndarray) -> np.ndarray:
+    """(G, 4, C) quality of group g with slot s replaced by channel x."""
+    r, c = mag.shape
+    g_count = channels.shape[0]
+    out = np.empty((g_count, 4, c), np.float32)
+    for g in range(g_count):
+        for s in range(4):
+            keep = [channels[g, t] for t in range(4) if t != s]
+            fixed = mag[:, keep]              # (R, 3)
+            cand = np.concatenate(
+                [np.broadcast_to(fixed[:, None, :], (r, c, 3)),
+                 mag[:, :, None]], axis=-1,
+            )                                  # (R, C, 4)
+            out[g, s] = np.sort(cand, axis=-1)[..., 2:].sum(axis=(0, 2))
+    return out
+
+
+def search_channel_permutation(
+    weight,
+    axis: int = -1,
+    max_swaps: int = 10_000,
+    min_gain: float = 1e-6,
+) -> Tuple[np.ndarray, float, float]:
+    """Greedy best-swap search.  Returns ``(perm, before, after)`` where
+    ``before``/``after`` are the retained magnitudes of the identity and
+    found permutations (``after >= before`` always).
+    """
+    mag = _to_2d(weight, axis)
+    r, c = mag.shape
+    if c % 4:
+        raise ValueError(f"channel count ({c}) must be divisible by 4")
+    g_count = c // 4
+    channels = np.arange(c).reshape(g_count, 4)
+    quality = _group_quality(mag, channels)
+    before = float(quality.sum())
+    if g_count < 2:
+        return np.arange(c), before, before
+
+    repl = _replacement_quality(mag, channels)
+
+    # gain of swapping (g1, s1) <-> (g2, s2):
+    #   repl[g1, s1, ch(g2, s2)] + repl[g2, s2, ch(g1, s1)]
+    #   - quality[g1] - quality[g2]
+    def best_swap():
+        ch_flat = channels.reshape(-1)                      # (G*4,)
+        q_flat = np.repeat(quality, 4)                      # (G*4,)
+        gain_to = repl.reshape(g_count * 4, c)[:, ch_flat]  # (G4, G4)
+        gains = gain_to + gain_to.T - q_flat[:, None] - q_flat[None, :]
+        # same-group swaps are no-ops; mask them
+        gid = np.repeat(np.arange(g_count), 4)
+        gains[gid[:, None] == gid[None, :]] = -np.inf
+        idx = int(np.argmax(gains))
+        a, b = divmod(idx, g_count * 4)
+        return float(gains[a, b]), a, b
+
+    swaps = 0
+    while swaps < max_swaps:
+        gain, a, b = best_swap()
+        if gain <= min_gain:
+            break
+        g1, s1 = divmod(a, 4)
+        g2, s2 = divmod(b, 4)
+        channels[g1, s1], channels[g2, s2] = (
+            channels[g2, s2], channels[g1, s1],
+        )
+        for g in (g1, g2):
+            quality[g] = _group_quality(mag, channels[g : g + 1])[0]
+            repl[g] = _replacement_quality(mag, channels[g : g + 1])[0]
+        swaps += 1
+
+    perm = channels.reshape(-1)
+    after = float(quality.sum())
+    return perm, before, after
+
+
+def apply_permutation(weight, perm, axis: int = -1):
+    """Permute ``axis`` of ``weight`` by ``perm`` (numpy or jax array in,
+    same type out via take)."""
+    import jax.numpy as jnp
+
+    return jnp.take(jnp.asarray(weight), jnp.asarray(perm), axis=axis)
+
+
+def invert_permutation(perm) -> np.ndarray:
+    perm = np.asarray(perm)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return inv

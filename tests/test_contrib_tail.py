@@ -325,6 +325,60 @@ class TestSparsity:
         w = np.asarray(pruned["weight"]).reshape(32, 16, 4)
         assert (np.count_nonzero(w, axis=-1) <= 2).all()
 
+    def test_permutation_search_improves_retained_magnitude(self):
+        """≙ permutation_lib: the greedy channel-permutation must retain
+        MORE magnitude under the 2:4 mask than identity on a random
+        matrix (VERDICT r2 item 9's done-criterion), and the permuted
+        mask must stay a valid 2:4 pattern."""
+        from apex_tpu.contrib.sparsity import (
+            create_mask,
+            permutation_retained_magnitude,
+            search_channel_permutation,
+        )
+
+        w = jax.random.normal(jax.random.PRNGKey(2), (64, 64))
+        perm, before, after = search_channel_permutation(w, axis=-1)
+        assert sorted(perm.tolist()) == list(range(64))  # a permutation
+        assert after > before  # random matrices essentially always improve
+        # reported values match the independent evaluator
+        ident = permutation_retained_magnitude(w, np.arange(64), axis=-1)
+        np.testing.assert_allclose(before, ident, rtol=1e-6)
+        np.testing.assert_allclose(
+            after, permutation_retained_magnitude(w, perm, axis=-1),
+            rtol=1e-6,
+        )
+        # retained magnitude of the actual masked permuted weight agrees
+        wp = np.asarray(w)[:, perm]
+        mask = np.asarray(create_mask(jnp.asarray(wp), axis=-1))
+        np.testing.assert_allclose(
+            float(np.abs(wp * mask).sum()), after, rtol=1e-5
+        )
+
+    def test_permutation_search_flax_layout_and_tree(self):
+        """compute_permutations walks the tree, prunes axis -2 for flax
+        kernels, skips biases; apply/invert round-trips."""
+        from apex_tpu.contrib.sparsity import (
+            ASP,
+            apply_permutation,
+            invert_permutation,
+        )
+
+        params = {
+            "dense": {
+                "kernel": jax.random.normal(jax.random.PRNGKey(3), (32, 24)),
+                "bias": jnp.ones((24,)),
+            }
+        }
+        perms = ASP.compute_permutations(params)
+        entry = perms["dense"]["kernel"]
+        assert perms["dense"]["bias"] is None
+        assert entry["axis"] == -2
+        assert entry["after"] >= entry["before"]
+        k = params["dense"]["kernel"]
+        kp = apply_permutation(k, entry["perm"], axis=-2)
+        back = apply_permutation(kp, invert_permutation(entry["perm"]), axis=-2)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(k))
+
 
 class TestConvBiasRelu:
     def test_vs_compose(self):
