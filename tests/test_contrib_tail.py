@@ -435,3 +435,73 @@ class TestNaStubs:
             )
         )(x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+    def test_openfold_scatter_gather(self, eight_devices):
+        from apex_tpu.contrib.openfold import gather, scatter
+
+        mesh = ps.initialize_model_parallel()  # dp=8
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 8, 4))
+
+        def f(x):
+            local = scatter(x, "dp", dim=0)  # enter DAP: rows sharded
+            assert local.shape == (2, 8, 4)
+            return gather(local, "dp", dim=0)
+
+        out = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                check_vma=False,
+            )
+        )(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+    def test_openfold_axial_pair_stack_sharded_matches_unsharded(
+        self, eight_devices
+    ):
+        """A 2-block DAP axial pair stack (row-attn on row-sharded layout,
+        row_to_col, col-attn on col-sharded layout, col_to_row, MLP) on a
+        4-device mesh must equal the same stack run unsharded — the
+        reference dap.py's equivalence contract (VERDICT r2 item 10)."""
+        from apex_tpu.contrib.openfold import DAPAxialBlock
+
+        R, C, D, H, dap = 8, 12, 16, 4, 4
+        x = jax.random.normal(jax.random.PRNGKey(2), (R, C, D))
+        key = jax.random.PRNGKey(3)
+
+        # golden: unsharded, axis_name=None (no transitions)
+        blocks_ref = [
+            DAPAxialBlock(dim=D, heads=H, axis_name=None, name=f"b{i}")
+            for i in range(2)
+        ]
+        y_ref = x
+        params_ref = []
+        for i, blk in enumerate(blocks_ref):
+            p = blk.init(jax.random.fold_in(key, i), y_ref)
+            params_ref.append(p)
+            y_ref = blk.apply(p, y_ref)
+
+        mesh = ps.initialize_model_parallel(
+            devices=jax.devices()[:dap]
+        )  # dp=4 used as the dap axis
+
+        def f(x):
+            y = x  # enters row-sharded: (R/dap, C, D)
+            for i in range(2):
+                blk = DAPAxialBlock(
+                    dim=D, heads=H, axis_name="dp", name=f"b{i}"
+                )
+                # same init key as golden; params are R-independent
+                # (Dense/LN over D) so both inits are identical
+                p = blk.init(jax.random.fold_in(key, i), y)
+                y = blk.apply(p, y)
+            return y
+
+        y_sh = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+                check_vma=False,
+            )
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(y_sh), np.asarray(y_ref), rtol=2e-5, atol=2e-5
+        )
