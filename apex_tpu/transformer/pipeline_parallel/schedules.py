@@ -108,6 +108,7 @@ def forward_backward_pipelining_without_interleaving(
     axis_name: str = _PP,
     forward_only: bool = False,
     remat: bool = True,
+    carry_chunk: Optional[int] = None,
 ):
     """≙ fwd_bwd_pipelining_without_interleaving.py (1F1B).
 
@@ -115,6 +116,15 @@ def forward_backward_pipelining_without_interleaving(
     e.g. a ``P('pp', ...)``-sharded stacked tree).  ``batch = (inputs,
     targets)`` with leaves stacked ``(num_microbatches, ...)``; ``inputs``
     must be activation-shaped (consumed by stage 0).
+
+    ``carry_chunk=K`` bounds the backward's saved scan carries for large
+    grad-accumulation ``nm`` (docs/pipeline-schedules.md's measured O(nm)
+    slope): the tick loop becomes a two-level scan whose outer body is
+    ``jax.checkpoint``-ed, so only the ~ticks/K chunk-boundary carries are
+    saved and each chunk's K inner carries are recomputed during backward
+    — O(ticks/K + K) live carries (minimal at K ≈ √ticks) for one extra
+    forward recompute per tick.  Ticks are padded up to a K multiple;
+    padded ticks compute masked garbage exactly like bubble ticks.
     """
     inputs, targets = batch
     nm = num_microbatches
@@ -148,9 +158,20 @@ def forward_backward_pipelining_without_interleaving(
             h_next = p2p.send_forward_recv_forward(y, axis_name)
             return (h_next, losses), None
 
-        (_, losses), _ = jax.lax.scan(
-            tick, (h0, jnp.zeros((nm,), jnp.float32)), jnp.arange(ticks)
-        )
+        carry0 = (h0, jnp.zeros((nm,), jnp.float32))
+        if carry_chunk and carry_chunk > 0:
+            k = min(carry_chunk, ticks)
+            n_outer = -(-ticks // k)  # ceil; padded ticks are masked no-ops
+            ts = jnp.arange(n_outer * k).reshape(n_outer, k)
+
+            @jax.checkpoint
+            def outer(carry, ts_chunk):
+                carry, _ = jax.lax.scan(tick, carry, ts_chunk)
+                return carry, None
+
+            (_, losses), _ = jax.lax.scan(outer, carry0, ts)
+        else:
+            (_, losses), _ = jax.lax.scan(tick, carry0, jnp.arange(ticks))
         # Differentiate the LOCAL loss sum (nonzero only on the last stage):
         # grads reach earlier stages through the reversed ppermutes.  Do NOT
         # psum the differentiated scalar — under check_vma=False the psum

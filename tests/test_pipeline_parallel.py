@@ -246,6 +246,78 @@ def test_interleaved_matches_sequential(eight_devices):
         )
 
 
+@pytest.mark.parametrize("carry_chunk", [1, 3, 4, 100])
+def test_1f1b_carry_chunk_matches_sequential(eight_devices, carry_chunk):
+    """The two-level (checkpointed) tick scan is numerics-identical to the
+    flat scan for any chunk size, including non-dividing and oversized."""
+    pp = 4
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=NM, carry_chunk=carry_chunk,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[None], grads)
+        return losses, grads
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")), check_vma=False,
+        )
+    )(stacked, inputs, targets)
+    ref_losses, ref_grads = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_1f1b_carry_chunk_bounds_memory(eight_devices):
+    """At large nm, carry_chunk≈√ticks must cut XLA's temp memory vs the
+    flat scan (the O(nm) carry slope measured in docs/pipeline-schedules)."""
+    pp, nm, d = 2, 64, 64
+    mesh = ps.initialize_model_parallel(1, pp)
+    rng = np.random.RandomState(0)
+    stacked = {
+        "w": jnp.asarray(rng.randn(pp, d, d) * 0.2, jnp.float32),
+        "b": jnp.asarray(rng.randn(pp, d) * 0.1, jnp.float32),
+    }
+    inputs = jnp.asarray(rng.randn(nm, 8, d), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, 8, d), jnp.float32)
+
+    def make(chunk):
+        def run(stacked_local, inputs, targets):
+            params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+            losses, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, params, (inputs, targets),
+                num_microbatches=nm, carry_chunk=chunk,
+            )
+            return losses, jax.tree_util.tree_map(lambda v: v[None], grads)
+
+        return jax.jit(
+            jax.shard_map(
+                run, mesh=mesh, in_specs=(P("pp"), P(), P()),
+                out_specs=(P(), P("pp")), check_vma=False,
+            )
+        )
+
+    def temp_bytes(f):
+        m = f.lower(stacked, inputs, targets).compile().memory_analysis()
+        return m.temp_size_in_bytes
+
+    flat, chunked = temp_bytes(make(None)), temp_bytes(make(8))
+    assert chunked < flat, (flat, chunked)
+
+
 @pytest.mark.parametrize("pp,vpp,nm", [(2, 3, 4), (4, 2, 8), (2, 2, 2)])
 def test_interleaved_matches_sequential_configs(eight_devices, pp, vpp, nm):
     n_virtual = pp * vpp

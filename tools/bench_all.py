@@ -1,0 +1,68 @@
+"""Run every BASELINE parity config and commit-ready artifact the results.
+
+VERDICT r2 item 2: the numbers for all five BASELINE configs (plus the
+long-context attention bench) existed each round but only the headline
+made it into a committed artifact.  This wrapper runs ``bench.py
+--config all`` and writes one JSON line per emitted metric to
+``BENCH_all_r{N}.json`` at the repo root (N from --round, default 3),
+leaving bench.py's own stdout contract (one JSON line per config run)
+untouched for the driver.
+
+Run on the real chip:  python tools/bench_all.py --round 3
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument(
+        "--configs", default="all",
+        help="comma list of bench.py configs, or 'all'",
+    )
+    args = ap.parse_args()
+
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    names = (
+        ["all"] if args.configs == "all" else args.configs.split(",")
+    )
+    lines = []
+    for name in names:
+        proc = subprocess.run(
+            cmd + ["--config", name],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        sys.stderr.write(proc.stderr)
+        for ln in proc.stdout.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            print(ln, flush=True)
+            lines.append(rec)
+        if proc.returncode != 0:
+            print(
+                f"[bench_all] config {name!r} exited "
+                f"{proc.returncode}", file=sys.stderr,
+            )
+
+    out = os.path.join(REPO, f"BENCH_all_r{args.round:02d}.json")
+    with open(out, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    print(f"[bench_all] wrote {len(lines)} metric lines to {out}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
