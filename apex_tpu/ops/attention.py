@@ -8,13 +8,16 @@ Capability parity with the reference's two fused-attention families:
 
 Two numerically-identical implementations (see apex_tpu.ops._dispatch):
 
-- **jnp path** — plain composition XLA fuses; supports every feature incl.
-  attention dropout and differentiable bias; the correctness reference.
+- **jnp path** — plain composition XLA fuses; supports every feature; the
+  correctness reference.  Its dropout draws from ``jax.random`` given
+  ``dropout_rng``.
 - **Pallas path** — online-softmax flash kernel
-  (apex_tpu.ops.pallas.flash_attention), O(S) memory, used on TPU when
-  shapes are tile-friendly and dropout is off (dropout in the hot kernel is
-  deliberately unsupported: large-model training on TPU runs dropout-free,
-  and the jnp path covers parity testing of dropout semantics).
+  (apex_tpu.ops.pallas.flash_attention), O(S) memory.  Supports additive
+  bias (trainable via a dedicated dbias kernel), arbitrary seq lengths
+  (padding + key masking), and fused attention dropout (counter-based
+  in-kernel PRNG ≙ the reference's philox dropout; the mask stream
+  differs from the jnp path's ``jax.random`` — both are valid dropout,
+  deterministic given their seeds).
 
 Interface dtype rules mirror the reference: compute in f32 inside the
 kernel, outputs in the input dtype, logsumexp saved in f32.
@@ -50,8 +53,6 @@ def _seq_pad(s: int) -> int:
 
 
 def _pallas_eligible(q, k, v, dropout_p, causal=False):
-    if dropout_p > 0.0:
-        return False
     sq, sk = q.shape[-2], k.shape[-2]
     # Arbitrary S is handled by padding to the next tileable size with the
     # padded keys masked at MASK_VALUE (≙ the reference's shape-general
@@ -96,25 +97,32 @@ def _pad_head_dim(x):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, scale, causal, causal_offset, bias_grad):
-    o, _ = _flash_fwd(q, k, v, bias, scale, causal, causal_offset, bias_grad)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, seed, scale, causal, causal_offset, bias_grad,
+           dropout_p):
+    o, _ = _flash_fwd(
+        q, k, v, bias, seed, scale, causal, causal_offset, bias_grad,
+        dropout_p,
+    )
     return o
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, causal_offset, bias_grad):
+def _flash_fwd(q, k, v, bias, seed, scale, causal, causal_offset, bias_grad,
+               dropout_p):
     o, lse = _pallas.flash_fwd(
         q, k, v, bias, scale=scale, causal=causal,
-        causal_offset=causal_offset,
+        causal_offset=causal_offset, dropout_p=dropout_p, dropout_seed=seed,
     )
-    return o, (q, k, v, bias, o, lse)
+    return o, (q, k, v, bias, seed, o, lse)
 
 
-def _flash_bwd(scale, causal, causal_offset, bias_grad, res, g):
-    q, k, v, bias, o, lse = res
+def _flash_bwd(scale, causal, causal_offset, bias_grad, dropout_p, res, g):
+    import numpy as np
+
+    q, k, v, bias, seed, o, lse = res
     dq, dk, dv = _pallas.flash_bwd(
         q, k, v, o, lse, g, bias, scale=scale, causal=causal,
-        causal_offset=causal_offset,
+        causal_offset=causal_offset, dropout_p=dropout_p, dropout_seed=seed,
     )
     if bias is None:
         dbias = None
@@ -124,13 +132,16 @@ def _flash_bwd(scale, causal, causal_offset, bias_grad, res, g):
         # see pallas.flash_attention.flash_dbias.
         dbias = _pallas.flash_dbias(
             q, k, v, o, lse, g, bias, scale=scale, causal=causal,
-            causal_offset=causal_offset,
+            causal_offset=causal_offset, dropout_p=dropout_p,
+            dropout_seed=seed,
         )
     else:
         # Bias as the reference's *additive mask* — non-trainable there;
         # zero cotangent.
         dbias = jnp.zeros_like(bias)
-    return dq, dk, dv, dbias
+    # int32 seed: the cotangent for an integer primal is float0
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dbias, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -201,7 +212,10 @@ def flash_attention(
     (≙ the reference's self_attn_bias fused backward); the jnp fallback
     differentiates naturally.  Arbitrary Sq/Sk are supported on the flash
     path by padding to the next tileable size with padded keys masked out
-    (one corner excepted — see ``_pallas_eligible``).  Returns (B,H,Sq,D)
+    (one corner excepted — see ``_pallas_eligible``).  ``dropout_p`` > 0
+    with ``dropout_rng`` fuses probability dropout into the kernels
+    (counter-based PRNG, deterministic in the rng; the jnp fallback's
+    mask stream differs — both are valid dropout).  Returns (B,H,Sq,D)
     in the input dtype.
     """
     from apex_tpu.amp.lists import amp_cast
@@ -223,6 +237,16 @@ def flash_attention(
             q, k, v, bias, causal=causal, scale=scale,
             dropout_p=dropout_p, dropout_rng=dropout_rng,
         )
+    if dropout_p > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_p > 0 requires dropout_rng")
+    seed = (
+        jax.random.randint(
+            dropout_rng, (1,), jnp.iinfo(jnp.int32).min,
+            jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
+        )
+        if dropout_p > 0.0
+        else jnp.zeros((1,), jnp.int32)
+    )
 
     b, h, sq, d = q.shape
     sk = k.shape[-2]
@@ -276,7 +300,10 @@ def flash_attention(
                 jnp.full((pad_k,), _pallas.PAD_VALUE, jnp.float32),
             ]
         ).reshape(1, 1, sk + pad_k)
-    o = _flash(qf, kf, vf, bias_f, scale, causal, sk - sq, bias_grad)
+    o = _flash(
+        qf, kf, vf, bias_f, seed, scale, causal, sk - sq, bias_grad,
+        dropout_p,
+    )
     return o[:, :sq, :d].reshape(b, h, sq, d)
 
 

@@ -107,6 +107,39 @@ def _bias_spec(bias, bh, bq, bk, order):
     )
 
 
+def _dropout_keep_block(seed, bh, i, j, bq, bk, dropout_p):
+    """Deterministic keep-mask for tile (i, j) of batch-head ``bh``.
+
+    ≙ the reference's fused philox dropout (multihead_attn ``philox.cuh``/
+    ``dropout.cuh``): a counter-based PRNG keyed on (seed, bh, element
+    coordinates), so the SAME mask regenerates in every backward kernel
+    with zero state.  The hardware PRNG (pltpu.prng_*) has no interpret-
+    mode lowering, so this is a pure-uint32 murmur3-finalizer hash over
+    the element index — portable, vectorized on the VPU, and independent
+    of grid iteration order.  Keep probability = 1 - dropout_p.
+    """
+    u32 = jnp.uint32
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + u32(i * bq)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + u32(j * bk)
+    # unique element counter in the (Sq, Sk) plane (mod 2^32); key folds
+    # the batch-head index and the caller's seed
+    h = rows * u32(0x0001_0001) + cols
+    key = (
+        seed.astype(jnp.uint32)
+        + bh.astype(jnp.uint32) * u32(0x9E37_79B9)
+    )
+    h = h ^ key
+    for mix_key in (u32(0x85EB_CA6B), u32(0xC2B2_AE35)):
+        h = h ^ (h >> u32(16))
+        h = h * mix_key
+        h = h ^ (h >> u32(13))
+        h = h * u32(0x27D4_EB2F)
+        h = h ^ (h >> u32(16))
+        h = h + key
+    threshold = u32(min(int(dropout_p * 2**32), 2**32 - 1))
+    return h >= threshold
+
+
 def _causal_mask_block(i, j, bq, bk, offset):
     # Bottom-right-aligned causal mask: query row r sees keys <= r + offset
     # where offset = Sk - Sq (matches jnp.tril(..., k=sk-sq) in the
@@ -139,9 +172,11 @@ def _causal_block_live(i, j, bq, bk, offset, include_fully_masked):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, bq, bk, nk, offset, prec,
+    q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
+    *, scale, causal, bq, bk, nk, offset, prec, dropout_p,
 ):
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -186,9 +221,20 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # The softmax DENOMINATOR accumulates the full p (dropout acts on
+        # the normalized probabilities, not the row sum); only the PV
+        # contribution is masked + 1/(1-p)-rescaled — elementwise, so it
+        # commutes with the final /l normalization.
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _dropout_keep_block(
+                seed_ref[0], bh, i, j, bq, bk, dropout_p
+            )
+            p_v = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+        else:
+            p_v = p
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p_v, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -212,11 +258,14 @@ def _fwd_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "causal", "block_q", "block_k", "causal_offset"),
+    static_argnames=(
+        "scale", "causal", "block_q", "block_k", "causal_offset",
+        "dropout_p",
+    ),
 )
 def flash_fwd(
     q, k, v, bias, *, scale, causal, block_q=None, block_k=None,
-    causal_offset=None,
+    causal_offset=None, dropout_p=0.0, dropout_seed=None,
 ):
     """Returns (o, lse).  q (BH,Sq,D), k/v (BH,Sk,D).
 
@@ -226,6 +275,11 @@ def flash_fwd(
     ``causal_offset`` overrides the bottom-right alignment offset
     (default ``Sk - Sq``) — callers that pad Sq/Sk to tile multiples pass
     the UNPADDED ``sk - sq`` so valid rows keep their original mask.
+
+    ``dropout_p`` > 0 fuses attention-probability dropout into the PV
+    accumulation (≙ the reference's in-kernel philox dropout), keyed by
+    the int32 scalar ``dropout_seed`` — the identical mask regenerates in
+    every backward kernel from (seed, bh, element coords).
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -234,6 +288,13 @@ def flash_fwd(
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
     grid = (bh, nq, nk)
     offset = causal_offset if causal_offset is not None else sk - sq
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = (
+        jnp.zeros((1,), jnp.int32)
+        if dropout_seed is None
+        else jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    )
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -241,18 +302,18 @@ def flash_fwd(
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
     ]
     args = [q, k, v]
+    common = dict(
+        scale=scale, causal=causal, bq=bq, bk=bk, nk=nk, offset=offset,
+        prec=_dot_precision(q.dtype), dropout_p=dropout_p,
+    )
     if bias is not None:
         in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
-        kernel = functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=offset, prec=_dot_precision(q.dtype),
-        )
+        kernel = functools.partial(_fwd_kernel, **common)
     else:
-        kernel = functools.partial(
-            _fwd_kernel_nobias, scale=scale, causal=causal, bq=bq, bk=bk,
-            nk=nk, offset=offset, prec=_dot_precision(q.dtype),
-        )
+        kernel = functools.partial(_fwd_kernel_nobias, **common)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    args.append(seed)
 
     return pl.pallas_call(
         kernel,
@@ -278,8 +339,12 @@ def flash_fwd(
     )(*args)
 
 
-def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, acc, m, l, **kw)
+def _fwd_kernel_nobias(
+    q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc, m, l, **kw
+):
+    _fwd_kernel(
+        q_ref, k_ref, v_ref, None, seed_ref, o_ref, lse_ref, acc, m, l, **kw
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -320,10 +385,11 @@ def _recompute_p(
 
 
 def _dkdv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, seed_ref,
     dk_ref, dv_ref, dk_acc, dv_acc,
-    *, scale, causal, bq, bk, nq, offset, prec, sk_total,
+    *, scale, causal, bq, bk, nq, offset, prec, sk_total, dropout_p,
 ):
+    bh = pl.program_id(0)
     i = pl.program_id(2)  # q-block index (inner loop)
     j = pl.program_id(1)  # k-block index
 
@@ -356,16 +422,31 @@ def _dkdv_kernel(
             q, k, bias_blk, lse, i, j, bq, bk, scale, causal, offset, prec,
             sk_total,
         )
-        # dv += p^T @ do
+        # With fused dropout D = keep/(1-p): o = (D ⊙ p̃) V, so
+        # dv = (D⊙p)ᵀ do and ds = p ⊙ (D⊙dp − delta) — delta already
+        # carries the D factor through rowsum(do·o).  Mask regenerated
+        # bit-identically from (seed, bh, coords).
+        if dropout_p > 0.0:
+            keep = _dropout_keep_block(
+                seed_ref[0], bh, i, j, bq, bk, dropout_p
+            )
+            drop = jnp.where(keep, 1.0 / (1.0 - dropout_p), 0.0)
+            p_v = p * drop
+        else:
+            drop = None
+            p_v = p
+        # dv += (D⊙p)^T @ do
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_v, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )
-        # dp = do @ v^T ; ds = p * (dp - delta)
+        # dp = do @ v^T ; ds = p * (D⊙dp - delta)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )
+        if drop is not None:
+            dp = dp * drop
         ds = p * (dp - delta)
         if mask is not None:
             # the causal mask is a where() on s: no gradient flows through
@@ -384,10 +465,11 @@ def _dkdv_kernel(
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, seed_ref,
     dq_ref, dq_acc,
-    *, scale, causal, bq, bk, nk, offset, prec, sk_total,
+    *, scale, causal, bq, bk, nk, offset, prec, sk_total, dropout_p,
 ):
+    bh = pl.program_id(0)
     i = pl.program_id(1)  # q-block index
     j = pl.program_id(2)  # k-block index (inner loop)
 
@@ -423,6 +505,11 @@ def _dq_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )
+        if dropout_p > 0.0:
+            keep = _dropout_keep_block(
+                seed_ref[0], bh, i, j, bq, bk, dropout_p
+            )
+            dp = dp * jnp.where(keep, 1.0 / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta)
         if mask is not None:
             ds = jnp.where(mask, ds, 0.0)
@@ -440,11 +527,12 @@ def _dq_kernel(
     jax.jit,
     static_argnames=(
         "scale", "causal", "block_q", "block_k", "causal_offset",
+        "dropout_p",
     ),
 )
 def flash_bwd(
     q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None,
-    dlse=None, causal_offset=None,
+    dlse=None, causal_offset=None, dropout_p=0.0, dropout_seed=None,
 ):
     """Returns (dq, dk, dv).  Recomputation backward: only lse was saved.
 
@@ -471,6 +559,13 @@ def flash_bwd(
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
     offset = causal_offset if causal_offset is not None else sk - sq
     sk_total = sk
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = (
+        jnp.zeros((1,), jnp.int32)
+        if dropout_seed is None
+        else jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    )
 
     # delta_i = rowsum(do * o) — the softmax-jacobian correction term
     # (≙ the reference bwd kernels' row reduction before the ds GEMM).
@@ -485,7 +580,13 @@ def flash_bwd(
     q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
     k_spec_j = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     row_spec_i = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0))
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     common = [q, k, v, do, lse, delta]
+    kern_kw = dict(
+        scale=scale, causal=causal, bq=bq, bk=bk,
+        prec=_dot_precision(q.dtype), sk_total=sk_total,
+        dropout_p=dropout_p,
+    )
 
     # --- dk/dv: grid (BH, nk, nq), q innermost ---
     in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, row_spec_i, row_spec_i]
@@ -494,14 +595,14 @@ def flash_bwd(
         in_specs.append(_bias_spec(bias, bh, bq, bk, "ji"))
         args.append(bias)
         dkdv_kernel = functools.partial(
-            _dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
+            _dkdv_kernel, nq=nq, offset=offset, **kern_kw
         )
     else:
         dkdv_kernel = functools.partial(
-            _dkdv_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
+            _dkdv_nobias, nq=nq, offset=offset, **kern_kw
         )
+    in_specs.append(seed_spec)
+    args.append(seed)
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(bh, nk, nq),
@@ -534,14 +635,14 @@ def flash_bwd(
         in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
         dq_kernel = functools.partial(
-            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
+            _dq_kernel, nk=nk, offset=offset, **kern_kw
         )
     else:
         dq_kernel = functools.partial(
-            _dq_nobias, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            offset=offset, prec=_dot_precision(q.dtype), sk_total=sk_total,
+            _dq_nobias, nk=nk, offset=offset, **kern_kw
         )
+    in_specs.append(seed_spec)
+    args.append(seed)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
@@ -557,12 +658,12 @@ def flash_bwd(
     return dq, dk, dv
 
 
-def _dkdv_nobias(q, k, v, do, lse, delta, dk, dv, dka, dva, **kw):
-    _dkdv_kernel(q, k, v, do, lse, delta, None, dk, dv, dka, dva, **kw)
+def _dkdv_nobias(q, k, v, do, lse, delta, seed, dk, dv, dka, dva, **kw):
+    _dkdv_kernel(q, k, v, do, lse, delta, None, seed, dk, dv, dka, dva, **kw)
 
 
-def _dq_nobias(q, k, v, do, lse, delta, dq, dqa, **kw):
-    _dq_kernel(q, k, v, do, lse, delta, None, dq, dqa, **kw)
+def _dq_nobias(q, k, v, do, lse, delta, seed, dq, dqa, **kw):
+    _dq_kernel(q, k, v, do, lse, delta, None, seed, dq, dqa, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -571,15 +672,19 @@ def _dq_nobias(q, k, v, do, lse, delta, dq, dqa, **kw):
 
 
 def _dbias_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, dbias_ref,
-    acc_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, seed_ref,
+    dbias_ref, acc_ref,
     *, scale, causal, bq, bk, offset, prec, sk_total, inner_total, rs1, div,
+    dropout_p,
 ):
     j = pl.program_id(2)
     t = pl.program_id(3)
     # rs1 folds (q-block, group-member) into the inner grid dim; the full
     # per-row case keeps the q-block as its own (parallel) grid dim.
     i = (t // div) if rs1 else pl.program_id(1)
+    # the flattened batch-head index this step works on (dropout seeding
+    # must match the fwd/dq/dkdv kernels, which key on bh)
+    bh_idx = pl.program_id(0) * div + (t % div if rs1 else t)
 
     @pl.when(t == 0)
     def _init():
@@ -611,6 +716,11 @@ def _dbias_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )
+        if dropout_p > 0.0:
+            keep = _dropout_keep_block(
+                seed_ref[0], bh_idx, i, j, bq, bk, dropout_p
+            )
+            dp = dp * jnp.where(keep, 1.0 / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta)
         if mask is not None:
             ds = jnp.where(mask, ds, 0.0)
@@ -635,11 +745,12 @@ def _dbias_kernel(
     jax.jit,
     static_argnames=(
         "scale", "causal", "block_q", "block_k", "causal_offset",
+        "dropout_p",
     ),
 )
 def flash_dbias(
     q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None,
-    causal_offset=None,
+    causal_offset=None, dropout_p=0.0, dropout_seed=None,
 ):
     """Gradient of the additive bias: dbias (same (G, RS, Sk) layout).
 
@@ -666,6 +777,13 @@ def flash_dbias(
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
     offset = causal_offset if causal_offset is not None else sk - sq
     rs1 = rs == 1
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = (
+        jnp.zeros((1,), jnp.int32)
+        if dropout_seed is None
+        else jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    )
 
     delta_rows = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -707,7 +825,7 @@ def flash_dbias(
     kernel = functools.partial(
         _dbias_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
         offset=offset, prec=_dot_precision(q.dtype), sk_total=sk,
-        inner_total=inner_total, rs1=rs1, div=div,
+        inner_total=inner_total, rs1=rs1, div=div, dropout_p=dropout_p,
     )
     return pl.pallas_call(
         kernel,
@@ -720,6 +838,7 @@ def flash_dbias(
             pl.BlockSpec((1, bq, _LANES), row_idx),
             pl.BlockSpec((1, bq, _LANES), row_idx),
             bias_spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=out_spec,
         out_shape=out_shape,
@@ -730,4 +849,4 @@ def flash_dbias(
             ),
         ),
         interpret=pallas_interpret(),
-    )(q, k, v, do, lse, delta, bias)
+    )(q, k, v, do, lse, delta, bias, seed)

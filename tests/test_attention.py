@@ -330,6 +330,121 @@ def test_causal_short_keys_unaligned_falls_back(force_pallas):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+class TestFusedDropout:
+    """In-kernel attention dropout (≙ the reference's philox dropout in
+    the fused MHA kernels).  The mask is extracted exactly by setting
+    V = I, which makes o = D ⊙ softmax(s): each output element IS the
+    dropped, rescaled probability."""
+
+    def _qkv_ident(self, key, s=128):
+        kq, kk = jax.random.split(key)
+        q = jax.random.normal(kq, (1, 1, s, s))
+        k = jax.random.normal(kk, (1, 1, s, s))
+        v = jnp.eye(s)[None, None]
+        return q, k, v
+
+    def test_mask_semantics_and_rate(self, force_pallas):
+        p = 0.15
+        q, k, v = self._qkv_ident(jax.random.PRNGKey(40))
+        rng = jax.random.PRNGKey(41)
+        probs = flash_attention(q, k, v)  # = softmax(s), no dropout
+        out = flash_attention(q, k, v, dropout_p=p, dropout_rng=rng)
+        mask = np.asarray(out) != 0.0
+        rate = mask.mean()
+        assert abs(rate - (1 - p)) < 0.03, rate  # binomial, 16k draws
+        # kept entries are exactly probs/(1-p); dropped are exactly 0
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.where(mask, np.asarray(probs) / (1 - p), 0.0),
+            atol=1e-6, rtol=1e-5,
+        )
+
+    def test_deterministic_and_rng_dependent(self, force_pallas):
+        q, k, v = self._qkv_ident(jax.random.PRNGKey(42))
+        r1, r2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+        a = flash_attention(q, k, v, dropout_p=0.3, dropout_rng=r1)
+        b = flash_attention(q, k, v, dropout_p=0.3, dropout_rng=r1)
+        c = flash_attention(q, k, v, dropout_p=0.3, dropout_rng=r2)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_mask_varies_per_batch_head(self, force_pallas):
+        s = 128
+        q = jax.random.normal(jax.random.PRNGKey(43), (2, 2, s, s))
+        k = jax.random.normal(jax.random.PRNGKey(44), (2, 2, s, s))
+        v = jnp.broadcast_to(jnp.eye(s), (2, 2, s, s))
+        out = np.asarray(
+            flash_attention(
+                q, k, v, dropout_p=0.3, dropout_rng=jax.random.PRNGKey(3)
+            )
+        )
+        masks = (out != 0.0).reshape(4, -1)
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not np.array_equal(masks[i], masks[j]), (i, j)
+
+    def test_grads_consistent_with_forward(self, force_pallas):
+        """The hand-written backward (mask regenerated in dkdv/dq kernels)
+        must match numerical differentiation of the actual forward."""
+        from jax.test_util import check_grads
+
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(45), 3)
+        q = jax.random.normal(kq, (1, 1, 128, 32))
+        k = jax.random.normal(kk, (1, 1, 128, 32))
+        v = jax.random.normal(kv, (1, 1, 128, 32))
+        rng = jax.random.PRNGKey(7)
+
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, dropout_p=0.25, dropout_rng=rng
+            ).astype(jnp.float32)
+
+        check_grads(f, (q, k, v), order=1, modes=["rev"],
+                    atol=1e-2, rtol=1e-2)
+
+    def test_dropout_with_trainable_bias_grads(self, force_pallas):
+        """dropout + bias_grad compose: dbias kernel applies the same
+        mask (checked against numerical diff)."""
+        from jax.test_util import check_grads
+
+        kq, kk, kv, kb = jax.random.split(jax.random.PRNGKey(46), 4)
+        q = jax.random.normal(kq, (1, 2, 128, 32))
+        k = jax.random.normal(kk, (1, 2, 128, 32))
+        v = jax.random.normal(kv, (1, 2, 128, 32))
+        bias = jax.random.normal(kb, (1, 2, 128, 128)) * 0.3
+        rng = jax.random.PRNGKey(8)
+
+        def f(bias):
+            return flash_attention(
+                q, k, v, bias, dropout_p=0.2, dropout_rng=rng,
+                bias_grad=True,
+            ).astype(jnp.float32)
+
+        check_grads(f, (bias,), order=1, modes=["rev"],
+                    atol=1e-2, rtol=1e-2)
+
+    def test_dropout_with_causal_and_padding(self, force_pallas):
+        """dropout composes with the causal mask and arbitrary-S padding:
+        zero positions stay a superset of the causal zeros, kept entries
+        scale by 1/(1-p)."""
+        s = 100  # pads to 104
+        q, k, v = self._qkv_ident(jax.random.PRNGKey(47), s=s)
+        rng = jax.random.PRNGKey(9)
+        probs = flash_attention(q, k, v, causal=True)
+        out = flash_attention(
+            q, k, v, causal=True, dropout_p=0.2, dropout_rng=rng
+        )
+        mask = np.asarray(out) != 0.0
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.where(mask, np.asarray(probs) / 0.8, 0.0),
+            atol=1e-6, rtol=1e-5,
+        )
+        # upper triangle (causal-masked) stays all zero
+        upper = np.triu(np.ones((s, s), bool), k=1)
+        assert not np.asarray(out)[0, 0][upper].any()
+
+
 class TestFlashAttentionWithLse:
     """flash_attention_with_lse: (o, lse) values AND the dlse backward
     (the ring-attention merge differentiates through lse)."""
