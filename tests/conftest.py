@@ -46,3 +46,126 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests"
     )
+
+
+# Tiering (VERDICT r2 item 8): everything that measured >= ~10 s on this
+# 1-core container (full run: 389 tests / ~38 min, 2026-07-30,
+# `pytest --durations=40`) is marked slow centrally here, so the default
+# quick signal is `pytest -m "not slow"` (~4-5 min) and CI runs the full
+# suite.  Regenerate the list with `pytest --durations=40` after adding
+# heavy tests.  test_examples_smoke.py is slow wholesale (end-to-end
+# example drives, ~13 min of the total).
+_SLOW_FILES = {"test_examples_smoke.py"}
+_SLOW_TESTS = {
+    "test_gpt_moe_trains_and_matches_ep",
+    "test_bert_sp_grads_match_unsharded",
+    "test_dryrun_multichip",
+    "test_gpt_moe_sp_grads_match_unsharded",
+    "test_1f1b_bert_stages_match_sequential",
+    "test_gpt_sp_grads_match_unsharded",
+    "test_cp_moe_gpt_matches_unsharded",
+    "test_syncbn_variant_runs",
+    "test_bert_tp_noSP_head_grads_match_unsharded",
+    "test_forward_and_grad",
+    "test_unsharded_loss_and_grads",
+    "test_gpt_tp_noSP_grads_match_unsharded",
+    "test_cp_gpt_matches_unsharded",
+    "test_reregistration_on_retrace",
+    "test_two_process_cpu_psum",
+    "test_grads_flow",
+    "test_cp_with_tp_loss_matches",
+    "test_chunked_mlm_loss_matches_unchunked",
+    "test_sp_matches_tp",
+    "test_unrolled_matches_scanned",
+    "test_forward_and_grads_unsharded",
+    "test_rope_cached",
+    "test_interleaved_matches_sequential_configs",
+    "test_training_descends",
+    "test_rope_fwd_bwd",
+    "test_tp_matches_unsharded",
+    "test_arbitrary_seq_with_bias_parity",
+    "test_1f1b_carry_chunk_matches_sequential",
+    # interpret-mode kernel parametrization sweeps (the quick tier keeps
+    # test_trainable_bias_multiblock / test_arbitrary_seq_grads_parity /
+    # test_mask_semantics_and_rate as representatives of each family)
+    "test_trainable_bias_grad_matches_reference",
+    "test_arbitrary_seq_kernel_parity",
+    "test_grads_consistent_with_forward",
+    "test_dropout_with_trainable_bias_grads",
+    "test_dropout_with_causal_and_padding",
+    "test_mask_varies_per_batch_head",
+    "test_interleaved_matches_sequential",
+    "test_gpt_moe_trains_and_matches_ep",
+    "test_imagenet_amp_smoke",
+    "test_tp_sp_matches_unsharded",
+    "test_causality",
+    "test_loss_grad_finite",
+    "test_openfold_axial_pair_stack_sharded_matches_unsharded",
+    "test_spatial_matches_full",
+    "test_synced_grads_match_global_objective",
+    "test_sp_dropout_masks_differ_per_rank",
+    "test_scaled_upper_triang_masked_softmax",
+    "test_lstm_vs_loop_reference",
+    "test_checkpoint_matches_uncheckpointed",
+    "test_instance_norm_module_running_stats",
+    "test_key_padding_bias_not_materialized",
+    "test_loss_vs_brute_force",
+    "test_fused_scale_mask_softmax_causal",
+}
+
+# Slow PARAMETRIZATIONS of otherwise-quick families: match the exact test
+# id so at least one parameter combination of each family stays in the
+# quick tier as a representative.
+_SLOW_EXACT = {
+    "test_layer_norm_affine_fwd_bwd[False-float32-shape0]",
+    "test_layer_norm_affine_fwd_bwd[False-float32-shape1]",
+    "test_layer_norm_affine_fwd_bwd[False-float32-shape2]",
+    "test_rms_norm_affine_fwd_bwd[False-float32]",
+    "test_xentropy_fwd_bwd[0.0-float32]",
+    "test_shapes_and_grad[RNNReLU]",
+    "test_shapes_and_grad[mLSTM]",
+    "test_shapes_and_grad[GRU]",
+    "test_conv_bias_relu_value_and_grad[float32]",
+    "test_conv_bias_relu_value_and_grad[bfloat16]",
+    "test_scaled_softmax[1.0-float32]",
+    "test_scaled_softmax[1.0-bfloat16]",
+    "test_group_norm_value_and_grad[float32]",
+    "test_arbitrary_seq_grads_parity[333-259]",
+    "test_ep_matches_unsharded[1]",
+    "test_standalone_providers_forward[bert_model_provider]",
+    "test_ring_kernel_path_matches_full[True]",
+    "test_pallas_kernel_matches_jnp_path[False-False]",
+    "test_vocab_parallel_cross_entropy_matches_full[0.0]",
+    "test_instance_norm_functional_matches_manual[float32]",
+    "test_groupbn_value_and_grad[False-float32]",
+    "test_grads_include_lse_cotangent[False]",
+    "test_grads_match_reference[False]",
+    "test_matches_plain_bn_math",
+    "test_ring_grads_match_full[False]",
+    "test_ring_grads_match_full[True]",
+    "test_wgrad_is_f32_under_bf16_compute[ColumnParallelLinear]",
+    "test_ignore_index",
+    "test_sequence_parallel_pair_matches_dense",
+    "test_focal_loss_ignore_and_grad_finite[float32]",
+    "test_fused_scale_mask_softmax_padding_mask",
+    "test_self_attn_matches_reference",
+    "test_save_restore_roundtrip",
+    "test_bn_group_psum",
+    "test_sigmoid_focal_loss_value_and_grad[float32]",
+    "test_group_norm_module_grad_dtypes[float32]",
+    "test_generic_alias",
+    "test_encdec_attn",
+    "test_capacity_bounds_per_expert",
+    "test_vs_compose",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = getattr(item, "originalname", None) or item.name
+        if (
+            item.fspath.basename in _SLOW_FILES
+            or name in _SLOW_TESTS
+            or item.name in _SLOW_EXACT
+        ):
+            item.add_marker(pytest.mark.slow)
