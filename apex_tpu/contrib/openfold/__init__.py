@@ -73,6 +73,12 @@ def scatter(x, axis_name: str, dim: int):
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     dim = dim % x.ndim
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"DAP scatter: dim {dim} (size {x.shape[dim]}) is not "
+            f"divisible by the {axis_name!r} axis size {n} — trailing "
+            "rows would silently belong to no rank; pad the axial dim"
+        )
     per = x.shape[dim] // n
     return jax.lax.dynamic_slice_in_dim(x, idx * per, per, axis=dim)
 

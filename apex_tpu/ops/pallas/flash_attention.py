@@ -121,21 +121,25 @@ def _dropout_keep_block(seed, bh, i, j, bq, bk, dropout_p):
     u32 = jnp.uint32
     rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + u32(i * bq)
     cols = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + u32(j * bk)
-    # unique element counter in the (Sq, Sk) plane (mod 2^32); key folds
-    # the batch-head index and the caller's seed
-    h = rows * u32(0x0001_0001) + cols
     key = (
         seed.astype(jnp.uint32)
         + bh.astype(jnp.uint32) * u32(0x9E37_79B9)
     )
-    h = h ^ key
-    for mix_key in (u32(0x85EB_CA6B), u32(0xC2B2_AE35)):
+
+    def fmix(h, mul):
         h = h ^ (h >> u32(16))
-        h = h * mix_key
+        h = h * mul
         h = h ^ (h >> u32(13))
         h = h * u32(0x27D4_EB2F)
         h = h ^ (h >> u32(16))
-        h = h + key
+        return h + key
+    # Keyed two-round hash of the (row, col) PAIR — mix the row first,
+    # then fold the column in and mix again.  A single linear row*C+col
+    # counter would alias once a seq dim exceeded the constant (correlated
+    # dropout at long context); hashing the coordinates separately leaves
+    # only accidental (birthday-level) collisions at any Sq/Sk.
+    h = fmix(rows ^ key, u32(0x85EB_CA6B))
+    h = fmix(h ^ cols, u32(0xC2B2_AE35))
     threshold = u32(min(int(dropout_p * 2**32), 2**32 - 1))
     return h >= threshold
 

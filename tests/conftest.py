@@ -95,7 +95,6 @@ _SLOW_TESTS = {
     "test_dropout_with_causal_and_padding",
     "test_mask_varies_per_batch_head",
     "test_interleaved_matches_sequential",
-    "test_gpt_moe_trains_and_matches_ep",
     "test_imagenet_amp_smoke",
     "test_tp_sp_matches_unsharded",
     "test_causality",

@@ -34,6 +34,7 @@ def main():
         ["all"] if args.configs == "all" else args.configs.split(",")
     )
     lines = []
+    failed = False
     for name in names:
         proc = subprocess.run(
             cmd + ["--config", name],
@@ -51,6 +52,7 @@ def main():
             print(ln, flush=True)
             lines.append(rec)
         if proc.returncode != 0:
+            failed = True
             print(
                 f"[bench_all] config {name!r} exited "
                 f"{proc.returncode}", file=sys.stderr,
@@ -62,6 +64,9 @@ def main():
             f.write(json.dumps(rec) + "\n")
     print(f"[bench_all] wrote {len(lines)} metric lines to {out}",
           file=sys.stderr)
+    if failed or not lines:
+        # a partial artifact must not read as a successful round
+        sys.exit(1)
 
 
 if __name__ == "__main__":
