@@ -205,6 +205,7 @@ def forward_backward_pipelining_with_interleaving(
     axis_name: str = _PP,
     forward_only: bool = False,
     remat: bool = True,
+    carry_chunk: Optional[int] = None,
 ):
     """≙ fwd_bwd_pipelining_with_interleaving.py (virtual/interleaved 1F1B).
 
@@ -234,6 +235,10 @@ def forward_backward_pipelining_with_interleaving(
     Like the reference schedule, requires ``num_microbatches`` to be a
     multiple of the pipeline size (SURVEY §2.3 interleaving row: Megatron
     asserts ``num_microbatches % pipeline_parallel_size == 0``).
+
+    ``carry_chunk``: same two-level checkpointed tick scan as the
+    non-interleaved schedule — more valuable here, since this schedule
+    runs ``nm·vpp + pp − 1`` ticks (vpp× the carries).
     """
     inputs, targets = batch
     nm = num_microbatches
@@ -289,9 +294,22 @@ def forward_backward_pipelining_with_interleaving(
             h_next = p2p.send_forward_recv_forward(y, axis_name, cyclic=True)
             return (h_next, losses), None
 
-        (_, losses), _ = jax.lax.scan(
-            tick, (h0, jnp.zeros((nm,), jnp.float32)), jnp.arange(ticks)
-        )
+        carry0 = (h0, jnp.zeros((nm,), jnp.float32))
+        if carry_chunk and carry_chunk > 0:
+            kk = min(carry_chunk, ticks)
+            n_outer = -(-ticks // kk)  # padded ticks are masked no-ops
+            ts = jnp.arange(n_outer * kk).reshape(n_outer, kk)
+
+            @jax.checkpoint
+            def outer(carry, ts_chunk):
+                carry, _ = jax.lax.scan(tick, carry, ts_chunk)
+                return carry, None
+
+            (_, losses), _ = jax.lax.scan(outer, carry0, ts)
+        else:
+            (_, losses), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(ticks)
+            )
         # local sum differentiated; psum only in aux (see 1F1B note above)
         return jnp.sum(losses) / nm, jax.lax.psum(losses, axis_name)
 

@@ -361,6 +361,54 @@ def test_interleaved_matches_sequential_configs(eight_devices, pp, vpp, nm):
         )
 
 
+@pytest.mark.parametrize("carry_chunk", [2, 5, 100])
+def test_interleaved_carry_chunk_matches_sequential(
+    eight_devices, carry_chunk
+):
+    """Chunked tick scan on the interleaved schedule: numerics identical
+    for dividing, non-dividing, and oversized chunk sizes."""
+    pp, vpp, nm = 2, 2, 4
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual, seed=9)
+    rng = np.random.RandomState(4)
+    inputs = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, grads = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=nm, num_model_chunks=vpp,
+            carry_chunk=carry_chunk,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[:, None], grads)
+        return losses, grads
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(P(None, "pp"), P(), P()),
+            out_specs=(P(), P(None, "pp")),
+            check_vma=False,
+        )
+    )(regrouped, inputs, targets)
+    ref_losses, ref_grads = sequential_reference(
+        stacked, inputs, targets, n_virtual
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(n_virtual, *stacked[k].shape[1:])
+        np.testing.assert_allclose(
+            got, np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+        )
+
+
 def test_interleaved_rejects_indivisible_microbatches(eight_devices):
     pp, vpp = 2, 2
     mesh = ps.initialize_model_parallel(1, pp)
