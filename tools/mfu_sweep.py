@@ -43,6 +43,26 @@ def run(batch, remat, remat_policy, scan_layers=True, remat_attention=False,
         )
 
 
+# The r3 exploration grid (VERDICT r2 item 5: push 0.53 -> >=0.58).
+# Each entry: (batch, remat, policy, scan, rattn, mlmc, pcse).  Rationale
+# per row in the comment; ~2-4 min each on the chip (compile + 3 trials).
+R3_GRID = [
+    # headline reference point (r2 tuned config)
+    (128, True, "dots", False, True, 8, False),
+    # bigger batch amortizes fixed per-step cost (LAMB, LN, loss tail)
+    (256, True, "dots", False, True, 8, False),
+    (192, True, "dots", False, True, 8, False),
+    # no remat at all: if HBM fits, removes the recompute premium
+    (128, False, "dots", False, False, 8, None),
+    (192, False, "dots", False, False, 8, None),
+    # MLM loss chunking sweep (chunk overhead vs logits memory)
+    (128, True, "dots", False, True, 4, False),
+    (128, True, "dots", False, True, 16, False),
+    # attention recompute off (keep the f32 score saves at S=128)
+    (128, True, "dots", False, False, 8, False),
+]
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default=None)
@@ -51,8 +71,19 @@ if __name__ == "__main__":
         help="batch,remat,policy,scan,rattn,mlmc[,pcse] "
              "e.g. 256,True,dots,F,T,8,F",
     )
+    ap.add_argument(
+        "--grid", action="store_true",
+        help="run the r3 exploration grid (one line per config)",
+    )
     args = ap.parse_args()
-    if args.only:
+    if args.grid:
+        for batch, remat, policy, scan, rattn, mlmc, pcse in R3_GRID:
+            run(
+                batch, remat, policy, scan_layers=scan,
+                remat_attention=rattn, mlm_loss_chunks=mlmc,
+                prevent_cse=pcse,
+            )
+    elif args.only:
         f = args.only.split(",")
         run(
             int(f[0]), f[1][0] in "Tt", f[2], trace_dir=args.trace,
