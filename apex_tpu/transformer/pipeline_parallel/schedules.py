@@ -23,7 +23,11 @@ Uniform-stage contract (SPMD): every pp rank runs the same
 :func:`parallel_state.get_pipeline_model_parallel_rank`, or outside the
 pipeline).  ``loss_fn(y, target) -> scalar`` is evaluated on the last
 stage; it must return finite values for arbitrary finite activations (it
-is traced on every stage and masked).
+is traced on every stage and masked).  With ``loss_takes_params=True``
+the signature becomes ``loss_fn(stage_params, y, target)`` — ≙ Megatron's
+post-process rank computing the loss THROUGH the output layer: the head
+(e.g. a tied unembedding) lives in the uniform per-rank param tree and
+receives gradients via the loss; see ``examples/gpt/train_gpt_pp.py``.
 
 All schedules share one signature and return ``(losses, grads)`` where
 ``losses`` is the per-microbatch loss vector (psum-shared across pp) and
@@ -70,15 +74,17 @@ def forward_backward_no_pipelining(
     axis_name: str = _PP,
     forward_only: bool = False,
     remat: bool = False,
+    loss_takes_params: bool = False,
 ):
     """≙ fwd_bwd_no_pipelining.py — scan microbatches, accumulate grads."""
     inputs, targets = batch
     run = _wrap_remat(stage_fn, remat)
+    lfn = loss_fn if loss_takes_params else (lambda p, y, t: loss_fn(y, t))
 
     def mean_loss(params):
         def body(carry, mb):
             x, t = mb
-            loss = loss_fn(run(params, x), t)
+            loss = lfn(params, run(params, x), t)
             return carry + loss, loss
 
         total, losses = jax.lax.scan(
@@ -109,6 +115,7 @@ def forward_backward_pipelining_without_interleaving(
     forward_only: bool = False,
     remat: bool = True,
     carry_chunk: Optional[int] = None,
+    loss_takes_params: bool = False,
 ):
     """≙ fwd_bwd_pipelining_without_interleaving.py (1F1B).
 
@@ -129,6 +136,7 @@ def forward_backward_pipelining_without_interleaving(
     inputs, targets = batch
     nm = num_microbatches
     run = _wrap_remat(stage_fn, remat)
+    lfn = loss_fn if loss_takes_params else (lambda p, y, t: loss_fn(y, t))
 
     def pipeline_loss(params):
         pp = jax.lax.axis_size(axis_name)
@@ -151,7 +159,7 @@ def forward_backward_pipelining_without_interleaving(
             tgt = jax.tree_util.tree_map(
                 lambda x: x[jnp.clip(out_idx, 0, nm - 1)], targets
             )
-            loss = loss_fn(y, tgt)
+            loss = lfn(params, y, tgt)
             losses = losses.at[jnp.clip(out_idx, 0, nm - 1)].add(
                 jnp.where(valid, loss, 0.0)
             )
@@ -206,6 +214,7 @@ def forward_backward_pipelining_with_interleaving(
     forward_only: bool = False,
     remat: bool = True,
     carry_chunk: Optional[int] = None,
+    loss_takes_params: bool = False,
 ):
     """≙ fwd_bwd_pipelining_with_interleaving.py (virtual/interleaved 1F1B).
 
@@ -248,6 +257,7 @@ def forward_backward_pipelining_with_interleaving(
     if vpp is None or vpp < 1:
         raise ValueError("num_model_chunks (virtual pipeline size) required")
     run = _wrap_remat(stage_fn, remat)
+    lfn = loss_fn if loss_takes_params else (lambda p, y, t: loss_fn(y, t))
 
     def pipeline_loss(params):
         pp = jax.lax.axis_size(axis_name)
@@ -288,7 +298,7 @@ def forward_backward_pipelining_with_interleaving(
             # loss: last virtual stage = rank pp-1 running chunk vpp-1
             finishing = is_last & (chunk == vpp - 1) & active
             tgt = jax.tree_util.tree_map(lambda x: x[mb_idx], targets)
-            loss = loss_fn(y, tgt)
+            loss = lfn(chunk_params, y, tgt)
             losses = losses.at[mb_idx].add(jnp.where(finishing, loss, 0.0))
 
             h_next = p2p.send_forward_recv_forward(y, axis_name, cyclic=True)

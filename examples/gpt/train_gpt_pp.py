@@ -1,0 +1,253 @@
+"""Pipeline-parallel GPT-style LM training — the apex.transformer
+pipeline workflow end to end (≙ the reference's Megatron-style pretrain
+loops over ``forward_backward_pipelining_*``; SURVEY §3.5).
+
+Demonstrates, on a virtual CPU mesh (or real chips):
+
+- the **uniform-stage contract**: every pp rank runs the same
+  ``stage_fn``; rank 0 additionally embeds (token ids ride in channel 0
+  of the activation and are swapped in by a ``where`` on the first-stage
+  predicate), so no per-rank Python branching exists inside the traced
+  step;
+- ``loss_takes_params=True``: the LAST rank computes cross-entropy
+  through the **tied unembedding** (the embedding table in its own param
+  tree) — Megatron's post-process pattern;
+- the **embedding-grad all-reduce across pp**: rank 0's embedding grad
+  and the last rank's tied-head grad are psum'd over the pp axis (≙
+  Megatron's ``allreduce_embedding_grads``) so every rank's copy stays
+  bit-identical through training;
+- grad accumulation over microbatches inside one jitted step, 1F1B or
+  interleaved (``--vpp``) schedule, fused-Adam update per stage.
+
+Run (8 virtual devices, pp=4):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/gpt/train_gpt_pp.py --pp 4 --steps 8
+
+Interleaved (pp=2, two chunks per rank):
+
+    python examples/gpt/train_gpt_pp.py --pp 2 --vpp 2 --steps 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if "--real-tpu" not in sys.argv:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+import jax
+
+if "--real-tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.models.bert import BertConfig, BertEncoderCore
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+)
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--pp", type=int, default=4)
+    p.add_argument("--vpp", type=int, default=0,
+                   help="virtual chunks/rank (0 = non-interleaved)")
+    p.add_argument("--layers", type=int, default=4, help="total layers")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--nm", type=int, default=4, help="microbatches/step")
+    p.add_argument("--mb", type=int, default=2, help="microbatch size")
+    p.add_argument("--seq", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--real-tpu", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    pp, vpp = args.pp, args.vpp
+    n_chunks = max(vpp, 1)
+    if args.layers % (pp * n_chunks):
+        raise SystemExit("--layers must divide pp * max(vpp, 1)")
+    if vpp and args.nm % pp:
+        raise SystemExit("interleaving requires --nm divisible by --pp")
+
+    mesh = ps.initialize_model_parallel(
+        pipeline_model_parallel_size=pp,
+        devices=jax.devices()[:pp],
+    )
+    cfg = BertConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=4,
+        intermediate_size=4 * args.hidden,
+        max_position_embeddings=args.seq, dtype=jnp.float32,
+    )
+    core = BertEncoderCore(
+        cfg, num_layers=args.layers // (pp * n_chunks)
+    )
+    tx = fused_adam(learning_rate=args.lr)
+    H, V, S, MB = args.hidden, args.vocab, args.seq, args.mb
+
+    # synthetic corpus: a fixed random LM task (next-token over a zipfy
+    # stream) — enough for the loss to fall measurably in a few steps
+    rng = np.random.RandomState(0)
+    tokens = rng.zipf(1.5, size=200_000) % V
+
+    def sample_batch(step):
+        r = np.random.RandomState(1000 + step)
+        starts = r.randint(0, len(tokens) - S - 1, size=(args.nm, MB))
+        ids = np.stack(
+            [[tokens[s : s + S + 1] for s in row] for row in starts]
+        )  # (nm, MB, S+1)
+        return jnp.asarray(ids, jnp.int32)
+
+    def make_stage_io(ids):
+        """inputs: (nm, S, MB, H) activations whose channel 0 carries the
+        input token ids (rank 0 swaps in the embedding); targets: the
+        shifted ids, broadcast to the activation rank for uniform stacking."""
+        x = jnp.zeros((args.nm, S, MB, H), jnp.float32)
+        x = x.at[..., 0].set(
+            jnp.transpose(ids[..., :-1], (0, 2, 1)).astype(jnp.float32)
+        )
+        tgt = jnp.transpose(ids[..., 1:], (0, 2, 1))  # (nm, S, MB) int
+        return x, tgt
+
+    def stage_fn(p, x):
+        # rank-gated embedding: ONLY the first virtual stage consumes ids.
+        # Uniform SPMD: every rank computes both branches; `where` picks.
+        # (chunk gating under interleaving rides the per-chunk is_chunk0
+        # param — the schedule slices it with the rest of the chunk tree.)
+        first = ps.is_pipeline_first_stage(ignore_virtual=True)
+        ids = jnp.clip(x[..., 0].astype(jnp.int32), 0, V - 1)
+        emb = p["embed"][ids] * jnp.sqrt(float(H))
+        h = jnp.where(first & (p["is_chunk0"] > 0), emb, x)
+        return core.apply(p["core"], h)
+
+    def loss_fn(p, y, tgt):
+        # tied unembedding through THIS rank's copy of the table —
+        # Megatron's post-process head; grads flow into p["embed"]
+        logits = jnp.einsum("sbh,vh->sbv", y, p["embed"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def init_rank_params(key, chunk_index):
+        core_p = core.init(
+            jax.random.fold_in(key, 17 + chunk_index),
+            jnp.zeros((S, MB, H)),
+        )
+        # embedding identical on every rank/chunk (same key, no fold)
+        embed = (
+            jax.random.normal(jax.random.fold_in(key, 99), (V, H))
+            / np.sqrt(H)
+        )
+        return {
+            "core": core_p, "embed": embed,
+            # only virtual stage 0 embeds; other chunks pass through
+            # (f32 flag so the tree stays differentiable; its grad is
+            # zeroed before the optimizer and wd=0 keeps it fixed)
+            "is_chunk0": jnp.asarray(float(chunk_index == 0), jnp.float32),
+        }
+
+    def train_step(params, opt_state, xs, tgts):
+        if vpp:
+            losses, grads = forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, params, (xs, tgts),
+                num_microbatches=args.nm, num_model_chunks=vpp,
+                loss_takes_params=True,
+            )
+        else:
+            losses, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, params, (xs, tgts),
+                num_microbatches=args.nm, loss_takes_params=True,
+            )
+        # ≙ Megatron allreduce_embedding_grads: rank 0 holds the input-
+        # embedding grad, the last rank the tied-head grad; psum over pp
+        # keeps every copy's update identical.
+        grads["embed"] = jax.lax.psum(
+            grads["embed"], ps.PIPELINE_PARALLEL_AXIS
+        )
+        grads["is_chunk0"] = jnp.zeros_like(params["is_chunk0"])
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, jnp.mean(losses)
+
+    def bootstrap(key):
+        rank = jax.lax.axis_index(ps.PIPELINE_PARALLEL_AXIS)
+        rkey = jax.random.fold_in(key, rank)
+        if vpp:
+            chunks = [init_rank_params(rkey, c) for c in range(vpp)]
+            params = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *chunks
+            )
+        else:
+            params = init_rank_params(rkey, 0)
+        return params, tx.init(params)
+
+    # per-leaf out specs: param/optimizer tensors are rank-local (P('pp')
+    # stacks them), but optimizer SCALARS (Adam's step count) are
+    # replicated — a scalar cannot carry a mesh axis.
+    shape_probe = jax.eval_shape(
+        lambda key: (
+            lambda p: (p, tx.init(p))
+        )(init_rank_params(key, 0) if not vpp else jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *[init_rank_params(key, c) for c in range(vpp)],
+        )),
+        jax.random.PRNGKey(0),
+    )
+    state_specs = jax.tree_util.tree_map(
+        lambda l: P("pp") if l.ndim else P(), shape_probe
+    )
+
+    step_jit = jax.jit(
+        jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(state_specs[0], state_specs[1], P(), P()),
+            out_specs=(state_specs[0], state_specs[1], P()),
+            check_vma=False,
+        )
+    )
+    boot = jax.jit(
+        jax.shard_map(
+            bootstrap, mesh=mesh, in_specs=(P(),),
+            out_specs=state_specs, check_vma=False,
+        )
+    )
+    params, opt_state = boot(jax.random.PRNGKey(0))
+
+    sched = f"interleaved vpp={vpp}" if vpp else "1F1B"
+    print(f"pipeline LM: pp={pp} ({sched}), layers={args.layers}, "
+          f"nm={args.nm}, mb={MB}, seq={S}")
+    for step in range(args.steps):
+        xs, tgts = make_stage_io(sample_batch(step))
+        t0 = time.perf_counter()
+        params, opt_state, loss = step_jit(params, opt_state, xs, tgts)
+        loss = float(loss)
+        print(f"step {step:3d}  loss {loss:7.4f}  "
+              f"({(time.perf_counter() - t0) * 1e3:6.1f} ms)")
+        if not np.isfinite(loss):
+            raise SystemExit("non-finite loss")
+    ps.destroy_model_parallel()
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,30 @@ def test_gpt_train_tiny_smoke():
     assert "chunk 0: loss" in out, out[-500:]
 
 
+def test_gpt_train_pp_smoke():
+    """Pipeline-parallel LM example: 1F1B, loss finite and printed."""
+    out = _run_example(
+        "examples/gpt/train_gpt_pp.py",
+        ["--pp", "2", "--steps", "3", "--layers", "2", "--seq", "16",
+         "--hidden", "32", "--vocab", "64"],
+        n_devices=2,
+    )
+    assert "pipeline LM: pp=2 (1F1B)" in out, out[-500:]
+    assert "step   2" in out, out[-500:]
+
+
+def test_gpt_train_pp_interleaved_smoke():
+    """Interleaved virtual-stage LM example (vpp=2)."""
+    out = _run_example(
+        "examples/gpt/train_gpt_pp.py",
+        ["--pp", "2", "--vpp", "2", "--steps", "3", "--layers", "4",
+         "--seq", "16", "--hidden", "32", "--vocab", "64"],
+        n_devices=2,
+    )
+    assert "interleaved vpp=2" in out, out[-500:]
+    assert "step   2" in out, out[-500:]
+
+
 def test_gpt_train_cp_ring_smoke():
     """Context-parallel ring attention end-to-end in the example."""
     out = _run_example(
