@@ -294,11 +294,6 @@ def flash_fwd(
     offset = causal_offset if causal_offset is not None else sk - sq
     if dropout_p > 0.0 and dropout_seed is None:
         raise ValueError("dropout_p > 0 requires dropout_seed")
-    seed = (
-        jnp.zeros((1,), jnp.int32)
-        if dropout_seed is None
-        else jnp.asarray(dropout_seed, jnp.int32).reshape(1)
-    )
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -309,15 +304,17 @@ def flash_fwd(
     common = dict(
         scale=scale, causal=causal, bq=bq, bk=bk, nk=nk, offset=offset,
         prec=_dot_precision(q.dtype), dropout_p=dropout_p,
+        has_bias=bias is not None, has_seed=dropout_p > 0.0,
     )
     if bias is not None:
         in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
-        kernel = functools.partial(_fwd_kernel, **common)
-    else:
-        kernel = functools.partial(_fwd_kernel_nobias, **common)
-    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-    args.append(seed)
+    # The seed operand exists ONLY on dropout runs, so the (on-chip
+    # proven) no-dropout kernels keep their exact operand signature.
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
+    kernel = functools.partial(_fwd_entry, **common)
 
     return pl.pallas_call(
         kernel,
@@ -343,11 +340,17 @@ def flash_fwd(
     )(*args)
 
 
-def _fwd_kernel_nobias(
-    q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc, m, l, **kw
-):
+def _fwd_entry(*refs, has_bias, has_seed, **kw):
+    """Adapter: optional bias/seed operands -> fixed kernel signature."""
+    i = 3
+    bias_ref = refs[i] if has_bias else None
+    i += int(has_bias)
+    seed_ref = refs[i] if has_seed else None
+    i += int(has_seed)
+    o_ref, lse_ref, acc, m, l = refs[i:]
     _fwd_kernel(
-        q_ref, k_ref, v_ref, None, seed_ref, o_ref, lse_ref, acc, m, l, **kw
+        refs[0], refs[1], refs[2], bias_ref, seed_ref, o_ref, lse_ref,
+        acc, m, l, **kw
     )
 
 
@@ -565,10 +568,9 @@ def flash_bwd(
     sk_total = sk
     if dropout_p > 0.0 and dropout_seed is None:
         raise ValueError("dropout_p > 0 requires dropout_seed")
-    seed = (
-        jnp.zeros((1,), jnp.int32)
-        if dropout_seed is None
-        else jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    has_seed = dropout_p > 0.0
+    seed_args = (
+        [jnp.asarray(dropout_seed, jnp.int32).reshape(1)] if has_seed else []
     )
 
     # delta_i = rowsum(do * o) — the softmax-jacobian correction term
@@ -584,12 +586,14 @@ def flash_bwd(
     q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
     k_spec_j = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     row_spec_i = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0))
-    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    seed_specs = (
+        [pl.BlockSpec(memory_space=pltpu.SMEM)] if has_seed else []
+    )
     common = [q, k, v, do, lse, delta]
     kern_kw = dict(
         scale=scale, causal=causal, bq=bq, bk=bk,
         prec=_dot_precision(q.dtype), sk_total=sk_total,
-        dropout_p=dropout_p,
+        dropout_p=dropout_p, has_bias=bias is not None, has_seed=has_seed,
     )
 
     # --- dk/dv: grid (BH, nk, nq), q innermost ---
@@ -598,15 +602,11 @@ def flash_bwd(
     if bias is not None:
         in_specs.append(_bias_spec(bias, bh, bq, bk, "ji"))
         args.append(bias)
-        dkdv_kernel = functools.partial(
-            _dkdv_kernel, nq=nq, offset=offset, **kern_kw
-        )
-    else:
-        dkdv_kernel = functools.partial(
-            _dkdv_nobias, nq=nq, offset=offset, **kern_kw
-        )
-    in_specs.append(seed_spec)
-    args.append(seed)
+    in_specs += seed_specs
+    args += seed_args
+    dkdv_kernel = functools.partial(
+        _dkdv_entry, nq=nq, offset=offset, **kern_kw
+    )
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(bh, nk, nq),
@@ -638,15 +638,11 @@ def flash_bwd(
     if bias is not None:
         in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
-        dq_kernel = functools.partial(
-            _dq_kernel, nk=nk, offset=offset, **kern_kw
-        )
-    else:
-        dq_kernel = functools.partial(
-            _dq_nobias, nk=nk, offset=offset, **kern_kw
-        )
-    in_specs.append(seed_spec)
-    args.append(seed)
+    in_specs += seed_specs
+    args += seed_args
+    dq_kernel = functools.partial(
+        _dq_entry, nk=nk, offset=offset, **kern_kw
+    )
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
@@ -662,12 +658,26 @@ def flash_bwd(
     return dq, dk, dv
 
 
-def _dkdv_nobias(q, k, v, do, lse, delta, seed, dk, dv, dka, dva, **kw):
-    _dkdv_kernel(q, k, v, do, lse, delta, None, seed, dk, dv, dka, dva, **kw)
+def _dkdv_entry(*refs, has_bias, has_seed, **kw):
+    i = 6
+    bias_ref = refs[i] if has_bias else None
+    i += int(has_bias)
+    seed_ref = refs[i] if has_seed else None
+    i += int(has_seed)
+    dk, dv, dka, dva = refs[i:]
+    _dkdv_kernel(
+        *refs[:6], bias_ref, seed_ref, dk, dv, dka, dva, **kw
+    )
 
 
-def _dq_nobias(q, k, v, do, lse, delta, seed, dq, dqa, **kw):
-    _dq_kernel(q, k, v, do, lse, delta, None, seed, dq, dqa, **kw)
+def _dq_entry(*refs, has_bias, has_seed, **kw):
+    i = 6
+    bias_ref = refs[i] if has_bias else None
+    i += int(has_bias)
+    seed_ref = refs[i] if has_seed else None
+    i += int(has_seed)
+    dq, dqa = refs[i:]
+    _dq_kernel(*refs[:6], bias_ref, seed_ref, dq, dqa, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -745,6 +755,14 @@ def _dbias_kernel(
             dbias_ref[...] = acc_ref[...].astype(dbias_ref.dtype)[None]
 
 
+def _dbias_entry(*refs, has_seed, **kw):
+    i = 7
+    seed_ref = refs[i] if has_seed else None
+    i += int(has_seed)
+    dbias_ref, acc_ref = refs[i:]
+    _dbias_kernel(*refs[:7], seed_ref, dbias_ref, acc_ref, **kw)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -783,11 +801,7 @@ def flash_dbias(
     rs1 = rs == 1
     if dropout_p > 0.0 and dropout_seed is None:
         raise ValueError("dropout_p > 0 requires dropout_seed")
-    seed = (
-        jnp.zeros((1,), jnp.int32)
-        if dropout_seed is None
-        else jnp.asarray(dropout_seed, jnp.int32).reshape(1)
-    )
+    has_seed = dropout_p > 0.0
 
     delta_rows = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -827,23 +841,28 @@ def flash_dbias(
         return ((b * div + (t % div if rs1 else t)), j, 0)
 
     kernel = functools.partial(
-        _dbias_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        _dbias_entry, scale=scale, causal=causal, bq=bq, bk=bk,
         offset=offset, prec=_dot_precision(q.dtype), sk_total=sk,
         inner_total=inner_total, rs1=rs1, div=div, dropout_p=dropout_p,
+        has_seed=has_seed,
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), bh_idx),
+        pl.BlockSpec((1, bk, d), k_idx),
+        pl.BlockSpec((1, bk, d), k_idx),
+        pl.BlockSpec((1, bq, d), bh_idx),
+        pl.BlockSpec((1, bq, _LANES), row_idx),
+        pl.BlockSpec((1, bq, _LANES), row_idx),
+        bias_spec,
+    ]
+    args = [q, k, v, do, lse, delta, bias]
+    if has_seed:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), bh_idx),
-            pl.BlockSpec((1, bk, d), k_idx),
-            pl.BlockSpec((1, bk, d), k_idx),
-            pl.BlockSpec((1, bq, d), bh_idx),
-            pl.BlockSpec((1, bq, _LANES), row_idx),
-            pl.BlockSpec((1, bq, _LANES), row_idx),
-            bias_spec,
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=out_spec,
         out_shape=out_shape,
         scratch_shapes=[acc_shape],
@@ -853,4 +872,4 @@ def flash_dbias(
             ),
         ),
         interpret=pallas_interpret(),
-    )(q, k, v, do, lse, delta, bias, seed)
+    )(*args)
