@@ -423,6 +423,37 @@ class TestFusedDropout:
         check_grads(f, (bias,), order=1, modes=["rev"],
                     atol=1e-2, rtol=1e-2)
 
+    def test_keep_mask_hash_no_long_context_aliasing(self):
+        """The keyed pair-hash must not correlate positions at long-
+        context coordinates (the linear-counter scheme aliased
+        (r, c+65537) with (r+1, c)); also sane keep-rate far from the
+        origin."""
+        from apex_tpu.ops.pallas.flash_attention import (
+            _dropout_keep_block,
+        )
+
+        seed = jnp.asarray(1234, jnp.int32)
+        bh = jnp.asarray(3, jnp.int32)
+        bq = bk = 128
+        # two tiles starting beyond the 2^16 boundary in both dims
+        i1, j1 = 512, 513  # rows/cols ~65.5k
+        m1 = np.asarray(
+            _dropout_keep_block(seed, bh, i1, j1, bq, bk, 0.5)
+        )
+        # the tile one row down, one "aliasing constant" right — under
+        # the old scheme shifted copies of the same mask appear
+        m2 = np.asarray(
+            _dropout_keep_block(seed, bh, i1 + 1, j1, bq, bk, 0.5)
+        )
+        assert not np.array_equal(m1, m2)
+        # no shifted-copy correlation: agreement stays near 50% for a
+        # p=0.5 mask (aliasing would give long identical runs)
+        agree = (m1[1:, :] == m2[:-1, :]).mean()
+        assert 0.4 < agree < 0.6, agree
+        # keep-rate far from origin within binomial noise
+        rate = m1.mean()
+        assert abs(rate - 0.5) < 0.04, rate
+
     def test_dropout_with_causal_and_padding(self, force_pallas):
         """dropout composes with the causal mask and arbitrary-S padding:
         zero positions stay a superset of the causal zeros, kept entries
