@@ -37,10 +37,36 @@ import argparse
 import contextlib
 import functools
 import json
+import os
+import sys
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
+
+
+def _backend_watchdog(seconds: float):
+    """Fail fast if backend init hangs (the axon tunnel has been observed
+    to wedge for hours — a bench that hangs is worse for the driver than
+    one that exits nonzero with a diagnostic).  Disarmed once the first
+    device call returns; APEX_TPU_BENCH_WATCHDOG_S=0 disables."""
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(seconds):
+            print(
+                f"bench.py: backend initialization exceeded {seconds:.0f}s "
+                "(TPU tunnel unresponsive?) — aborting", file=sys.stderr,
+            )
+            os._exit(3)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return done
+
+
+_WATCHDOG_S = float(os.environ.get("APEX_TPU_BENCH_WATCHDOG_S", "900"))
 
 # per-chip dense bf16 peak FLOP/s by device kind (public specs)
 _PEAK = {
@@ -552,6 +578,10 @@ _CONFIGS = {
 
 
 def main(config="bert_lamb", trace_dir=None):
+    if _WATCHDOG_S > 0:
+        armed = _backend_watchdog(_WATCHDOG_S)
+        jax.devices()  # first backend touch happens under the watchdog
+        armed.set()
     if config == "all":
         for name, fn in _CONFIGS.items():
             # one trace (the headline config) per invocation
