@@ -361,6 +361,38 @@ def test_interleaved_matches_sequential_configs(eight_devices, pp, vpp, nm):
         )
 
 
+def test_1f1b_remat_policy_dots_matches_sequential(eight_devices):
+    """remat_policy='dots' (selective recompute) is numerics-identical."""
+    pp = 2
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=NM, remat=True, remat_policy="dots",
+        )
+        return losses, jax.tree_util.tree_map(lambda v: v[None], grads)
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")), check_vma=False,
+        )
+    )(stacked, inputs, targets)
+    ref_losses, ref_grads = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
 def test_1f1b_loss_takes_params_matches_sequential(eight_devices):
     """loss_fn(stage_params, y, t): the LAST stage's params get loss-side
     gradients (Megatron post-process head pattern) — golden = sequential
