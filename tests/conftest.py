@@ -85,6 +85,7 @@ _SLOW_TESTS = {
     "test_tp_matches_unsharded",
     "test_arbitrary_seq_with_bias_parity",
     "test_1f1b_carry_chunk_matches_sequential",
+    "test_interleaved_carry_chunk_matches_sequential",
     # interpret-mode kernel parametrization sweeps (the quick tier keeps
     # test_trainable_bias_multiblock / test_arbitrary_seq_grads_parity /
     # test_mask_semantics_and_rate as representatives of each family)
