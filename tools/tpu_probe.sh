@@ -1,0 +1,10 @@
+#!/bin/sh
+# Probe the axon TPU tunnel: exit 0 iff a tiny jit compile+execute completes.
+# The tunnel's observed failure mode is accepting metadata calls
+# (jax.devices()) while hanging on compile/execute, so the probe must run
+# a real computation, under a hard timeout.
+timeout "${1:-90}" python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+print(jax.jit(lambda a: (a @ a).sum())(x))
+" >/dev/null 2>&1
