@@ -37,6 +37,12 @@ __all__ = [
     "scatter_rows_gather_cols",
     "scatter_cols_gather_rows",
     "DAPAxialBlock",
+    # evoformer pair-stack modules (openfold_triton's model-side surface)
+    "GatedAttention",
+    "TriangleAttention",
+    "TriangleMultiplicativeUpdate",
+    "PairTransition",
+    "EvoformerPairBlock",
 ]
 
 
@@ -148,3 +154,12 @@ class DAPAxialBlock(nn.Module):
         h = nn.Dense(self.mlp_ratio * self.dim, name="mlp_up")(h)
         h = jax.nn.gelu(h)
         return x + nn.Dense(self.dim, name="mlp_down")(h)
+
+
+from apex_tpu.contrib.openfold.evoformer import (  # noqa: E402,F401
+    EvoformerPairBlock,
+    GatedAttention,
+    PairTransition,
+    TriangleAttention,
+    TriangleMultiplicativeUpdate,
+)

@@ -101,6 +101,11 @@ _SLOW_TESTS = {
     "test_causality",
     "test_loss_grad_finite",
     "test_openfold_axial_pair_stack_sharded_matches_unsharded",
+    "test_evoformer_pair_block_dap_matches_unsharded",
+    "test_evoformer_pair_block_dap_grads_match",
+    # quick tier keeps test_trainable_bias_multiblock as the dbias-kernel
+    # representative; this one re-proves it through TriangleAttention
+    "test_triangle_attention_bias_is_trainable",
     "test_spatial_matches_full",
     "test_synced_grads_match_global_objective",
     "test_sp_dropout_masks_differ_per_rank",
@@ -117,6 +122,7 @@ _SLOW_TESTS = {
 # id so at least one parameter combination of each family stays in the
 # quick tier as a representative.
 _SLOW_EXACT = {
+    "test_triangle_multiplicative_update_dap_matches[incoming]",
     "test_layer_norm_affine_fwd_bwd[False-float32-shape0]",
     "test_layer_norm_affine_fwd_bwd[False-float32-shape1]",
     "test_layer_norm_affine_fwd_bwd[False-float32-shape2]",
