@@ -43,6 +43,10 @@ __all__ = [
     "TriangleMultiplicativeUpdate",
     "PairTransition",
     "EvoformerPairBlock",
+    "MSARowAttentionWithPairBias",
+    "MSAColumnAttention",
+    "OuterProductMean",
+    "EvoformerBlock",
 ]
 
 
@@ -157,8 +161,12 @@ class DAPAxialBlock(nn.Module):
 
 
 from apex_tpu.contrib.openfold.evoformer import (  # noqa: E402,F401
+    EvoformerBlock,
     EvoformerPairBlock,
     GatedAttention,
+    MSAColumnAttention,
+    MSARowAttentionWithPairBias,
+    OuterProductMean,
     PairTransition,
     TriangleAttention,
     TriangleMultiplicativeUpdate,

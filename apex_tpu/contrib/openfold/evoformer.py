@@ -41,6 +41,10 @@ __all__ = [
     "TriangleMultiplicativeUpdate",
     "PairTransition",
     "EvoformerPairBlock",
+    "MSARowAttentionWithPairBias",
+    "MSAColumnAttention",
+    "OuterProductMean",
+    "EvoformerBlock",
 ]
 
 
@@ -49,6 +53,28 @@ def _layer_norm(mod: nn.Module, x, name: str):
     g = mod.param(name + "_scale", nn.initializers.ones, (d,))
     b = mod.param(name + "_bias", nn.initializers.zeros, (d,))
     return fused_layer_norm_affine(x, g, b, (d,))
+
+
+def _pair_bias(mod: nn.Module, z_ln, heads: int, axis_name: Optional[str],
+               n_res: int, name: str = "tri_bias"):
+    """Per-head attention bias projected from the (LN'd) pair rep.
+
+    Projects on the LOCAL rows first and all-gathers the small
+    (N/dap, N, heads) result (heads < D: the gather moves and the ranks
+    redundantly compute D/heads-fold less than gathering the pair itself
+    for an identical pointwise result).  Returns (1, H, N, N) — one bias
+    group shared by every batch row, trainable through the flash path's
+    dbias kernel (the grouped-G reduction sums the batch dim).
+    """
+    tri = nn.Dense(heads, use_bias=False, name=name)(z_ln)
+    if axis_name is not None:
+        tri = jax.lax.all_gather(tri, axis_name, axis=0, tiled=True)
+    if tri.shape[0] != n_res or tri.shape[1] != n_res:
+        raise ValueError(
+            f"pair bias needs a square pair representation matching the "
+            f"attended dim {n_res}; got {tri.shape[:2]}"
+        )
+    return tri.transpose(2, 0, 1)[None]
 
 
 class GatedAttention(nn.Module):
@@ -118,18 +144,9 @@ class TriangleAttention(nn.Module):
     def __call__(self, z):
         _, n_cols, _ = z.shape
         z_ln = _layer_norm(self, z, "ln")
-        tri = nn.Dense(self.heads, use_bias=False, name="tri_bias")(z_ln)
-        if self.axis_name is not None:
-            tri = jax.lax.all_gather(tri, self.axis_name, axis=0, tiled=True)
-        if tri.shape[0] != n_cols:
-            raise ValueError(
-                "triangle attention needs a square pair representation; "
-                f"got {tri.shape[0]}x{n_cols}"
-            )
-        # (N, N, H) -> (1, H, N, N): one bias group shared by every row
-        # of the batch; trainable through the dbias kernel on the flash
-        # path (the grouped-G reduction sums the batch dim).
-        tri_bias = tri.transpose(2, 0, 1)[None]
+        tri_bias = _pair_bias(
+            self, z_ln, self.heads, self.axis_name, n_cols
+        )
         return GatedAttention(heads=self.heads, name="attn")(
             z_ln, bias=tri_bias
         )
@@ -252,3 +269,136 @@ class EvoformerPairBlock(nn.Module):
         zc = zt.transpose(1, 0, 2)
         z = col_to_row(zc, ax) if ax is not None else zc
         return z + PairTransition(ratio=self.mlp_ratio, name="transition")(z)
+
+
+class MSARowAttentionWithPairBias(nn.Module):
+    """MSA row-wise gated self-attention, biased by the pair rep (AF2
+    suppl. Alg 7): each MSA row attends across residues with a per-head
+    additive bias projected from LN(z), shared by every row.
+
+    DAP layout: MSA (S/dap, R, c_m) sharded over its row (sequence) dim,
+    pair (R/dap, R, c_z) sharded over its leading residue dim.  The bias
+    is projected from the LOCAL pair rows and all-gathered as the small
+    (R, R, heads) tensor — the same local-project-then-gather shape
+    trick :class:`TriangleAttention` uses.
+    """
+
+    heads: int
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, m, z):
+        r = m.shape[1]
+        m_ln = _layer_norm(self, m, "ln_m")
+        z_ln = _layer_norm(self, z, "ln_z")
+        bias = _pair_bias(
+            self, z_ln, self.heads, self.axis_name, r, name="pair_bias"
+        )
+        return GatedAttention(heads=self.heads, name="attn")(m_ln, bias=bias)
+
+
+class MSAColumnAttention(nn.Module):
+    """MSA column-wise gated self-attention (AF2 suppl. Alg 8): per
+    residue, attend over the MSA's sequence dim.  Operates on the
+    COLUMN-major layout (R_loc, S, c_m) — :class:`EvoformerBlock` crosses
+    into it with the same ``row_to_col`` all-to-all the pair stack uses.
+    """
+
+    heads: int
+
+    @nn.compact
+    def __call__(self, m_col):
+        m_ln = _layer_norm(self, m_col, "ln")
+        return GatedAttention(heads=self.heads, name="attn")(m_ln)
+
+
+class OuterProductMean(nn.Module):
+    """Pair update from the MSA (AF2 suppl. Alg 10):
+    o[i,j] = Linear(flatten(mean_s a[s,i] ⊗ b[s,j])).
+
+    DAP form: the mean contracts over the SHARDED MSA row dim, so each
+    rank contracts its local rows and one ``psum_scatter`` both finishes
+    the sum and lands the output pair rows on their owning ranks — the
+    same reduce-scatter dual the incoming triangle update uses.  The
+    mean's divisor is the GLOBAL row count, recovered as
+    local · ``axis_size`` (shards are equal-sized by the DAP layout
+    contract) — not the local shard size.
+    """
+
+    hidden: int = 8
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, m, out_dim: int):
+        s_total = m.shape[0] * (
+            jax.lax.axis_size(self.axis_name)
+            if self.axis_name is not None
+            else 1
+        )
+        m_ln = _layer_norm(self, m, "ln")
+        a = nn.Dense(self.hidden, name="a")(m_ln)
+        b = nn.Dense(self.hidden, name="b")(m_ln)
+        o = jnp.einsum("sic,sjd->ijcd", a, b) / s_total
+        if self.axis_name is not None:
+            o = jax.lax.psum_scatter(
+                o, self.axis_name, scatter_dimension=0, tiled=True
+            )
+        o = o.reshape(o.shape[0], o.shape[1], self.hidden * self.hidden)
+        return nn.Dense(
+            out_dim, name="out", kernel_init=nn.initializers.zeros
+        )(o)
+
+
+class EvoformerBlock(nn.Module):
+    """One full evoformer block (AF2 suppl. Alg 6): the MSA stack (row
+    attention with pair bias, column attention, transition), the
+    outer-product-mean MSA→pair communication, then the pair stack
+    (:class:`EvoformerPairBlock`'s sequence).  This is the model-level
+    structure ALL of the reference's openfold_triton kernels serve; under
+    DAP both representations stay sharded on their leading dim and every
+    cross-layout move is one collective.
+
+    ``msa_dim``/``pair_dim`` are the channel widths.
+    """
+
+    msa_dim: int
+    pair_dim: int
+    heads: int
+    axis_name: Optional[str] = None
+    mlp_ratio: int = 4
+    opm_hidden: int = 8
+
+    @nn.compact
+    def __call__(self, m, z):
+        from apex_tpu.contrib.openfold import col_to_row, row_to_col
+
+        if m.shape[-1] != self.msa_dim:
+            raise ValueError(
+                f"MSA channel dim {m.shape[-1]} != configured {self.msa_dim}"
+            )
+        if z.shape[-1] != self.pair_dim:
+            raise ValueError(
+                f"pair channel dim {z.shape[-1]} != configured {self.pair_dim}"
+            )
+        ax = self.axis_name
+        # --- MSA stack -------------------------------------------------
+        m = m + MSARowAttentionWithPairBias(
+            heads=self.heads, axis_name=ax, name="msa_row_att"
+        )(m, z)
+        mc = row_to_col(m, ax) if ax is not None else m
+        mt = mc.transpose(1, 0, 2)  # (R_loc, S, c_m)
+        mt = mt + MSAColumnAttention(heads=self.heads, name="msa_col_att")(mt)
+        mc = mt.transpose(1, 0, 2)
+        m = col_to_row(mc, ax) if ax is not None else mc
+        m = m + PairTransition(ratio=self.mlp_ratio, name="msa_transition")(m)
+        # --- MSA -> pair communication --------------------------------
+        z = z + OuterProductMean(
+            hidden=self.opm_hidden, axis_name=ax,
+            name="outer_product_mean",
+        )(m, self.pair_dim)
+        # --- pair stack ------------------------------------------------
+        z = EvoformerPairBlock(
+            dim=self.pair_dim, heads=self.heads, axis_name=ax,
+            mlp_ratio=self.mlp_ratio, name="pair_block",
+        )(z)
+        return m, z

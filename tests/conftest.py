@@ -103,6 +103,8 @@ _SLOW_TESTS = {
     "test_openfold_axial_pair_stack_sharded_matches_unsharded",
     "test_evoformer_pair_block_dap_matches_unsharded",
     "test_evoformer_pair_block_dap_grads_match",
+    "test_evoformer_block_dap_matches_unsharded",
+    "test_evoformer_block_dap_grads_match",
     # quick tier keeps test_trainable_bias_multiblock as the dbias-kernel
     # representative; this one re-proves it through TriangleAttention
     "test_triangle_attention_bias_is_trainable",
