@@ -37,6 +37,7 @@ __all__ = [
     "write_token_file",
     "synthetic_token_corpus",
     "bert_mlm_batches",
+    "pack_mlm_predictions",
 ]
 
 
@@ -309,6 +310,41 @@ class DevicePrefetcher:
         self.close()
 
 
+def pack_mlm_predictions(labels, max_predictions_per_seq=20, seq_first=True):
+    """Dense MLM labels (S, B; -1 = unmasked) → the reference recipe's
+    fixed-K prediction triple: ``(positions, label_ids, weights)``, each
+    (K, B) with K = ``max_predictions_per_seq`` (≙ the BERT pretraining
+    input tensors masked_lm_positions / masked_lm_ids / masked_lm_weights).
+
+    Sequences with more than K masked positions are truncated (in position
+    order — exactly what the reference data pipeline does); sequences with
+    fewer are zero-padded with weight 0.  ``bert_pretrain_loss`` consumes
+    the triple to run the MLM head on K rows instead of all S.
+    """
+    labels = np.asarray(labels)
+    if not seq_first:
+        labels = labels.T
+    k = max_predictions_per_seq
+    mask = labels >= 0
+    # stable argsort of ~mask floats masked row-indices to the front, in
+    # position order; the first K per column are the kept predictions
+    order = np.argsort(~mask, axis=0, kind="stable")[:k]
+    weights = np.take_along_axis(mask, order, axis=0)
+    if order.shape[0] < k:  # K > S: zero-pad to keep the (K, B) contract
+        pad = np.zeros((k - order.shape[0], order.shape[1]), order.dtype)
+        order = np.concatenate([order, pad], axis=0)
+        weights = np.concatenate(
+            [weights, pad.astype(bool)], axis=0
+        )
+    ids = np.where(weights, np.take_along_axis(labels, order, axis=0), 0)
+    positions = np.where(weights, order, 0)
+    return (
+        positions.astype(np.int32),
+        ids.astype(np.int32),
+        weights.astype(np.float32),
+    )
+
+
 def bert_mlm_batches(
     loader: DataLoader,
     *,
@@ -319,6 +355,7 @@ def bert_mlm_batches(
     special_floor: int = 1000,
     seq_first: bool = True,
     start_step: int = 0,
+    max_predictions_per_seq: "int | None" = None,
 ):
     """Endless BERT phase-1 batches from a token loader.
 
@@ -330,6 +367,11 @@ def bert_mlm_batches(
     at that batch index (O(1), nothing gathered for skipped batches) and
     the corruption seed counter starts there, so batch N of a resumed
     stream is bit-identical to batch N of an uninterrupted one.
+
+    ``max_predictions_per_seq``: when set, each batch also carries the
+    fixed-K ``mlm_positions``/``mlm_label_ids``/``mlm_weights`` triple
+    (:func:`pack_mlm_predictions` — the reference recipe's input format),
+    which ``bert_pretrain_loss`` prefers over the dense labels.
     """
     step = start_step
     src = (
@@ -361,7 +403,7 @@ def bert_mlm_batches(
         nsp = np.random.default_rng(
             np.random.SeedSequence([seed, step, 0x4E53])
         ).integers(0, 2, size=(b,)).astype(np.int32)
-        yield {
+        out = {
             "input_ids": masked,
             "token_type_ids": np.zeros_like(masked),
             "attention_mask": np.ones(
@@ -371,4 +413,14 @@ def bert_mlm_batches(
             "mlm_labels": labels,
             "nsp_labels": nsp,
         }
+        if max_predictions_per_seq:
+            pos, pids, w = pack_mlm_predictions(
+                labels, max_predictions_per_seq, seq_first=seq_first
+            )
+            if not seq_first:
+                pos, pids, w = pos.T, pids.T, w.T
+            out.update(
+                mlm_positions=pos, mlm_label_ids=pids, mlm_weights=w
+            )
+        yield out
         step += 1

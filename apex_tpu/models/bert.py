@@ -525,6 +525,18 @@ def bert_pretrain_loss(
     vocab-parallel and feed vocab_parallel_cross_entropy directly — no
     logits gather, ≙ _VocabParallelCrossEntropy).
 
+    **Masked-position gather (the reference recipe's input format).**  When
+    the batch carries the fixed-K triple ``mlm_positions`` (K, B) /
+    ``mlm_label_ids`` (K, B) / ``mlm_weights`` (K, B; 1.0 = real
+    prediction, 0.0 = pad), the MLM head runs only on the K gathered rows
+    per sequence — the BERT ``max_predictions_per_seq`` recipe
+    (masked_lm_positions/masked_lm_ids/masked_lm_weights in the reference's
+    BERT pretraining input), which at phase-1 shapes (S=128, K=20) removes
+    ~84% of the decoder-matmul + cross-entropy work.  The dense
+    ``mlm_labels`` path remains for full-sequence scoring;
+    :func:`apex_tpu.data.pack_mlm_predictions` converts dense labels to the
+    triple.
+
     ``mlm_loss_chunks``: split the (S·B, V) logits matmul + cross entropy
     into this many row chunks, each rematerialized in backward — the full
     f32 logits tensor (2 GB at batch 128 / BERT-Large vocab) never exists;
@@ -540,7 +552,19 @@ def bert_pretrain_loss(
         rngs=rngs,
     )
     embed = params["params"]["bert"]["embeddings"]["word_embeddings"]["weight"]
-    labels = batch["mlm_labels"]
+    positions = batch.get("mlm_positions")
+    if positions is not None:
+        # (S, B, H) -> (K, B, H); backward is a scatter-add into dh.  h is
+        # full-S in both layouts (the SP path gathered inside the model),
+        # so the gather is rank-local and the tp grad boundaries below are
+        # unchanged.
+        h = jnp.take_along_axis(h, positions[:, :, None], axis=0)
+        labels = batch["mlm_label_ids"]
+        weights = batch["mlm_weights"].astype(jnp.float32)
+    else:
+        labels = batch["mlm_labels"]
+        weights = (labels >= 0).astype(jnp.float32)
+        labels = jnp.maximum(labels, 0)
     if not model.cfg.sequence_parallel and ps.axis_is_bound(_TP):
         # ≙ Megatron's copy_to_tensor_model_parallel_region before the
         # vocab-sharded logits matmul: identity forward, psum backward.
@@ -554,7 +578,7 @@ def bert_pretrain_loss(
     with jax.named_scope("mlm_logits_xent"):
         dec = jnp.transpose(embed).astype(model.cfg.dtype)
 
-        def rows_loss(h_rows, l_rows):
+        def rows_loss(h_rows, l_rows, w_rows):
             logits = (
                 jnp.matmul(
                     h_rows.astype(model.cfg.dtype), dec,
@@ -562,21 +586,22 @@ def bert_pretrain_loss(
                 )
                 + mlm_bias
             )
-            m = (l_rows >= 0).astype(jnp.float32)
             losses = vocab_parallel_cross_entropy(
-                logits.astype(jnp.float32), jnp.maximum(l_rows, 0)
+                logits.astype(jnp.float32), l_rows
             )
-            return jnp.sum(losses * m), jnp.sum(m)
+            return jnp.sum(losses * w_rows), jnp.sum(w_rows)
 
         nc = mlm_loss_chunks or 1
         if nc > 1:
             rows = labels.size
             if rows % nc:
                 raise ValueError(
-                    f"mlm_loss_chunks={nc} must divide S*B={rows}"
+                    f"mlm_loss_chunks={nc} must divide the number of "
+                    f"MLM prediction rows ({rows})"
                 )
             hc = h.reshape(nc, rows // nc, h.shape[-1])
             lc = labels.reshape(nc, rows // nc)
+            wc = weights.reshape(nc, rows // nc)
             # Statically unrolled (not lax.map/scan): scan's backward stacks
             # the per-chunk dh cotangents into an (nc, rows/nc, H) buffer
             # through dynamic-update-slice — an extra full pass over dh that
@@ -586,13 +611,14 @@ def bert_pretrain_loss(
             total = jnp.float32(0.0)
             count = jnp.float32(0.0)
             for i in range(nc):
-                s, c = chunk_fn(hc[i], lc[i])
+                s, c = chunk_fn(hc[i], lc[i], wc[i])
                 total = total + s
                 count = count + c
             mlm_loss = total / jnp.maximum(count, 1.0)
         else:
             total, count = rows_loss(
-                h.reshape(-1, h.shape[-1]), labels.reshape(-1)
+                h.reshape(-1, h.shape[-1]), labels.reshape(-1),
+                weights.reshape(-1),
             )
             mlm_loss = total / jnp.maximum(count, 1.0)
 

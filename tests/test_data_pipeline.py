@@ -214,3 +214,23 @@ class TestMlmBatches:
         # consecutive steps draw different masks
         b2 = next(it)
         assert not np.array_equal(b["mlm_labels"], b2["mlm_labels"])
+
+    def test_packed_prediction_triple(self, token_file):
+        """max_predictions_per_seq adds the fixed-K positions/ids/weights
+        triple consistent with the dense labels (reference input format)."""
+        p, _ = token_file
+        ds = TokenFileDataset(p, seq_len=128)
+        dl = DataLoader(ds, batch_size=4, seed=1)
+        it = bert_mlm_batches(
+            dl, seed=5, vocab_size=6000, max_predictions_per_seq=24
+        )
+        b = next(it)
+        pos, ids, w = b["mlm_positions"], b["mlm_label_ids"], b["mlm_weights"]
+        assert pos.shape == ids.shape == w.shape == (24, 4)
+        labels = b["mlm_labels"]
+        for col in range(4):
+            want = np.nonzero(labels[:, col] >= 0)[0][:24]
+            n = len(want)
+            np.testing.assert_array_equal(pos[:n, col], want)
+            np.testing.assert_array_equal(ids[:n, col], labels[want, col])
+            assert w[:n, col].all() and not w[n:, col].any()

@@ -38,10 +38,22 @@ def _bert_batch():
     }
 
 
-def _sharded_bert_loss(sp, tp=8):
+def _pack_batch(batch, k):
+    from apex_tpu.data import pack_mlm_predictions
+
+    pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], k)
+    return dict(
+        batch, mlm_positions=jnp.asarray(pos),
+        mlm_label_ids=jnp.asarray(ids), mlm_weights=jnp.asarray(w),
+    )
+
+
+def _sharded_bert_loss(sp, tp=8, packed=False):
     mesh = ps.initialize_model_parallel(tensor_model_parallel_size=tp)
     m = BertForPreTraining(BertConfig(sequence_parallel=sp, **BERT_KW))
     batch = _bert_batch()
+    if packed:
+        batch = _pack_batch(batch, 8)
 
     def f(key, batch):
         params = m.init(key, batch["input_ids"])
@@ -91,6 +103,68 @@ class TestBert:
         )
         with pytest.raises(ValueError):
             bert_pretrain_loss(params, m, batch, mlm_loss_chunks=7)
+
+    def test_packed_mlm_matches_dense(self):
+        """The fixed-K masked-position path (mlm_positions/label_ids/
+        weights, ≙ the reference recipe's max_predictions_per_seq input)
+        must reproduce the dense-label loss and grads exactly when K covers
+        every masked position."""
+        from apex_tpu.data import pack_mlm_predictions
+
+        m = BertForPreTraining(BertConfig(**BERT_KW))
+        batch = _bert_batch()
+        params = m.init(jax.random.PRNGKey(0), batch["input_ids"])
+        n_masked = int(jnp.max(jnp.sum(batch["mlm_labels"] >= 0, axis=0)))
+        pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], n_masked)
+        assert int(w.sum()) == int(jnp.sum(batch["mlm_labels"] >= 0))
+        packed = dict(
+            batch, mlm_positions=jnp.asarray(pos),
+            mlm_label_ids=jnp.asarray(ids), mlm_weights=jnp.asarray(w),
+        )
+        l1, g1 = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m, batch)
+        )(params)
+        l2, g2 = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m, packed)
+        )(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-4, atol=2e-5,
+            ),
+            g1, g2,
+        )
+
+    def test_packed_mlm_truncates_and_chunks(self):
+        """K smaller than the masked count truncates in position order (the
+        reference behavior); chunking composes with the packed path."""
+        from apex_tpu.data import pack_mlm_predictions
+
+        m = BertForPreTraining(BertConfig(**BERT_KW))
+        batch = _bert_batch()
+        params = m.init(jax.random.PRNGKey(0), batch["input_ids"])
+        pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], 2)
+        assert pos.shape == (2, B) and w.sum() <= 2 * B
+        # truncation keeps the first masked positions per sequence
+        labels_np = np.asarray(batch["mlm_labels"])
+        for b in range(B):
+            want = np.nonzero(labels_np[:, b] >= 0)[0][:2]
+            got = pos[: len(want), b]
+            np.testing.assert_array_equal(got, want)
+        packed = dict(
+            batch, mlm_positions=jnp.asarray(pos),
+            mlm_label_ids=jnp.asarray(ids), mlm_weights=jnp.asarray(w),
+        )
+        l1 = bert_pretrain_loss(params, m, packed)
+        l2 = bert_pretrain_loss(params, m, packed, mlm_loss_chunks=2)
+        assert np.isfinite(float(l1))
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        # K > S keeps the documented fixed-(K, B) shape, zero-padded
+        pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], S + 4)
+        assert pos.shape == ids.shape == w.shape == (S + 4, B)
+        assert not w[S:].any() and not pos[S:].any()
+        assert int(w.sum()) == int(jnp.sum(batch["mlm_labels"] >= 0))
 
     def test_unrolled_matches_scanned(self):
         """scan_layers / remat_attention are pure layout+schedule knobs:
@@ -162,6 +236,20 @@ class TestBert:
         ps.destroy_model_parallel()
         l_sp = _sharded_bert_loss(sp=True)
         assert abs(l_tp - l_sp) < 1e-4, (l_tp, l_sp)
+
+    def test_packed_mlm_tp_sp_matches_unsharded(self, eight_devices):
+        """The masked-position gather sits above the tp/SP grad boundaries
+        (copy_to / SP gather), so the packed loss must agree across
+        unsharded, tp, and tp+SP runs."""
+        m1 = BertForPreTraining(BertConfig(**BERT_KW))
+        batch = _pack_batch(_bert_batch(), 8)
+        p1 = m1.init(jax.random.PRNGKey(0), batch["input_ids"])
+        l1 = float(bert_pretrain_loss(p1, m1, batch))
+        l_tp = _sharded_bert_loss(sp=False, packed=True)
+        ps.destroy_model_parallel()
+        l_sp = _sharded_bert_loss(sp=True, packed=True)
+        assert abs(l_tp - l1) < 2e-3, (l_tp, l1)
+        assert abs(l_sp - l_tp) < 1e-4, (l_sp, l_tp)
 
     def test_training_descends(self):
         m = BertForPreTraining(BertConfig(**BERT_KW))

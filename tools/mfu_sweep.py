@@ -21,7 +21,7 @@ import bench
 
 
 def run(batch, remat, remat_policy, scan_layers=True, remat_attention=False,
-        mlm_loss_chunks=None, prevent_cse=None, trace_dir=None):
+        mlm_loss_chunks=None, prevent_cse=None, mpps=None, trace_dir=None):
     cfg_kwargs = dict(
         remat=remat, remat_policy=remat_policy, scan_layers=scan_layers,
         remat_attention=remat_attention, remat_prevent_cse=prevent_cse,
@@ -29,12 +29,13 @@ def run(batch, remat, remat_policy, scan_layers=True, remat_attention=False,
     label = (
         f"batch={batch:4d} remat={remat!s:5} policy={remat_policy:5} "
         f"scan={scan_layers!s:5} rattn={remat_attention!s:5} "
-        f"mlmc={mlm_loss_chunks} pcse={prevent_cse}"
+        f"mlmc={mlm_loss_chunks} pcse={prevent_cse} mpps={mpps}"
     )
     try:
         mfu, t, _loss = bench.bench_bert_lamb(
             trace_dir=trace_dir, batch=batch, cfg_kwargs=cfg_kwargs,
-            mlm_loss_chunks=mlm_loss_chunks, emit=False,
+            mlm_loss_chunks=mlm_loss_chunks,
+            max_predictions_per_seq=mpps, emit=False,
         )
         print(f"{label} step={t * 1e3:7.1f}ms MFU={mfu:.4f}", flush=True)
     except Exception as e:  # OOM / compile failure etc.
@@ -43,6 +44,11 @@ def run(batch, remat, remat_policy, scan_layers=True, remat_attention=False,
         )
 
 
+# NOTE on comparability: rows run the DENSE MLM head (mpps=None) unless
+# the mpps field is set; packed-head (mpps=20) numbers execute ~84% less
+# decoder work and are only comparable to other packed rows (bench.py
+# emits the executed-FLOPs mfu_exec alongside the 6NT headline for this
+# reason).
 # The r3 exploration grid (VERDICT r2 item 5: push 0.53 -> >=0.58).
 # Each entry: (batch, remat, policy, scan, rattn, mlmc, pcse).  Rationale
 # per row in the comment; ~2-4 min each on the chip (compile + 3 trials).
@@ -68,8 +74,8 @@ if __name__ == "__main__":
     ap.add_argument("--trace", default=None)
     ap.add_argument(
         "--only", default=None,
-        help="batch,remat,policy,scan,rattn,mlmc[,pcse] "
-             "e.g. 256,True,dots,F,T,8,F",
+        help="batch,remat,policy,scan,rattn,mlmc[,pcse[,mpps]] "
+             "e.g. 256,True,dots,F,T,8,F,20 (mpps=0 → dense labels)",
     )
     ap.add_argument(
         "--grid", action="store_true",
@@ -91,6 +97,7 @@ if __name__ == "__main__":
             remat_attention=f[4][0] in "Tt" if len(f) > 4 else False,
             mlm_loss_chunks=int(f[5]) if len(f) > 5 and f[5] != "0" else None,
             prevent_cse=(f[6][0] in "Tt") if len(f) > 6 else None,
+            mpps=int(f[7]) if len(f) > 7 and f[7] != "0" else None,
         )
     else:
         # no args = exactly the headline: cfg_kwargs=None takes bench.py's
