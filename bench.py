@@ -130,7 +130,7 @@ def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
 
 
 def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
-                    cfg_kwargs=None, mlm_loss_chunks=None,
+                    cfg_kwargs=None, mlm_loss_chunks="auto",
                     max_predictions_per_seq=20, emit=True):
     """Returns (mfu, step_time, loss).  ``cfg_kwargs`` overrides the tuned
     model config (tools/mfu_sweep.py reuses this function for its variants,
@@ -140,8 +140,9 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
     reference recipe's masked_lm_positions input; 20 is its phase-1 value
     at seq 128).  The r2 headline scored the MLM head on all 128 positions
     — ~3.1 TFLOP/step of vocab matmul where the recipe does ~0.5;
-    None restores that dense-label variant (where mlm_loss_chunks=16 is
-    the measured best)."""
+    None restores that dense-label variant.  ``mlm_loss_chunks="auto"``
+    resolves to unchunked for the packed head and the measured-best 16
+    for dense; an explicit None always means unchunked."""
     import apex_tpu.utils
     from apex_tpu.models import (
         BertForPreTraining,
@@ -190,10 +191,12 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
             mlm_label_ids=jnp.asarray(pids),
             mlm_weights=jnp.asarray(w),
         )
-    elif mlm_loss_chunks is None:
-        # dense-label fallback: never materialize the full (S·B, V) f32
-        # logits (~2 GB at batch 128); 16 is the measured-best chunking
-        mlm_loss_chunks = 16
+    if mlm_loss_chunks == "auto":
+        # packed head: the (K·B, V) logits are small — unchunked.  Dense
+        # fallback: never materialize the full (S·B, V) f32 logits (~2 GB
+        # at batch 128); 16 is the measured-best chunking.  An explicit
+        # None always means unchunked.
+        mlm_loss_chunks = None if max_predictions_per_seq else 16
 
     params = model.init(jax.random.PRNGKey(1), ids)
     opt_state = tx.init(params)

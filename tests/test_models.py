@@ -39,13 +39,15 @@ def _bert_batch():
 
 
 def _pack_batch(batch, k):
+    """Packed batch + the raw (positions, ids, weights) triple."""
     from apex_tpu.data import pack_mlm_predictions
 
     pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], k)
-    return dict(
+    packed = dict(
         batch, mlm_positions=jnp.asarray(pos),
         mlm_label_ids=jnp.asarray(ids), mlm_weights=jnp.asarray(w),
     )
+    return packed, (pos, ids, w)
 
 
 def _sharded_bert_loss(sp, tp=8, packed=False):
@@ -53,7 +55,7 @@ def _sharded_bert_loss(sp, tp=8, packed=False):
     m = BertForPreTraining(BertConfig(sequence_parallel=sp, **BERT_KW))
     batch = _bert_batch()
     if packed:
-        batch = _pack_batch(batch, 8)
+        batch, _ = _pack_batch(batch, 8)
 
     def f(key, batch):
         params = m.init(key, batch["input_ids"])
@@ -109,18 +111,12 @@ class TestBert:
         weights, ≙ the reference recipe's max_predictions_per_seq input)
         must reproduce the dense-label loss and grads exactly when K covers
         every masked position."""
-        from apex_tpu.data import pack_mlm_predictions
-
         m = BertForPreTraining(BertConfig(**BERT_KW))
         batch = _bert_batch()
         params = m.init(jax.random.PRNGKey(0), batch["input_ids"])
         n_masked = int(jnp.max(jnp.sum(batch["mlm_labels"] >= 0, axis=0)))
-        pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], n_masked)
+        packed, (pos, ids, w) = _pack_batch(batch, n_masked)
         assert int(w.sum()) == int(jnp.sum(batch["mlm_labels"] >= 0))
-        packed = dict(
-            batch, mlm_positions=jnp.asarray(pos),
-            mlm_label_ids=jnp.asarray(ids), mlm_weights=jnp.asarray(w),
-        )
         l1, g1 = jax.value_and_grad(
             lambda p: bert_pretrain_loss(p, m, batch)
         )(params)
@@ -144,7 +140,7 @@ class TestBert:
         m = BertForPreTraining(BertConfig(**BERT_KW))
         batch = _bert_batch()
         params = m.init(jax.random.PRNGKey(0), batch["input_ids"])
-        pos, ids, w = pack_mlm_predictions(batch["mlm_labels"], 2)
+        packed, (pos, ids, w) = _pack_batch(batch, 2)
         assert pos.shape == (2, B) and w.sum() <= 2 * B
         # truncation keeps the first masked positions per sequence
         labels_np = np.asarray(batch["mlm_labels"])
@@ -152,10 +148,6 @@ class TestBert:
             want = np.nonzero(labels_np[:, b] >= 0)[0][:2]
             got = pos[: len(want), b]
             np.testing.assert_array_equal(got, want)
-        packed = dict(
-            batch, mlm_positions=jnp.asarray(pos),
-            mlm_label_ids=jnp.asarray(ids), mlm_weights=jnp.asarray(w),
-        )
         l1 = bert_pretrain_loss(params, m, packed)
         l2 = bert_pretrain_loss(params, m, packed, mlm_loss_chunks=2)
         assert np.isfinite(float(l1))
@@ -242,7 +234,7 @@ class TestBert:
         (copy_to / SP gather), so the packed loss must agree across
         unsharded, tp, and tp+SP runs."""
         m1 = BertForPreTraining(BertConfig(**BERT_KW))
-        batch = _pack_batch(_bert_batch(), 8)
+        batch, _ = _pack_batch(_bert_batch(), 8)
         p1 = m1.init(jax.random.PRNGKey(0), batch["input_ids"])
         l1 = float(bert_pretrain_loss(p1, m1, batch))
         l_tp = _sharded_bert_loss(sp=False, packed=True)

@@ -1,0 +1,291 @@
+"""Collective structure of the multi-device BASELINE configs (#2, #5).
+
+One real chip cannot time dp>1 / tp>1 (VERDICT r2 "what's weak" #2: the
+TP comm fraction that BASELINE config #5 exists to measure has never been
+recorded).  What CAN be recorded honestly without a pod is the *compiled
+collective schedule*: build the real train step on the 8-device CPU mesh
+(identical shardings/program to the TPU run — GSPMD doesn't care about
+the backend), compile it, and read every collective out of the optimized
+HLO with its operand shape.  From bytes moved + an explicit ICI bandwidth
+model this yields an analytic comm fraction; the artifact records the
+structure (op kinds, counts, bytes) so the model's inputs are auditable.
+
+Writes one JSON line per config to COMM_STRUCTURE_r{N}.json at the repo
+root:  python tools/comm_structure.py --round 3
+
+Bandwidth/peak model (overridable): v5e ICI = 45 GB/s per link per
+direction x 4 links/chip (2D torus, public "How to Scale Your Model"
+figures), bf16 peak 197 TFLOP/s.  Collectives here ride one mesh axis, so
+the per-chip effective bandwidth used is one link pair (ring algorithms
+stream over two directed links): 90 GB/s.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4,
+    "u16": 2, "u8": 1, "pred": 1,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def _shape_bytes(shape: str) -> int:
+    """bytes of an HLO shape string like 'bf16[8,128,1024]' (tuples:
+    sum of elements)."""
+    total = 0
+    for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", shape):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def collect(hlo_text: str):
+    """Per-kind {count, bytes} for every collective in optimized HLO.
+
+    Bytes = operand bytes of each op (the data a rank contributes); for
+    all-gather the moved volume is (world-1)/world of the OUTPUT, for
+    all-reduce a ring moves ~2x the operand — the analytic model below
+    applies those factors per kind.
+    """
+    out = {}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        m = re.match(r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*(\([^)]*\)|[^\s]+)\s+"
+                     r"(all-reduce|all-gather|reduce-scatter|"
+                     r"collective-permute|all-to-all)", line)
+        if not m:
+            continue
+        shape, kind = m.group(1), m.group(2)
+        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += _shape_bytes(shape)
+    return out
+
+
+def ring_traffic_bytes(kinds: dict, world: int) -> float:
+    """Per-chip ICI traffic (bytes sent) under ring algorithms."""
+    t = 0.0
+    for kind, rec in kinds.items():
+        b = rec["bytes"]
+        if kind == "all-reduce":
+            t += 2.0 * b * (world - 1) / world
+        elif kind in ("all-gather", "reduce-scatter"):
+            # operand is the local shard for AG / full buffer for RS; the
+            # shapes recorded are op RESULTS for AG (full) and shards for
+            # RS in XLA's notation — both stream (world-1)/world of the
+            # full buffer; b is whichever the HLO printed, so this is a
+            # lower bound for RS and exact for AG results.
+            t += b * (world - 1) / world
+        elif kind == "collective-permute":
+            t += b  # one hop
+        elif kind == "all-to-all":
+            t += b * (world - 1) / world
+    return t
+
+
+def emit(rec, fh):
+    line = json.dumps(rec)
+    print(line, flush=True)
+    fh.write(line + "\n")
+
+
+def tp_gpt_structure(world: int):
+    """BASELINE #5: the GPT block train step at tp=world (+SP)."""
+    from apex_tpu import parallel_state as ps
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        allreduce_sequence_parallel_gradients,
+    )
+    from apex_tpu.models.gpt import GptBlock, GptConfig
+    from apex_tpu.optimizers import fused_adam
+
+    devices = jax.devices()[:world]
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(
+        tensor_model_parallel_size=world, devices=devices
+    )
+    mesh = Mesh(devices, (ps.TENSOR_PARALLEL_AXIS,))
+    seq, batch = 1024, 8
+    cfg = GptConfig(
+        hidden_size=1024, num_heads=16, intermediate_size=4096,
+        sequence_parallel=True, dtype=jnp.bfloat16,
+    )
+    block = GptBlock(cfg)
+    tx = fused_adam(learning_rate=1e-4)
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (seq, batch, cfg.hidden_size), jnp.bfloat16
+    )
+
+    def step(x):
+        rank = jax.lax.axis_index(ps.TENSOR_PARALLEL_AXIS)
+        xl = jax.lax.dynamic_slice_in_dim(
+            x, rank * (seq // world), seq // world, 0
+        )
+        params = block.init(jax.random.PRNGKey(1), xl)
+        opt_state = tx.init(params)
+
+        def loss_fn(p):
+            y = block.apply(p, xl)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = allreduce_sequence_parallel_gradients(grads)
+        updates, _ = tx.update(grads, opt_state, params)
+        # fold every update leaf into the output so the whole backward +
+        # optimizer graph (incl. its collectives) survives DCE
+        return loss + sum(
+            jnp.sum(u).astype(jnp.float32)
+            for u in jax.tree_util.tree_leaves(updates)
+        )
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    hlo = fn.lower(x).compile().as_text()
+    ps.destroy_model_parallel()
+    kinds = collect(hlo)
+    # fwd+bwd GEMM FLOPs of the block per chip: qkv/out/mlp-in/mlp-out
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    gemm = 2 * seq * batch * (h * 3 * h + h * h + h * i + i * h)
+    flops_chip = 3 * gemm / world
+    return kinds, flops_chip
+
+
+def ddp_syncbn_structure(world: int):
+    """BASELINE #2: ResNet-50 + DDP + SyncBatchNorm at dp=world.
+
+    Small images (64x64): conv compute shrinks but the collective
+    structure (grad psums + per-BN Welford psums) and grad BYTES are
+    image-size-invariant; the recorded flops_chip reflects the small
+    images and is marked as such.
+    """
+    from apex_tpu.models.resnet import resnet50
+    from apex_tpu.optimizers import fused_sgd
+    from apex_tpu.parallel import distributed as dist
+    from apex_tpu import parallel_state as ps
+
+    devices = jax.devices()[:world]
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(devices=devices)  # pure dp mesh
+    mesh = Mesh(devices, (ps.DATA_PARALLEL_AXIS,))
+    batch = 2  # per replica
+    model = resnet50(use_syncbn=True)
+    tx = fused_sgd(learning_rate=0.1, momentum=0.9)
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (world * batch, 64, 64, 3), jnp.bfloat16
+    )
+    y = jax.random.randint(jax.random.PRNGKey(1), (world * batch,), 0, 1000)
+
+    def step(x, y):
+        rank = jax.lax.axis_index(ps.DATA_PARALLEL_AXIS)
+        xl = jax.lax.dynamic_slice_in_dim(x, rank * batch, batch, 0)
+        yl = jax.lax.dynamic_slice_in_dim(y, rank * batch, batch, 0)
+        variables = model.init(jax.random.PRNGKey(2), xl, train=False)
+        params, bstats = variables["params"], variables["batch_stats"]
+        opt_state = tx.init(params)
+
+        def loss_fn(p):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": bstats}, xl, train=True,
+                mutable=["batch_stats"],
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(
+                jnp.take_along_axis(logp, yl[:, None], axis=-1)
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = dist.all_reduce_gradients(
+            grads, axis_name=ps.DATA_PARALLEL_AXIS
+        )
+        updates, _ = tx.update(grads, opt_state, params)
+        return loss + sum(
+            jnp.sum(u).astype(jnp.float32)
+            for u in jax.tree_util.tree_leaves(updates)
+        )
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    hlo = fn.lower(x, y).compile().as_text()
+    ps.destroy_model_parallel()
+    return collect(hlo), None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--ici-gbps", type=float, default=90.0,
+                    help="per-chip usable ICI GB/s for one mesh axis "
+                    "(v5e: one bidirectional link pair)")
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    args = ap.parse_args()
+
+    out_path = os.path.join(
+        REPO, f"COMM_STRUCTURE_r{args.round:02d}.json"
+    )
+    with open(out_path, "w") as fh:
+        for name, fn in (
+            ("tp_gpt_block", tp_gpt_structure),
+            ("ddp_resnet50_syncbn", ddp_syncbn_structure),
+        ):
+            kinds, flops_chip = fn(args.world)
+            traffic = ring_traffic_bytes(kinds, args.world)
+            comm_s = traffic / (args.ici_gbps * 1e9)
+            rec = {
+                "config": name,
+                "world": args.world,
+                "collectives": kinds,
+                "per_chip_traffic_bytes": int(traffic),
+                "ici_model_gbps": args.ici_gbps,
+                "analytic_comm_ms": round(comm_s * 1e3, 4),
+            }
+            if flops_chip:
+                comp_s = flops_chip / (args.peak_tflops * 1e12)
+                rec["per_chip_gemm_flops"] = int(flops_chip)
+                rec["analytic_compute_ms_at_peak"] = round(comp_s * 1e3, 4)
+                rec["analytic_comm_fraction"] = round(
+                    comm_s / (comm_s + comp_s), 4
+                )
+            emit(rec, fh)
+    print(f"[comm_structure] wrote {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
