@@ -29,7 +29,38 @@ def load_trace(log_dir: str) -> dict:
         return json.load(f)
 
 
-def summarize(trace: dict, top: int, like: str | None):
+def load_hlo_metadata(path: str) -> dict:
+    """op name → \"op_name (source_file:line)\" from an HLO text dump.
+
+    Join key: XLA's op names in profiler traces ("fusion.9461",
+    "add_add_fusion.78") are the HLO instruction names, so a compiled
+    ``jit_fn.lower(...).compile().as_text()`` dump attributes every trace
+    row to the model source that produced it — the manual step of the
+    r2/r3 MFU loops, automated.
+    """
+    import re
+
+    meta = {}
+    pat = re.compile(
+        r"%?([\w.-]+) = .*metadata=\{[^}]*?op_name=\"([^\"]+)\""
+        r"(?:[^}]*?source_file=\"([^\"]+)\")?"
+        r"(?:[^}]*?source_line=(\d+))?"
+    )
+    with open(path) as f:
+        for line in f:
+            m = pat.search(line)
+            if not m:
+                continue
+            name, op, src, ln = m.groups()
+            where = ""
+            if src:
+                base = src.rsplit("/", 1)[-1]
+                where = f" ({base}:{ln})" if ln else f" ({base})"
+            meta[name] = f"{op}{where}"
+    return meta
+
+
+def summarize(trace: dict, top: int, like: str | None, hlo_meta=None):
     events = trace.get("traceEvents", [])
     # pid -> process name; device tracks are named "/device:TPU:0" etc.
     # One device pid carries several threads (XLA Modules spanning whole
@@ -91,7 +122,10 @@ def summarize(trace: dict, top: int, like: str | None):
     print(f"{'total_ms':>9} {'n':>6} {'avg_us':>8}  name")
     for name, dur in per_op.most_common(top):
         n = per_op_n[name]
-        print(f"{dur:9.2f} {n:6d} {dur / n * 1e3:8.1f}  {name[:110]}")
+        attr = ""
+        if hlo_meta is not None:
+            attr = "  <- " + hlo_meta.get(name, "?")
+        print(f"{dur:9.2f} {n:6d} {dur / n * 1e3:8.1f}  {name[:110]}{attr[:160]}")
 
 
 if __name__ == "__main__":
@@ -99,5 +133,12 @@ if __name__ == "__main__":
     ap.add_argument("log_dir")
     ap.add_argument("-n", type=int, default=30)
     ap.add_argument("--like", default=None, help="substring filter")
+    ap.add_argument(
+        "--hlo", default=None,
+        help="optimized-HLO text dump (jit_fn.lower().compile().as_text())"
+        " of the traced program; attributes each op row to its op_name +"
+        " source line",
+    )
     args = ap.parse_args()
-    summarize(load_trace(args.log_dir), args.n, args.like)
+    meta = load_hlo_metadata(args.hlo) if args.hlo else None
+    summarize(load_trace(args.log_dir), args.n, args.like, hlo_meta=meta)
