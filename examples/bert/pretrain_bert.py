@@ -70,6 +70,11 @@ def parse_args():
         help="resume from the newest checkpoint in --ckpt-dir",
     )
     p.add_argument("--tiny", action="store_true", help="toy config smoke run")
+    p.add_argument(
+        "--max-predictions-per-seq", type=int, default=20,
+        help="fixed-K masked-position MLM head (the reference recipe's "
+        "masked_lm_* input; 0 = dense labels over all positions)",
+    )
     return p.parse_args()
 
 
@@ -103,6 +108,7 @@ def batch_stream(args, cfg, start_step=0):
         loader, seed=42, mask_prob=0.15, mask_id=103,
         vocab_size=cfg.vocab_size, special_floor=1000,
         start_step=start_step,
+        max_predictions_per_seq=args.max_predictions_per_seq or None,
     )
     while True:
         chunk = [next(stream) for _ in range(args.chunk)]
@@ -124,6 +130,8 @@ def main():
     dp = ps.get_data_parallel_world_size()
     if args.batch % dp:
         raise SystemExit(f"--batch must divide dp={dp}")
+    if args.max_predictions_per_seq < 0:
+        raise SystemExit("--max-predictions-per-seq must be >= 0")
 
     model = BertForPreTraining(cfg)
     tx = fused_lamb(learning_rate=args.lr, weight_decay=0.01)
@@ -187,6 +195,13 @@ def main():
         "mlm_labels": P(None, None, "dp"),
         "nsp_labels": P(None, "dp"),
     }
+    if args.max_predictions_per_seq:
+        # the packed triple is (chunk, K, B) — dp shards B like the labels
+        batch_specs.update(
+            mlm_positions=P(None, None, "dp"),
+            mlm_label_ids=P(None, None, "dp"),
+            mlm_weights=P(None, None, "dp"),
+        )
     step = jax.jit(
         jax.shard_map(
             chunk_fn,

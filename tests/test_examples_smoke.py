@@ -84,7 +84,16 @@ def test_simple_ddp_smoke():
 
 
 def test_bert_pretrain_tiny_smoke():
+    # default path: packed masked-position MLM head (the recipe input)
     _run_example("examples/bert/pretrain_bert.py", ["--tiny"])
+
+
+def test_bert_pretrain_dense_head_smoke():
+    # --max-predictions-per-seq 0 keeps the dense-label MLM head
+    _run_example(
+        "examples/bert/pretrain_bert.py",
+        ["--tiny", "--max-predictions-per-seq", "0"],
+    )
 
 
 def test_gpt_train_tiny_smoke():
