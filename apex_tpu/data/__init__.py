@@ -310,25 +310,46 @@ class DevicePrefetcher:
         self.close()
 
 
-def pack_mlm_predictions(labels, max_predictions_per_seq=20, seq_first=True):
+def pack_mlm_predictions(labels, max_predictions_per_seq=20, seq_first=True,
+                         rng=None):
     """Dense MLM labels (S, B; -1 = unmasked) → the reference recipe's
     fixed-K prediction triple: ``(positions, label_ids, weights)``, each
     (K, B) with K = ``max_predictions_per_seq`` (≙ the BERT pretraining
     input tensors masked_lm_positions / masked_lm_ids / masked_lm_weights).
 
-    Sequences with more than K masked positions are truncated (in position
-    order — exactly what the reference data pipeline does); sequences with
-    fewer are zero-padded with weight 0.  ``bert_pretrain_loss`` consumes
-    the triple to run the MLM head on K rows instead of all S.
+    Sequences with more than K masked positions are truncated; sequences
+    with fewer are zero-padded with weight 0.  ``rng`` (a
+    ``np.random.Generator``) selects the kept K uniformly at random from
+    the masked set — the reference data pipeline's behavior, which keeps
+    every position's selection probability uniform.  ``rng=None`` keeps
+    the first K in position order (deterministic, but over-budget
+    sequences then never train their latest masked positions — fine for
+    benchmarks, biased for real training).  ``bert_pretrain_loss``
+    consumes the triple to run the MLM head on K rows instead of all S.
     """
     labels = np.asarray(labels)
     if not seq_first:
         labels = labels.T
     k = max_predictions_per_seq
     mask = labels >= 0
-    # stable argsort of ~mask floats masked row-indices to the front, in
-    # position order; the first K per column are the kept predictions
-    order = np.argsort(~mask, axis=0, kind="stable")[:k]
+    if rng is None or mask.sum(axis=0).max(initial=0) <= k:
+        # stable argsort of ~mask floats masked row-indices to the front,
+        # in position order; the first K per column are kept.  Also the
+        # fast path when no column exceeds K: random selection would keep
+        # the whole masked set anyway (pads are zeroed either way).
+        order = np.argsort(~mask, axis=0, kind="stable")[:k]
+    else:
+        # random sort key among masked rows → uniform K-subset; then
+        # reorder the selection so real rows come first in position
+        # order and pad rows (unmasked, selected only under budget)
+        # sit at the end — the reference layout
+        key = np.where(mask, rng.random(mask.shape), 2.0)
+        sel = np.argsort(key, axis=0)[:k]
+        selmask = np.take_along_axis(mask, sel, axis=0)
+        rank = np.where(selmask, sel, labels.shape[0] + sel)
+        order = np.take_along_axis(
+            sel, np.argsort(rank, axis=0, kind="stable"), axis=0
+        )
     weights = np.take_along_axis(mask, order, axis=0)
     if order.shape[0] < k:  # K > S: zero-pad to keep the (K, B) contract
         pad = np.zeros((k - order.shape[0], order.shape[1]), order.dtype)
@@ -415,7 +436,13 @@ def bert_mlm_batches(
         }
         if max_predictions_per_seq:
             pos, pids, w = pack_mlm_predictions(
-                labels, max_predictions_per_seq, seq_first=seq_first
+                labels, max_predictions_per_seq, seq_first=seq_first,
+                # deterministic in (seed, step), independent of the
+                # corruption stream: over-budget truncation selects a
+                # uniform K-subset, reproducibly (resume-safe)
+                rng=np.random.default_rng(
+                    np.random.SeedSequence([seed, step, 0x4D50])
+                ),
             )
             if not seq_first:
                 pos, pids, w = pos.T, pids.T, w.T

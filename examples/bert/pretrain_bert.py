@@ -112,6 +112,11 @@ def batch_stream(args, cfg, start_step=0):
     )
     while True:
         chunk = [next(stream) for _ in range(args.chunk)]
+        if args.max_predictions_per_seq:
+            # the loss reads only the packed triple — don't ship the
+            # dense (S, B) labels to device alongside it
+            for b in chunk:
+                b.pop("mlm_labels", None)
         yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *chunk)
 
 
@@ -197,6 +202,8 @@ def main():
     }
     if args.max_predictions_per_seq:
         # the packed triple is (chunk, K, B) — dp shards B like the labels
+        # (which the stream drops in this mode; see batch_stream)
+        del batch_specs["mlm_labels"]
         batch_specs.update(
             mlm_positions=P(None, None, "dp"),
             mlm_label_ids=P(None, None, "dp"),

@@ -217,20 +217,33 @@ class TestMlmBatches:
 
     def test_packed_prediction_triple(self, token_file):
         """max_predictions_per_seq adds the fixed-K positions/ids/weights
-        triple consistent with the dense labels (reference input format)."""
+        triple consistent with the dense labels (reference input format):
+        real rows are a position-sorted uniform subset of the masked set
+        (random selection when over budget), ids match the labels, pads
+        carry weight 0.  Selection is deterministic in (seed, step)."""
         p, _ = token_file
         ds = TokenFileDataset(p, seq_len=128)
         dl = DataLoader(ds, batch_size=4, seed=1)
-        it = bert_mlm_batches(
+        b = next(bert_mlm_batches(
             dl, seed=5, vocab_size=6000, max_predictions_per_seq=24
-        )
-        b = next(it)
+        ))
         pos, ids, w = b["mlm_positions"], b["mlm_label_ids"], b["mlm_weights"]
         assert pos.shape == ids.shape == w.shape == (24, 4)
         labels = b["mlm_labels"]
         for col in range(4):
-            want = np.nonzero(labels[:, col] >= 0)[0][:24]
-            n = len(want)
-            np.testing.assert_array_equal(pos[:n, col], want)
-            np.testing.assert_array_equal(ids[:n, col], labels[want, col])
+            masked = np.nonzero(labels[:, col] >= 0)[0]
+            n = int(w[:, col].sum())
+            assert n == min(len(masked), 24)
+            got = pos[:n, col]
+            assert (np.sort(got) == got).all()  # position order
+            assert set(got) <= set(masked)  # subset of the masked set
+            np.testing.assert_array_equal(ids[:n, col], labels[got, col])
             assert w[:n, col].all() and not w[n:, col].any()
+        # deterministic in (seed, step): a fresh stream reproduces the
+        # same selection bit-exactly
+        b2 = next(bert_mlm_batches(
+            DataLoader(ds, batch_size=4, seed=1), seed=5, vocab_size=6000,
+            max_predictions_per_seq=24,
+        ))
+        np.testing.assert_array_equal(b2["mlm_positions"], pos)
+        np.testing.assert_array_equal(b2["mlm_weights"], w)
