@@ -46,7 +46,18 @@ import jax
 import jax.numpy as jnp
 
 
-def _backend_watchdog(seconds: float):
+_METRIC_NAMES = {
+    "resnet50": "resnet50_imgs_per_sec",
+    "ddp_syncbn": "ddp_syncbn_resnet50_imgs_per_sec",
+    "bert_lamb": "bert_large_lamb_mfu",
+    "mha": "mha_fused_speedup",
+    "tp_gpt": "tp_gpt_block_step_ms",
+    "long_attn": "long_context_flash_attn_tflops",
+    "all": "bert_large_lamb_mfu",  # the headline stands in for the batch
+}
+
+
+def _backend_watchdog(seconds: float, metric: str = "bert_large_lamb_mfu"):
     """Fail fast if backend init hangs (the axon tunnel has been observed
     to wedge for hours — a bench that hangs is worse for the driver than
     one that exits nonzero with a diagnostic).  Disarmed once the first
@@ -58,6 +69,15 @@ def _backend_watchdog(seconds: float):
             print(
                 f"bench.py: backend initialization exceeded {seconds:.0f}s "
                 "(TPU tunnel unresponsive?) — aborting", file=sys.stderr,
+            )
+            # one honest JSON line so the driver records the outage as an
+            # explicit non-measurement instead of silence (value null —
+            # never a stale number)
+            _emit(
+                metric, None,
+                "NOT MEASURED: TPU tunnel unresponsive "
+                f"(backend init > {seconds:.0f}s); see BENCH_all artifacts "
+                "for the last measured round", None,
             )
             os._exit(3)
 
@@ -622,7 +642,9 @@ _CONFIGS = {
 
 def main(config="bert_lamb", trace_dir=None):
     if _WATCHDOG_S > 0:
-        armed = _backend_watchdog(_WATCHDOG_S)
+        armed = _backend_watchdog(
+            _WATCHDOG_S, _METRIC_NAMES.get(config, config)
+        )
         jax.devices()  # first backend touch happens under the watchdog
         armed.set()
     if config == "all":

@@ -147,10 +147,9 @@ def _train_trajectory(opt_level, loss_scale=None, steps=40):
     """Loss trajectory + final f32 weights for one (opt_level, loss_scale)
     cell of the reference's L1 cross-product harness."""
     params0 = toy_params()
-    kwargs = {} if loss_scale is None else {"loss_scale": loss_scale}
     params, handle = amp.initialize(
         params0, fused_adam(5e-2), opt_level=opt_level,
-        half_dtype=jnp.bfloat16, **kwargs
+        half_dtype=jnp.bfloat16, loss_scale=loss_scale,
     )
     state = handle.init(params)
     x = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.float32)
