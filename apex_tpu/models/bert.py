@@ -36,6 +36,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from apex_tpu import parallel_state as ps
 from apex_tpu.ops.attention import flash_attention
@@ -88,6 +89,16 @@ class BertConfig:
     # compute); "dots" saves every dense (no-batch-dim) matmul output and
     # recomputes only attention internals + elementwise (softmax/GELU) —
     # ~0.6% extra FLOPs on BERT-Large, the MFU-preserving default.
+    # "sums" saves the same BYTES as "dots" but picks the tensors backward
+    # actually consumes: qkv, fc1 (wgrad/recompute inputs) and the two
+    # post-residual sums (LayerNorm-backward inputs) instead of the raw
+    # out-proj/fc2 matmul outputs.  Under "dots" those raw outputs have
+    # two consumers (the remat save + the bias/residual add), which
+    # forces XLA to materialize them and run the adds as separate
+    # bandwidth-bound kLoop fusions (measured ~6% of the v5e BERT-Large
+    # step, docs/mfu.md); single-consumer raw outputs let the epilogue
+    # fuse into the matmul.  Extra recompute vs "dots": gelu + 2 LN
+    # forwards per layer (elementwise).
     remat_policy: str = "full"
     # Always recompute the attention core (scores/softmax/PV) in backward,
     # regardless of remat_policy: an inner nothing_saveable checkpoint.
@@ -113,10 +124,10 @@ class BertConfig:
     scan_layers: bool = True
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "sums"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r} "
-                "(options are 'full', 'dots')"
+                "(options are 'full', 'dots', 'sums')"
             )
 
 
@@ -179,6 +190,8 @@ class BertSelfAttention(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype, name="qkv",
         )(x)
+        # inert unless remat_policy="sums" selects it by name
+        qkv = checkpoint_name(qkv, "bert_qkv")
         s = qkv.shape[0]  # full sequence after the SP gather inside Column
         b = qkv.shape[1]
         # Global QKV column layout is (heads, 3, head_dim) — per-head
@@ -229,6 +242,7 @@ class BertMlp(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype, name="fc1",
         )(x)
+        y = checkpoint_name(y, "bert_fc1")
         y = jax.nn.gelu(y, approximate=True)
         return RowParallelLinear(
             cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True,
@@ -258,7 +272,7 @@ class BertLayer(nn.Module):
         x = _LayerNorm(
             cfg.hidden_size, cfg.layer_norm_eps,
             sequence_parallel=cfg.sequence_parallel, name="ln_attn",
-        )(x + attn)
+        )(checkpoint_name(x + attn, "bert_sum_attn"))
         mlp = BertMlp(cfg, name="mlp")(x)
         if not deterministic and cfg.hidden_dropout > 0.0:
             mlp = nn.Dropout(cfg.hidden_dropout)(
@@ -268,7 +282,7 @@ class BertLayer(nn.Module):
         return _LayerNorm(
             cfg.hidden_size, cfg.layer_norm_eps,
             sequence_parallel=cfg.sequence_parallel, name="ln_mlp",
-        )(x + mlp)
+        )(checkpoint_name(x + mlp, "bert_sum_mlp"))
 
 
 class _BlockStep(nn.Module):
@@ -306,6 +320,12 @@ class BertEncoderCore(nn.Module):
             # in JAX — keys are values, not stateful generators)
             if self.cfg.remat_policy == "dots":
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif self.cfg.remat_policy == "sums":
+                # same bytes as "dots", chosen so every raw matmul output
+                # is single-consumer (epilogues fuse); see BertConfig
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+                )
             else:  # "full" (validated in BertConfig.__post_init__)
                 policy = None
             # prevent_cse=False is documented safe only under scan/pmap

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 
 
+# single source of truth for metric names: used by every bench's _emit
+# and by the watchdog's NOT-MEASURED line, so they cannot drift
 _METRIC_NAMES = {
     "resnet50": "resnet50_imgs_per_sec",
     "ddp_syncbn": "ddp_syncbn_resnet50_imgs_per_sec",
@@ -268,7 +270,7 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
                 flops_exec / (step_time * peak), max_predictions_per_seq
             )
         _emit(
-            "bert_large_lamb_mfu",
+            _METRIC_NAMES["bert_lamb"],
             round(mfu, 4),
             "MFU (step_time_ms=%.1f, batch=%d, params=%dM, loss=%.3f%s)"
             % (step_time * 1e3, batch, n_params // 1_000_000, loss, extra),
@@ -336,7 +338,7 @@ def bench_resnet50(trace_dir=None, batch=256, chunk=4, trials=3):
         profile=apex_tpu.utils.trace(trace_dir) if trace_dir else None,
     )
     _emit(
-        "resnet50_imgs_per_sec",
+        _METRIC_NAMES["resnet50"],
         round(batch / step_time, 1),
         "img/s (step_time_ms=%.1f, batch=%d, loss=%.3f, single device; "
         "reference publishes no absolute number)"
@@ -401,7 +403,7 @@ def bench_ddp_syncbn(trace_dir=None, batch_per_replica=128, chunk=4, trials=3):
     )
     ps.destroy_model_parallel()
     _emit(
-        "ddp_syncbn_resnet50_imgs_per_sec",
+        _METRIC_NAMES["ddp_syncbn"],
         round(global_batch / step_time, 1),
         "img/s (step_time_ms=%.1f, dp=%d, global_batch=%d, loss=%.3f, "
         "SyncBN; reference publishes no absolute number)"
@@ -456,7 +458,7 @@ def bench_mha(trace_dir=None, batch=8, seq=2048, heads=16, head_dim=64,
     t_unfused = timed(mha_reference)
     speedup = t_unfused / t_fused
     _emit(
-        "mha_fused_speedup",
+        _METRIC_NAMES["mha"],
         round(speedup, 3),
         "x vs unfused (fused_ms=%.2f, unfused_ms=%.2f, b=%d h=%d s=%d d=%d, "
         "fwd+bwd)" % (t_fused * 1e3, t_unfused * 1e3, *((batch, heads, seq,
@@ -565,7 +567,7 @@ def bench_tp_gpt(trace_dir=None, batch=8, seq=1024, chunk=4, trials=3):
         step_time = (t_long - t_short) / chunk
         basis = "init-cancelled two-length measurement"
     _emit(
-        "tp_gpt_block_step_ms",
+        _METRIC_NAMES["tp_gpt"],
         round(step_time * 1e3, 2),
         "ms/step (tp=%d, seq=%d, batch=%d, h=%d, SP=%s, %s; reference "
         "publishes no absolute number)"
@@ -621,7 +623,7 @@ def bench_long_attn(trace_dir=None, batch=1, heads=8, seq=16384,
     peak = _chip_peak(jax.devices()[0])
     tf = flops / t / 1e12
     _emit(
-        "long_context_flash_attn_tflops",
+        _METRIC_NAMES["long_attn"],
         round(tf, 1),
         "TFLOP/s (%.0f%% of peak, step_ms=%.1f, b=%d h=%d s=%d d=%d, "
         "causal fwd+bwd, O(S) memory; reference caps at seq 512)"

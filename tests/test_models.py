@@ -158,6 +158,40 @@ class TestBert:
         assert not w[S:].any() and not pos[S:].any()
         assert int(w.sum()) == int(jnp.sum(batch["mlm_labels"] >= 0))
 
+    @pytest.fixture(scope="class")
+    def no_remat_reference(self):
+        """(params, loss, grads) of the no-remat model — shared across the
+        policy parametrizations (policy-independent, compile once)."""
+        m_ref = BertForPreTraining(BertConfig(**BERT_KW))
+        batch = _bert_batch()
+        params = m_ref.init(jax.random.PRNGKey(0), batch["input_ids"])
+        l_r, g_r = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m_ref, batch)
+        )(params)
+        return params, l_r, g_r
+
+    @pytest.mark.parametrize("policy", ["full", "dots", "sums"])
+    def test_remat_policy_preserves_values(self, policy, no_remat_reference):
+        """Remat policies (incl. the named-saves 'sums' policy that frees
+        raw matmul outputs for epilogue fusion) are pure schedule knobs:
+        loss and grads must match the no-remat model exactly."""
+        params, l_r, g_r = no_remat_reference
+        m_pol = BertForPreTraining(
+            BertConfig(remat=True, remat_policy=policy, **BERT_KW)
+        )
+        batch = _bert_batch()
+        l_p, g_p = jax.value_and_grad(
+            lambda p: bert_pretrain_loss(p, m_pol, batch)
+        )(params)
+        np.testing.assert_allclose(float(l_r), float(l_p), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-5, atol=1e-6,
+            ),
+            g_r, g_p,
+        )
+
     def test_unrolled_matches_scanned(self):
         """scan_layers / remat_attention are pure layout+schedule knobs:
         same params (modulo the (L, ...) stacking axis), same loss, same

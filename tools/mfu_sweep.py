@@ -68,6 +68,17 @@ R3_GRID = [
     (128, True, "dots", False, False, 8, False),
 ]
 
+# Staged for the next chip window (run with --grid2): the r3 "sums"
+# remat policy (same saved bytes as "dots", raw matmul outputs freed for
+# epilogue fusion — docs/mfu.md lever #1) on the packed-head headline,
+# vs the dots packed baseline.  Entries gain an mpps field.
+R3_GRID2 = [
+    (128, True, "dots", False, True, 0, False, 20),  # packed baseline
+    (128, True, "sums", False, True, 0, False, 20),  # epilogue-fusion bet
+    (128, True, "sums", False, False, 0, False, 20),  # sums w/o attn rematerialization
+    (128, True, "sums", False, True, 16, False, None),  # dense-head control
+]
+
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
@@ -81,6 +92,10 @@ if __name__ == "__main__":
         "--grid", action="store_true",
         help="run the r3 exploration grid (one line per config)",
     )
+    ap.add_argument(
+        "--grid2", action="store_true",
+        help="run the staged 'sums'-policy grid (packed head)",
+    )
     args = ap.parse_args()
     if args.grid:
         for batch, remat, policy, scan, rattn, mlmc, pcse in R3_GRID:
@@ -88,6 +103,13 @@ if __name__ == "__main__":
                 batch, remat, policy, scan_layers=scan,
                 remat_attention=rattn, mlm_loss_chunks=mlmc,
                 prevent_cse=pcse,
+            )
+    elif args.grid2:
+        for batch, remat, policy, scan, rattn, mlmc, pcse, mpps in R3_GRID2:
+            run(
+                batch, remat, policy, scan_layers=scan,
+                remat_attention=rattn, mlm_loss_chunks=mlmc or None,
+                prevent_cse=pcse, mpps=mpps,
             )
     elif args.only:
         f = args.only.split(",")
