@@ -60,12 +60,22 @@ def _wrap_remat(fn, remat, remat_policy=None):
     everything (min memory); "dots" = save no-batch-dim matmul outputs
     and recompute only elementwise/attention internals (the models'
     selective-recompute default — ~4/3 → ~1.0 of the fwd+bwd premium
-    for a modest memory bump)."""
+    for a modest memory bump); "sums" = save only the checkpoint_name
+    tags the BERT layers mark (qkv/fc1/residual sums — epilogue-fusion
+    friendly, see BertConfig.remat_policy).  A stage whose model carries
+    no tags saves nothing under "sums" (= "full" behavior, same values)."""
     if not remat:
         return fn
     if remat_policy == "dots":
         return jax.checkpoint(
             fn, policy=jax.checkpoint_policies.checkpoint_dots
+        )
+    if remat_policy == "sums":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+            ),
         )
     if remat_policy not in (None, "full"):
         raise ValueError(f"unknown remat_policy {remat_policy!r}")
