@@ -5,7 +5,10 @@ from apex_tpu.amp.frontend import (  # noqa: F401
     AmpHandle,
     AmpState,
     initialize,
+    load_state_dict,
+    master_params,
     scale_loss,
+    state_dict,
 )
 from apex_tpu.amp.policy import Policy, Properties, opt_levels  # noqa: F401
 from apex_tpu.amp.scaler import (  # noqa: F401

@@ -186,3 +186,21 @@ def initialize(
 def scale_loss(loss, handle: AmpHandle, state: AmpState):
     """Free-function parity alias for ``amp.scale_loss``."""
     return handle.scale_loss(loss, state)
+
+
+def master_params(params, state: AmpState):
+    """≙ ``apex.amp.master_params(optimizer)``: the fp32 view the optimizer
+    actually steps — the master copies when the opt level keeps them (O2),
+    else the model params themselves."""
+    return state.master_params if state.master_params is not None else params
+
+
+def state_dict(handle: AmpHandle, state: AmpState) -> dict:
+    """≙ module-level ``apex.amp.state_dict()`` (scaler state for
+    checkpointing); the handle method, free-function shaped."""
+    return handle.state_dict(state)
+
+
+def load_state_dict(handle: AmpHandle, state: AmpState, sd: dict) -> AmpState:
+    """≙ module-level ``apex.amp.load_state_dict(sd)``."""
+    return handle.load_state_dict(state, sd)

@@ -12,3 +12,7 @@ from apex_tpu.parallel.distributed_fused_optimizers import (  # noqa: F401
     DistributedFusedAdam,
     DistributedFusedLAMB,
 )
+
+# the reference's exact casing (apex/contrib/optimizers ::
+# DistributedFusedLamb)
+DistributedFusedLamb = DistributedFusedLAMB

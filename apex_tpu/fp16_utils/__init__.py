@@ -22,6 +22,7 @@ from apex_tpu.amp.scaler import DynamicLossScaler, StaticLossScaler, amp_update
 __all__ = [
     "tofp16",
     "network_to_half",
+    "BN_convert_float",
     "prep_param_lists",
     "master_params_to_model_params",
     "model_grads_to_master_grads",
@@ -39,6 +40,45 @@ def network_to_half(tree, half_dtype=jnp.bfloat16):
     choice here — normalization ops compute statistics in f32 regardless,
     see apex_tpu.ops)."""
     return tofp16(tree, half_dtype)
+
+
+_BN_SCOPE_PREFIXES = ("batchnorm", "batch_norm", "syncbatchnorm", "bn")
+
+
+def _is_bn_segment(seg: str, prefixes) -> bool:
+    # anchored: the segment IS a BN scope name (optionally numbered,
+    # flax-style "BatchNorm_0"/"bn_1"), never a substring hit like
+    # "subnet" containing "bn"
+    seg = seg.lower()
+    return any(
+        seg == p or seg.startswith(p + "_") for p in prefixes
+    )
+
+
+def BN_convert_float(tree, prefixes=_BN_SCOPE_PREFIXES):
+    """≙ BN_convert_float: after a half cast, return BatchNorm parameters
+    to fp32 for stable statistics.
+
+    The torch original walks modules; the pytree analog upcasts every
+    half-precision leaf that sits under a BatchNorm-named scope (a path
+    segment equal to — or a numbered instance of — one of ``prefixes``;
+    flax convention ``BatchNorm_0``/``bn_1``/``SyncBatchNorm_2``).  Other
+    leaves untouched.
+    """
+
+    def convert(path, leaf):
+        if not hasattr(leaf, "dtype") or leaf.dtype not in (
+            jnp.float16, jnp.bfloat16
+        ):
+            return leaf
+        segs = [
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path
+        ]
+        if any(_is_bn_segment(s, prefixes) for s in segs):
+            return leaf.astype(jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(convert, tree)
 
 
 def prep_param_lists(params) -> Tuple[Any, Any]:

@@ -66,6 +66,12 @@ __all__ = [
 
 _TP = ps.TENSOR_PARALLEL_AXIS
 
+# checkpoint_name tags the remat_policy="sums" named-saves policy selects
+# (also consumed by pipeline_parallel.schedules._wrap_remat — one list)
+SUMS_SAVE_NAMES = (
+    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class BertConfig:
@@ -324,7 +330,7 @@ class BertEncoderCore(nn.Module):
                 # same bytes as "dots", chosen so every raw matmul output
                 # is single-consumer (epilogues fuse); see BertConfig
                 policy = jax.checkpoint_policies.save_only_these_names(
-                    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+                    *SUMS_SAVE_NAMES
                 )
             else:  # "full" (validated in BertConfig.__post_init__)
                 policy = None

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from apex_tpu.optimizers.multi_tensor import global_norm
 
-__all__ = ["clip_grad_norm"]
+__all__ = ["clip_grad_norm", "clip_grad_norm_"]
 
 
 def clip_grad_norm(
@@ -50,3 +50,8 @@ def clip_grad_norm(
         lambda x: (x.astype(jnp.float32) * scale).astype(x.dtype), grads
     )
     return clipped, total
+
+
+# the reference's exact name (apex/contrib/clip_grad :: clip_grad_norm_ —
+# torch's trailing-underscore in-place convention; pure here, same math)
+clip_grad_norm_ = clip_grad_norm

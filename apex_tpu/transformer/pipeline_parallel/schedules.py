@@ -71,10 +71,12 @@ def _wrap_remat(fn, remat, remat_policy=None):
             fn, policy=jax.checkpoint_policies.checkpoint_dots
         )
     if remat_policy == "sums":
+        from apex_tpu.models.bert import SUMS_SAVE_NAMES
+
         return jax.checkpoint(
             fn,
             policy=jax.checkpoint_policies.save_only_these_names(
-                "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+                *SUMS_SAVE_NAMES
             ),
         )
     if remat_policy not in (None, "full"):

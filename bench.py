@@ -59,13 +59,11 @@ _METRIC_NAMES = {
 }
 
 
-def _backend_watchdog(seconds: float, metric: str = None):
+def _backend_watchdog(seconds: float, metric: str = _METRIC_NAMES["bert_lamb"]):
     """Fail fast if backend init hangs (the axon tunnel has been observed
     to wedge for hours — a bench that hangs is worse for the driver than
     one that exits nonzero with a diagnostic).  Disarmed once the first
     device call returns; APEX_TPU_BENCH_WATCHDOG_S=0 disables."""
-    if metric is None:
-        metric = _METRIC_NAMES["bert_lamb"]
     done = threading.Event()
 
     def watch():

@@ -227,6 +227,34 @@ def test_state_dict_roundtrip():
     assert int(state2.scaler_state.growth_tracker) == 7
 
 
+def test_bn_convert_float_and_master_params():
+    """≙ fp16_utils.BN_convert_float + module-level amp.master_params."""
+    tree = {
+        "conv": {"kernel": jnp.ones((2, 2), jnp.float32)},
+        "bn_1": {"scale": jnp.ones((2,), jnp.float32)},
+        "BatchNorm_0": {"bias": jnp.zeros((2,), jnp.float32)},
+    }
+    half = fp16_utils.network_to_half(tree)
+    assert half["bn_1"]["scale"].dtype == jnp.bfloat16
+    fixed = fp16_utils.BN_convert_float(half)
+    assert fixed["bn_1"]["scale"].dtype == jnp.float32
+    assert fixed["BatchNorm_0"]["bias"].dtype == jnp.float32
+    assert fixed["conv"]["kernel"].dtype == jnp.bfloat16  # untouched
+
+    # master_params: O2 returns the fp32 masters, O0 the params themselves
+    p2, h2 = amp.initialize(toy_params(), fused_adam(1e-3), opt_level="O2",
+                            half_dtype=jnp.bfloat16)
+    s2 = h2.init(p2)
+    assert amp.master_params(p2, s2)["w"].dtype == jnp.float32
+    p0, h0 = amp.initialize(toy_params(), fused_adam(1e-3), opt_level="O0")
+    s0 = h0.init(p0)
+    assert amp.master_params(p0, s0) is p0
+    # module-level state_dict round trip
+    sd = amp.state_dict(h2, s2)
+    s2b = amp.load_state_dict(h2, s2, sd)
+    assert float(s2b.scaler_state.loss_scale) == float(sd["loss_scale"])
+
+
 def test_fp16_optimizer_end_to_end():
     params = fp16_utils.network_to_half(toy_params())
     assert params["w"].dtype == jnp.bfloat16

@@ -1,0 +1,61 @@
+"""Reference public-API surface parity (import-level).
+
+A user migrating from the reference must find the same names in the
+mirrored namespaces (SURVEY §2 component tables).  Import-level only —
+behavior is covered by the per-module suites; this guards against broken
+re-exports (a real one shipped in r3: contrib.clip_grad importing a name
+its backing module didn't export) and accidental renames.
+"""
+
+import importlib
+
+import pytest
+
+SURFACE = [
+    ("apex_tpu.amp", ["initialize", "scale_loss", "master_params",
+                      "state_dict", "load_state_dict", "AmpHandle",
+                      "DynamicLossScaler", "opt_levels"]),
+    ("apex_tpu.parallel", ["DistributedDataParallel", "SyncBatchNorm",
+                           "convert_syncbn_model", "LARC", "Reducer"]),
+    ("apex_tpu.optimizers", ["FusedAdam", "FusedLAMB", "FusedSGD",
+                             "FusedNovoGrad", "FusedAdagrad",
+                             "FusedMixedPrecisionLamb", "clip_grad_norm"]),
+    ("apex_tpu.normalization", ["FusedLayerNorm", "FusedRMSNorm",
+                                "MixedFusedLayerNorm", "MixedFusedRMSNorm"]),
+    ("apex_tpu.fp16_utils", ["FP16_Optimizer", "network_to_half",
+                             "BN_convert_float", "prep_param_lists",
+                             "master_params_to_model_params",
+                             "model_grads_to_master_grads", "tofp16"]),
+    ("apex_tpu.multi_tensor_apply", ["MultiTensorApply",
+                                     "multi_tensor_applier"]),
+    ("apex_tpu.transformer.tensor_parallel", [
+        "ColumnParallelLinear", "RowParallelLinear",
+        "VocabParallelEmbedding", "vocab_parallel_cross_entropy",
+        "broadcast_data", "checkpoint", "get_cuda_rng_tracker",
+        "model_parallel_cuda_manual_seed"]),
+    ("apex_tpu.transformer.functional", [
+        "FusedScaleMaskSoftmax", "fused_apply_rotary_pos_emb",
+        "fused_apply_rotary_pos_emb_cached"]),
+    ("apex_tpu.contrib.multihead_attn", ["SelfMultiheadAttn",
+                                         "EncdecMultiheadAttn"]),
+    ("apex_tpu.contrib.xentropy", ["SoftmaxCrossEntropyLoss"]),
+    ("apex_tpu.contrib.sparsity", ["ASP"]),
+    ("apex_tpu.contrib.clip_grad", ["clip_grad_norm_"]),
+    ("apex_tpu.contrib.optimizers", ["DistributedFusedAdam",
+                                     "DistributedFusedLamb"]),
+    ("apex_tpu.contrib.focal_loss", []),
+    ("apex_tpu.contrib.transducer", []),
+    ("apex_tpu.contrib.group_norm", []),
+    ("apex_tpu.contrib.index_mul_2d", []),
+    ("apex_tpu.contrib.conv_bias_relu", []),
+    ("apex_tpu.contrib.fmha", []),
+    ("apex_tpu.contrib.peer_memory", []),
+    ("apex_tpu.contrib.bottleneck", []),
+]
+
+
+@pytest.mark.parametrize("mod,names", SURFACE, ids=[m for m, _ in SURFACE])
+def test_reference_surface(mod, names):
+    m = importlib.import_module(mod)
+    missing = [n for n in names if not hasattr(m, n)]
+    assert not missing, f"{mod} missing reference names: {missing}"
