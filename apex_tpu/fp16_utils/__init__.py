@@ -51,7 +51,8 @@ def _is_bn_segment(seg: str, prefixes) -> bool:
     # "subnet" containing "bn"
     seg = seg.lower()
     return any(
-        seg == p or seg.startswith(p + "_") for p in prefixes
+        seg == p or seg.startswith(p + "_")
+        for p in (q.lower() for q in prefixes)
     )
 
 

@@ -66,10 +66,10 @@ __all__ = [
 
 _TP = ps.TENSOR_PARALLEL_AXIS
 
-# checkpoint_name tags the remat_policy="sums" named-saves policy selects
-# (also consumed by pipeline_parallel.schedules._wrap_remat — one list)
-SUMS_SAVE_NAMES = (
-    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+# one list with pipeline_parallel's "sums" remat wrapper (defined there —
+# infra does not import the model layer); re-exported for convenience
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: E402
+    SUMS_SAVE_NAMES,
 )
 
 

@@ -54,6 +54,13 @@ __all__ = [
 
 _PP = ps.PIPELINE_PARALLEL_AXIS
 
+# checkpoint_name tags the "sums" named-saves policy selects.  Defined in
+# infra (models import it — apex_tpu.models.bert tags these in its layers)
+# so the model layer depends on the schedule layer, never the reverse.
+SUMS_SAVE_NAMES = (
+    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+)
+
 
 def _wrap_remat(fn, remat, remat_policy=None):
     """Per-tick stage checkpoint.  ``remat_policy``: None = recompute
@@ -71,8 +78,6 @@ def _wrap_remat(fn, remat, remat_policy=None):
             fn, policy=jax.checkpoint_policies.checkpoint_dots
         )
     if remat_policy == "sums":
-        from apex_tpu.models.bert import SUMS_SAVE_NAMES
-
         return jax.checkpoint(
             fn,
             policy=jax.checkpoint_policies.save_only_these_names(

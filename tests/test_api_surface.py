@@ -44,13 +44,55 @@ SURFACE = [
     ("apex_tpu.contrib.optimizers", ["DistributedFusedAdam",
                                      "DistributedFusedLamb"]),
     ("apex_tpu.contrib.focal_loss", []),
-    ("apex_tpu.contrib.transducer", []),
-    ("apex_tpu.contrib.group_norm", []),
+    ("apex_tpu.contrib.transducer", ["TransducerJoint", "TransducerLoss"]),
+    ("apex_tpu.contrib.group_norm", ["GroupNorm"]),
+    ("apex_tpu.contrib.groupbn", ["BatchNorm2d_NHWC"]),
     ("apex_tpu.contrib.index_mul_2d", []),
     ("apex_tpu.contrib.conv_bias_relu", []),
     ("apex_tpu.contrib.fmha", []),
-    ("apex_tpu.contrib.peer_memory", []),
-    ("apex_tpu.contrib.bottleneck", []),
+    ("apex_tpu.contrib.peer_memory", ["PeerMemoryPool",
+                                      "PeerHaloExchanger1d"]),
+    ("apex_tpu.contrib.bottleneck", ["Bottleneck", "SpatialBottleneck"]),
+    ("apex_tpu.parallel_state", [
+        "initialize_model_parallel", "destroy_model_parallel",
+        "get_tensor_model_parallel_rank",
+        "get_tensor_model_parallel_world_size",
+        "get_pipeline_model_parallel_rank",
+        "get_pipeline_model_parallel_world_size",
+        "get_data_parallel_rank", "get_data_parallel_world_size",
+        "is_pipeline_first_stage", "is_pipeline_last_stage",
+        "set_virtual_pipeline_model_parallel_rank",
+        "get_virtual_pipeline_model_parallel_world_size"]),
+    ("apex_tpu.transformer.pipeline_parallel", [
+        "get_forward_backward_func", "forward_backward_no_pipelining",
+        "forward_backward_pipelining_without_interleaving",
+        "forward_backward_pipelining_with_interleaving"]),
+    ("apex_tpu.transformer.pipeline_parallel.p2p_communication", [
+        "recv_forward", "recv_backward", "send_forward", "send_backward",
+        "send_forward_recv_backward", "send_backward_recv_forward",
+        "send_forward_recv_forward"]),
+    ("apex_tpu.transformer.pipeline_parallel.utils", [
+        "setup_microbatch_calculator", "get_num_microbatches",
+        "listify_model", "get_kth_microbatch"]),
+    ("apex_tpu.transformer.tensor_parallel.mappings", [
+        "copy_to_tensor_model_parallel_region",
+        "reduce_from_tensor_model_parallel_region",
+        "scatter_to_tensor_model_parallel_region",
+        "gather_from_tensor_model_parallel_region",
+        "scatter_to_sequence_parallel_region",
+        "gather_from_sequence_parallel_region",
+        "reduce_scatter_to_sequence_parallel_region",
+        "allreduce_sequence_parallel_gradients"]),
+    ("apex_tpu.transformer.tensor_parallel.utils", [
+        "VocabUtility", "divide", "split_tensor_along_last_dim"]),
+    ("apex_tpu.transformer.amp", ["GradScaler"]),
+    ("apex_tpu.transformer.enums", ["ModelType", "AttnType",
+                                    "AttnMaskType"]),
+    ("apex_tpu.transformer.microbatches", [
+        "ConstantNumMicroBatches", "RampupBatchsizeNumMicroBatches"]),
+    ("apex_tpu.mlp", ["MLP"]),
+    ("apex_tpu.fused_dense", ["FusedDense", "FusedDenseGeluDense",
+                              "fused_dense_function"]),
 ]
 
 
