@@ -1,0 +1,142 @@
+"""On-chip flash-attention block-size tuner.
+
+≙ the reference's hand-tuned per-shape kernel traits (fmha's fixed-seqlen
+kernels / multihead_attn's launch configs).  The Pallas kernels take
+``block_q``/``block_k``; ``_auto_block`` picks 512/256 heuristically.
+This sweeps (block_q, block_k) on the real chip for the two bench-critical
+shapes (BASELINE #4 mha and the long-context config) plus fwd-only and
+fwd+bwd, prints TFLOP/s per cell, and flags where the heuristic loses.
+
+Run (on a TPU host):  python tools/attn_tune.py [--shapes mha,long]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.pallas import flash_attention as fa
+
+SHAPES = {
+    # name: (batch, heads, sq, d, causal)
+    "mha": (8, 16, 2048, 64, True),      # BASELINE #4 microbench shape
+    "long": (1, 8, 16384, 128, True),    # bench.py --config long_attn
+    "bert": (128, 16, 128, 64, False),   # headline phase-1 shape
+    "tiny": (1, 2, 256, 64, True),       # CPU interpret-mode smoke
+}
+BLOCKS = [128, 256, 512, 1024]
+
+
+def _flops(b, h, sq, d, causal, bwd):
+    # scores + PV matmuls, causal halves the live area; bwd ~2x fwd
+    f = 2 * 2 * b * h * sq * sq * d * (0.5 if causal else 1.0)
+    return f * (3.0 if bwd else 1.0)
+
+
+def _time_scan(step, q, k, v, iters=8, trials=3):
+    """Median per-iteration time with on-device serialization.
+
+    Same discipline as ln_tune._time_scan / bench.py: independent
+    dispatches mis-time over the remote device tunnel (the host clock
+    sees dispatch, not execution), so each scan iteration's q is
+    data-dependent on the previous output — execution serializes on
+    device and chunk_time/iters is honest.  ``step(q, k, v)`` must
+    return a q-shaped tensor (o for fwd, dq for fwd+bwd).
+    """
+
+    @jax.jit
+    def chunk(q):
+        def body(carry, _):
+            out = step(carry, k, v)
+            return carry + out * jnp.asarray(1e-8, carry.dtype), None
+
+        carry, _ = jax.lax.scan(body, q, None, length=iters)
+        return carry
+
+    carry = chunk(q)
+    jax.block_until_ready(carry)
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        carry = chunk(carry)
+        jax.block_until_ready(carry)
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def sweep(name, bwd):
+    b, h, sq, d, causal = SHAPES[name]
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b * h, sq, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b * h, sq, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b * h, sq, d), jnp.bfloat16)
+    scale = d ** -0.5
+    flops = _flops(b, h, sq, d, causal, bwd)
+    mode = "fwd+bwd" if bwd else "fwd"
+    print(f"\n== {name} {SHAPES[name]} {mode} ==")
+    print(f"{'bq':>5} {'bk':>5} {'ms':>9} {'TFLOP/s':>9}")
+    best = (None, 0.0)
+    for bq in BLOCKS:
+        if bq > sq or sq % bq:
+            continue
+        for bk in BLOCKS:
+            if bk > sq or sq % bk:
+                continue
+
+            if bwd:
+                # fwd + the recomputation backward, kernels called
+                # directly (the public custom_vjp sits a layer up);
+                # returns dq — q-shaped, as _time_scan's carry needs
+                def step(q, k, v, _bq=bq, _bk=bk):
+                    o, lse = fa.flash_fwd(
+                        q, k, v, None, scale=scale, causal=causal,
+                        block_q=_bq, block_k=_bk,
+                    )
+                    dq, _, _ = fa.flash_bwd(
+                        q, k, v, o, lse, 2.0 * o, None, scale=scale,
+                        causal=causal, block_q=_bq, block_k=_bk,
+                    )
+                    return dq
+            else:
+                def step(q, k, v, _bq=bq, _bk=bk):
+                    o, _ = fa.flash_fwd(
+                        q, k, v, None, scale=scale, causal=causal,
+                        block_q=_bq, block_k=_bk,
+                    )
+                    return o
+            try:
+                t = _time_scan(step, q, k, v)
+            except Exception as e:
+                print(f"{bq:5d} {bk:5d}   FAILED  {type(e).__name__}:"
+                      f" {str(e)[:60]}")
+                continue
+            tflops = flops / t / 1e12
+            mark = ""
+            if tflops > best[1]:
+                best = ((bq, bk), tflops)
+                mark = "  <-- best"
+            print(f"{bq:5d} {bk:5d} {t * 1e3:9.2f} {tflops:9.1f}{mark}")
+    auto = fa._auto_block(sq, d)
+    print(f"auto heuristic picks ({auto}, {auto}); best {best[0]} "
+          f"at {best[1]:.1f} TFLOP/s")
+    return best
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="mha,long")
+    ap.add_argument("--fwd-only", action="store_true")
+    args = ap.parse_args()
+    for name in args.shapes.split(","):
+        sweep(name, bwd=False)
+        if not args.fwd_only:
+            sweep(name, bwd=True)
