@@ -107,6 +107,75 @@ def test_flash_vs_reference_fuzz(seed):
         )
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_with_lse_fuzz(seed):
+    """flash_attention_with_lse (the ring-attention building block) under
+    random aligned shapes: (o, lse) and grads — INCLUDING the lse
+    cotangent the ring merge differentiates through — kernel vs jnp."""
+    from apex_tpu.ops.attention import flash_attention_with_lse
+
+    rng = np.random.default_rng(77 + seed)
+    b = int(rng.integers(1, 3))
+    h = int(rng.integers(1, 3))
+    d = int(rng.choice([32, 64]))
+    # aligned shapes only (the lse variant has no pad/bias plumbing):
+    # multiples of the sublane/lane quantum
+    sq = int(rng.choice([16, 64, 128, 256]))
+    sk = int(rng.choice([16, 64, 128, 256]))
+    causal = bool(rng.integers(0, 2))
+    if causal and sk < sq:
+        sk = sq
+    dtype = jnp.bfloat16 if rng.integers(0, 2) else jnp.float32
+    tol = (
+        dict(rtol=3e-2, atol=3e-2)
+        if dtype == jnp.bfloat16
+        else dict(rtol=3e-4, atol=3e-4)
+    )
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv, kc = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, h, sq, d), dtype)
+    k = jax.random.normal(kk, (b, h, sk, d), dtype)
+    v = jax.random.normal(kv, (b, h, sk, d), dtype)
+    # a fixed random cotangent for lse so its backward path is exercised
+    dlse_w = jax.random.normal(kc, (b, h, sq), jnp.float32)
+    desc = f"b={b} h={h} d={d} sq={sq} sk={sk} causal={causal} {dtype.__name__}"
+
+    def run(forced):
+        _dispatch.set_use_pallas(forced)
+        try:
+            def loss(q, k, v):
+                o, lse = flash_attention_with_lse(q, k, v, causal=causal)
+                return (
+                    jnp.sum(o.astype(jnp.float32) ** 2)
+                    + jnp.sum(lse * dlse_w),
+                    (o, lse),
+                )
+
+            (_, (o, lse)), grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2), has_aux=True
+            )(q, k, v)
+            return o, lse, grads
+        finally:
+            _dispatch.set_use_pallas(None)
+
+    o_k, lse_k, g_k = run(True)
+    o_r, lse_r, g_r = run(False)
+    np.testing.assert_allclose(
+        np.asarray(o_k, np.float32), np.asarray(o_r, np.float32),
+        err_msg=desc, **tol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse_k), np.asarray(lse_r), err_msg=desc,
+        rtol=1e-3, atol=1e-3,
+    )
+    for a, b_ in zip(g_k, g_r):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            err_msg=desc, **tol,
+        )
+
+
 def test_mha_reference_is_the_golden():
     """The fuzz compares against mha_reference — pin that it matches a
     hand-written softmax composition once, so the golden itself is
