@@ -585,7 +585,11 @@ def bert_pretrain_loss(
         # so the gather is rank-local and the tp grad boundaries below are
         # unchanged.
         h = jnp.take_along_axis(h, positions[:, :, None], axis=0)
-        labels = batch["mlm_label_ids"]
+        # pack_mlm_predictions pads label ids with 0, but a hand-built
+        # triple may use the dense path's -1 ignore convention; an
+        # out-of-range id would NaN the xent gather and survive the
+        # weight-0 multiply, so clamp exactly as the dense path does.
+        labels = jnp.maximum(batch["mlm_label_ids"], 0)
         weights = batch["mlm_weights"].astype(jnp.float32)
     else:
         labels = batch["mlm_labels"]

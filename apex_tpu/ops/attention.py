@@ -52,6 +52,19 @@ def _seq_pad(s: int) -> int:
     return (-s) % 8 if s < _LANES else (-s) % _LANES
 
 
+def _derive_dropout_seed(dropout_rng, dropout_p):
+    """The ONE seed derivation for every fused-dropout kernel entry point
+    (flash_attention and flash_attention_with_lse must stay in lockstep —
+    tests/test_attention_fuzz.py pins this contract externally to
+    regenerate the kernel keep mask)."""
+    if dropout_p > 0.0:
+        return jax.random.randint(
+            dropout_rng, (1,), jnp.iinfo(jnp.int32).min,
+            jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
+        )
+    return jnp.zeros((1,), jnp.int32)
+
+
 def _pallas_eligible(q, k, v, dropout_p, causal=False):
     sq, sk = q.shape[-2], k.shape[-2]
     # Arbitrary S is handled by padding to the next tileable size with the
@@ -239,14 +252,7 @@ def flash_attention(
         )
     if dropout_p > 0.0 and dropout_rng is None:
         raise ValueError("dropout_p > 0 requires dropout_rng")
-    seed = (
-        jax.random.randint(
-            dropout_rng, (1,), jnp.iinfo(jnp.int32).min,
-            jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
-        )
-        if dropout_p > 0.0
-        else jnp.zeros((1,), jnp.int32)
-    )
+    seed = _derive_dropout_seed(dropout_rng, dropout_p)
 
     b, h, sq, d = q.shape
     sk = k.shape[-2]
@@ -307,29 +313,40 @@ def flash_attention(
     return o[:, :sq, :d].reshape(b, h, sq, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_lse(q, k, v, scale, causal):
-    return _flash_lse_fwd(q, k, v, scale, causal)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_lse(q, k, v, seed, scale, causal, dropout_p):
+    return _flash_lse_fwd(q, k, v, seed, scale, causal, dropout_p)[0]
 
 
-def _flash_lse_fwd(q, k, v, scale, causal):
-    o, lse = _pallas.flash_fwd(q, k, v, None, scale=scale, causal=causal)
-    return (o, lse[..., 0]), (q, k, v, o, lse)
-
-
-def _flash_lse_bwd(scale, causal, res, cts):
-    q, k, v, o, lse = res
-    do, dlse = cts
-    dq, dk, dv = _pallas.flash_bwd(
-        q, k, v, o, lse, do, None, scale=scale, causal=causal, dlse=dlse
+def _flash_lse_fwd(q, k, v, seed, scale, causal, dropout_p):
+    o, lse = _pallas.flash_fwd(
+        q, k, v, None, scale=scale, causal=causal, dropout_p=dropout_p,
+        dropout_seed=seed,
     )
-    return dq, dk, dv
+    return (o, lse[..., 0]), (q, k, v, seed, o, lse)
+
+
+def _flash_lse_bwd(scale, causal, dropout_p, res, cts):
+    import numpy as np
+
+    q, k, v, seed, o, lse = res
+    do, dlse = cts
+    # dlse folds as ds = p·(dp − (delta − dlse)): the dlse term enters
+    # delta BEFORE the keep-mask multiplies dp, so it correctly bypasses
+    # dropout (lse accumulates the full, undropped row sum).
+    dq, dk, dv = _pallas.flash_bwd(
+        q, k, v, o, lse, do, None, scale=scale, causal=causal, dlse=dlse,
+        dropout_p=dropout_p, dropout_seed=seed,
+    )
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention_with_lse(q, k, v, *, causal=False, scale=None):
+def flash_attention_with_lse(q, k, v, *, causal=False, scale=None,
+                             dropout_p: float = 0.0, dropout_rng=None):
     """Fused attention returning ``(o, lse)`` — both differentiable.
 
     The building block for composed softmax schemes that need the row
@@ -343,42 +360,65 @@ def flash_attention_with_lse(q, k, v, *, causal=False, scale=None):
     dtype and lse f32 (B,H,Sq).  Uses the Pallas kernels whenever the
     shape is eligible (interpret-mode off TPU), else a jnp composition
     with identical semantics.
+
+    ``dropout_p`` > 0 (with ``dropout_rng``) applies fused probability
+    dropout exactly as :func:`flash_attention` does: the PV contribution
+    is masked + rescaled while ``lse`` stays the full undropped row
+    statistic, and the dlse cotangent correctly bypasses the keep mask in
+    backward.  The mask's element coordinates are LOCAL to this call —
+    ring/Ulysses compositions that shard keys must fold the shard offset
+    into ``dropout_rng`` themselves if they need cross-hop-independent
+    masks.
     """
     from apex_tpu.amp.lists import amp_cast
 
     q, k, v = amp_cast("attention", q, k, v)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if dropout_p > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_p > 0 requires dropout_rng")
     b, h, sq, d = q.shape
     # Aligned shapes only: the lse variant has no bias plumbing, so padded
     # keys could not be masked out (ring attention's shards are aligned).
     if (
         not _seq_pad(sq)
         and not _seq_pad(k.shape[-2])
-        and _pallas_eligible(q, k, v, 0.0, causal)
+        and _pallas_eligible(q, k, v, dropout_p, causal)
     ):
+        seed = _derive_dropout_seed(dropout_rng, dropout_p)
         qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
-        o, lse = _flash_lse(qf, kf, vf, scale, causal)
+        o, lse = _flash_lse(qf, kf, vf, seed, scale, causal, dropout_p)
         return (
             o[..., :d].reshape(b, h, sq, d),
             lse.reshape(b, h, sq),
         )
-    return mha_reference_with_lse(q, k, v, causal=causal, scale=scale)
+    return mha_reference_with_lse(
+        q, k, v, causal=causal, scale=scale, dropout_p=dropout_p,
+        dropout_rng=dropout_rng,
+    )
 
 
-def mha_reference_with_lse(q, k, v, *, causal=False, scale=None):
+def mha_reference_with_lse(q, k, v, *, causal=False, scale=None,
+                           dropout_p: float = 0.0, dropout_rng=None):
     """jnp composition returning ``(o, lse)`` — the correctness reference
     for :func:`flash_attention_with_lse` (numerics identical to
-    :func:`mha_reference` plus the row logsumexp)."""
+    :func:`mha_reference` plus the row logsumexp).  Dropout masks the
+    normalized probabilities only; ``lse`` stays the undropped row
+    statistic (the kernel contract — the mask stream differs from the
+    kernel's, both are valid dropout)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     s = _scores(q, k, None, causal, scale)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum(
-        "bhqk,bhkd->bhqd", (p / l).astype(q.dtype), v
-    )
+    pn = p / l
+    if dropout_p > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_p > 0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, pn.shape)
+        pn = jnp.where(keep, pn / (1.0 - dropout_p), 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", pn.astype(q.dtype), v)
     lse = (m + jnp.log(l))[..., 0]
     return o, lse
 

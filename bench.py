@@ -109,18 +109,19 @@ def _chip_peak(device) -> float:
     return 197e12  # conservative default
 
 
-def _emit(metric, value, unit, vs_baseline):
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": value,
-                "unit": unit,
-                "vs_baseline": vs_baseline,
-            }
-        ),
-        flush=True,
-    )
+def _emit(metric, value, unit, vs_baseline, degenerate=False):
+    """``degenerate=True`` marks a multi-device config that ran with only
+    one device visible (dp=1/tp=1): the number is a valid single-chip
+    measurement but does NOT exercise the config's collective path."""
+    rec = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+    }
+    if degenerate:
+        rec["degenerate"] = True
+    print(json.dumps(rec), flush=True)
 
 
 def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
@@ -154,7 +155,9 @@ def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
 def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
                     cfg_kwargs=None, mlm_loss_chunks="auto",
                     max_predictions_per_seq=20, emit=True):
-    """Returns (mfu, step_time, loss).  ``cfg_kwargs`` overrides the tuned
+    """Returns (mfu, step_time, loss, mfu_exec) — mfu is the 6·N·T
+    recipe-parity headline, mfu_exec the executed-FLOPs utilization
+    (equal for the dense head).  ``cfg_kwargs`` overrides the tuned
     model config (tools/mfu_sweep.py reuses this function for its variants,
     so sweep numbers and the headline stay comparable).
 
@@ -256,18 +259,21 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
     flops = 6.0 * n_params * tokens
     peak = sum(_chip_peak(d) for d in jax.devices())
     mfu = flops / (step_time * peak)
+    # Honesty sidecar: the packed head EXECUTES fewer decoder FLOPs than
+    # 6·N·T credits (K·B rows instead of T through the tied V×H decoder).
+    # mfu_exec charges only executed work — the utilization number, vs
+    # the recipe-parity headline above.  Dense head: identical.
+    mfu_exec = mfu
+    if max_predictions_per_seq:
+        dec = cfg.vocab_size * cfg.hidden_size
+        kb = max_predictions_per_seq * batch
+        flops_exec = flops - 6.0 * (tokens - kb) * dec
+        mfu_exec = flops_exec / (step_time * peak)
     if emit:
         extra = ""
         if max_predictions_per_seq:
-            # Honesty sidecar: the packed head EXECUTES fewer decoder
-            # FLOPs than 6·N·T credits (K·B rows instead of T through the
-            # tied V×H decoder).  mfu_exec charges only executed work —
-            # the utilization number, vs the recipe-parity number above.
-            dec = cfg.vocab_size * cfg.hidden_size
-            kb = max_predictions_per_seq * batch
-            flops_exec = flops - 6.0 * (tokens - kb) * dec
             extra = ", mfu_exec=%.4f, mpps=%d" % (
-                flops_exec / (step_time * peak), max_predictions_per_seq
+                mfu_exec, max_predictions_per_seq
             )
         _emit(
             _METRIC_NAMES["bert_lamb"],
@@ -276,7 +282,7 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
             % (step_time * 1e3, batch, n_params // 1_000_000, loss, extra),
             round(mfu / 0.50, 4),
         )
-    return mfu, step_time, loss
+    return mfu, step_time, loss, mfu_exec
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +415,7 @@ def bench_ddp_syncbn(trace_dir=None, batch_per_replica=128, chunk=4, trials=3):
         "SyncBN; reference publishes no absolute number)"
         % (step_time * 1e3, dp, global_batch, loss),
         None,
+        degenerate=dp == 1,
     )
 
 
@@ -573,6 +580,7 @@ def bench_tp_gpt(trace_dir=None, batch=8, seq=1024, chunk=4, trials=3):
         "publishes no absolute number)"
         % (tp, seq, batch, cfg.hidden_size, tp > 1, basis),
         None,
+        degenerate=tp == 1,
     )
 
 

@@ -176,6 +176,235 @@ def test_with_lse_fuzz(seed):
         )
 
 
+def _kernel_keep_mask(seed, b, h, sq, sk, p):
+    """The full (B,H,Sq,Sk) keep mask the kernel's counter-based PRNG
+    generates: ``_dropout_keep_block`` is a pure function of (seed, bh,
+    absolute row, absolute col), so evaluating tile (0, 0) at full size
+    reproduces every kernel tile's coordinates exactly."""
+    from apex_tpu.ops.pallas.flash_attention import _dropout_keep_block
+
+    masks = [
+        _dropout_keep_block(
+            seed, jnp.asarray(bh, jnp.int32), 0, 0, sq, sk, p
+        )
+        for bh in range(b * h)
+    ]
+    return jnp.stack(masks).reshape(b, h, sq, sk)
+
+
+def _derive_seed(dropout_rng):
+    # exactly the dispatcher's derivation (ops/attention.py)
+    return jax.random.randint(
+        dropout_rng, (1,), jnp.iinfo(jnp.int32).min,
+        jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
+    )[0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8))
+def test_flash_dropout_fuzz(seed):
+    """Fused-dropout fuzz (VERDICT r3 #6): random shape x causal x
+    bias_kind x bias_grad x padded-S draws with dropout_p > 0, checking
+    the kernel against its OWN keep-mask contract — a jnp golden that
+    applies the kernel's regenerated mask to the reference softmax
+    (values AND grads) — plus determinism and keep-rate statistics.  The
+    jnp fallback's jax.random mask stream differs by documented contract,
+    so kernel-vs-jnp comparison is only valid through the shared mask."""
+    from apex_tpu.ops.pallas import flash_attention as _pallas
+    from apex_tpu.ops.attention import _scores
+
+    rng = np.random.default_rng(5678 + seed)
+    b, h, d, sq, sk, causal, dtype, bias_kind, bias_grad = _draw(rng)
+    if causal and sk < sq:
+        # fully-masked rows (bottom-right causal, Sk < Sq) have
+        # uniform-average semantics the masked-softmax golden can't
+        # express with dropout; the no-dropout fuzz keeps that corner
+        sk = sq
+    dropout_p = float(rng.choice([0.1, 0.2, 0.35, 0.5]))
+    tol = (
+        dict(rtol=3e-2, atol=3e-2)
+        if dtype == jnp.bfloat16
+        else dict(rtol=2e-4, atol=2e-4)
+    )
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv, kb, kr = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (b, h, sq, d), dtype)
+    k = jax.random.normal(kk, (b, h, sk, d), dtype)
+    v = jax.random.normal(kv, (b, h, sk, d), dtype)
+    bias = None
+    if bias_kind == 1:
+        bias = jax.random.normal(kb, (1, 1, 1, sk), jnp.float32)
+    elif bias_kind == 2:
+        bias = jax.random.normal(kb, (b, h, sq, sk), jnp.float32)
+    desc = (f"b={b} h={h} d={d} sq={sq} sk={sk} causal={causal} "
+            f"dtype={dtype.__name__} bias={bias_kind} bgrad={bias_grad} "
+            f"p={dropout_p}")
+    args = (q, k, v) + ((bias,) if bias is not None else ())
+
+    def kernel_run(rng_key):
+        _dispatch.set_use_pallas(True)
+        try:
+            def loss(*args):
+                o = flash_attention(
+                    *args, causal=causal, bias_grad=bias_grad,
+                    dropout_p=dropout_p, dropout_rng=rng_key,
+                )
+                return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+            (l, o), grads = jax.value_and_grad(
+                loss, argnums=tuple(range(len(args))), has_aux=True
+            )(*args)
+            return o, grads
+        finally:
+            _dispatch.set_use_pallas(None)
+
+    o_k, g_k = kernel_run(kr)
+
+    # determinism: identical rng -> bitwise-identical output
+    o_k2, _ = kernel_run(kr)
+    np.testing.assert_array_equal(
+        np.asarray(o_k), np.asarray(o_k2), err_msg=desc
+    )
+
+    # golden: reference softmax with the kernel's regenerated keep mask
+    keep = _kernel_keep_mask(_derive_seed(kr), b, h, sq, sk, dropout_p)
+
+    # keep-rate statistics (binomial over b*h*sq*sk draws)
+    n = keep.size
+    rate = float(jnp.mean(keep))
+    bound = 5.0 * float(np.sqrt(dropout_p * (1 - dropout_p) / n)) + 1e-3
+    assert abs(rate - (1 - dropout_p)) < bound, (desc, rate)
+
+    scale = 1.0 / (d ** 0.5)
+
+    def golden(*args):
+        q, k, v = args[:3]
+        bz = args[3] if len(args) > 3 else None
+        if bz is not None:
+            # the dispatcher clamps the bias to MASK_VALUE on both paths
+            bz = jnp.maximum(bz, _pallas.MASK_VALUE)
+        s = _scores(q, k, bz, causal, scale)
+        probs = jax.nn.softmax(s, axis=-1)
+        pd = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    (_, o_g), g_g = jax.value_and_grad(
+        golden, argnums=tuple(range(len(args))), has_aux=True
+    )(*args)
+
+    np.testing.assert_allclose(
+        np.asarray(o_k, np.float32), np.asarray(o_g, np.float32),
+        err_msg=desc, **tol,
+    )
+    n_cmp = 3 + (1 if (bias is not None and bias_grad) else 0)
+    for a, b_ in zip(g_k[:n_cmp], g_g[:n_cmp]):
+        _assert_grad_close(a, b_, dtype, tol, desc)
+
+
+def _assert_grad_close(a, b_, dtype, tol, desc):
+    """Grad comparison scaled to the golden's own magnitude: a bf16 dot
+    product's rounding error is proportional to the LARGEST values summed
+    into it, not to each output element — so bf16 draws get an atol of
+    2% of the golden's max |g| (f32 draws keep the strict tol; they pin
+    exactness of the shared mask stream)."""
+    a32, b32 = np.asarray(a, np.float32), np.asarray(b_, np.float32)
+    eff = dict(tol)
+    if dtype == jnp.bfloat16:
+        eff["atol"] = max(eff["atol"], 2e-2 * float(np.abs(b32).max()))
+    np.testing.assert_allclose(a32, b32, err_msg=desc, **eff)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(4))
+def test_with_lse_dropout_fuzz(seed):
+    """Dropout on the with-lse path (ring-attention building block):
+    the PV contribution is masked + rescaled while lse stays the full
+    undropped row statistic, and the dlse cotangent bypasses the keep
+    mask in backward — checked against the keep-mask golden, values
+    (o AND lse) and grads with a live lse cotangent."""
+    from apex_tpu.ops.attention import _scores, flash_attention_with_lse
+
+    rng = np.random.default_rng(901 + seed)
+    b = int(rng.integers(1, 3))
+    h = int(rng.integers(1, 3))
+    d = int(rng.choice([32, 64]))
+    sq = int(rng.choice([16, 64, 128, 256]))
+    sk = int(rng.choice([16, 64, 128, 256]))
+    causal = bool(rng.integers(0, 2))
+    if causal and sk < sq:
+        sk = sq
+    dropout_p = float(rng.choice([0.1, 0.25, 0.4]))
+    dtype = jnp.bfloat16 if rng.integers(0, 2) else jnp.float32
+    tol = (
+        dict(rtol=3e-2, atol=3e-2)
+        if dtype == jnp.bfloat16
+        else dict(rtol=3e-4, atol=3e-4)
+    )
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv, kc, kr = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (b, h, sq, d), dtype)
+    k = jax.random.normal(kk, (b, h, sk, d), dtype)
+    v = jax.random.normal(kv, (b, h, sk, d), dtype)
+    dlse_w = jax.random.normal(kc, (b, h, sq), jnp.float32)
+    desc = (f"b={b} h={h} d={d} sq={sq} sk={sk} causal={causal} "
+            f"{dtype.__name__} p={dropout_p}")
+
+    def kernel_run():
+        _dispatch.set_use_pallas(True)
+        try:
+            def loss(q, k, v):
+                o, lse = flash_attention_with_lse(
+                    q, k, v, causal=causal, dropout_p=dropout_p,
+                    dropout_rng=kr,
+                )
+                return (
+                    jnp.sum(o.astype(jnp.float32) ** 2)
+                    + jnp.sum(lse * dlse_w),
+                    (o, lse),
+                )
+
+            (_, (o, lse)), grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2), has_aux=True
+            )(q, k, v)
+            return o, lse, grads
+        finally:
+            _dispatch.set_use_pallas(None)
+
+    o_k, lse_k, g_k = kernel_run()
+
+    keep = _kernel_keep_mask(_derive_seed(kr), b, h, sq, sk, dropout_p)
+    scale = 1.0 / (d ** 0.5)
+
+    def golden(q, k, v):
+        s = _scores(q, k, None, causal, scale)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        pexp = jnp.exp(s - m)
+        l = jnp.sum(pexp, axis=-1, keepdims=True)
+        pd = jnp.where(keep, (pexp / l) / (1.0 - dropout_p), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+        lse = (m + jnp.log(l))[..., 0]
+        return (
+            jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse * dlse_w),
+            (o, lse),
+        )
+
+    (_, (o_g, lse_g)), g_g = jax.value_and_grad(
+        golden, argnums=(0, 1, 2), has_aux=True
+    )(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(o_k, np.float32), np.asarray(o_g, np.float32),
+        err_msg=desc, **tol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse_k), np.asarray(lse_g), err_msg=desc,
+        rtol=1e-3, atol=1e-3,
+    )
+    for a, b_ in zip(g_k, g_g):
+        _assert_grad_close(a, b_, dtype, tol, desc)
+
+
 def test_mha_reference_is_the_golden():
     """The fuzz compares against mha_reference — pin that it matches a
     hand-written softmax composition once, so the golden itself is

@@ -82,10 +82,21 @@ def collect(hlo_text: str):
         line = line.strip()
         m = re.match(r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*(\([^)]*\)|[^\s]+)\s+"
                      r"(all-reduce|all-gather|reduce-scatter|"
-                     r"collective-permute|all-to-all)", line)
+                     r"collective-permute|all-to-all)(-start|-done)?\(",
+                     line)
         if not m:
             continue
-        shape, kind = m.group(1), m.group(2)
+        shape, kind, variant = m.group(1), m.group(2), m.group(3)
+        if variant == "-done":
+            # async pairs are counted once, at -start
+            continue
+        if variant == "-start" and shape.startswith("("):
+            # -start returns (operand, result[, contexts]); keep only the
+            # result array so bytes match the sync form instead of
+            # summing operand+result
+            arrays = re.findall(r"\w+\[[0-9,]*\]", shape)
+            if len(arrays) > 1:
+                shape = arrays[1]
         rec = out.setdefault(kind, {"count": 0, "bytes": 0})
         rec["count"] += 1
         rec["bytes"] += _shape_bytes(shape)
@@ -119,8 +130,16 @@ def emit(rec, fh):
     fh.write(line + "\n")
 
 
-def tp_gpt_structure(world: int):
-    """BASELINE #5: the GPT block train step at tp=world (+SP)."""
+def tp_gpt_structure(world: int, hidden=1024, heads=16, inter=4096,
+                     seq=1024, batch=8):
+    """BASELINE #5: the GPT block train step at tp=world (+SP).
+
+    The default (h=1024) shape is the bench.py #5 toy and is
+    comm-DOMINATED by construction — its analytic fraction measures the
+    shape, not the design.  main() also records a GPT-Large-class shape
+    (h=4096) where compute/comm overlap is the actual question (VERDICT
+    r3 #7); this only compiles (never executes), so the big shape is
+    cheap on the CPU mesh."""
     from apex_tpu import parallel_state as ps
     from apex_tpu.transformer.tensor_parallel.mappings import (
         allreduce_sequence_parallel_gradients,
@@ -134,9 +153,8 @@ def tp_gpt_structure(world: int):
         tensor_model_parallel_size=world, devices=devices
     )
     mesh = Mesh(devices, (ps.TENSOR_PARALLEL_AXIS,))
-    seq, batch = 1024, 8
     cfg = GptConfig(
-        hidden_size=1024, num_heads=16, intermediate_size=4096,
+        hidden_size=hidden, num_heads=heads, intermediate_size=inter,
         sequence_parallel=True, dtype=jnp.bfloat16,
     )
     block = GptBlock(cfg)
@@ -263,6 +281,12 @@ def main():
     with open(out_path, "w") as fh:
         for name, fn in (
             ("tp_gpt_block", tp_gpt_structure),
+            # GPT-Large-class shape: h=4096 puts the GEMMs where a real
+            # tp deployment sits, so the analytic fraction is a design
+            # signal rather than a toy-shape artifact (VERDICT r3 #7)
+            ("tp_gpt_block_h4096",
+             lambda w: tp_gpt_structure(w, hidden=4096, heads=32,
+                                        inter=16384)),
             ("ddp_resnet50_syncbn", ddp_syncbn_structure),
         ):
             kinds, flops_chip = fn(args.world)
