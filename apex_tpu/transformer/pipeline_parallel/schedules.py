@@ -48,6 +48,7 @@ from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
 __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_1f1b",
     "forward_backward_pipelining_with_interleaving",
     "get_forward_backward_func",
 ]
@@ -230,6 +231,220 @@ def forward_backward_pipelining_without_interleaving(
 
 
 # ---------------------------------------------------------------------------
+# hand-scheduled 1F1B: explicit O(pp) stash ring, manually reversed permutes
+# ---------------------------------------------------------------------------
+
+
+def forward_backward_pipelining_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params,
+    batch: Tuple[Any, Any],
+    *,
+    num_microbatches: int,
+    axis_name: str = _PP,
+    forward_only: bool = False,
+    stash: str = "residuals",
+    remat: bool = False,
+    remat_policy=None,
+    loss_takes_params: bool = False,
+):
+    """True 1F1B with a bounded activation window and NO dependence on
+    ``jax.grad`` over the tick loop — ≙ the reference's
+    ``forward_backward_pipelining_without_interleaving`` memory/compute
+    point (SURVEY §3.5: ≤pp in-flight activations, no recompute).
+
+    Where :func:`forward_backward_pipelining_without_interleaving`
+    differentiates a lockstep scan (backward falls out of autodiff, at
+    the price of either per-tick rematerialization or O(nm) saved scan
+    carries), this schedule computes gradients INSIDE a single forward
+    scan: each tick runs one stage forward AND one stage backward on
+    different microbatches, per-microbatch vjp residuals live in an
+    explicit ring buffer, and cotangents ride a manually reversed
+    ``ppermute`` (``send_backward_recv_backward``).  Nothing about the
+    loop is differentiated, so nm-proportional autodiff memory never
+    exists.
+
+    Timetable (lockstep SPMD — every rank runs the same program; bubble
+    slots compute masked garbage): stage ``s`` forwards microbatch ``m``
+    at tick ``m + s`` and backwards it at tick ``2(pp-1) - s + m``;
+    total ticks ``nm + 2(pp-1)`` (vs ``nm + pp - 1`` per direction for
+    the lockstep scan — the steady state overlaps one fwd with one bwd
+    per tick exactly like the reference's 1F1B).  The in-flight window
+    on stage ``s`` is ``2(pp-1-s) + 1 <= 2pp - 1``: the lockstep
+    round-trip bound (the reference's asynchronous ranks reach ``pp - s``
+    by backpressure instead of clock; both are O(pp), independent of nm).
+
+    ``stash`` selects what the ring holds:
+
+    * ``"residuals"`` (default) — the stage vjp's residuals, so backward
+      replays NOTHING: the no-recompute-premium point.  Residual leaves
+      that are parameter passthroughs (detected by tracer identity) are
+      NOT ring-stashed — they are loop-invariant and read from a single
+      copy, so ring memory is ~W x the stage's activation-derived
+      residuals only.  Combine with ``remat_policy`` to bound residual
+      size (policy-saved tensors + stage input become the residuals).
+    * ``"input"`` — the ring holds only each microbatch's stage input;
+      backward re-runs the stage forward under ``jax.vjp`` (the ~4/3
+      recompute premium, minimal O(pp x |activation|) ring — strictly
+      less memory than ``carry_chunk``'s O(sqrt(nm)) carries at equal
+      compute).
+
+    Same contract as the other schedules: call inside ``shard_map``,
+    ``batch`` leaves stacked ``(num_microbatches, ...)``, returns
+    ``(losses, grads)`` with ``losses`` psum-shared across pp.
+    """
+    if stash not in ("residuals", "input"):
+        raise ValueError(f"unknown stash mode {stash!r}")
+    inputs, targets = batch
+    nm = num_microbatches
+    run = _wrap_remat(stage_fn, remat, remat_policy)
+    lfn = loss_fn if loss_takes_params else (lambda p, y, t: loss_fn(y, t))
+
+    if forward_only:
+        losses, _ = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, params, batch, num_microbatches=nm,
+            axis_name=axis_name, forward_only=True, remat=False,
+            loss_takes_params=loss_takes_params,
+        )
+        return losses, None
+
+    pp = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    is_first = stage == 0
+    is_last = stage == pp - 1
+    ticks = nm + 2 * (pp - 1)
+    window = 2 * (pp - 1) + 1
+    tree = jax.tree_util
+
+    h0 = tree.tree_map(lambda x: jnp.zeros_like(x[0]), inputs)
+
+    def stage_vjp(p, x):
+        return jax.vjp(lambda p_, x_: run(p_, x_), p, x)
+
+    # Template vjp (traced once, outside the loop): fixes the residual
+    # pytree structure, and partitions its leaves into parameter
+    # passthroughs (loop-invariant — kept as a single closed-over copy)
+    # vs activation-derived residuals (ring-stashed per in-flight mb).
+    y_t, vjp_t = stage_vjp(params, h0)
+    t_leaves, t_def = tree.tree_flatten(vjp_t)
+    param_ids = {id(l) for l in tree.tree_leaves(params)}
+    varying = [
+        i for i, l in enumerate(t_leaves) if id(l) not in param_ids
+    ]
+
+    if stash == "residuals":
+        ring0 = [
+            jnp.zeros((window,) + t_leaves[i].shape, t_leaves[i].dtype)
+            for i in varying
+        ]
+    else:
+        ring0 = [
+            jnp.zeros((window,) + l.shape, l.dtype)
+            for l in tree.tree_leaves(h0)
+        ]
+    x_def = tree.tree_structure(h0)
+    g0 = tree.tree_map(jnp.zeros_like, y_t)
+    dp0 = tree.tree_map(jnp.zeros_like, params)
+
+    def tick(carry, t):
+        h_recv, g_recv, ring, dp_acc, losses = carry
+
+        # ---- forward lane: stage s forwards microbatch t - s ----------
+        mf = t - stage
+        mf_c = jnp.clip(mf, 0, nm - 1)
+        inject = tree.tree_map(lambda x: x[mf_c], inputs)
+        x_in = tree.tree_map(
+            lambda a, b: jnp.where(is_first, a, b), inject, h_recv
+        )
+        y, vjp_f = stage_vjp(params, x_in)
+        # NOTE: the per-tick vjp treedef is NOT == t_def (each trace
+        # wraps a fresh closure in the Partial's static part), but the
+        # residual LEAVES line up one-to-one with the template's — that
+        # is what the ring relies on, so pin it structurally.
+        f_leaves, f_def = tree.tree_flatten(vjp_f)
+        assert [(l.shape, l.dtype) for l in f_leaves] == [
+            (l.shape, l.dtype) for l in t_leaves
+        ], "vjp residual structure changed across ticks"
+        assert [
+            i for i, l in enumerate(f_leaves) if id(l) not in param_ids
+        ] == varying, "param-passthrough residual positions changed"
+        slot_f = t % window
+        if stash == "residuals":
+            ring = [
+                r.at[slot_f].set(f_leaves[i])
+                for r, i in zip(ring, varying)
+            ]
+        else:
+            ring = [
+                r.at[slot_f].set(l)
+                for r, l in zip(ring, tree.tree_leaves(x_in))
+            ]
+
+        # ---- loss lane (last stage; same tick as its forward) ---------
+        tgt = tree.tree_map(lambda x: x[mf_c], targets)
+        (loss, (dhead, dy)) = _loss_and_head_grads(
+            lfn, params, y, tgt, loss_takes_params
+        )
+        f_valid = (mf >= 0) & (mf < nm) & is_last
+        losses = losses.at[mf_c].add(jnp.where(f_valid, loss, 0.0))
+        wt = jnp.where(f_valid, 1.0 / nm, 0.0)
+        dy = tree.tree_map(lambda g: g * wt, dy)
+        if dhead is not None:
+            dp_acc = tree.tree_map(
+                lambda a, d: a + d * wt, dp_acc, dhead
+            )
+
+        # ---- backward lane: stage s backwards mb t - 2(pp-1) + s ------
+        mb = t - 2 * (pp - 1) + stage
+        b_valid = (mb >= 0) & (mb < nm)
+        mb_c = jnp.clip(mb, 0, nm - 1)
+        slot_b = (mb_c + stage) % window  # = that mb's fwd tick mod W
+        if stash == "residuals":
+            # invariant (param-passthrough) positions reuse this tick's
+            # own leaves — identical values every tick, never stashed
+            leaves_b = list(f_leaves)
+            for r, i in zip(ring, varying):
+                leaves_b[i] = r[slot_b]
+            vjp_b = tree.tree_unflatten(f_def, leaves_b)
+        else:
+            x_b = tree.tree_unflatten(x_def, [r[slot_b] for r in ring])
+            _, vjp_b = stage_vjp(params, x_b)
+        g_in = tree.tree_map(
+            lambda a, b: jnp.where(is_last, a, b), dy, g_recv
+        )
+        # zeroed cotangent on bubble ticks => vjp (linear in g) yields
+        # exact zeros, so garbage residuals never reach the accumulators
+        g_in = tree.tree_map(
+            lambda g: jnp.where(b_valid, g, jnp.zeros_like(g)), g_in
+        )
+        dp, dx = vjp_b(g_in)
+        dp_acc = tree.tree_map(jnp.add, dp_acc, dp)
+
+        # ---- edges: activations down, cotangents up -------------------
+        h_next = p2p.send_forward_recv_forward(y, axis_name)
+        g_next = p2p.send_backward_recv_backward(dx, axis_name)
+        return (h_next, g_next, ring, dp_acc, losses), None
+
+    carry0 = (h0, g0, ring0, dp0, jnp.zeros((nm,), jnp.float32))
+    (_, _, _, grads, losses), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(ticks)
+    )
+    return jax.lax.psum(losses, axis_name), grads
+
+
+def _loss_and_head_grads(lfn, params, y, tgt, loss_takes_params):
+    """Loss value + its cotangents wrt (params-if-taken, y), unscaled."""
+    if loss_takes_params:
+        loss, dvjp = jax.vjp(lambda p, y_: lfn(p, y_, tgt), params, y)
+        dhead, dy = dvjp(jnp.ones((), loss.dtype))
+        return loss, (dhead, dy)
+    loss, dvjp = jax.vjp(lambda y_: lfn(params, y_, tgt), y)
+    (dy,) = dvjp(jnp.ones((), loss.dtype))
+    return loss, (None, dy)
+
+
+# ---------------------------------------------------------------------------
 # interleaved 1F1B (virtual pipeline stages)
 # ---------------------------------------------------------------------------
 
@@ -373,8 +588,14 @@ def forward_backward_pipelining_with_interleaving(
 def get_forward_backward_func(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_size: Optional[int] = None,
+    hand_scheduled: bool = False,
 ):
-    """≙ schedules/__init__.py :: get_forward_backward_func."""
+    """≙ schedules/__init__.py :: get_forward_backward_func.
+
+    ``hand_scheduled=True`` opts the non-interleaved case into
+    :func:`forward_backward_pipelining_1f1b` (explicit O(pp) stash ring,
+    no autodiff over the tick loop) — the reference's 1F1B memory point;
+    see docs/pipeline-schedules.md for when each wins."""
     if pipeline_model_parallel_size is None and ps.model_parallel_is_initialized():
         pipeline_model_parallel_size = ps.get_pipeline_model_parallel_world_size()
     if virtual_pipeline_model_parallel_size is None and ps.model_parallel_is_initialized():
@@ -388,4 +609,6 @@ def get_forward_backward_func(
             forward_backward_pipelining_with_interleaving,
             num_model_chunks=virtual_pipeline_model_parallel_size,
         )
+    if hand_scheduled:
+        return forward_backward_pipelining_1f1b
     return forward_backward_pipelining_without_interleaving

@@ -176,6 +176,31 @@ _SLOW_EXACT = {
     "test_encdec_attn",
     "test_capacity_bounds_per_expert",
     "test_vs_compose",
+    # r4 re-tier (VERDICT r3 #8: quick tier standalone ≤ 240 s on this
+    # 1-core container; measured 328 s before, 237 s after, both
+    # standalone 2026-07-31).  Families keep a quick representative:
+    # LN keeps [True-*-shape0] + the pallas-vs-jnp [True-*] ids,
+    # scaled-softmax keeps test_scaled_masked_softmax, xentropy keeps
+    # [0.1-bfloat16], rms keeps [True-bfloat16], group_norm keeps
+    # module_grad_dtypes[bfloat16], hand-1F1B keeps both pp=4 modes,
+    # remat-policy parity rides the full tier + the dryrun's "sums" leg
+    # (its class fixture alone cost 13.8 s), packed-MLM and the
+    # gpt-provider forward ride the full tier + __graft_entry__ drives.
+    "test_remat_policy_preserves_values[sums]",
+    "test_layer_norm_affine_fwd_bwd[True-float32-shape1]",
+    "test_layer_norm_affine_fwd_bwd[True-float32-shape2]",
+    "test_layer_norm_affine_fwd_bwd[False-bfloat16-shape0]",
+    "test_scaled_softmax[0.125-bfloat16]",
+    "test_xentropy_fwd_bwd[0.1-float32]",
+    "test_rms_norm_affine_fwd_bwd[True-float32]",
+    "test_group_norm_value_and_grad[bfloat16]",
+    "test_pallas_kernel_matches_jnp_path[False-True]",
+    "test_hand_1f1b_matches_sequential[8-residuals]",
+    "test_hand_1f1b_matches_sequential[8-input]",
+    "test_ep_matches_unsharded[2]",
+    "test_standalone_providers_forward[gpt_model_provider]",
+    "test_packed_mlm_truncates_and_chunks",
+    "test_outer_product_mean_math",
 }
 
 

@@ -448,6 +448,166 @@ def test_1f1b_loss_takes_params_matches_sequential(eight_devices):
     assert not np.allclose(np.asarray(grads["b"][-1]), 0.0)
 
 
+# ---------------------------------------------------------------------------
+# hand-scheduled 1F1B (explicit stash ring, manually reversed permutes)
+# ---------------------------------------------------------------------------
+
+
+def _run_hand_1f1b(mesh, stacked, inputs, targets, nm, **kw):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_1f1b(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=nm, **kw,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[None], grads)
+        return losses, grads
+
+    return jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")), check_vma=False,
+        )
+    )(stacked, inputs, targets)
+
+
+@pytest.mark.parametrize("stash", ["residuals", "input"])
+@pytest.mark.parametrize("pp", [4, 8])
+def test_hand_1f1b_matches_sequential(eight_devices, stash, pp):
+    """The manual schedule (grads computed inside ONE forward scan, no
+    autodiff over the tick loop) reproduces the sequential golden for
+    both stash modes, at nm > pp and nm < pp."""
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+    losses, grads = _run_hand_1f1b(
+        mesh, stacked, inputs, targets, NM, stash=stash
+    )
+    ref_losses, ref_grads = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_hand_1f1b_residuals_with_remat_policy(eight_devices):
+    """stash="residuals" composes with a checkpoint policy: the policy
+    bounds what the ring holds (saved names + inputs) and numerics are
+    unchanged."""
+    pp = 4
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+    losses, grads = _run_hand_1f1b(
+        mesh, stacked, inputs, targets, NM,
+        stash="residuals", remat=True, remat_policy="dots",
+    )
+    ref_losses, ref_grads = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_hand_1f1b_loss_takes_params(eight_devices):
+    """Megatron post-process head pattern through the manual loss lane:
+    the last stage's params receive loss-side gradients."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+
+    pp = 4
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+
+    def head_loss(p, y, t):
+        return jnp.mean((y + p["b"] - t) ** 2)
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_1f1b(
+            stage_fn, head_loss, params, (inputs, targets),
+            num_microbatches=NM, loss_takes_params=True,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[None], grads)
+        return losses, grads
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")), check_vma=False,
+        )
+    )(stacked, inputs, targets)
+
+    def seq_loss(stacked):
+        def one(x, t):
+            for s in range(pp):
+                p_s = jax.tree_util.tree_map(lambda v: v[s], stacked)
+                x = stage_fn(p_s, x)
+            p_last = jax.tree_util.tree_map(lambda v: v[pp - 1], stacked)
+            return head_loss(p_last, x, t)
+
+        losses = jax.vmap(one)(inputs, targets)
+        return jnp.mean(losses), losses
+
+    (_, ref_losses), ref_grads = jax.value_and_grad(
+        seq_loss, has_aux=True
+    )(stacked)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-5,
+        )
+    assert not np.allclose(np.asarray(grads["b"][-1]), 0.0)
+
+
+def test_hand_1f1b_forward_only(eight_devices):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+
+    pp = 4
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp)
+    inputs, targets = make_batch()
+
+    def run(stacked_local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+        losses, grads = forward_backward_pipelining_1f1b(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=NM, forward_only=True,
+        )
+        assert grads is None
+        return losses
+
+    losses = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )(stacked, inputs, targets)
+    ref_losses, _ = sequential_reference(stacked, inputs, targets, pp)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+
+
 @pytest.mark.parametrize("carry_chunk", [2, 5, 100])
 def test_interleaved_carry_chunk_matches_sequential(
     eight_devices, carry_chunk
@@ -538,6 +698,15 @@ def test_get_forward_backward_func(eight_devices):
     ps.initialize_model_parallel(1, 2, virtual_pipeline_model_parallel_size=2)
     f = get_forward_backward_func()
     assert f.func is forward_backward_pipelining_with_interleaving
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(1, 2)
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+    assert (
+        get_forward_backward_func(hand_scheduled=True)
+        is forward_backward_pipelining_1f1b
+    )
 
 
 # ---------------------------------------------------------------------------

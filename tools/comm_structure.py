@@ -69,6 +69,28 @@ def _shape_bytes(shape: str) -> int:
     return total
 
 
+def _async_start_result(shape: str) -> str:
+    """Result element of an async ``-start`` op's tuple shape
+    ``(operand(s), result(s)[, contexts...])`` — the second TOP-LEVEL
+    element, which for a variadic combined op is itself a tuple whose
+    arrays all count."""
+    if not shape.startswith("("):
+        return shape
+    parts, depth, cur = [], 0, []
+    for ch in shape[1:-1]:
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        cur.append(ch)
+    parts.append("".join(cur))
+    return parts[1] if len(parts) > 1 else parts[0]
+
+
 def collect(hlo_text: str):
     """Per-kind {count, bytes} for every collective in optimized HLO.
 
@@ -80,23 +102,26 @@ def collect(hlo_text: str):
     out = {}
     for line in hlo_text.splitlines():
         line = line.strip()
-        m = re.match(r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*(\([^)]*\)|[^\s]+)\s+"
-                     r"(all-reduce|all-gather|reduce-scatter|"
-                     r"collective-permute|all-to-all)(-start|-done)?\(",
-                     line)
+        # shape alternative allows one level of tuple nesting: variadic
+        # combined async ops (XLA's collective combiners) print
+        # ((op0, op1), (res0, res1)) — a flat [^)]* would stop at the
+        # first ')' and silently drop the op from the count
+        m = re.match(
+            r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*"
+            r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
+            r"(all-reduce|all-gather|reduce-scatter|"
+            r"collective-permute|all-to-all)(-start|-done)?\(",
+            line)
         if not m:
             continue
         shape, kind, variant = m.group(1), m.group(2), m.group(3)
         if variant == "-done":
             # async pairs are counted once, at -start
             continue
-        if variant == "-start" and shape.startswith("("):
-            # -start returns (operand, result[, contexts]); keep only the
-            # result array so bytes match the sync form instead of
-            # summing operand+result
-            arrays = re.findall(r"\w+\[[0-9,]*\]", shape)
-            if len(arrays) > 1:
-                shape = arrays[1]
+        if variant == "-start":
+            # -start returns (operand(s), result(s)[, contexts]); keep
+            # only the result element so bytes match the sync form
+            shape = _async_start_result(shape)
         rec = out.setdefault(kind, {"count": 0, "bytes": 0})
         rec["count"] += 1
         rec["bytes"] += _shape_bytes(shape)

@@ -1,0 +1,44 @@
+#!/bin/sh
+# The staged on-chip queue (VERDICT r3 #2): run everything that needs the
+# real TPU chip, in value order, with per-step logging — so a short
+# tunnel window is never wasted deciding what to run.
+#
+#   sh tools/onchip_queue.sh [ROUND]
+#
+# Steps (each guarded by a fresh probe so a mid-queue outage skips the
+# rest instead of hanging):
+#   1. tests_tpu           — on-chip parity suite (incl. sums remat case)
+#   2. mfu_sweep --grid2   — sums-policy A/B on the packed headline
+#   3. attn_tune           — flash-attention (block_q, block_k) sweep
+#   4. bench_all --round N — refresh BENCH_all_r{N}.json artifacts
+# Logs land in onchip_r{N}.*.log at the repo root.
+
+set -u
+ROUND="${1:-4}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO" || exit 1
+
+probe() {
+    sh tools/tpu_probe.sh 120
+}
+
+step() {
+    name="$1"; shift
+    log="onchip_r$(printf %02d "$ROUND").$name.log"
+    if ! probe; then
+        echo "[$name] SKIPPED: probe failed (tunnel down)" | tee -a "$log"
+        return 1
+    fi
+    echo "[$name] start $(date -u +%H:%M:%S)" | tee -a "$log"
+    # 45 min cap per step: nothing in the queue legitimately needs more
+    timeout 2700 "$@" >>"$log" 2>&1
+    rc=$?
+    echo "[$name] done rc=$rc $(date -u +%H:%M:%S)" | tee -a "$log"
+    return $rc
+}
+
+step tests_tpu python -m pytest tests_tpu/ -q -p no:cacheprovider
+step mfu_sweep python tools/mfu_sweep.py --grid2
+step attn_tune python tools/attn_tune.py
+step bench_all python tools/bench_all.py --round "$ROUND"
+echo "queue finished $(date -u)"

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from apex_tpu import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_no_pipelining,
+    forward_backward_pipelining_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
 )
@@ -226,6 +227,113 @@ def run_lockstep_nm(pp, nm, remat=True):
     return mem
 
 
+FRONTIER_HIDDEN = 256  # 1/4 the compute of HIDDEN=512; same memory SHAPE
+
+
+def run_schedule(pp, nm, schedule, **kw):
+    """Wall + compile-time memory for one schedule at (pp, nm) — the
+    frontier measurement (VERDICT r3 #5): lockstep variants vs the
+    hand-scheduled 1F1B at grad-accumulation scale.  One compile serves
+    both the memory analysis and the (single-rep: 1-core container, the
+    memory column is the trustworthy one) wall timing."""
+    devices = jax.devices()[:pp]
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size=pp, devices=devices
+    )
+    mesh = Mesh(devices, (ps.PIPELINE_PARALLEL_AXIS,))
+    stage = make_stage_fn(LAYERS // pp)
+    key = jax.random.PRNGKey(0)
+    h = FRONTIER_HIDDEN
+    scale = 1.0 / (h ** 0.5)
+    x = jax.random.normal(key, (nm, MB, SEQ, h), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
+
+    def frontier_params(k):
+        ks = jax.random.split(k, 2 * (LAYERS // pp))
+        return [
+            (
+                jax.random.normal(ks[2 * i], (h, 4 * h), jnp.float32)
+                * scale,
+                jax.random.normal(ks[2 * i + 1], (4 * h, h), jnp.float32)
+                * scale,
+            )
+            for i in range(LAYERS // pp)
+        ]
+
+    def sharded_step(x, t):
+        rank = jax.lax.axis_index(ps.PIPELINE_PARALLEL_AXIS)
+        params = frontier_params(jax.random.fold_in(key, rank))
+        losses, grads = schedule(
+            stage, loss_fn, params, (x, t), num_microbatches=nm, **kw
+        )
+        return jnp.sum(losses), sum(
+            jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
+        )
+
+    step = jax.shard_map(
+        sharded_step, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False,
+    )
+    c = jax.jit(step).lower(x, t).compile()
+    m = c.memory_analysis()
+    mem = (m.temp_size_in_bytes + m.output_size_in_bytes) / 1e6
+    jax.block_until_ready(c(x, t))  # warm (allocation etc.)
+    t0 = time.perf_counter()
+    jax.block_until_ready(c(x, t))
+    wall = time.perf_counter() - t0
+    ps.destroy_model_parallel()
+    return wall, mem
+
+
+FRONTIER_POINTS = [
+    # (label, schedule, kwargs) — every bounded-memory point on offer
+    ("lockstep remat",
+     forward_backward_pipelining_without_interleaving,
+     dict(remat=True)),
+    ("lockstep no-remat",
+     forward_backward_pipelining_without_interleaving,
+     dict(remat=False)),
+    ("lockstep carry_chunk",
+     forward_backward_pipelining_without_interleaving,
+     dict(remat=True, carry_chunk="sqrt")),
+    ("hand 1f1b residuals",
+     forward_backward_pipelining_1f1b,
+     dict(stash="residuals")),
+    ("hand 1f1b input",
+     forward_backward_pipelining_1f1b,
+     dict(stash="input")),
+]
+
+
+def run_frontier():
+    """The memory/compute frontier at grad-accumulation scale:
+    nm in {32, 64} x pp in {4, 8}, wall + compiled memory for each
+    schedule.  Decision recorded in docs/pipeline-schedules.md."""
+    print(
+        f"{'schedule':<24}{'pp':>4}{'nm':>5}{'wall ms':>10}{'mem MB':>9}",
+        flush=True,
+    )
+    for pp in (4, 8):
+        for nm in (32, 64):
+            for label, schedule, kw in FRONTIER_POINTS:
+                kw = dict(kw)
+                if kw.get("carry_chunk") == "sqrt":
+                    kw["carry_chunk"] = max(
+                        2, int(round((nm + pp - 1) ** 0.5))
+                    )
+                try:
+                    wall, mem = run_schedule(pp, nm, schedule, **kw)
+                except Exception as e:
+                    print(f"{label:<24}{pp:>4}{nm:>5}  FAILED: {e}")
+                    continue
+                print(
+                    f"{label:<24}{pp:>4}{nm:>5}{wall*1e3:>10.1f}"
+                    f"{mem:>9.1f}",
+                    flush=True,
+                )
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "all"
     header = (
@@ -271,6 +379,12 @@ def main():
                     f"{speed/ideal:>7.2f}",
                     flush=True,
                 )
+
+    if mode in ("all", "frontier"):
+        print()
+        print("memory/compute frontier at grad-accumulation scale:",
+              flush=True)
+        run_frontier()
 
     if mode in ("all", "nm-sweep"):
         print()
