@@ -413,12 +413,19 @@ def forward_backward_pipelining_1f1b(
         g_in = tree.tree_map(
             lambda a, b: jnp.where(is_last, a, b), dy, g_recv
         )
-        # zeroed cotangent on bubble ticks => vjp (linear in g) yields
-        # exact zeros, so garbage residuals never reach the accumulators
         g_in = tree.tree_map(
             lambda g: jnp.where(b_valid, g, jnp.zeros_like(g)), g_in
         )
         dp, dx = vjp_b(g_in)
+        # A zero cotangent is NOT enough to null a bubble tick: a
+        # never-written (zero) ring slot can make the vjp divide by a
+        # stored statistic (0 * inf = NaN), so mask the OUTPUTS too.
+        dp = tree.tree_map(
+            lambda d: jnp.where(b_valid, d, jnp.zeros_like(d)), dp
+        )
+        dx = tree.tree_map(
+            lambda d: jnp.where(b_valid, d, jnp.zeros_like(d)), dx
+        )
         dp_acc = tree.tree_map(jnp.add, dp_acc, dp)
 
         # ---- edges: activations down, cotangents up -------------------

@@ -35,59 +35,124 @@ def _stage(pp, sp=False):
     return BertEncoderCore(cfg, num_layers=CFG["num_layers"] // pp)
 
 
-def test_1f1b_bert_stages_match_sequential(eight_devices):
-    """4 encoder stages through 1F1B (pp=4, tp=2 inside) == sequential."""
-    pp, tp = 4, 2
+def _bert_stage_batch():
     h = CFG["hidden_size"]
     rng = np.random.RandomState(0)
     xs = jnp.asarray(rng.randn(NM, S, MB, h), np.float32)  # (nm, S, B, H)
     ts = jnp.asarray(rng.randn(NM, S, MB, h), np.float32)
+    return xs, ts
 
-    with cpu_mesh(tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp) as mesh:
-        stage = _stage(pp)
 
-        def run(key, xs, ts):
-            pp_rank = ps.get_pipeline_model_parallel_rank()
-            stage_key = jax.random.fold_in(key, pp_rank)
-            params = stage.init(stage_key, xs[0])
+def _run_bert_stage_schedule(mesh, pp, schedule, xs, ts, **kw):
+    """(losses, grads-pytree) of one pipeline schedule over real BERT
+    encoder stages on the live (tp, pp) mesh — shared driver so every
+    schedule under test sees identical params/inputs/sharding."""
+    stage = _stage(pp)
 
-            def stage_fn(p, x):
-                return stage.apply(p, x)
+    def run(key, xs, ts):
+        pp_rank = ps.get_pipeline_model_parallel_rank()
+        stage_key = jax.random.fold_in(key, pp_rank)
+        params = stage.init(stage_key, xs[0])
 
-            def loss_fn(y, t):
-                return jnp.mean((y - t) ** 2)
+        def stage_fn(p, x):
+            return stage.apply(p, x)
 
-            losses, grads = forward_backward_pipelining_without_interleaving(
-                stage_fn, loss_fn, params, (xs, ts), num_microbatches=NM,
-            )
-            gsum = sum(
-                jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
-            )
-            return losses, jax.lax.psum(gsum, ps.TENSOR_PARALLEL_AXIS)
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
 
-        losses, _ = jax.jit(
-            jax.shard_map(
-                run, mesh=mesh, in_specs=(P(), P(), P()),
-                out_specs=(P(), P()), check_vma=False,
-            )
-        )(jax.random.PRNGKey(3), xs, ts)
+        losses, grads = schedule(
+            stage_fn, loss_fn, params, (xs, ts), num_microbatches=NM, **kw
+        )
+        # grads are per-(pp, tp)-rank shards: stack them under two
+        # leading axes so the caller can compare schedules leaf-by-leaf
+        return losses, jax.tree_util.tree_map(
+            lambda g: g[None, None], grads
+        )
 
-    # sequential reference: same 4 stages (same per-stage keys), tp=1
+    return jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=(
+                P(),
+                P(ps.PIPELINE_PARALLEL_AXIS, ps.TENSOR_PARALLEL_AXIS),
+            ),
+            check_vma=False,
+        )
+    )(jax.random.PRNGKey(3), xs, ts)
+
+
+def _sequential_bert_stage_losses(pp, xs, ts):
+    """Sequential composition of the same stages (same per-stage keys)."""
     ps.destroy_model_parallel()
-    seq_losses = []
     stage1 = _stage(pp)
     stage_params = [
         stage1.init(jax.random.fold_in(jax.random.PRNGKey(3), r), xs[0])
         for r in range(pp)
     ]
+    seq_losses = []
     for m in range(NM):
         hcur = xs[m]
         for p in stage_params:
             hcur = stage1.apply(p, hcur)
         seq_losses.append(float(jnp.mean((hcur - ts[m]) ** 2)))
+    return seq_losses
+
+
+def test_1f1b_bert_stages_match_sequential(eight_devices):
+    """4 encoder stages through 1F1B (pp=4, tp=2 inside) == sequential."""
+    pp, tp = 4, 2
+    xs, ts = _bert_stage_batch()
+    with cpu_mesh(tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp) as mesh:
+        losses, _ = _run_bert_stage_schedule(
+            mesh, pp, forward_backward_pipelining_without_interleaving,
+            xs, ts,
+        )
+    seq_losses = _sequential_bert_stage_losses(pp, xs, ts)
     np.testing.assert_allclose(
         np.asarray(losses), np.asarray(seq_losses), rtol=2e-4, atol=1e-5
     )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stash", ["residuals", "input"])
+def test_hand_1f1b_bert_stages_match_sequential(eight_devices, stash):
+    """The hand-scheduled 1F1B (explicit stash ring, reversed permutes)
+    through REAL BERT encoder stages with tp=2 inside pp=4: the per-tick
+    ``jax.vjp`` must compose with the stage's internal tp collectives
+    (psum/all-gather transposes) and the residual ring must stash
+    tp-sharded activation residuals.  Losses check against the
+    sequential composition; GRADS check leaf-exactly against the
+    lockstep schedule on identical params/inputs — the tp-composed
+    backward is exactly what tests/test_pipeline_parallel.py (tp=1)
+    does not cover."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+
+    pp, tp = 4, 2
+    xs, ts = _bert_stage_batch()
+    with cpu_mesh(tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp) as mesh:
+        losses, grads = _run_bert_stage_schedule(
+            mesh, pp, forward_backward_pipelining_1f1b, xs, ts, stash=stash
+        )
+        ref_losses, ref_grads = _run_bert_stage_schedule(
+            mesh, pp, forward_backward_pipelining_without_interleaving,
+            xs, ts, remat=False,
+        )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-6, atol=1e-7
+    )
+    seq_losses = _sequential_bert_stage_losses(pp, xs, ts)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(seq_losses), rtol=2e-4, atol=1e-5
+    )
+    flat, _ = jax.tree_util.tree_flatten(grads)
+    flat_ref, _ = jax.tree_util.tree_flatten(ref_grads)
+    assert flat and len(flat) == len(flat_ref)
+    for g, gr in zip(flat, flat_ref):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(gr), rtol=2e-4, atol=1e-5
+        )
 
 
 @pytest.mark.parametrize("provider", [bert_model_provider, gpt_model_provider])
