@@ -41,7 +41,8 @@ __all__ = ["ring_attention", "ulysses_attention"]
 _CP = ps.CONTEXT_PARALLEL_AXIS
 
 
-def _block_attend(q, k, v, scale, *, causal=False):
+def _block_attend(q, k, v, scale, *, causal=False, dropout_p=0.0,
+                  dropout_rng=None):
     """One (q-block × kv-block) flash block: returns (o (f32), lse).
 
     o is the block-normalized output, lse the row logsumexp — exactly the
@@ -56,7 +57,10 @@ def _block_attend(q, k, v, scale, *, causal=False):
     """
     from apex_tpu.ops.attention import flash_attention_with_lse
 
-    o, lse = flash_attention_with_lse(q, k, v, causal=causal, scale=scale)
+    o, lse = flash_attention_with_lse(
+        q, k, v, causal=causal, scale=scale, dropout_p=dropout_p,
+        dropout_rng=dropout_rng,
+    )
     return o.astype(jnp.float32), lse
 
 
@@ -67,6 +71,8 @@ def ring_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_rng=None,
     axis_name: str = _CP,
 ):
     """Blockwise ring attention over ``axis_name``.
@@ -82,9 +88,21 @@ def ring_attention(
     computes 1 block, rank cp-1 computes cp) — the wall-clock cost per hop
     is set by the busiest rank; a zigzag/striped layout would balance it
     and is left as a further optimization.
+
+    ``dropout_p`` > 0 (with ``dropout_rng``) applies attention dropout
+    that composes exactly with the ring merge: each (q-rank, kv-chunk)
+    block draws an independent mask (``dropout_rng`` folded with
+    ``rank·cp + src``), the block's PV contribution is masked +
+    rescaled while its lse stays the full undropped statistic, and the
+    merge weights blocks by true softmax mass — the result equals
+    full-sequence attention under the block-assembled mask.  Masks
+    regenerate deterministically in backward (the hop is
+    ``jax.checkpoint``-ed with the same folded rng).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if dropout_p > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_p > 0 requires dropout_rng")
     world = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
@@ -95,14 +113,22 @@ def ring_attention(
     def hop(qf, kv, src):
         """(o, lse) for this rank's q against the kv chunk from ``src``."""
         kb, vb = kv
+        drop = {}
+        if dropout_p > 0.0:
+            drop = dict(
+                dropout_p=dropout_p,
+                dropout_rng=jax.random.fold_in(
+                    dropout_rng, rank * world + src
+                ),
+            )
         if not causal:
-            return _block_attend(qf, kb, vb, scale)
+            return _block_attend(qf, kb, vb, scale, **drop)
 
         def self_block(_):
-            return _block_attend(qf, kb, vb, scale, causal=True)
+            return _block_attend(qf, kb, vb, scale, causal=True, **drop)
 
         def past_block(_):
-            return _block_attend(qf, kb, vb, scale)
+            return _block_attend(qf, kb, vb, scale, **drop)
 
         def future_block(_):
             # fully masked: zero mass — skip both einsums entirely

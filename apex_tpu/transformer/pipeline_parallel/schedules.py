@@ -389,10 +389,17 @@ def forward_backward_pipelining_1f1b(
         f_valid = (mf >= 0) & (mf < nm) & is_last
         losses = losses.at[mf_c].add(jnp.where(f_valid, loss, 0.0))
         wt = jnp.where(f_valid, 1.0 / nm, 0.0)
+        # dy may be non-finite on bubble ticks (loss vjp over the garbage
+        # chain) — safe, because every consumer SELECTS with where()
+        # (is_last/b_valid below).  dhead is ACCUMULATED, so it needs a
+        # select, not the wt multiply: NaN * 0 = NaN would poison dp_acc.
         dy = tree.tree_map(lambda g: g * wt, dy)
         if dhead is not None:
             dp_acc = tree.tree_map(
-                lambda a, d: a + d * wt, dp_acc, dhead
+                lambda a, d: a + jnp.where(
+                    f_valid, d * (1.0 / nm), jnp.zeros_like(d)
+                ),
+                dp_acc, dhead,
             )
 
         # ---- backward lane: stage s backwards mb t - 2(pp-1) + s ------

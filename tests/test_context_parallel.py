@@ -96,6 +96,109 @@ def test_ring_grads_match_full(eight_devices, causal):
     np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=5e-4, rtol=1e-3)
 
 
+def _ring_dropout_golden_keep(rng, cp, p, causal):
+    """The full-(S, S) keep mask ring dropout induces on the jnp dispatch
+    path: block (r, src) draws bernoulli from fold_in(rng, r*cp + src) —
+    exactly ring_attention's per-hop folding.  Future causal blocks carry
+    no probability mass, so their (undrawn) mask entries are irrelevant."""
+    s_local = S // cp
+    keep = np.ones((B, H, S, S), bool)
+    for r in range(cp):
+        for src in range(cp):
+            if causal and src > r:
+                continue
+            m = jax.random.bernoulli(
+                jax.random.fold_in(rng, r * cp + src), 1.0 - p,
+                (B, H, s_local, s_local),
+            )
+            keep[
+                :, :, r * s_local:(r + 1) * s_local,
+                src * s_local:(src + 1) * s_local,
+            ] = np.asarray(m)
+    return jnp.asarray(keep)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_dropout_matches_blockmask_golden(eight_devices, causal):
+    """Ring attention with fused dropout == full attention under the
+    block-assembled keep mask (values AND grads): the merge weights each
+    block by its TRUE softmax mass while the block's PV contribution is
+    masked + rescaled, so the composition is exact, not just in
+    expectation."""
+    from apex_tpu.ops.attention import _scores
+
+    cp, p = 4, 0.25
+    q, k, v = _qkv(jax.random.PRNGKey(5))
+    rng = jax.random.PRNGKey(77)
+    scale = 1.0 / (D ** 0.5)
+    keep = _ring_dropout_golden_keep(rng, cp, p, causal)
+
+    def ring_fn(q, k, v):
+        return ring_attention(
+            q, k, v, causal=causal, dropout_p=p, dropout_rng=rng
+        )
+
+    out = _run_cp(ring_fn, q, k, v, cp)
+
+    def golden(q, k, v):
+        s = _scores(q, k, None, causal, scale)
+        probs = jax.nn.softmax(s, axis=-1)
+        pd = jnp.where(keep, probs / (1.0 - p), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+
+    ref = golden(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    # determinism: identical rng → identical output; fresh rng → different
+    out2 = _run_cp(ring_fn, q, k, v, cp)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    out3 = _run_cp(
+        lambda q, k, v: ring_attention(
+            q, k, v, causal=causal, dropout_p=p,
+            dropout_rng=jax.random.PRNGKey(78),
+        ),
+        q, k, v, cp,
+    )
+    assert not np.array_equal(np.asarray(out), np.asarray(out3))
+
+    # grads through the dropped ring == grads of the masked golden
+    mesh = ps.initialize_model_parallel(context_parallel_size=cp)
+
+    def f(q, k, v):
+        gq, gk, gv = jax.grad(
+            lambda args: jax.lax.psum(
+                jnp.sum(ring_fn(*args) ** 2), "cp"
+            ) / cp
+        )((q, k, v))
+        return gq, gk, gv
+
+    gq, gk, gv = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, None, "cp"),) * 3,
+            out_specs=(P(None, None, "cp"),) * 3, check_vma=False,
+        )
+    )(q, k, v)
+    ps.destroy_model_parallel()
+    rq, rk, rv = jax.grad(
+        lambda args: jnp.sum(golden(*args) ** 2)
+    )((q, k, v))
+    for g, r in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), atol=5e-4, rtol=1e-3
+        )
+
+
+def test_ring_dropout_requires_rng(eight_devices):
+    q, k, v = _qkv(jax.random.PRNGKey(6))
+    with pytest.raises(ValueError, match="dropout_rng"):
+        _run_cp(
+            lambda q, k, v: ring_attention(q, k, v, dropout_p=0.3),
+            q, k, v, 2,
+        )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("cp", [2, 4])
 def test_ulysses_matches_full(eight_devices, causal, cp):

@@ -81,6 +81,25 @@ def _run_bert_stage_schedule(mesh, pp, schedule, xs, ts, **kw):
     )(jax.random.PRNGKey(3), xs, ts)
 
 
+_LOCKSTEP_REF_CACHE = {}
+
+
+def _lockstep_bert_stage_ref(mesh, pp, xs, ts):
+    """Module-cached lockstep-schedule reference run: identical for every
+    `stash` parametrization, and the pp=4 x tp=2 BERT compile is the
+    expensive part of the test."""
+    if pp not in _LOCKSTEP_REF_CACHE:
+        losses, grads = _run_bert_stage_schedule(
+            mesh, pp, forward_backward_pipelining_without_interleaving,
+            xs, ts, remat=False,
+        )
+        _LOCKSTEP_REF_CACHE[pp] = (
+            np.asarray(losses),
+            [np.asarray(l) for l in jax.tree_util.tree_leaves(grads)],
+        )
+    return _LOCKSTEP_REF_CACHE[pp]
+
+
 def _sequential_bert_stage_losses(pp, xs, ts):
     """Sequential composition of the same stages (same per-stage keys)."""
     ps.destroy_model_parallel()
@@ -135,23 +154,21 @@ def test_hand_1f1b_bert_stages_match_sequential(eight_devices, stash):
         losses, grads = _run_bert_stage_schedule(
             mesh, pp, forward_backward_pipelining_1f1b, xs, ts, stash=stash
         )
-        ref_losses, ref_grads = _run_bert_stage_schedule(
-            mesh, pp, forward_backward_pipelining_without_interleaving,
-            xs, ts, remat=False,
+        ref_losses, ref_grad_leaves = _lockstep_bert_stage_ref(
+            mesh, pp, xs, ts
         )
     np.testing.assert_allclose(
-        np.asarray(losses), np.asarray(ref_losses), rtol=1e-6, atol=1e-7
+        np.asarray(losses), ref_losses, rtol=1e-6, atol=1e-7
     )
     seq_losses = _sequential_bert_stage_losses(pp, xs, ts)
     np.testing.assert_allclose(
         np.asarray(losses), np.asarray(seq_losses), rtol=2e-4, atol=1e-5
     )
-    flat, _ = jax.tree_util.tree_flatten(grads)
-    flat_ref, _ = jax.tree_util.tree_flatten(ref_grads)
-    assert flat and len(flat) == len(flat_ref)
-    for g, gr in zip(flat, flat_ref):
+    flat = jax.tree_util.tree_leaves(grads)
+    assert flat and len(flat) == len(ref_grad_leaves)
+    for g, gr in zip(flat, ref_grad_leaves):
         np.testing.assert_allclose(
-            np.asarray(g), np.asarray(gr), rtol=2e-4, atol=1e-5
+            np.asarray(g), gr, rtol=2e-4, atol=1e-5
         )
 
 
