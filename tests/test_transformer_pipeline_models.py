@@ -88,16 +88,17 @@ def _lockstep_bert_stage_ref(mesh, pp, xs, ts):
     """Module-cached lockstep-schedule reference run: identical for every
     `stash` parametrization, and the pp=4 x tp=2 BERT compile is the
     expensive part of the test."""
-    if pp not in _LOCKSTEP_REF_CACHE:
+    key = (pp, np.asarray(xs).tobytes(), np.asarray(ts).tobytes())
+    if key not in _LOCKSTEP_REF_CACHE:
         losses, grads = _run_bert_stage_schedule(
             mesh, pp, forward_backward_pipelining_without_interleaving,
             xs, ts, remat=False,
         )
-        _LOCKSTEP_REF_CACHE[pp] = (
+        _LOCKSTEP_REF_CACHE[key] = (
             np.asarray(losses),
             [np.asarray(l) for l in jax.tree_util.tree_leaves(grads)],
         )
-    return _LOCKSTEP_REF_CACHE[pp]
+    return _LOCKSTEP_REF_CACHE[key]
 
 
 def _sequential_bert_stage_losses(pp, xs, ts):

@@ -284,6 +284,145 @@ def test_scaled_softmax_compiled_matches_jnp():
     _assert_close(gv, wv, jnp.bfloat16)
 
 
+def _kernel_keep_mask_full(seed, b, h, sq, sk, p):
+    """Full (B,H,Sq,Sk) keep mask of the kernel's counter-based PRNG —
+    `_dropout_keep_block` is a pure function of (seed, bh, absolute
+    coords), so tile (0,0) at full size reproduces every kernel tile
+    (identical on Mosaic and the host: pure uint32 arithmetic)."""
+    from apex_tpu.ops.pallas.flash_attention import _dropout_keep_block
+
+    return jnp.stack([
+        _dropout_keep_block(seed, jnp.asarray(bh, jnp.int32), 0, 0, sq, sk, p)
+        for bh in range(b * h)
+    ]).reshape(b, h, sq, sk)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_on_chip(causal):
+    """Compiled fused dropout vs the keep-mask golden: the Mosaic kernel
+    must regenerate the identical mask the host-side hash predicts
+    (values AND grads), and be deterministic across calls.  The jnp
+    dispatch path draws a DIFFERENT stream by documented contract, so
+    kernel-vs-jnp comparison is only valid through the shared mask."""
+    from apex_tpu.ops.attention import _derive_dropout_seed, _scores
+
+    b, h, s, d, p = 1, 2, 256, 64, 0.2
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+    rng = jax.random.PRNGKey(12)
+    scale = 1.0 / (d ** 0.5)
+    keep = _kernel_keep_mask_full(
+        _derive_dropout_seed(rng, p)[0], b, h, s, s, p
+    )
+
+    def kernel_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, dropout_p=p, dropout_rng=rng
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    def golden_loss(q, k, v):
+        s_ = _scores(q, k, None, causal, scale)
+        probs = jax.nn.softmax(s_, axis=-1)
+        pd = jnp.where(keep, probs / (1.0 - p), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    with jax.default_matmul_precision("highest"):
+        _dispatch.set_use_pallas(True)
+        try:
+            (l_k, o_k), g_k = jax.jit(jax.value_and_grad(
+                kernel_loss, argnums=(0, 1, 2), has_aux=True
+            ))(q, k, v)
+            (_, o_k2), _ = jax.jit(jax.value_and_grad(
+                kernel_loss, argnums=(0, 1, 2), has_aux=True
+            ))(q, k, v)
+        finally:
+            _dispatch.set_use_pallas(None)
+        (l_g, o_g), g_g = jax.jit(jax.value_and_grad(
+            golden_loss, argnums=(0, 1, 2), has_aux=True
+        ))(q, k, v)
+
+    np.testing.assert_array_equal(np.asarray(o_k), np.asarray(o_k2))
+    np.testing.assert_allclose(
+        np.asarray(o_k), np.asarray(o_g), atol=2e-5, rtol=2e-5
+    )
+    for a, b_ in zip(g_k, g_g):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_with_lse_dropout_on_chip():
+    """Compiled with-lse dropout: lse stays the undropped statistic and
+    the dlse cotangent bypasses the keep mask (the ring-attention
+    building block) — vs the keep-mask golden."""
+    from apex_tpu.ops.attention import (
+        _derive_dropout_seed,
+        _scores,
+        flash_attention_with_lse,
+    )
+
+    b, h, s, d, p = 1, 2, 256, 64, 0.25
+    kq, kk, kv, kc = jax.random.split(jax.random.PRNGKey(21), 4)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+    dlse_w = jax.random.normal(kc, (b, h, s), jnp.float32)
+    rng = jax.random.PRNGKey(22)
+    scale = 1.0 / (d ** 0.5)
+    keep = _kernel_keep_mask_full(
+        _derive_dropout_seed(rng, p)[0], b, h, s, s, p
+    )
+
+    def kernel_loss(q, k, v):
+        o, lse = flash_attention_with_lse(
+            q, k, v, dropout_p=p, dropout_rng=rng
+        )
+        return (
+            jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse * dlse_w),
+            (o, lse),
+        )
+
+    def golden_loss(q, k, v):
+        s_ = _scores(q, k, None, False, scale)
+        m = jnp.max(s_, axis=-1, keepdims=True)
+        pe = jnp.exp(s_ - m)
+        l = jnp.sum(pe, axis=-1, keepdims=True)
+        pd = jnp.where(keep, (pe / l) / (1.0 - p), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pd.astype(q.dtype), v)
+        lse = (m + jnp.log(l))[..., 0]
+        return (
+            jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse * dlse_w),
+            (o, lse),
+        )
+
+    with jax.default_matmul_precision("highest"):
+        _dispatch.set_use_pallas(True)
+        try:
+            (_, (o_k, lse_k)), g_k = jax.jit(jax.value_and_grad(
+                kernel_loss, argnums=(0, 1, 2), has_aux=True
+            ))(q, k, v)
+        finally:
+            _dispatch.set_use_pallas(None)
+        (_, (o_g, lse_g)), g_g = jax.jit(jax.value_and_grad(
+            golden_loss, argnums=(0, 1, 2), has_aux=True
+        ))(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(o_k), np.asarray(o_g), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse_k), np.asarray(lse_g), atol=1e-5, rtol=1e-5
+    )
+    for a, b_ in zip(g_k, g_g):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+        )
+
+
 def test_sums_remat_policy_on_chip():
     """remat_policy='sums' (named saves freeing matmul epilogues, r3) must
     compile under Mosaic/XLA-TPU and reproduce the 'dots' loss and grads
