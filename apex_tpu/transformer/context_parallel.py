@@ -22,8 +22,11 @@ ring through the scanned ``ppermute`` (per-hop recompute via
 memory stays O(S_local) per hop rather than O(S²)).
 
 Layouts match the attention stack: q, k, v are ``(B, H, S_local, D)``
-shards, sequence split contiguously across the axis (rank r holds rows
-``[r·S_local, (r+1)·S_local)``), causal masking honors global positions.
+shards.  With the default ``layout="contiguous"`` rank r holds rows
+``[r·S_local, (r+1)·S_local)``; with ``layout="zigzag"`` (causal
+load balancing) rank r holds global chunks ``r`` and ``2cp−1−r`` — use
+:func:`zigzag_split` / :func:`zigzag_merge` to convert.  Causal masking
+honors global positions in both layouts.
 """
 
 from __future__ import annotations
@@ -36,7 +39,12 @@ import jax.numpy as jnp
 
 from apex_tpu import parallel_state as ps
 
-__all__ = ["ring_attention", "ulysses_attention"]
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "zigzag_split",
+    "zigzag_merge",
+]
 
 _CP = ps.CONTEXT_PARALLEL_AXIS
 
@@ -64,6 +72,34 @@ def _block_attend(q, k, v, scale, *, causal=False, dropout_p=0.0,
     return o.astype(jnp.float32), lse
 
 
+def _merge_block(carry, block):
+    """Fold one (o, lse) block into the running online-softmax state
+    ``(acc, m, l)``.  Block o is block-normalized (mass 1·β); a skipped
+    block's ``lse = -inf`` folds to exactly zero weight against any
+    finite running max.  THE merge for every ring layout — the max-shift
+    / rescale / renormalize here is the numerically subtle core, so it
+    exists exactly once."""
+    acc, m, l = carry
+    o_b, lse_b = block
+    m_new = jnp.maximum(m, lse_b)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(lse_b - m_new)
+    l_new = l * alpha + beta
+    acc_new = (
+        acc * (l * alpha)[..., None] + o_b * beta[..., None]
+    ) / l_new[..., None]
+    return acc_new, m_new, l_new
+
+
+def _skipped_block(b, h, rows, d):
+    """(o, lse) of a fully-masked (causal-future) block: zero mass —
+    both einsums skipped entirely."""
+    return (
+        jnp.zeros((b, h, rows, d), jnp.float32),
+        jnp.full((b, h, rows), -jnp.inf, jnp.float32),
+    )
+
+
 def ring_attention(
     q,
     k,
@@ -73,21 +109,26 @@ def ring_attention(
     scale: Optional[float] = None,
     dropout_p: float = 0.0,
     dropout_rng=None,
+    layout: str = "contiguous",
     axis_name: str = _CP,
 ):
     """Blockwise ring attention over ``axis_name``.
 
-    q, k, v: ``(B, H, S_local, D)`` — this rank's contiguous sequence
-    chunk.  Returns ``(B, H, S_local, D)`` in q's dtype, equal (within
-    numerics) to full attention over the gathered sequence.
+    q, k, v: ``(B, H, S_local, D)`` — this rank's sequence chunk.
+    Returns ``(B, H, S_local, D)`` in q's dtype, equal (within numerics)
+    to full attention over the gathered sequence.
 
     Causal mode skips the block compute entirely for hops whose kv chunk
     lies in this rank's causal future (``lax.switch`` on the chunk order);
     the permute still runs every hop, so the ring stays in lockstep.  Note
     contiguous chunking makes causal work *imbalanced* across ranks (rank 0
     computes 1 block, rank cp-1 computes cp) — the wall-clock cost per hop
-    is set by the busiest rank; a zigzag/striped layout would balance it
-    and is left as a further optimization.
+    is set by the busiest rank.  ``layout="zigzag"`` fixes that: each
+    rank holds global chunks ``r`` and ``2cp−1−r`` (use
+    :func:`zigzag_split` / :func:`zigzag_merge` for the layout), pairing
+    a cheap early chunk with an expensive late one so every rank computes
+    ~2 half-blocks per hop — halving causal ring wall on real hardware
+    (Megatron-LM's cp layout).  Zigzag requires ``causal=True``.
 
     ``dropout_p`` > 0 (with ``dropout_rng``) applies attention dropout
     that composes exactly with the ring merge: each (q-rank, kv-chunk)
@@ -103,6 +144,18 @@ def ring_attention(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if dropout_p > 0.0 and dropout_rng is None:
         raise ValueError("dropout_p > 0 requires dropout_rng")
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "layout='zigzag' exists to balance CAUSAL ring work; "
+                "non-causal rings are already balanced — use the "
+                "contiguous layout"
+            )
+        return _ring_attention_zigzag(
+            q, k, v, scale, dropout_p, dropout_rng, axis_name
+        )
+    if layout != "contiguous":
+        raise ValueError(f"unknown ring layout {layout!r}")
     world = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
@@ -131,26 +184,10 @@ def ring_attention(
             return _block_attend(qf, kb, vb, scale, **drop)
 
         def future_block(_):
-            # fully masked: zero mass — skip both einsums entirely
-            return (
-                jnp.zeros((b, h, s_local, d), jnp.float32),
-                jnp.full((b, h, s_local), -jnp.inf, jnp.float32),
-            )
+            return _skipped_block(b, h, s_local, d)
 
         branch = jnp.where(src == rank, 0, jnp.where(src < rank, 1, 2))
         return jax.lax.switch(branch, [self_block, past_block, future_block], None)
-
-    def merge(carry, block):
-        acc, m, l = carry
-        o_b, lse_b = block
-        m_new = jnp.maximum(m, lse_b)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(lse_b - m_new)  # block o_b is normalized: mass 1·β
-        l_new = l * alpha + beta
-        acc_new = (acc * (l * alpha)[..., None] + o_b * beta[..., None]) / l_new[
-            ..., None
-        ]
-        return acc_new, m_new, l_new
 
     # hop 0 is always the self block — no permute needed before it, and it
     # seeds the running max with a finite lse (so -inf skipped hops merge
@@ -165,7 +202,7 @@ def ring_attention(
             lambda x: jax.lax.ppermute(x, axis_name, perm), kv
         )
         src = (rank - step) % world
-        carry = merge(carry, hop(qf, kv, src))
+        carry = _merge_block(carry, hop(qf, kv, src))
         return (kv, carry), None
 
     if world > 1:
@@ -174,6 +211,127 @@ def ring_attention(
         )
     acc, _, _ = carry
     return acc.astype(q.dtype)
+
+
+def zigzag_split(x, cp: int, axis: int = 2):
+    """Global → zigzag layout: split ``axis`` into ``2·cp`` chunks and
+    stack per-rank locals ``(cp, ..., S/cp, ...)`` where rank ``r`` holds
+    the concatenation of chunks ``r`` and ``2cp−1−r``.  This pairs an
+    early (cheap) causal chunk with a late (expensive) one, balancing
+    causal ring work across ranks (Megatron-LM's cp layout)."""
+    chunks = jnp.split(x, 2 * cp, axis=axis)
+    return jnp.stack(
+        [
+            jnp.concatenate([chunks[r], chunks[2 * cp - 1 - r]], axis=axis)
+            for r in range(cp)
+        ]
+    )
+
+
+def zigzag_merge(locals_, cp: int, axis: int = 2):
+    """Inverse of :func:`zigzag_split`: ``(cp, ..., S/cp, ...)`` stacked
+    per-rank zigzag locals → the global-order array."""
+    out = [None] * (2 * cp)
+    for r in range(cp):
+        lo, hi = jnp.split(locals_[r], 2, axis=axis)
+        out[r] = lo
+        out[2 * cp - 1 - r] = hi
+    return jnp.concatenate(out, axis=axis)
+
+
+def _ring_attention_zigzag(q, k, v, scale, dropout_p, dropout_rng,
+                           axis_name):
+    """Causal ring attention over the zigzag layout: this rank's
+    ``S_local`` rows are [global chunk ``r``; global chunk ``2cp−1−r``].
+
+    Work per hop is balanced by construction: the lo half attends only lo
+    kv halves (one half-block, skipped for future sources), the hi half
+    attends every lo half (always) plus non-future hi halves — every rank
+    computes ~2 half-blocks per hop instead of the contiguous layout's
+    worst-rank full block, halving causal ring wall on real hardware.
+    """
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    if s_local % 2:
+        raise ValueError("zigzag layout needs an even local sequence")
+    half = s_local // 2
+    qf = q.astype(jnp.float32)
+    q_lo, q_hi = qf[:, :, :half], qf[:, :, half:]
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    skip = _skipped_block(b, h, half, d)
+
+    def _drop(src, pair):
+        if dropout_p == 0.0:
+            return {}
+        return dict(
+            dropout_p=dropout_p,
+            dropout_rng=jax.random.fold_in(
+                dropout_rng, (rank * world + src) * 4 + pair
+            ),
+        )
+
+    @jax.checkpoint
+    def hop(q_lo, q_hi, kv, src):
+        k_lo, v_lo, k_hi, v_hi = kv
+        # lo (global chunk rank) vs lo' (global chunk src)
+        lo = jax.lax.switch(
+            jnp.where(src == rank, 0, jnp.where(src < rank, 1, 2)),
+            [
+                lambda _: _block_attend(
+                    q_lo, k_lo, v_lo, scale, causal=True, **_drop(src, 0)
+                ),
+                lambda _: _block_attend(
+                    q_lo, k_lo, v_lo, scale, **_drop(src, 0)
+                ),
+                lambda _: skip,
+            ],
+            None,
+        )
+        # hi (chunk 2cp−1−rank) vs lo' (chunk src < cp): always past
+        hi_lo = _block_attend(q_hi, k_lo, v_lo, scale, **_drop(src, 1))
+        # hi vs hi' (chunk 2cp−1−src): past iff src > rank
+        hi_hi = jax.lax.switch(
+            jnp.where(src == rank, 0, jnp.where(src > rank, 1, 2)),
+            [
+                lambda _: _block_attend(
+                    q_hi, k_hi, v_hi, scale, causal=True, **_drop(src, 2)
+                ),
+                lambda _: _block_attend(
+                    q_hi, k_hi, v_hi, scale, **_drop(src, 2)
+                ),
+                lambda _: skip,
+            ],
+            None,
+        )
+        return lo, hi_lo, hi_hi
+
+    kv0 = (
+        k[:, :, :half], v[:, :, :half],
+        k[:, :, half:], v[:, :, half:],
+    )
+    lo0, hi_lo0, hi_hi0 = hop(q_lo, q_hi, kv0, rank)
+    ones = jnp.ones((b, h, half), jnp.float32)
+    c_lo = (lo0[0], lo0[1], ones)
+    c_hi = _merge_block((hi_lo0[0], hi_lo0[1], ones), hi_hi0)
+
+    def body(state, step):
+        kv, c_lo, c_hi = state
+        kv = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), kv
+        )
+        src = (rank - step) % world
+        lo, hi_lo, hi_hi = hop(q_lo, q_hi, kv, src)
+        c_lo = _merge_block(c_lo, lo)
+        c_hi = _merge_block(_merge_block(c_hi, hi_lo), hi_hi)
+        return (kv, c_lo, c_hi), None
+
+    if world > 1:
+        (_, c_lo, c_hi), _ = jax.lax.scan(
+            body, (kv0, c_lo, c_hi), jnp.arange(1, world)
+        )
+    return jnp.concatenate([c_lo[0], c_hi[0]], axis=2).astype(q.dtype)
 
 
 def ulysses_attention(
