@@ -46,6 +46,24 @@ def _cp_world(cfg) -> int:
     return 1
 
 
+def _cp_shard_rows(table, cfg, s_local):
+    """This cp rank's ``s_local`` rows of a GLOBAL per-position table
+    (RoPE cos/sin, learned position embeddings).  Contiguous layout:
+    rows [rank·s_local, ...).  Zigzag ("ring_zigzag"): the concatenation
+    of global chunks ``rank`` and ``2cp−1−rank`` (chunk = s_local/2
+    rows), matching :func:`context_parallel.zigzag_split`."""
+    rank = jax.lax.axis_index(_CP)
+    if cfg.context_parallel == "ring_zigzag":
+        cp = jax.lax.axis_size(_CP)
+        sc = s_local // 2
+        lo = jax.lax.dynamic_slice_in_dim(table, rank * sc, sc, 0)
+        hi = jax.lax.dynamic_slice_in_dim(
+            table, (2 * cp - 1 - rank) * sc, sc, 0
+        )
+        return jnp.concatenate([lo, hi], axis=0)
+    return jax.lax.dynamic_slice_in_dim(table, rank * s_local, s_local, 0)
+
+
 def _rope_cos_sin(seq_len: int, dim: int, base: float = 10000.0):
     """Cached cos/sin tables (S, D) in the rotate_half (GPT-NeoX) layout
     the fused RoPE kernel expects."""
@@ -69,9 +87,12 @@ class GptConfig:
     sequence_parallel: bool = False
     # Context parallelism (long-context attention over the cp mesh axis,
     # apex_tpu.transformer.context_parallel): None, "ring" (ppermute'd KV
-    # blocks, O(S_local) memory) or "ulysses" (head<->sequence
+    # blocks, O(S_local) memory), "ring_zigzag" (same ring with the
+    # causal-load-balanced zigzag layout: this rank's S/cp rows are
+    # global chunks [rank; 2cp-1-rank] — shard inputs with
+    # context_parallel.zigzag_split) or "ulysses" (head<->sequence
     # all-to-all).  The model's sequence inputs are then the cp rank's
-    # contiguous S/cp shard; RoPE/positions index GLOBAL positions.
+    # S/cp shard; RoPE/positions index GLOBAL positions in either layout.
     # Mutually exclusive with sequence_parallel (the sequence dim is
     # already sharded).  Gradients: treat cp like a data axis — pmean
     # over cp alongside dp (every param's grad covers only local tokens'
@@ -89,10 +110,11 @@ class GptConfig:
     moe_aux_coef: float = 0.01
 
     def __post_init__(self):
-        if self.context_parallel not in (None, "ring", "ulysses"):
+        if self.context_parallel not in (None, "ring", "ring_zigzag",
+                                         "ulysses"):
             raise ValueError(
-                f"context_parallel must be None, 'ring' or 'ulysses', got "
-                f"{self.context_parallel!r}"
+                f"context_parallel must be None, 'ring', 'ring_zigzag' "
+                f"or 'ulysses', got {self.context_parallel!r}"
             )
         if self.context_parallel and self.sequence_parallel:
             raise ValueError(
@@ -133,12 +155,13 @@ class GptBlock(nn.Module):
         cp = _cp_world(cfg)
         if cfg.rotary:
             # under cp, s is the LOCAL shard: RoPE must use the global
-            # positions [rank*s, (rank+1)*s)
+            # positions of this rank's shard (contiguous [rank·s, ...),
+            # or the two zigzag chunks)
             cos, sin = _rope_cos_sin(s * cp, head_dim)
             if cp > 1:
-                off = jax.lax.axis_index(_CP) * s
-                cos = jax.lax.dynamic_slice_in_dim(cos, off, s, 0)
-                sin = jax.lax.dynamic_slice_in_dim(sin, off, s, 0)
+                cos, sin = (
+                    _cp_shard_rows(t, cfg, s) for t in (cos, sin)
+                )
             q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
             k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
         if cp > 1:
@@ -147,12 +170,19 @@ class GptBlock(nn.Module):
                 ulysses_attention,
             )
 
-            cp_attend = (
-                ring_attention
-                if cfg.context_parallel == "ring"
-                else ulysses_attention
-            )
-            ctx = cp_attend(q, k, v, causal=True, scale=head_dim**-0.5)
+            if cfg.context_parallel == "ulysses":
+                ctx = ulysses_attention(
+                    q, k, v, causal=True, scale=head_dim**-0.5
+                )
+            else:
+                ctx = ring_attention(
+                    q, k, v, causal=True, scale=head_dim**-0.5,
+                    layout=(
+                        "zigzag"
+                        if cfg.context_parallel == "ring_zigzag"
+                        else "contiguous"
+                    ),
+                )
         else:
             ctx = flash_attention(q, k, v, causal=True, scale=head_dim**-0.5)
         ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, heads_local * head_dim)
@@ -237,6 +267,7 @@ class GptModel(nn.Module):
                 (cfg.max_seq_len, cfg.hidden_size),
             )
             start = 0
+            rows = None
             if cfg.sequence_parallel and _tp_world(_TP) > 1:
                 # x is the SP seq shard [rank·S/tp, (rank+1)·S/tp): slice
                 # the matching positions, and mark the table tp-partial.
@@ -253,19 +284,23 @@ class GptModel(nn.Module):
                     self.path + ("position_embeddings",)
                 )
             elif _cp_world(cfg) > 1:
-                # cp shard: global positions [rank·S_local, ...); grads
-                # need no marking — cp is synced like a data axis (pmean).
-                # The global length must fit the table: dynamic_slice
-                # CLAMPS out-of-range starts, which would silently reuse
-                # the last rows on high ranks instead of failing.
+                # cp shard: global positions of this rank's shard
+                # (contiguous or zigzag); grads need no marking — cp is
+                # synced like a data axis (pmean).  The global length
+                # must fit the table: dynamic_slice CLAMPS out-of-range
+                # starts, which would silently reuse the last rows on
+                # high ranks instead of failing.
                 cp = _cp_world(cfg)
                 if cp * x.shape[0] > cfg.max_seq_len:
                     raise ValueError(
                         f"global sequence cp*S_local = {cp}*{x.shape[0]} "
                         f"exceeds max_seq_len ({cfg.max_seq_len})"
                     )
-                start = jax.lax.axis_index(_CP) * x.shape[0]
-            rows = jax.lax.dynamic_slice_in_dim(pos, start, x.shape[0], 0)
+                rows = _cp_shard_rows(pos, cfg, x.shape[0])
+            if rows is None:
+                rows = jax.lax.dynamic_slice_in_dim(
+                    pos, start, x.shape[0], 0
+                )
             x = x + rows[:, None, :].astype(cfg.dtype)
         step = _GptStep
         if cfg.remat:
@@ -368,16 +403,18 @@ def gpt_lm_loss_cp(
 ):
     """Next-token CE for a context-parallel-sharded sequence.
 
-    ``input_ids_local``: ``(S_local, B)`` — this cp rank's CONTIGUOUS
-    shard of the global sequence (rank r holds rows [r·S_local, ...)).
-    The next-token shift crosses shard boundaries: each rank's last
-    position predicts the NEXT rank's first token (fetched with one
-    ``ppermute``); the global last position has no target and is masked
-    on the last rank.  Returns the global-token-mean loss, replicated
-    over cp (summed with psum, so it equals the unsharded
-    :func:`gpt_lm_loss` value).  Gradient sync: treat cp like a data
-    axis — ``pmean`` gradients over cp (alongside dp) before the
-    optimizer step.
+    ``input_ids_local``: ``(S_local, B)`` — this cp rank's shard of the
+    global sequence in the model's configured layout: contiguous (rank r
+    holds rows [r·S_local, ...)) for ``context_parallel="ring"`` /
+    ``"ulysses"``, or the zigzag pair (global chunks ``r`` and
+    ``2cp−1−r``, see ``context_parallel.zigzag_split``) for
+    ``"ring_zigzag"``.  The next-token shift crosses shard boundaries
+    with ``ppermute`` fetches; the global last position has no target
+    and is masked (on the last rank for contiguous, rank 0's hi half for
+    zigzag).  Returns the global-token-mean loss, replicated over cp
+    (summed with psum, so it equals the unsharded :func:`gpt_lm_loss`
+    value).  Gradient sync: treat cp like a data axis — ``pmean``
+    gradients over cp (alongside dp) before the optimizer step.
     """
     # aux values are cp-replicated (SwitchMoe pmeans its stats over cp)
     h, aux_total = _apply_with_moe_aux(
@@ -387,21 +424,53 @@ def gpt_lm_loss_cp(
     logits = _tied_vocab_logits(params, model, h, sp_gathered=False)
     world = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
-    # target for local position i is local token i+1; for the last local
-    # position it is the next rank's FIRST token (one ring hop backwards)
-    first_next = jax.lax.ppermute(
-        input_ids_local[:1],
-        axis_name,
-        [((i + 1) % world, i) for i in range(world)],
+    valid = jnp.ones(
+        (input_ids_local.shape[0], input_ids_local.shape[1]), jnp.float32
     )
-    targets = jnp.concatenate([input_ids_local[1:], first_next], axis=0)
+    if model.cfg.context_parallel == "ring_zigzag":
+        # local rows = [chunk rank; chunk 2cp−1−rank].  Boundary targets:
+        # chunk r's last row predicts chunk r+1's first token — that is
+        # rank r+1's lo-first, EXCEPT chunk cp−1 whose successor (chunk
+        # cp) is this same rank's OWN hi-first.  Chunk 2cp−1−r's last row
+        # predicts chunk 2cp−r's first token = rank r−1's hi-first; for
+        # rank 0 the hi chunk is the global end (masked).
+        sc = input_ids_local.shape[0] // 2
+        lo, hi = input_ids_local[:sc], input_ids_local[sc:]
+        lo_first_next = jax.lax.ppermute(
+            lo[:1], axis_name,
+            [((i + 1) % world, i) for i in range(world)],
+        )
+        lo_boundary = jnp.where(
+            jnp.equal(rank, world - 1), hi[:1], lo_first_next
+        )
+        hi_boundary = jax.lax.ppermute(
+            hi[:1], axis_name,
+            [(i, (i + 1) % world) for i in range(world)],
+        )
+        targets = jnp.concatenate(
+            [lo[1:], lo_boundary, hi[1:], hi_boundary], axis=0
+        )
+        # global final position = chunk 2cp−1's last row = rank 0's last
+        rank0 = jnp.equal(rank, 0).astype(valid.dtype)
+        valid = valid.at[-1].set(1.0 - rank0)
+    else:
+        # target for local position i is local token i+1; for the last
+        # local position it is the next rank's FIRST token (one ring hop
+        # backwards)
+        first_next = jax.lax.ppermute(
+            input_ids_local[:1],
+            axis_name,
+            [((i + 1) % world, i) for i in range(world)],
+        )
+        targets = jnp.concatenate(
+            [input_ids_local[1:], first_next], axis=0
+        )
+        # the global final position (last rank's last row): no successor
+        last_rank = jnp.equal(rank, world - 1).astype(valid.dtype)
+        valid = valid.at[-1].set(1.0 - last_rank)
     losses = vocab_parallel_cross_entropy(
         logits.astype(jnp.float32), targets
     )  # (S_local, B)
-    valid = jnp.ones_like(losses)
-    # the global final position (last rank's last row) has no successor
-    last_rank = jnp.equal(rank, world - 1).astype(losses.dtype)
-    valid = valid.at[-1].set(1.0 - last_rank)
     local_sum = jnp.sum(losses * valid)
     local_count = jnp.sum(valid)
     ce = jax.lax.psum(local_sum, axis_name) / jax.lax.psum(

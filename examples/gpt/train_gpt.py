@@ -63,7 +63,9 @@ def parse_args():
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sequence-parallel", action="store_true")
     p.add_argument(
-        "--context-parallel", choices=["ring", "ulysses"], default=None
+        "--context-parallel",
+        choices=["ring", "ring_zigzag", "ulysses"],
+        default=None,
     )
     p.add_argument("--cp", type=int, default=2, help="cp degree when used")
     p.add_argument("--num-experts", type=int, default=0)
@@ -152,9 +154,22 @@ def main():
             if cp > 1:
                 rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
                 s_local = ids.shape[0] // cp
-                ids = jax.lax.dynamic_slice_in_dim(
-                    ids, rank * s_local, s_local, 0
-                )
+                if args.context_parallel == "ring_zigzag":
+                    # zigzag layout: this rank holds global chunks rank
+                    # and 2cp−1−rank (see context_parallel.zigzag_split)
+                    sc = s_local // 2
+                    ids = jnp.concatenate([
+                        jax.lax.dynamic_slice_in_dim(
+                            ids, rank * sc, sc, 0
+                        ),
+                        jax.lax.dynamic_slice_in_dim(
+                            ids, (2 * cp - 1 - rank) * sc, sc, 0
+                        ),
+                    ], axis=0)
+                else:
+                    ids = jax.lax.dynamic_slice_in_dim(
+                        ids, rank * s_local, s_local, 0
+                    )
             loss, grads = jax.value_and_grad(loss_fn)(params, ids)
             if args.num_experts:
                 grads = sync_moe_gradients(

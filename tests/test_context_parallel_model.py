@@ -34,12 +34,24 @@ def _ids():
 
 def _run_cp(cfg, ids, tp=1):
     """loss + synced grads of the cp-sharded model (ids replicated in,
-    sliced per cp rank inside)."""
+    sliced per cp rank inside, honoring the configured layout)."""
     m = GptModel(cfg)
 
     def f(key, ids):
         rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
-        local = jax.lax.dynamic_slice_in_dim(ids, rank * (S // CP), S // CP, 0)
+        if cfg.context_parallel == "ring_zigzag":
+            # this rank's zigzag pair: global chunks rank and 2cp−1−rank
+            sc = S // (2 * CP)
+            local = jnp.concatenate([
+                jax.lax.dynamic_slice_in_dim(ids, rank * sc, sc, 0),
+                jax.lax.dynamic_slice_in_dim(
+                    ids, (2 * CP - 1 - rank) * sc, sc, 0
+                ),
+            ], axis=0)
+        else:
+            local = jax.lax.dynamic_slice_in_dim(
+                ids, rank * (S // CP), S // CP, 0
+            )
         params = m.init(key, local)
         loss, grads = jax.value_and_grad(
             lambda p: gpt_lm_loss_cp(p, m, local)
@@ -89,7 +101,7 @@ def _run_ref(ids, **kw):
     return float(loss), out
 
 
-@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("mode", ["ring", "ring_zigzag", "ulysses"])
 @pytest.mark.parametrize("rotary", [True, False])
 def test_cp_gpt_matches_unsharded(mode, rotary, eight_devices):
     ids = _ids()

@@ -141,6 +141,20 @@ def test_gpt_train_cp_ring_smoke():
     assert "cp=2(ring)" in out, out[-500:]
 
 
+def test_gpt_train_cp_zigzag_smoke():
+    """The causal-load-balanced zigzag layout end-to-end in the example
+    (layout-aware input sharding + zigzag RoPE + zigzag loss shift)."""
+    out = _run_example(
+        "examples/gpt/train_gpt.py",
+        [
+            "--tiny", "--steps", "4", "--batch", "2", "--seq-len", "64",
+            "--context-parallel", "ring_zigzag", "--cp", "2",
+        ],
+        n_devices=4,
+    )
+    assert "cp=2(ring_zigzag)" in out, out[-500:]
+
+
 def test_gpt_train_tp_sp_moe_smoke():
     out = _run_example(
         "examples/gpt/train_gpt.py",

@@ -577,6 +577,76 @@ def test_hand_1f1b_loss_takes_params(eight_devices):
     assert not np.allclose(np.asarray(grads["b"][-1]), 0.0)
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_hand_1f1b_config_fuzz(eight_devices, seed):
+    """Seeded (pp, nm, stash, remat, head) draws — including nm=1 (pure
+    warmup/cooldown) and nm < pp — hand schedule vs the lockstep golden
+    on identical params/inputs (losses AND grads)."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+
+    rng = np.random.RandomState(4321 + seed)
+    pp = int(rng.choice([2, 4, 8]))
+    # seed 0 pins nm=1 (pure warmup/cooldown), seed 1 pins nm < pp;
+    # the rest draw freely
+    if seed == 0:
+        nm = 1
+    elif seed == 1:
+        pp, nm = 8, int(rng.randint(2, 8))
+    else:
+        nm = int(rng.randint(1, 9))
+    stash = str(rng.choice(["residuals", "input"]))
+    remat = bool(rng.randint(0, 2)) and stash == "residuals"
+    takes_params = bool(rng.randint(0, 2))
+    desc = f"pp={pp} nm={nm} stash={stash} remat={remat} head={takes_params}"
+
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp, seed=seed)
+    inputs = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+
+    if takes_params:
+        def lfn(p, y, t):
+            return jnp.mean((y + p["b"] - t) ** 2)
+    else:
+        lfn = loss_fn
+
+    def run(schedule, **kw):
+        def body(stacked_local, inputs, targets):
+            params = jax.tree_util.tree_map(lambda v: v[0], stacked_local)
+            losses, grads = schedule(
+                stage_fn, lfn, params, (inputs, targets),
+                num_microbatches=nm, loss_takes_params=takes_params, **kw
+            )
+            return losses, jax.tree_util.tree_map(lambda v: v[None], grads)
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P("pp"), P(), P()),
+                out_specs=(P(), P("pp")), check_vma=False,
+            )
+        )(stacked, inputs, targets)
+
+    losses, grads = run(
+        forward_backward_pipelining_1f1b, stash=stash,
+        remat=remat, remat_policy="dots" if remat else None,
+    )
+    ref_losses, ref_grads = run(
+        forward_backward_pipelining_without_interleaving, remat=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses),
+        rtol=1e-5, atol=1e-7, err_msg=desc,
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-6, err_msg=desc,
+        )
+
+
 def test_hand_1f1b_forward_only(eight_devices):
     from apex_tpu.transformer.pipeline_parallel import (
         forward_backward_pipelining_1f1b,
