@@ -54,13 +54,9 @@ def _cp_shard_rows(table, cfg, s_local):
     rows), matching :func:`context_parallel.zigzag_split`."""
     rank = jax.lax.axis_index(_CP)
     if cfg.context_parallel == "ring_zigzag":
-        cp = jax.lax.axis_size(_CP)
-        sc = s_local // 2
-        lo = jax.lax.dynamic_slice_in_dim(table, rank * sc, sc, 0)
-        hi = jax.lax.dynamic_slice_in_dim(
-            table, (2 * cp - 1 - rank) * sc, sc, 0
-        )
-        return jnp.concatenate([lo, hi], axis=0)
+        from apex_tpu.transformer.context_parallel import zigzag_shard
+
+        return zigzag_shard(table, rank, jax.lax.axis_size(_CP), axis=0)
     return jax.lax.dynamic_slice_in_dim(table, rank * s_local, s_local, 0)
 
 

@@ -42,6 +42,7 @@ from apex_tpu import parallel_state as ps
 __all__ = [
     "ring_attention",
     "ulysses_attention",
+    "zigzag_shard",
     "zigzag_split",
     "zigzag_merge",
 ]
@@ -211,6 +212,27 @@ def ring_attention(
         )
     acc, _, _ = carry
     return acc.astype(q.dtype)
+
+
+def zigzag_shard(x, rank, cp: int, axis: int = 0):
+    """ONE rank's zigzag shard of a GLOBAL array: the concatenation of
+    global chunks ``rank`` and ``2cp−1−rank`` along ``axis`` (``rank``
+    may be traced, e.g. ``jax.lax.axis_index``).  THE definition of the
+    zigzag layout contract for in-shard_map use — models, examples and
+    tests slice through here so the chunk math exists once; whole-array
+    host-side conversion is :func:`zigzag_split` / :func:`zigzag_merge`.
+    Raises unless the axis divides into ``2·cp`` chunks (a remainder
+    would silently drop trailing tokens)."""
+    size = x.shape[axis]
+    if size % (2 * cp):
+        raise ValueError(
+            f"zigzag layout needs the sequence ({size}) divisible by "
+            f"2*cp ({2 * cp}); a remainder would silently drop tokens"
+        )
+    sc = size // (2 * cp)
+    lo = jax.lax.dynamic_slice_in_dim(x, rank * sc, sc, axis)
+    hi = jax.lax.dynamic_slice_in_dim(x, (2 * cp - 1 - rank) * sc, sc, axis)
+    return jnp.concatenate([lo, hi], axis=axis)
 
 
 def zigzag_split(x, cp: int, axis: int = 2):

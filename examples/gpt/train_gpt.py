@@ -121,6 +121,13 @@ def main():
         raise SystemExit(
             f"--seq-len ({args.seq_len}) must be divisible by cp={cp}"
         )
+    if args.context_parallel == "ring_zigzag" and args.seq_len % (2 * cp):
+        # 2 chunks per rank: a bare cp-divisible length would silently
+        # truncate without this (zigzag_shard also raises at trace time)
+        raise SystemExit(
+            f"--seq-len ({args.seq_len}) must be divisible by 2*cp="
+            f"{2 * cp} for ring_zigzag"
+        )
 
     model = GptModel(cfg)
     tx = fused_adam(learning_rate=args.lr)
@@ -155,17 +162,11 @@ def main():
                 rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
                 s_local = ids.shape[0] // cp
                 if args.context_parallel == "ring_zigzag":
-                    # zigzag layout: this rank holds global chunks rank
-                    # and 2cp−1−rank (see context_parallel.zigzag_split)
-                    sc = s_local // 2
-                    ids = jnp.concatenate([
-                        jax.lax.dynamic_slice_in_dim(
-                            ids, rank * sc, sc, 0
-                        ),
-                        jax.lax.dynamic_slice_in_dim(
-                            ids, (2 * cp - 1 - rank) * sc, sc, 0
-                        ),
-                    ], axis=0)
+                    from apex_tpu.transformer.context_parallel import (
+                        zigzag_shard,
+                    )
+
+                    ids = zigzag_shard(ids, rank, cp, axis=0)
                 else:
                     ids = jax.lax.dynamic_slice_in_dim(
                         ids, rank * s_local, s_local, 0

@@ -40,14 +40,9 @@ def _run_cp(cfg, ids, tp=1):
     def f(key, ids):
         rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
         if cfg.context_parallel == "ring_zigzag":
-            # this rank's zigzag pair: global chunks rank and 2cp−1−rank
-            sc = S // (2 * CP)
-            local = jnp.concatenate([
-                jax.lax.dynamic_slice_in_dim(ids, rank * sc, sc, 0),
-                jax.lax.dynamic_slice_in_dim(
-                    ids, (2 * CP - 1 - rank) * sc, sc, 0
-                ),
-            ], axis=0)
+            from apex_tpu.transformer.context_parallel import zigzag_shard
+
+            local = zigzag_shard(ids, rank, CP, axis=0)
         else:
             local = jax.lax.dynamic_slice_in_dim(
                 ids, rank * (S // CP), S // CP, 0
