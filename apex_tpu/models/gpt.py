@@ -56,7 +56,11 @@ def _cp_shard_rows(table, cfg, s_local):
     if cfg.context_parallel == "ring_zigzag":
         from apex_tpu.transformer.context_parallel import zigzag_shard
 
-        return zigzag_shard(table, rank, jax.lax.axis_size(_CP), axis=0)
+        cp = jax.lax.axis_size(_CP)
+        # chunk math runs on the GLOBAL SEQUENCE (cp·s_local rows), not
+        # the full table — a learned-position table longer than the
+        # sequence (max_seq_len > S) must be trimmed first
+        return zigzag_shard(table[: cp * s_local], rank, cp, axis=0)
     return jax.lax.dynamic_slice_in_dim(table, rank * s_local, s_local, 0)
 
 

@@ -59,6 +59,7 @@ from apex_tpu import parallel_state as ps
 from apex_tpu.models.bert import BertConfig, BertEncoderCore
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
 )
@@ -69,6 +70,14 @@ def parse_args():
     p.add_argument("--pp", type=int, default=4)
     p.add_argument("--vpp", type=int, default=0,
                    help="virtual chunks/rank (0 = non-interleaved)")
+    p.add_argument("--hand-1f1b", action="store_true",
+                   help="hand-scheduled 1F1B (O(pp) stash ring, flat in "
+                        "--nm; see docs/pipeline-schedules.md) instead "
+                        "of the lockstep scan; excludes --vpp")
+    p.add_argument("--stash", choices=["residuals", "input"],
+                   default="residuals",
+                   help="hand-1F1B ring contents (residuals = "
+                        "no-recompute, input = minimal memory)")
     p.add_argument("--layers", type=int, default=4, help="total layers")
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--nm", type=int, default=4, help="microbatches/step")
@@ -89,6 +98,8 @@ def main():
         raise SystemExit("--layers must divide pp * max(vpp, 1)")
     if vpp and args.nm % pp:
         raise SystemExit("interleaving requires --nm divisible by --pp")
+    if args.hand_1f1b and vpp:
+        raise SystemExit("--hand-1f1b does not interleave; drop --vpp")
 
     mesh = ps.initialize_model_parallel(
         pipeline_model_parallel_size=pp,
@@ -174,6 +185,12 @@ def main():
                 num_microbatches=args.nm, num_model_chunks=vpp,
                 loss_takes_params=True,
             )
+        elif args.hand_1f1b:
+            losses, grads = forward_backward_pipelining_1f1b(
+                stage_fn, loss_fn, params, (xs, tgts),
+                num_microbatches=args.nm, loss_takes_params=True,
+                stash=args.stash,
+            )
         else:
             losses, grads = forward_backward_pipelining_without_interleaving(
                 stage_fn, loss_fn, params, (xs, tgts),
@@ -234,7 +251,12 @@ def main():
     )
     params, opt_state = boot(jax.random.PRNGKey(0))
 
-    sched = f"interleaved vpp={vpp}" if vpp else "1F1B"
+    if vpp:
+        sched = f"interleaved vpp={vpp}"
+    elif args.hand_1f1b:
+        sched = f"hand-1F1B stash={args.stash}"
+    else:
+        sched = "1F1B"
     print(f"pipeline LM: pp={pp} ({sched}), layers={args.layers}, "
           f"nm={args.nm}, mb={MB}, seq={S}")
     for step in range(args.steps):

@@ -231,12 +231,14 @@ def test_ring_zigzag_matches_full(eight_devices, cp):
 
 
 def test_ring_zigzag_grads_match_full(eight_devices):
+    """cp=2 keeps this in the quick tier while covering every hop branch
+    (self/past/skip appear for both halves across the two ranks)."""
     from apex_tpu.transformer.context_parallel import (
         zigzag_merge,
         zigzag_split,
     )
 
-    cp = 4
+    cp = 2
     q, k, v = _qkv(jax.random.PRNGKey(9))
     mesh = ps.initialize_model_parallel(context_parallel_size=cp)
     qs, ks, vs = (zigzag_split(x, cp) for x in (q, k, v))

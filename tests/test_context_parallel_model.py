@@ -96,6 +96,24 @@ def _run_ref(ids, **kw):
     return float(loss), out
 
 
+def test_cp_zigzag_positions_with_oversized_table(eight_devices):
+    """Learned position embeddings with max_seq_len > S under zigzag:
+    the chunk math must run on the global SEQUENCE length, not the
+    table length (regression: the table-length variant returned
+    wrong-size, wrong-position rows)."""
+    kw = dict(KW, max_seq_len=4 * S)
+    ids = _ids()
+    loss, grads = _run_cp(
+        GptConfig(context_parallel="ring_zigzag", rotary=False, **kw),
+        ids,
+    )
+    loss_ref, ref = _run_ref(ids, rotary=False, **kw)
+    np.testing.assert_allclose(loss, loss_ref, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["pos"]), np.asarray(ref["pos"]), **TOL
+    )
+
+
 @pytest.mark.parametrize("mode", ["ring", "ring_zigzag", "ulysses"])
 @pytest.mark.parametrize("rotary", [True, False])
 def test_cp_gpt_matches_unsharded(mode, rotary, eight_devices):

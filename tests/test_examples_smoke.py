@@ -128,6 +128,18 @@ def test_gpt_train_pp_interleaved_smoke():
     assert "step   2" in out, out[-500:]
 
 
+def test_gpt_train_pp_hand_1f1b_smoke():
+    """Hand-scheduled 1F1B (stash ring) LM example end-to-end."""
+    out = _run_example(
+        "examples/gpt/train_gpt_pp.py",
+        ["--pp", "2", "--hand-1f1b", "--steps", "3", "--layers", "2",
+         "--seq", "16", "--hidden", "32", "--vocab", "64"],
+        n_devices=2,
+    )
+    assert "hand-1F1B stash=residuals" in out, out[-500:]
+    assert "step   2" in out, out[-500:]
+
+
 def test_gpt_train_cp_ring_smoke():
     """Context-parallel ring attention end-to-end in the example."""
     out = _run_example(
