@@ -27,6 +27,9 @@ from apex_tpu.parallel.distributed_fused_optimizers import (  # noqa: F401
     DistributedFusedAdam,
     DistributedFusedLAMB,
 )
+from apex_tpu.parallel.quantized import (  # noqa: F401
+    quantized_all_reduce_gradients,
+)
 from apex_tpu.parallel.multihost import (  # noqa: F401
     distributed_is_initialized,
     finalize_distributed,

@@ -1,0 +1,130 @@
+"""Quantized-wire gradient all-reduce over the data-parallel axis.
+
+Beyond the reference (apex syncs f32/f16 gradients over NCCL at full
+width).  Pattern: EQuARX — Efficient Quantized AllReduce in XLA
+(arxiv 2506.17615) — which shows a blockwise-scaled int8 wire format for
+the all-reduce's two phases at minor quality cost.  This is an
+independent TPU-native implementation of that idea with jax collectives:
+
+    reduce-scatter phase   all_to_all(int8 chunks + f32 scales)
+                           -> local dequant-accumulate in f32
+    all-gather phase       all_gather(int8 reduced shard + scale)
+
+Wire bytes per chip ≈ 1/4 of an f32 ring all-reduce (int8 payload both
+phases, plus one f32 scale per chunk), which is the lever when gradient
+sync rides DCN between hosts or competes with compute for ICI.
+
+Accuracy: values are scaled per (rank-chunk) by max|g|/127, so each of
+the two quantization stages contributes at most ~0.8% relative error
+w.r.t. its chunk's max — fine for SGD/Adam-class updates (gradient
+noise dominates), measurably NOT bit-identical to the exact psum.  Use
+the plain :func:`apex_tpu.parallel.all_reduce_gradients` when exact
+reproducibility across world sizes matters.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import parallel_state as ps
+
+__all__ = ["quantized_all_reduce_gradients"]
+
+_QMAX = 127.0
+
+
+def _quantize(x):
+    """(int8 codes, f32 scale) with scale = max|x|/127 per leading row."""
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / _QMAX
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(jnp.round(x / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def _pack(q, scale):
+    """Append the f32 scale's 4 raw bytes to each int8 row, so codes and
+    scale ride ONE collective (the module targets the latency-bound DCN
+    path — a second tiny scale collective per leaf would erode the win)."""
+    sbytes = jax.lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.int8
+    ).reshape(*q.shape[:-1], 4)
+    return jnp.concatenate([q, sbytes], axis=-1)
+
+
+def _unpack(payload):
+    q, sbytes = payload[..., :-4], payload[..., -4:]
+    # int8[..., 4] -> f32[...]: restore the keepdims the scale had
+    scale = jax.lax.bitcast_convert_type(sbytes, jnp.float32)[..., None]
+    return q, scale
+
+
+def _qar_leaf(g, axis_name, world):
+    """Raw SUM over the axis (averaging is a post-scale at the caller —
+    constant scaling commutes exactly with max/127 quantization)."""
+    n = g.size
+    flat = g.reshape(-1).astype(jnp.float32)
+    pad = (-n) % world
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    chunks = flat.reshape(world, -1)  # row j = the shard rank j will own
+
+    # phase 1 (reduce-scatter shape): one all_to_all of int8 codes with
+    # the scale packed in, then dequant-accumulate this rank's shard
+    recv = jax.lax.all_to_all(
+        _pack(*_quantize(chunks)), axis_name, 0, 0, tiled=False
+    )
+    q_recv, s_recv = _unpack(recv)
+    shard = jnp.sum(q_recv.astype(jnp.float32) * s_recv, axis=0)
+
+    # phase 2: re-quantize the reduced shard, one all_gather of all shards
+    gathered = jax.lax.all_gather(_pack(*_quantize(shard)), axis_name)
+    q_all, s_all = _unpack(gathered)  # (world, chunk), (world, 1)
+    out = (q_all.astype(jnp.float32) * s_all).reshape(-1)
+    if pad:
+        out = out[:n]
+    return out.reshape(g.shape).astype(g.dtype)
+
+
+def quantized_all_reduce_gradients(
+    grads: Any,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    gradient_average: bool = True,
+    gradient_predivide_factor=None,
+    min_size: int = 1024,
+):
+    """int8-wire gradient sync over ``axis_name`` (call inside
+    shard_map); a drop-in for :func:`parallel.all_reduce_gradients`
+    (same kwargs incl. ``gradient_predivide_factor``) when wire
+    bandwidth — not exactness — is the constraint.
+
+    Leaves smaller than ``min_size`` elements go through the exact psum:
+    their wire cost is dominated by latency, and tiny tensors (biases,
+    LN scales) are the most scale-sensitive.
+    """
+    world = jax.lax.axis_size(axis_name)
+    post = 1.0
+    if gradient_average:
+        post = (
+            world / gradient_predivide_factor
+            if gradient_predivide_factor is not None
+            else world
+        )
+
+    def f(g):
+        if gradient_predivide_factor is not None:
+            # max/127 scaling makes predivision a numerical no-op inside
+            # the quantized path, but honoring it keeps half-precision
+            # INPUT grads from overflowing before the cast, exactly as
+            # in all_reduce_gradients
+            g = g / gradient_predivide_factor
+        if g.size < min_size or world == 1:
+            gf = jax.lax.psum(g, axis_name)
+            return gf / post if gradient_average else gf
+        out = _qar_leaf(g, axis_name, world)
+        return out / post if gradient_average else out
+
+    with jax.named_scope("ddp_quantized_allreduce"):
+        return jax.tree_util.tree_map(f, grads)
