@@ -4,22 +4,30 @@ Beyond the reference (apex syncs f32/f16 gradients over NCCL at full
 width).  Pattern: EQuARX — Efficient Quantized AllReduce in XLA
 (arxiv 2506.17615) — which shows a blockwise-scaled int8 wire format for
 the all-reduce's two phases at minor quality cost.  This is an
-independent TPU-native implementation of that idea with jax collectives:
+independent TPU-native implementation of that idea with jax collectives.
 
-    reduce-scatter phase   all_to_all(int8 chunks + f32 scales)
+Structure: every eligible gradient leaf is flattened into ONE bucket, so
+the whole tree costs exactly two collectives —
+
+    reduce-scatter phase   one all_to_all of int8 codes + packed scales
                            -> local dequant-accumulate in f32
-    all-gather phase       all_gather(int8 reduced shard + scale)
+    all-gather phase       one all_gather of the re-quantized shard
 
-Wire bytes per chip ≈ 1/4 of an f32 ring all-reduce (int8 payload both
-phases, plus one f32 scale per chunk), which is the lever when gradient
-sync rides DCN between hosts or competes with compute for ICI.
+— not two per leaf (DDP-style bucketing; per-collective latency on DCN
+would otherwise erode the bandwidth win).  Quantization is per-BLOCK
+(``block`` elements share one f32 max/127 scale), so mixed-magnitude
+tensors in the bucket don't share scales; wire bytes ≈ 1/4 of the f32
+psum (+4/block for scales).
 
-Accuracy: values are scaled per (rank-chunk) by max|g|/127, so each of
-the two quantization stages contributes at most ~0.8% relative error
-w.r.t. its chunk's max — fine for SGD/Adam-class updates (gradient
-noise dominates), measurably NOT bit-identical to the exact psum.  Use
-the plain :func:`apex_tpu.parallel.all_reduce_gradients` when exact
-reproducibility across world sizes matters.
+Accuracy: with ``gradient_average=True`` (the DDP default) worst-case
+element error is ≈ 1/127 of the element's BLOCK max — the reduce-scatter
+stage sums ``world`` half-ulp errors but averaging divides them right
+back down, and the re-quantize stage adds one more half-ulp.  With
+``gradient_average=False`` the absolute error of the SUM scales with
+``world`` (each rank contributes its own half-ulp), just as the sum
+itself does.  Either way this is NOT bit-identical to the exact psum:
+use :func:`apex_tpu.parallel.all_reduce_gradients` when exact
+reproducibility matters.
 """
 
 from __future__ import annotations
@@ -36,56 +44,68 @@ __all__ = ["quantized_all_reduce_gradients"]
 _QMAX = 127.0
 
 
-def _quantize(x):
-    """(int8 codes, f32 scale) with scale = max|x|/127 per leading row."""
-    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / _QMAX
+def _quantize_blocks(x, block):
+    """x (..., n·block) -> int8 codes (same shape) + f32 scales
+    (..., n) with scale = max|block|/127."""
+    shape = x.shape
+    xb = x.reshape(*shape[:-1], -1, block)
+    scale = jnp.max(jnp.abs(xb), axis=-1, keepdims=True) / _QMAX
     scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
-    q = jnp.clip(jnp.round(x / scale), -_QMAX, _QMAX).astype(jnp.int8)
-    return q, scale
+    q = jnp.clip(jnp.round(xb / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q.reshape(shape), scale[..., 0]
+
+
+def _dequantize_blocks(q, scale, block):
+    shape = q.shape
+    xb = q.reshape(*shape[:-1], -1, block).astype(jnp.float32)
+    return (xb * scale[..., None]).reshape(shape)
 
 
 def _pack(q, scale):
-    """Append the f32 scale's 4 raw bytes to each int8 row, so codes and
-    scale ride ONE collective (the module targets the latency-bound DCN
-    path — a second tiny scale collective per leaf would erode the win)."""
+    """Append the scales' raw bytes to the int8 codes, so codes and
+    scales ride ONE collective."""
     sbytes = jax.lax.bitcast_convert_type(
         scale.astype(jnp.float32), jnp.int8
-    ).reshape(*q.shape[:-1], 4)
+    ).reshape(*q.shape[:-1], -1)
     return jnp.concatenate([q, sbytes], axis=-1)
 
 
-def _unpack(payload):
-    q, sbytes = payload[..., :-4], payload[..., -4:]
-    # int8[..., 4] -> f32[...]: restore the keepdims the scale had
-    scale = jax.lax.bitcast_convert_type(sbytes, jnp.float32)[..., None]
+def _unpack(payload, n_codes):
+    q, sbytes = payload[..., :n_codes], payload[..., n_codes:]
+    scale = jax.lax.bitcast_convert_type(
+        sbytes.reshape(*sbytes.shape[:-1], -1, 4), jnp.float32
+    )
     return q, scale
 
 
-def _qar_leaf(g, axis_name, world):
-    """Raw SUM over the axis (averaging is a post-scale at the caller —
-    constant scaling commutes exactly with max/127 quantization)."""
-    n = g.size
-    flat = g.reshape(-1).astype(jnp.float32)
-    pad = (-n) % world
+def _qar_flat(flat, axis_name, world, block):
+    """Raw SUM of a flat f32 vector over the axis in two int8-wire
+    collectives (averaging is a post-scale at the caller — constant
+    scaling commutes exactly with max/127 quantization)."""
+    n = flat.shape[0]
+    pad = (-n) % (world * block)
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
     chunks = flat.reshape(world, -1)  # row j = the shard rank j will own
+    csize = chunks.shape[1]
 
-    # phase 1 (reduce-scatter shape): one all_to_all of int8 codes with
-    # the scale packed in, then dequant-accumulate this rank's shard
+    # phase 1 (reduce-scatter shape): one all_to_all, dequant-accumulate
     recv = jax.lax.all_to_all(
-        _pack(*_quantize(chunks)), axis_name, 0, 0, tiled=False
+        _pack(*_quantize_blocks(chunks, block)), axis_name, 0, 0,
+        tiled=False,
     )
-    q_recv, s_recv = _unpack(recv)
-    shard = jnp.sum(q_recv.astype(jnp.float32) * s_recv, axis=0)
+    q_recv, s_recv = _unpack(recv, csize)
+    shard = jnp.sum(_dequantize_blocks(q_recv, s_recv, block), axis=0)
 
-    # phase 2: re-quantize the reduced shard, one all_gather of all shards
-    gathered = jax.lax.all_gather(_pack(*_quantize(shard)), axis_name)
-    q_all, s_all = _unpack(gathered)  # (world, chunk), (world, 1)
-    out = (q_all.astype(jnp.float32) * s_all).reshape(-1)
+    # phase 2: re-quantize the reduced shard, one all_gather
+    gathered = jax.lax.all_gather(
+        _pack(*_quantize_blocks(shard, block)), axis_name
+    )
+    q_all, s_all = _unpack(gathered, csize)
+    out = _dequantize_blocks(q_all, s_all, block).reshape(-1)
     if pad:
         out = out[:n]
-    return out.reshape(g.shape).astype(g.dtype)
+    return out
 
 
 def quantized_all_reduce_gradients(
@@ -94,15 +114,18 @@ def quantized_all_reduce_gradients(
     gradient_average: bool = True,
     gradient_predivide_factor=None,
     min_size: int = 1024,
+    block: int = 256,
 ):
     """int8-wire gradient sync over ``axis_name`` (call inside
     shard_map); a drop-in for :func:`parallel.all_reduce_gradients`
     (same kwargs incl. ``gradient_predivide_factor``) when wire
     bandwidth — not exactness — is the constraint.
 
-    Leaves smaller than ``min_size`` elements go through the exact psum:
-    their wire cost is dominated by latency, and tiny tensors (biases,
-    LN scales) are the most scale-sensitive.
+    Leaves smaller than ``min_size`` elements go through the exact psum
+    (their wire cost is latency-dominated and tiny tensors — biases, LN
+    scales — are the most noise-sensitive); everything else shares one
+    bucket and exactly two collectives.  ``block`` elements share one
+    quantization scale.
     """
     world = jax.lax.axis_size(axis_name)
     post = 1.0
@@ -113,18 +136,41 @@ def quantized_all_reduce_gradients(
             else world
         )
 
-    def f(g):
+    def pre(g):
         if gradient_predivide_factor is not None:
-            # max/127 scaling makes predivision a numerical no-op inside
-            # the quantized path, but honoring it keeps half-precision
-            # INPUT grads from overflowing before the cast, exactly as
-            # in all_reduce_gradients
-            g = g / gradient_predivide_factor
-        if g.size < min_size or world == 1:
-            gf = jax.lax.psum(g, axis_name)
-            return gf / post if gradient_average else gf
-        out = _qar_leaf(g, axis_name, world)
-        return out / post if gradient_average else out
+            # a numerical no-op inside the quantized path (constant
+            # scaling commutes with max/127 quantization), but it keeps
+            # half-precision INPUT grads from overflowing before the
+            # cast, exactly as in all_reduce_gradients
+            return g / gradient_predivide_factor
+        return g
 
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
     with jax.named_scope("ddp_quantized_allreduce"):
-        return jax.tree_util.tree_map(f, grads)
+        out = []
+        big = [
+            i for i, l in enumerate(leaves)
+            if l.size >= min_size and world > 1
+        ]
+        if big:
+            flat = jnp.concatenate(
+                [pre(leaves[i]).reshape(-1).astype(jnp.float32)
+                 for i in big]
+            )
+            synced = _qar_flat(flat, axis_name, world, block) / post
+            offs = 0
+            synced_by_idx = {}
+            for i in big:
+                n = leaves[i].size
+                synced_by_idx[i] = (
+                    synced[offs:offs + n]
+                    .reshape(leaves[i].shape)
+                    .astype(leaves[i].dtype)
+                )
+                offs += n
+        for i, l in enumerate(leaves):
+            if big and i in synced_by_idx:
+                out.append(synced_by_idx[i])
+            else:
+                out.append(jax.lax.psum(pre(l), axis_name) / post)
+        return jax.tree_util.tree_unflatten(treedef, out)

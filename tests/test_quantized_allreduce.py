@@ -43,8 +43,10 @@ def _per_rank_grads(key, shape):
 
 
 def test_error_bounded_vs_exact(eight_devices):
-    """Two quantization stages at max|chunk|/127 scales: element error
-    stays within a few parts in 127 of the result's max magnitude."""
+    """Averaged sync: the reduce-scatter stage's world half-ulp errors
+    average back down, plus one re-quantize half-ulp — so element error
+    is bounded by ~1/127 of the PRE-reduction input max (a world-robust
+    bound, unlike one phrased against the post-mean result)."""
     g = {
         "w": _per_rank_grads(jax.random.PRNGKey(0), (64, 96)),
         "b": _per_rank_grads(jax.random.PRNGKey(1), (4096,)),
@@ -56,7 +58,8 @@ def test_error_bounded_vs_exact(eight_devices):
         # replicated output: every rank row identical
         for r in range(1, DP):
             np.testing.assert_array_equal(np.asarray(got[k][r]), a)
-        bound = 3.0 / 127.0 * np.abs(b).max()
+        gmax = np.abs(np.asarray(g[k])).max()  # pre-reduction magnitude
+        bound = 2.0 / 127.0 * gmax
         assert np.abs(a - b).max() <= bound, (k, np.abs(a - b).max(), bound)
         # and the quantized result is genuinely close in aggregate
         rel = np.abs(a - b).mean() / (np.abs(b).mean() + 1e-12)
@@ -87,7 +90,10 @@ def test_sum_semantics_and_odd_sizes(eight_devices):
     )
     a, b = np.asarray(got["x"][0]), np.asarray(want["x"][0])
     assert a.shape == shape
-    bound = 3.0 / 127.0 * np.abs(b).max()
+    # SUM semantics: each rank contributes its own half-ulp, so the
+    # absolute bound scales with world (as the sum itself does)
+    gmax = np.abs(np.asarray(g["x"])).max()
+    bound = (0.5 * (DP + 1) + 0.5) / 127.0 * gmax
     assert np.abs(a - b).max() <= bound
 
 
@@ -112,8 +118,40 @@ def test_predivide_factor_matches_exact_semantics(eight_devices):
         lambda t: all_reduce_gradients(t, gradient_predivide_factor=4.0),
         g,
     )
-    bound = 3.0 / 127.0 * np.abs(np.asarray(want["w"])).max()
+    bound = 2.0 / 127.0 * np.abs(np.asarray(g["w"])).max()
     assert np.abs(np.asarray(pre["w"]) - np.asarray(want["w"])).max() <= bound
+
+
+def test_single_bucket_two_collectives(eight_devices):
+    """The whole tree's eligible leaves share ONE bucket: compiled HLO
+    contains exactly one all-to-all and one all-gather regardless of
+    leaf count (the DCN-latency property the module promises)."""
+    mesh = ps.initialize_model_parallel(devices=jax.devices()[:DP])
+    tree = {
+        f"p{i}": jnp.ones((137 + 61 * i, 33)) for i in range(5)
+    }  # 5 eligible leaves, deliberately awkward sizes
+
+    def f(t):
+        return quantized_all_reduce_gradients(t, min_size=1)
+
+    hlo = (
+        jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        .lower(tree)
+        .compile()
+        .as_text()
+    )
+    ps.destroy_model_parallel()
+    import re
+
+    n_a2a = len(re.findall(r"\ball-to-all(?:-start)?\(", hlo))
+    n_ag = len(re.findall(r"\ball-gather(?:-start)?\(", hlo))
+    assert n_a2a == 1, n_a2a
+    assert n_ag == 1, n_ag
 
 
 def test_ddp_training_converges_with_quantized_sync(eight_devices):
