@@ -187,8 +187,13 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
         # keeps whichever forward activations fit HBM instead of honoring
         # the full recompute (same values; 316 ms vs 371 ms measured) —
         # the right trade on one chip at batch 128.
+        # APEX_TPU_BENCH_POLICY lets the on-chip queue flip the headline
+        # remat policy (dots vs the staged "sums" epilogue-fusion bet,
+        # docs/mfu.md lever #1) without editing code mid-window.
         cfg_kwargs = dict(
-            remat=True, remat_policy="dots", scan_layers=False,
+            remat=True,
+            remat_policy=os.environ.get("APEX_TPU_BENCH_POLICY", "dots"),
+            scan_layers=False,
             remat_attention=True, remat_prevent_cse=False,
         )
     cfg = bert_large_config(**cfg_kwargs)
@@ -275,6 +280,9 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
             extra = ", mfu_exec=%.4f, mpps=%d" % (
                 mfu_exec, max_predictions_per_seq
             )
+        # record the remat policy that actually ran so artifacts from
+        # different APEX_TPU_BENCH_POLICY settings stay distinguishable
+        extra += ", policy=%s" % cfg.remat_policy
         _emit(
             _METRIC_NAMES["bert_lamb"],
             round(mfu, 4),
@@ -651,6 +659,14 @@ _CONFIGS = {
 
 
 def main(config="bert_lamb", trace_dir=None):
+    # Fail a typo'd APEX_TPU_BENCH_POLICY BEFORE any backend touch:
+    # under --config all the bert config would otherwise raise only
+    # after earlier benches burned scarce tunnel time.
+    policy = os.environ.get("APEX_TPU_BENCH_POLICY", "dots")
+    if policy not in ("dots", "sums", "full"):
+        raise SystemExit(
+            f"APEX_TPU_BENCH_POLICY must be dots|sums|full, got {policy!r}"
+        )
     if _WATCHDOG_S > 0:
         armed = _backend_watchdog(
             _WATCHDOG_S, _METRIC_NAMES.get(config, config)

@@ -7,11 +7,17 @@
 #
 # Steps (each guarded by a fresh probe so a mid-queue outage skips the
 # rest instead of hanging):
-#   1. tests_tpu           — on-chip parity suite (incl. sums remat case)
+#   1. tests_tpu           — on-chip parity suite (incl. sums remat +
+#                            compiled-dropout keep-mask cases)
 #   2. mfu_sweep --grid2   — sums-policy A/B on the packed headline
 #   3. attn_tune           — flash-attention (block_q, block_k) sweep
 #   4. bench_all --round N — refresh BENCH_all_r{N}.json artifacts
 # Logs land in onchip_r{N}.*.log at the repo root.
+#
+# If the grid2 A/B shows "sums" beating "dots" on step time / mfu_exec,
+# re-run step 4 with the headline flipped — no code edit needed:
+#   APEX_TPU_BENCH_POLICY=sums sh tools/onchip_queue.sh N   (or just
+#   APEX_TPU_BENCH_POLICY=sums python tools/bench_all.py --round N)
 
 set -u
 ROUND="${1:-4}"
