@@ -323,17 +323,14 @@ class BertEncoderCore(nn.Module):
         if self.cfg.remat:
             # activation checkpointing per layer ≙ tensor_parallel.random
             # .checkpoint (recompute-in-backward; PRNG replay is automatic
-            # in JAX — keys are values, not stateful generators)
-            if self.cfg.remat_policy == "dots":
-                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            elif self.cfg.remat_policy == "sums":
-                # same bytes as "dots", chosen so every raw matmul output
-                # is single-consumer (epilogues fuse); see BertConfig
-                policy = jax.checkpoint_policies.save_only_these_names(
-                    *SUMS_SAVE_NAMES
-                )
-            else:  # "full" (validated in BertConfig.__post_init__)
-                policy = None
+            # in JAX — keys are values, not stateful generators).  "sums":
+            # same bytes as "dots", chosen so every raw matmul output is
+            # single-consumer (epilogues fuse); see BertConfig.
+            from apex_tpu.transformer.pipeline_parallel.schedules import (
+                resolve_remat_policy,
+            )
+
+            policy = resolve_remat_policy(self.cfg.remat_policy)
             # prevent_cse=False is documented safe only under scan/pmap
             # differentiation; on the unrolled path the layer is
             # differentiated directly under jit, where CSE could merge the

@@ -14,6 +14,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from apex_tpu import parallel_state as ps
 from apex_tpu.models.bert import _LayerNorm
@@ -100,6 +101,12 @@ class GptConfig:
     # shard boundaries.
     context_parallel: Optional[str] = None
     remat: bool = False
+    # Per-layer checkpoint policy when remat=True — same taxonomy as
+    # BertConfig: "full" recomputes everything, "dots" saves no-batch-dim
+    # matmul outputs, "sums" saves only the gpt_{qkv,fc1,sum_attn,
+    # sum_mlp} named tags (epilogue-fusion friendly: every raw matmul
+    # output stays single-consumer).
+    remat_policy: str = "full"
     # MoE: num_experts > 0 replaces the dense MLP with a SwitchMoe block
     # (experts sharded over the dp/ep axis, apex_tpu.transformer.moe); the
     # per-layer aux losses are sown into the "losses" collection and folded
@@ -120,6 +127,11 @@ class GptConfig:
             raise ValueError(
                 "context_parallel and sequence_parallel are mutually "
                 "exclusive: both shard the sequence dimension"
+            )
+        if self.remat_policy not in ("full", "dots", "sums"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                "(expected 'full', 'dots' or 'sums')"
             )
 
 
@@ -145,6 +157,9 @@ class GptBlock(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype, name="qkv",
         )(y)
+        # inert unless remat_policy="sums" selects it by name (the same
+        # epilogue-fusion-friendly save set as the BERT blocks)
+        qkv = checkpoint_name(qkv, "gpt_qkv")
         s, b = qkv.shape[0], qkv.shape[1]
         # per-head-interleaved (heads, 3, head_dim) column layout — see
         # BertSelfAttention: required for tp-invariant column sharding
@@ -191,7 +206,7 @@ class GptBlock(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype, name="out",
         )(ctx)
-        x = x + attn
+        x = checkpoint_name(x + attn, "gpt_sum_attn")
 
         y = _LayerNorm(
             h, cfg.layer_norm_eps,
@@ -227,13 +242,14 @@ class GptBlock(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 dtype=cfg.dtype, name="fc1",
             )(y)
+            y = checkpoint_name(y, "gpt_fc1")
             y = jax.nn.gelu(y, approximate=True)
             y = RowParallelLinear(
                 cfg.intermediate_size, h, input_is_parallel=True,
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 dtype=cfg.dtype, name="fc2",
             )(y)
-        return x + y
+        return checkpoint_name(x + y, "gpt_sum_mlp")
 
 
 class _GptStep(nn.Module):
@@ -304,7 +320,14 @@ class GptModel(nn.Module):
             x = x + rows[:, None, :].astype(cfg.dtype)
         step = _GptStep
         if cfg.remat:
-            step = nn.remat(step, prevent_cse=False)
+            from apex_tpu.transformer.pipeline_parallel.schedules import (
+                resolve_remat_policy,
+            )
+
+            step = nn.remat(
+                step, prevent_cse=False,
+                policy=resolve_remat_policy(cfg.remat_policy),
+            )
         scanned = nn.scan(
             step,
             variable_axes={"params": 0, "losses": 0},

@@ -56,11 +56,31 @@ __all__ = [
 _PP = ps.PIPELINE_PARALLEL_AXIS
 
 # checkpoint_name tags the "sums" named-saves policy selects.  Defined in
-# infra (models import it — apex_tpu.models.bert tags these in its layers)
-# so the model layer depends on the schedule layer, never the reverse.
+# infra (models import it — apex_tpu.models.{bert,gpt} tag these in their
+# layers) so the model layer depends on the schedule layer, never the
+# reverse.  A stage whose model carries none of these tags saves nothing
+# under "sums" (= "full" behavior, same values).
 SUMS_SAVE_NAMES = (
-    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp"
+    "bert_qkv", "bert_fc1", "bert_sum_attn", "bert_sum_mlp",
+    "gpt_qkv", "gpt_fc1", "gpt_sum_attn", "gpt_sum_mlp",
 )
+
+
+def resolve_remat_policy(name):
+    """The ONE full/dots/sums -> jax.checkpoint policy resolution, shared
+    by the models (BertConfig/GptConfig remat_policy) and the pipeline
+    schedules' per-tick wrap.  ``None``/"full" -> recompute everything
+    (policy None); "dots" -> save no-batch-dim matmul outputs; "sums" ->
+    save only the :data:`SUMS_SAVE_NAMES` tags."""
+    if name in (None, "full"):
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "sums":
+        return jax.checkpoint_policies.save_only_these_names(
+            *SUMS_SAVE_NAMES
+        )
+    raise ValueError(f"unknown remat_policy {name!r}")
 
 
 def _wrap_remat(fn, remat, remat_policy=None):
@@ -75,19 +95,16 @@ def _wrap_remat(fn, remat, remat_policy=None):
     if not remat:
         return fn
     if remat_policy == "dots":
+        # the schedules' historical "dots" is checkpoint_dots (saves all
+        # matmul outputs), intentionally broader than the models'
+        # no-batch-dim variant — per-tick stages see one microbatch
         return jax.checkpoint(
             fn, policy=jax.checkpoint_policies.checkpoint_dots
         )
-    if remat_policy == "sums":
-        return jax.checkpoint(
-            fn,
-            policy=jax.checkpoint_policies.save_only_these_names(
-                *SUMS_SAVE_NAMES
-            ),
-        )
-    if remat_policy not in (None, "full"):
-        raise ValueError(f"unknown remat_policy {remat_policy!r}")
-    return jax.checkpoint(fn)
+    policy = resolve_remat_policy(remat_policy)
+    if policy is None:
+        return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=policy)
 
 
 # ---------------------------------------------------------------------------

@@ -89,6 +89,9 @@ def _backend_watchdog(seconds: float, metric: str = _METRIC_NAMES["bert_lamb"]):
 
 
 _WATCHDOG_S = float(os.environ.get("APEX_TPU_BENCH_WATCHDOG_S", "900"))
+# Headline remat policy (dots | sums | full) — one read shared by the
+# main() fail-fast guard and bench_bert_lamb's default config.
+_BENCH_POLICY = os.environ.get("APEX_TPU_BENCH_POLICY", "dots")
 
 # per-chip dense bf16 peak FLOP/s by device kind (public specs)
 _PEAK = {
@@ -187,13 +190,11 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
         # keeps whichever forward activations fit HBM instead of honoring
         # the full recompute (same values; 316 ms vs 371 ms measured) —
         # the right trade on one chip at batch 128.
-        # APEX_TPU_BENCH_POLICY lets the on-chip queue flip the headline
-        # remat policy (dots vs the staged "sums" epilogue-fusion bet,
+        # _BENCH_POLICY lets the on-chip queue flip the headline remat
+        # policy (dots vs the staged "sums" epilogue-fusion bet,
         # docs/mfu.md lever #1) without editing code mid-window.
         cfg_kwargs = dict(
-            remat=True,
-            remat_policy=os.environ.get("APEX_TPU_BENCH_POLICY", "dots"),
-            scan_layers=False,
+            remat=True, remat_policy=_BENCH_POLICY, scan_layers=False,
             remat_attention=True, remat_prevent_cse=False,
         )
     cfg = bert_large_config(**cfg_kwargs)
@@ -661,12 +662,18 @@ _CONFIGS = {
 def main(config="bert_lamb", trace_dir=None):
     # Fail a typo'd APEX_TPU_BENCH_POLICY BEFORE any backend touch:
     # under --config all the bert config would otherwise raise only
-    # after earlier benches burned scarce tunnel time.
-    policy = os.environ.get("APEX_TPU_BENCH_POLICY", "dots")
-    if policy not in ("dots", "sums", "full"):
-        raise SystemExit(
-            f"APEX_TPU_BENCH_POLICY must be dots|sums|full, got {policy!r}"
-        )
+    # after earlier benches burned scarce tunnel time.  The guard and
+    # the consumer share ONE module-level read (_BENCH_POLICY) and the
+    # validation delegates to the models' own resolution, so a policy
+    # added there is automatically accepted here.
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        resolve_remat_policy,
+    )
+
+    try:
+        resolve_remat_policy(_BENCH_POLICY)
+    except ValueError as e:
+        raise SystemExit(f"APEX_TPU_BENCH_POLICY: {e}")
     if _WATCHDOG_S > 0:
         armed = _backend_watchdog(
             _WATCHDOG_S, _METRIC_NAMES.get(config, config)

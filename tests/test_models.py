@@ -329,6 +329,33 @@ class TestGpt:
         )
         assert abs(l1 - l8) < 2e-3, (l1, l8)
 
+    @pytest.mark.parametrize("policy", ["dots", "sums"])
+    def test_gpt_remat_policy_preserves_values(self, policy):
+        """remat=True with 'dots'/'sums' reproduces the no-remat loss and
+        grads (the gpt_* named tags mirror the BERT sums save set)."""
+        kw = dict(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_seq_len=16, dtype=jnp.float32,
+        )
+        ids = jax.random.randint(jax.random.PRNGKey(2), (16, 2), 0, 64)
+
+        def loss_and_grads(**extra):
+            m = GptModel(GptConfig(**kw, **extra))
+            params = m.init(jax.random.PRNGKey(3), ids)
+            return jax.value_and_grad(
+                lambda p: gpt_lm_loss(p, m, ids)
+            )(params)
+
+        l_ref, g_ref = loss_and_grads()
+        l_p, g_p = loss_and_grads(remat=True, remat_policy=policy)
+        np.testing.assert_allclose(float(l_ref), float(l_p), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            g_ref, g_p,
+        )
+
     def test_causality(self):
         """Changing a future token must not change earlier losses' inputs:
         logits at position t depend only on ids[:t+1]."""
