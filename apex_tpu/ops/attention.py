@@ -52,6 +52,44 @@ def _seq_pad(s: int) -> int:
     return (-s) % 8 if s < _LANES else (-s) % _LANES
 
 
+def _format_bias(bias, b, h, sk, pad_q, pad_k, bias_grad):
+    """(B?, H?, Sq?, Sk) bias -> the kernel's (G, RS, Sk) layout.
+
+    A head-independent bias keeps G = bb (∈ {1, B}) and a
+    query-independent (key-padding) bias keeps RS = 1, so the common
+    (B, 1, 1, Sk) padding mask never materializes a (Sq, Sk) matrix —
+    the kernel's index map folds b//(BH/G) and broadcasts the row.
+
+    Padded keys are masked at PAD_VALUE — strictly below the user bias's
+    MASK_VALUE clamp, so a row whose real keys are ALL masked still
+    averages V over the real keys only (padded keys underflow out of its
+    softmax).  Padded q rows (sliced off by callers) get zero bias rows.
+    Both pads sit OUTSIDE the custom VJP, so autodiff slices the dbias
+    back to the user's shape."""
+    bb, bh_, bsq, bsk = bias.shape
+    if bsk != sk:
+        bias = jnp.broadcast_to(bias, (bb, bh_, bsq, sk))
+    if bh_ == 1:
+        bias_f = bias.reshape(bb, bsq, sk)
+    else:
+        bias_f = jnp.broadcast_to(bias, (b, h, bsq, sk)).reshape(
+            b * h, bsq, sk
+        )
+    if not bias_grad:
+        # Zero cotangent on this path; stop_gradient makes that explicit
+        # so an unintended trainable bias fails loudly in tests (zero
+        # grad) rather than appearing shape-dependent.
+        bias_f = jax.lax.stop_gradient(bias_f)
+    if pad_k:
+        bias_f = jnp.pad(
+            bias_f, ((0, 0), (0, 0), (0, pad_k)),
+            constant_values=_pallas.PAD_VALUE,
+        )
+    if bsq != 1 and pad_q:
+        bias_f = jnp.pad(bias_f, ((0, 0), (0, pad_q), (0, 0)))
+    return bias_f
+
+
 def _derive_dropout_seed(dropout_rng, dropout_p):
     """The ONE seed derivation for every fused-dropout kernel entry point
     (flash_attention and flash_attention_with_lse must stay in lockstep —
@@ -265,38 +303,7 @@ def flash_attention(
         vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
     bias_f = None
     if bias is not None:
-        bb, bh_, bsq, bsk = bias.shape
-        if bsk != sk:
-            bias = jnp.broadcast_to(bias, (bb, bh_, bsq, sk))
-        # (G, RS, Sk) layout for the kernel (see pallas.flash_attention):
-        # a head-independent bias keeps G = bb (∈ {1, B}) and a
-        # query-independent (key-padding) bias keeps RS = 1, so the common
-        # (B, 1, 1, Sk) padding mask never materializes a (Sq, Sk) matrix
-        # — the kernel's index map folds b//(BH/G) and broadcasts the row.
-        if bh_ == 1:
-            bias_f = bias.reshape(bb, bsq, sk)
-        else:
-            bias_f = jnp.broadcast_to(bias, (b, h, bsq, sk)).reshape(
-                b * h, bsq, sk
-            )
-        if not bias_grad:
-            # Zero cotangent on this path; stop_gradient makes that
-            # explicit so an unintended trainable bias fails loudly in
-            # tests (zero grad) rather than appearing shape-dependent.
-            bias_f = jax.lax.stop_gradient(bias_f)
-        # Padded keys are masked at PAD_VALUE — strictly below the user
-        # bias's MASK_VALUE clamp, so a row whose real keys are ALL masked
-        # still averages V over the real keys only (padded keys underflow
-        # out of its softmax).  Padded q rows (sliced off below) get zero
-        # bias rows.  Both pads sit OUTSIDE the custom VJP, so autodiff
-        # slices the dbias back to the user's shape.
-        if pad_k:
-            bias_f = jnp.pad(
-                bias_f, ((0, 0), (0, 0), (0, pad_k)),
-                constant_values=_pallas.PAD_VALUE,
-            )
-        if bsq != 1 and pad_q:
-            bias_f = jnp.pad(bias_f, ((0, 0), (0, pad_q), (0, 0)))
+        bias_f = _format_bias(bias, b, h, sk, pad_q, pad_k, bias_grad)
     elif pad_k:
         # No user bias but padded keys: mask them via the cheap RS=1, G=1
         # key-padding row (never materializes an (Sq, Sk) matrix).
@@ -313,40 +320,44 @@ def flash_attention(
     return o[:, :sq, :d].reshape(b, h, sq, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_lse(q, k, v, seed, scale, causal, dropout_p):
-    return _flash_lse_fwd(q, k, v, seed, scale, causal, dropout_p)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_lse(q, k, v, bias, seed, scale, causal, dropout_p):
+    return _flash_lse_fwd(q, k, v, bias, seed, scale, causal, dropout_p)[0]
 
 
-def _flash_lse_fwd(q, k, v, seed, scale, causal, dropout_p):
+def _flash_lse_fwd(q, k, v, bias, seed, scale, causal, dropout_p):
     o, lse = _pallas.flash_fwd(
-        q, k, v, None, scale=scale, causal=causal, dropout_p=dropout_p,
+        q, k, v, bias, scale=scale, causal=causal, dropout_p=dropout_p,
         dropout_seed=seed,
     )
-    return (o, lse[..., 0]), (q, k, v, seed, o, lse)
+    return (o, lse[..., 0]), (q, k, v, bias, seed, o, lse)
 
 
 def _flash_lse_bwd(scale, causal, dropout_p, res, cts):
     import numpy as np
 
-    q, k, v, seed, o, lse = res
+    q, k, v, bias, seed, o, lse = res
     do, dlse = cts
     # dlse folds as ds = p·(dp − (delta − dlse)): the dlse term enters
     # delta BEFORE the keep-mask multiplies dp, so it correctly bypasses
     # dropout (lse accumulates the full, undropped row sum).
     dq, dk, dv = _pallas.flash_bwd(
-        q, k, v, o, lse, do, None, scale=scale, causal=causal, dlse=dlse,
+        q, k, v, o, lse, do, bias, scale=scale, causal=causal, dlse=dlse,
         dropout_p=dropout_p, dropout_seed=seed,
     )
+    # the with-lse bias is the ADDITIVE-MASK form (≙ flash_attention's
+    # bias_grad=False): zero cotangent
+    dbias = None if bias is None else jnp.zeros_like(bias)
     dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dseed
+    return dq, dk, dv, dbias, dseed
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention_with_lse(q, k, v, *, causal=False, scale=None,
-                             dropout_p: float = 0.0, dropout_rng=None):
+def flash_attention_with_lse(q, k, v, bias=None, *, causal=False,
+                             scale=None, dropout_p: float = 0.0,
+                             dropout_rng=None):
     """Fused attention returning ``(o, lse)`` — both differentiable.
 
     The building block for composed softmax schemes that need the row
@@ -360,6 +371,13 @@ def flash_attention_with_lse(q, k, v, *, causal=False, scale=None,
     dtype and lse f32 (B,H,Sq).  Uses the Pallas kernels whenever the
     shape is eligible (interpret-mode off TPU), else a jnp composition
     with identical semantics.
+
+    ``bias`` (broadcastable to (B, H, Sq, Sk), e.g. a (B, 1, 1, Sk)
+    key-padding mask) is the ADDITIVE-MASK form — non-trainable, zero
+    cotangent, clamped at MASK_VALUE like :func:`flash_attention`'s
+    ``bias_grad=False`` path.  A row whose keys are ALL masked yields
+    the uniform average of V with a finite (~MASK_VALUE-ish) lse, which
+    merges to zero weight against any real block in ring composition.
 
     ``dropout_p`` > 0 (with ``dropout_rng``) applies fused probability
     dropout exactly as :func:`flash_attention` does: the PV contribution
@@ -378,37 +396,59 @@ def flash_attention_with_lse(q, k, v, *, causal=False, scale=None,
     if dropout_p > 0.0 and dropout_rng is None:
         raise ValueError("dropout_p > 0 requires dropout_rng")
     b, h, sq, d = q.shape
-    # Aligned shapes only: the lse variant has no bias plumbing, so padded
-    # keys could not be masked out (ring attention's shards are aligned).
+    sk = k.shape[-2]
+    if bias is not None:
+        if bias.ndim < 4:
+            bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        # shared fully-masked-row semantics with the jnp path (see
+        # flash_attention's dispatcher); the with-lse bias is ALWAYS the
+        # additive-mask form, so stop_gradient here keeps the zero
+        # cotangent identical on BOTH dispatch paths (the jnp fallback
+        # would otherwise differentiate it naturally — a backend/shape-
+        # dependent gradient)
+        bias = jax.lax.stop_gradient(
+            jnp.maximum(bias, _pallas.MASK_VALUE)
+        )
+    # Aligned shapes only (ring attention's shards are aligned): padding
+    # would need the PAD_VALUE masking the flash dispatcher builds.
     if (
         not _seq_pad(sq)
-        and not _seq_pad(k.shape[-2])
+        and not _seq_pad(sk)
         and _pallas_eligible(q, k, v, dropout_p, causal)
     ):
         seed = _derive_dropout_seed(dropout_rng, dropout_p)
         qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
-        o, lse = _flash_lse(qf, kf, vf, seed, scale, causal, dropout_p)
+        bias_f = (
+            None if bias is None
+            else _format_bias(bias, b, h, sk, 0, 0, bias_grad=False)
+        )
+        o, lse = _flash_lse(
+            qf, kf, vf, bias_f, seed, scale, causal, dropout_p
+        )
         return (
             o[..., :d].reshape(b, h, sq, d),
             lse.reshape(b, h, sq),
         )
     return mha_reference_with_lse(
-        q, k, v, causal=causal, scale=scale, dropout_p=dropout_p,
+        q, k, v, bias, causal=causal, scale=scale, dropout_p=dropout_p,
         dropout_rng=dropout_rng,
     )
 
 
-def mha_reference_with_lse(q, k, v, *, causal=False, scale=None,
-                           dropout_p: float = 0.0, dropout_rng=None):
+def mha_reference_with_lse(q, k, v, bias=None, *, causal=False,
+                           scale=None, dropout_p: float = 0.0,
+                           dropout_rng=None):
     """jnp composition returning ``(o, lse)`` — the correctness reference
     for :func:`flash_attention_with_lse` (numerics identical to
-    :func:`mha_reference` plus the row logsumexp).  Dropout masks the
+    :func:`mha_reference` plus the row logsumexp).  ``bias`` is the
+    additive-mask form (non-trainable upstream; here it differentiates
+    naturally but callers pass it stop-gradiented).  Dropout masks the
     normalized probabilities only; ``lse`` stays the undropped row
     statistic (the kernel contract — the mask stream differs from the
     kernel's, both are valid dropout)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = _scores(q, k, None, causal, scale)
+    s = _scores(q, k, bias, causal, scale)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)

@@ -51,7 +51,7 @@ _CP = ps.CONTEXT_PARALLEL_AXIS
 
 
 def _block_attend(q, k, v, scale, *, causal=False, dropout_p=0.0,
-                  dropout_rng=None):
+                  dropout_rng=None, bias=None):
     """One (q-block × kv-block) flash block: returns (o (f32), lse).
 
     o is the block-normalized output, lse the row logsumexp — exactly the
@@ -67,7 +67,7 @@ def _block_attend(q, k, v, scale, *, causal=False, dropout_p=0.0,
     from apex_tpu.ops.attention import flash_attention_with_lse
 
     o, lse = flash_attention_with_lse(
-        q, k, v, causal=causal, scale=scale, dropout_p=dropout_p,
+        q, k, v, bias, causal=causal, scale=scale, dropout_p=dropout_p,
         dropout_rng=dropout_rng,
     )
     return o.astype(jnp.float32), lse
@@ -105,6 +105,7 @@ def ring_attention(
     q,
     k,
     v,
+    bias=None,
     *,
     causal: bool = False,
     scale: Optional[float] = None,
@@ -131,6 +132,16 @@ def ring_attention(
     ~2 half-blocks per hop — halving causal ring wall on real hardware
     (Megatron-LM's cp layout).  Zigzag requires ``causal=True``.
 
+    ``bias``: a per-rank KEY-PADDING mask of shape ``(B, 1, 1,
+    S_local)`` (additive, non-trainable, MASK_VALUE-clamped) covering
+    this rank's OWN kv chunk — it rotates around the ring with (k, v),
+    so every hop masks the padded keys of whichever chunk it attends.
+    Variable-length long-document batches are the use case; each query
+    row must keep at least one unmasked key globally.  Query-dependent
+    bias shapes are rejected (they cannot rotate with kv; fold such
+    terms into the model instead).  Not supported with
+    ``layout="zigzag"`` yet.
+
     ``dropout_p`` > 0 (with ``dropout_rng``) applies attention dropout
     that composes exactly with the ring merge: each (q-rank, kv-chunk)
     block draws an independent mask (``dropout_rng`` folded with
@@ -145,6 +156,27 @@ def ring_attention(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if dropout_p > 0.0 and dropout_rng is None:
         raise ValueError("dropout_p > 0 requires dropout_rng")
+    if bias is not None:
+        if layout == "zigzag":
+            raise ValueError(
+                "ring_attention: bias is not supported with "
+                "layout='zigzag' yet"
+            )
+        if bias.ndim < 4:
+            bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        if bias.shape[1] != 1 or bias.shape[2] != 1:
+            raise ValueError(
+                "ring_attention only rotates a key-padding bias of "
+                f"shape (B, 1, 1, S_local); got {bias.shape} — "
+                "query-dependent bias cannot rotate with kv"
+            )
+        if bias.shape[-1] not in (1, k.shape[-2]):
+            raise ValueError(
+                f"ring_attention bias covers {bias.shape[-1]} keys but "
+                f"this rank's kv chunk has {k.shape[-2]} — pass the "
+                "RANK-LOCAL slice of the global mask (it rotates with "
+                "kv), not the global mask itself"
+            )
     if layout == "zigzag":
         if not causal:
             raise ValueError(
@@ -166,23 +198,23 @@ def ring_attention(
     @jax.checkpoint
     def hop(qf, kv, src):
         """(o, lse) for this rank's q against the kv chunk from ``src``."""
-        kb, vb = kv
-        drop = {}
+        kb, vb, bias_b = kv
+        kw = {} if bias_b is None else dict(bias=bias_b)
         if dropout_p > 0.0:
-            drop = dict(
+            kw.update(
                 dropout_p=dropout_p,
                 dropout_rng=jax.random.fold_in(
                     dropout_rng, rank * world + src
                 ),
             )
         if not causal:
-            return _block_attend(qf, kb, vb, scale, **drop)
+            return _block_attend(qf, kb, vb, scale, **kw)
 
         def self_block(_):
-            return _block_attend(qf, kb, vb, scale, causal=True, **drop)
+            return _block_attend(qf, kb, vb, scale, causal=True, **kw)
 
         def past_block(_):
-            return _block_attend(qf, kb, vb, scale, **drop)
+            return _block_attend(qf, kb, vb, scale, **kw)
 
         def future_block(_):
             return _skipped_block(b, h, s_local, d)
@@ -193,12 +225,14 @@ def ring_attention(
     # hop 0 is always the self block — no permute needed before it, and it
     # seeds the running max with a finite lse (so -inf skipped hops merge
     # to exactly zero weight)
-    o0, lse0 = hop(qf, (k, v), rank)
+    kv0 = (k, v, bias)
+    o0, lse0 = hop(qf, kv0, rank)
     carry = (o0, lse0, jnp.ones((b, h, s_local), jnp.float32))
 
     def body(state, step):
         kv, carry = state
-        # rotate FIRST: world-1 permutes total, none wasted on the last hop
+        # rotate FIRST: world-1 permutes total, none wasted on the last
+        # hop; the key-padding bias rides the same rotation as (k, v)
         kv = jax.tree_util.tree_map(
             lambda x: jax.lax.ppermute(x, axis_name, perm), kv
         )
@@ -208,7 +242,7 @@ def ring_attention(
 
     if world > 1:
         (_, carry), _ = jax.lax.scan(
-            body, ((k, v), carry), jnp.arange(1, world)
+            body, (kv0, carry), jnp.arange(1, world)
         )
     acc, _, _ = carry
     return acc.astype(q.dtype)

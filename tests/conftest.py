@@ -222,6 +222,8 @@ _SLOW_EXACT = {
     "test_triangle_multiplicative_update_math[outgoing]",
     # [sums] (the novel policy) carries the quick GPT remat signal
     "test_gpt_remat_policy_preserves_values[dots]",
+    # ring key-padding: non-causal carries the quick signal
+    "test_ring_key_padding_bias_matches_full[True]",
 }
 
 

@@ -500,6 +500,53 @@ class TestFlashAttentionWithLse:
             np.asarray(lse), np.asarray(lw), atol=2e-5, rtol=2e-5
         )
 
+    def test_key_padding_bias_matches_reference(self, force_pallas):
+        """(B, 1, 1, Sk) key-padding bias on the with-lse path: kernel
+        vs jnp composition for (o, lse) AND grads (the bias is the
+        additive-mask form — its own cotangent is zero)."""
+        from apex_tpu.ops.attention import (
+            flash_attention_with_lse,
+            mha_reference_with_lse,
+        )
+        from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
+
+        q, k, v = _rand_qkv(jax.random.PRNGKey(11))
+        keep = jax.random.bernoulli(
+            jax.random.PRNGKey(12), 0.8, (2, 1, 1, 128)
+        ).at[..., 0].set(True)  # every row keeps key 0
+        bias = jnp.where(keep, 0.0, MASK_VALUE)
+
+        def loss(fn, q, k, v):
+            o, lse = fn(q, k, v, bias)
+            return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse), (o, lse)
+
+        (_, (o, lse)), g = jax.value_and_grad(
+            lambda q, k, v: loss(flash_attention_with_lse, q, k, v),
+            argnums=(0, 1, 2), has_aux=True,
+        )(q, k, v)
+        _dispatch.set_use_pallas(False)
+        (_, (ow, lw)), gw = jax.value_and_grad(
+            lambda q, k, v: loss(mha_reference_with_lse, q, k, v),
+            argnums=(0, 1, 2), has_aux=True,
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(ow), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(lw), atol=2e-5, rtol=2e-5
+        )
+        for a, b_ in zip(g, gw):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+            )
+        # masked keys contribute nothing: their dk/dv are exactly zero
+        dk = np.asarray(g[1])
+        masked_cols = ~np.asarray(keep)[:, 0, 0]  # (B, Sk)
+        for bi in range(2):
+            np.testing.assert_allclose(
+                dk[bi][:, masked_cols[bi]], 0.0, atol=1e-6
+            )
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_grads_include_lse_cotangent(self, force_pallas, causal):
         """A loss that consumes BOTH outputs — the lse term exercises the

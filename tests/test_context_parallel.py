@@ -403,6 +403,75 @@ def test_zigzag_split_merge_roundtrip():
         np.testing.assert_array_equal(lo, np.asarray(x[:, :, :sc]))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_key_padding_bias_matches_full(eight_devices, causal):
+    """A per-rank (B, 1, 1, S_local) key-padding bias rotates around the
+    ring with kv: result == full attention under the GLOBAL mask
+    (values and grads) — variable-length long-document batches."""
+    from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
+
+    cp = 4
+    q, k, v = _qkv(jax.random.PRNGKey(13))
+    keep = jax.random.bernoulli(
+        jax.random.PRNGKey(14), 0.75, (B, 1, 1, S)
+    ).at[..., 0].set(True)  # every row keeps global key 0
+    bias = jnp.where(keep, 0.0, MASK_VALUE)
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=cp)
+
+    def f(q, k, v, bias):
+        rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
+        s_local = S // cp
+        bias_local = jax.lax.dynamic_slice_in_dim(
+            bias, rank * s_local, s_local, 3
+        )
+
+        def ring_loss(args):
+            q, k, v = args
+            o = ring_attention(q, k, v, bias_local, causal=causal)
+            return jax.lax.psum(jnp.sum(o.astype(jnp.float32) ** 2), "cp") / cp, o
+
+        (_, o), (gq, gk, gv) = jax.value_and_grad(
+            ring_loss, has_aux=True
+        )((q, k, v))
+        return o, gq, gk, gv
+
+    o, gq, gk, gv = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, None, "cp"),) * 3 + (P(),),
+            out_specs=(P(None, None, "cp"),) * 4, check_vma=False,
+        )
+    )(q, k, v, bias)
+    ps.destroy_model_parallel()
+
+    def golden(args):
+        q, k, v = args
+        o = mha_reference(q, k, v, bias, causal=causal)
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    (_, ow), (rq, rk, rv) = jax.value_and_grad(golden, has_aux=True)(
+        (q, k, v)
+    )
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(ow), atol=2e-5, rtol=2e-5
+    )
+    for g, r in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), atol=5e-4, rtol=1e-3
+        )
+
+
+def test_ring_bias_rejects_query_dependent_shape(eight_devices):
+    q, k, v = _qkv(jax.random.PRNGKey(15))
+    bad = jnp.zeros((B, 1, S // 2, S // 2))
+    with pytest.raises(ValueError, match="key-padding"):
+        _run_cp(
+            lambda q, k, v: ring_attention(q, k, v, bad[:, :, : S // 2]),
+            q, k, v, 2,
+        )
+
+
 def test_ring_dropout_requires_rng(eight_devices):
     q, k, v = _qkv(jax.random.PRNGKey(6))
     with pytest.raises(ValueError, match="dropout_rng"):
