@@ -220,10 +220,32 @@ _SLOW_EXACT = {
     "test_self_attn_key_padding_mask",
     "test_groupbn_value_and_grad[False-bfloat16]",
     "test_triangle_multiplicative_update_math[outgoing]",
-    # [sums] (the novel policy) carries the quick GPT remat signal
-    "test_gpt_remat_policy_preserves_values[dots]",
     # ring key-padding: non-causal carries the quick signal
     "test_ring_key_padding_bias_matches_full[True]",
+    # r4 third trim (row additions pushed the measured tier to 287 s;
+    # target ≤ 240 s — note this box's wall measurements wobble ±15 s
+    # with background load, so the tier is sized ~25 s under target;
+    # same-session measurements: 240/244/247 s across three runs of
+    # successively SMALLER sets): GPT remat-policy parity rides the
+    # full tier (the
+    # boundary drive + hand-1F1B policy test keep sums covered), LN
+    # keeps [True-bfloat16-shape0]/[True-float32-shape1,2] and the
+    # pallas-vs-jnp [True-True] ids, RNN and xentropy families ride the
+    # full tier (stable modules; their slow variants were already
+    # tiered), groupbn keeps [True-bfloat16]
+    "test_gpt_remat_policy_preserves_values[dots]",
+    "test_gpt_remat_policy_preserves_values[sums]",
+    "test_layer_norm_affine_fwd_bwd[True-bfloat16-shape1]",
+    "test_layer_norm_affine_fwd_bwd[True-bfloat16-shape2]",
+    "test_layer_norm_affine_fwd_bwd[True-float32-shape0]",
+    "test_shapes_and_grad[RNNTanh]",
+    "test_groupbn_value_and_grad[True-float32]",
+    "test_pallas_kernel_matches_jnp_path[True-False]",
+    "test_xentropy_fwd_bwd[0.1-bfloat16]",
+    # fused-softmax + vocab-parallel-CE families ride the full tier
+    # (8+ slow variants each; the quick tier keeps the TP layer tests)
+    "test_scaled_masked_softmax",
+    "test_vocab_parallel_cross_entropy_matches_full[0.1]",
 }
 
 
