@@ -111,15 +111,17 @@ def test_flash_vs_reference_fuzz(seed):
 @pytest.mark.parametrize("seed", range(6))
 def test_with_lse_fuzz(seed):
     """flash_attention_with_lse (the ring-attention building block) under
-    random aligned shapes: (o, lse) and grads — INCLUDING the lse
-    cotangent the ring merge differentiates through — kernel vs jnp."""
+    random aligned shapes × optional key-padding bias: (o, lse) and
+    grads — INCLUDING the lse cotangent the ring merge differentiates
+    through — kernel vs jnp."""
     from apex_tpu.ops.attention import flash_attention_with_lse
+    from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
 
     rng = np.random.default_rng(77 + seed)
     b = int(rng.integers(1, 3))
     h = int(rng.integers(1, 3))
     d = int(rng.choice([32, 64]))
-    # aligned shapes only (the lse variant has no pad/bias plumbing):
+    # aligned shapes only (the lse variant has no pad plumbing):
     # multiples of the sublane/lane quantum
     sq = int(rng.choice([16, 64, 128, 256]))
     sk = int(rng.choice([16, 64, 128, 256]))
@@ -127,25 +129,36 @@ def test_with_lse_fuzz(seed):
     if causal and sk < sq:
         sk = sq
     dtype = jnp.bfloat16 if rng.integers(0, 2) else jnp.float32
+    with_bias = bool(rng.integers(0, 2))
     tol = (
         dict(rtol=3e-2, atol=3e-2)
         if dtype == jnp.bfloat16
         else dict(rtol=3e-4, atol=3e-4)
     )
     key = jax.random.PRNGKey(seed)
-    kq, kk, kv, kc = jax.random.split(key, 4)
+    kq, kk, kv, kc, kb = jax.random.split(key, 5)
     q = jax.random.normal(kq, (b, h, sq, d), dtype)
     k = jax.random.normal(kk, (b, h, sk, d), dtype)
     v = jax.random.normal(kv, (b, h, sk, d), dtype)
+    bias = None
+    if with_bias:
+        # key-padding mask; key 0 always kept so no row is fully masked
+        keep = jax.random.bernoulli(
+            kb, 0.75, (b, 1, 1, sk)
+        ).at[..., 0].set(True)
+        bias = jnp.where(keep, 0.0, MASK_VALUE)
     # a fixed random cotangent for lse so its backward path is exercised
     dlse_w = jax.random.normal(kc, (b, h, sq), jnp.float32)
-    desc = f"b={b} h={h} d={d} sq={sq} sk={sk} causal={causal} {dtype.__name__}"
+    desc = (f"b={b} h={h} d={d} sq={sq} sk={sk} causal={causal} "
+            f"{dtype.__name__} bias={with_bias}")
 
     def run(forced):
         _dispatch.set_use_pallas(forced)
         try:
             def loss(q, k, v):
-                o, lse = flash_attention_with_lse(q, k, v, causal=causal)
+                o, lse = flash_attention_with_lse(
+                    q, k, v, bias, causal=causal
+                )
                 return (
                     jnp.sum(o.astype(jnp.float32) ** 2)
                     + jnp.sum(lse * dlse_w),
