@@ -134,13 +134,15 @@ def ring_attention(
 
     ``bias``: a per-rank KEY-PADDING mask of shape ``(B, 1, 1,
     S_local)`` (additive, non-trainable, MASK_VALUE-clamped) covering
-    this rank's OWN kv chunk — it rotates around the ring with (k, v),
-    so every hop masks the padded keys of whichever chunk it attends.
-    Variable-length long-document batches are the use case; each query
-    row must keep at least one unmasked key globally.  Query-dependent
-    bias shapes are rejected (they cannot rotate with kv; fold such
-    terms into the model instead).  Not supported with
-    ``layout="zigzag"`` yet.
+    this rank's OWN kv chunk — in the rank's configured layout, so
+    under ``layout="zigzag"`` its halves cover the rank's two global
+    chunks (``zigzag_shard`` the global mask along its key axis).  It
+    rotates around the ring with (k, v), so every hop masks the padded
+    keys of whichever chunk it attends.  Variable-length long-document
+    batches are the use case; each query row must keep at least one
+    unmasked key globally.  Query-dependent bias shapes are rejected
+    (they cannot rotate with kv; fold such terms into the model
+    instead).
 
     ``dropout_p`` > 0 (with ``dropout_rng``) applies attention dropout
     that composes exactly with the ring merge: each (q-rank, kv-chunk)
@@ -157,11 +159,6 @@ def ring_attention(
     if dropout_p > 0.0 and dropout_rng is None:
         raise ValueError("dropout_p > 0 requires dropout_rng")
     if bias is not None:
-        if layout == "zigzag":
-            raise ValueError(
-                "ring_attention: bias is not supported with "
-                "layout='zigzag' yet"
-            )
         if bias.ndim < 4:
             bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
         if bias.shape[1] != 1 or bias.shape[2] != 1:
@@ -185,7 +182,7 @@ def ring_attention(
                 "contiguous layout"
             )
         return _ring_attention_zigzag(
-            q, k, v, scale, dropout_p, dropout_rng, axis_name
+            q, k, v, bias, scale, dropout_p, dropout_rng, axis_name
         )
     if layout != "contiguous":
         raise ValueError(f"unknown ring layout {layout!r}")
@@ -295,7 +292,7 @@ def zigzag_merge(locals_, cp: int, axis: int = 2):
     return jnp.concatenate(out, axis=axis)
 
 
-def _ring_attention_zigzag(q, k, v, scale, dropout_p, dropout_rng,
+def _ring_attention_zigzag(q, k, v, bias, scale, dropout_p, dropout_rng,
                            axis_name):
     """Causal ring attention over the zigzag layout: this rank's
     ``S_local`` rows are [global chunk ``r``; global chunk ``2cp−1−r``].
@@ -330,32 +327,38 @@ def _ring_attention_zigzag(q, k, v, scale, dropout_p, dropout_rng,
 
     @jax.checkpoint
     def hop(q_lo, q_hi, kv, src):
-        k_lo, v_lo, k_hi, v_hi = kv
+        k_lo, v_lo, k_hi, v_hi, b_lo, b_hi = kv
+        blo = {} if b_lo is None else dict(bias=b_lo)
+        bhi = {} if b_hi is None else dict(bias=b_hi)
         # lo (global chunk rank) vs lo' (global chunk src)
         lo = jax.lax.switch(
             jnp.where(src == rank, 0, jnp.where(src < rank, 1, 2)),
             [
                 lambda _: _block_attend(
-                    q_lo, k_lo, v_lo, scale, causal=True, **_drop(src, 0)
+                    q_lo, k_lo, v_lo, scale, causal=True,
+                    **blo, **_drop(src, 0)
                 ),
                 lambda _: _block_attend(
-                    q_lo, k_lo, v_lo, scale, **_drop(src, 0)
+                    q_lo, k_lo, v_lo, scale, **blo, **_drop(src, 0)
                 ),
                 lambda _: skip,
             ],
             None,
         )
         # hi (chunk 2cp−1−rank) vs lo' (chunk src < cp): always past
-        hi_lo = _block_attend(q_hi, k_lo, v_lo, scale, **_drop(src, 1))
+        hi_lo = _block_attend(
+            q_hi, k_lo, v_lo, scale, **blo, **_drop(src, 1)
+        )
         # hi vs hi' (chunk 2cp−1−src): past iff src > rank
         hi_hi = jax.lax.switch(
             jnp.where(src == rank, 0, jnp.where(src > rank, 1, 2)),
             [
                 lambda _: _block_attend(
-                    q_hi, k_hi, v_hi, scale, causal=True, **_drop(src, 2)
+                    q_hi, k_hi, v_hi, scale, causal=True,
+                    **bhi, **_drop(src, 2)
                 ),
                 lambda _: _block_attend(
-                    q_hi, k_hi, v_hi, scale, **_drop(src, 2)
+                    q_hi, k_hi, v_hi, scale, **bhi, **_drop(src, 2)
                 ),
                 lambda _: skip,
             ],
@@ -363,9 +366,19 @@ def _ring_attention_zigzag(q, k, v, scale, dropout_p, dropout_rng,
         )
         return lo, hi_lo, hi_hi
 
+    b_lo = b_hi = None
+    if bias is not None:
+        # the (B, 1, 1, S_local) key-padding mask splits into the two
+        # chunk halves and rotates with them; a broadcast (..., 1) mask
+        # applies to both halves as-is
+        if bias.shape[-1] == 1:
+            b_lo = b_hi = bias
+        else:
+            b_lo, b_hi = bias[..., :half], bias[..., half:]
     kv0 = (
         k[:, :, :half], v[:, :, :half],
         k[:, :, half:], v[:, :, half:],
+        b_lo, b_hi,
     )
     lo0, hi_lo0, hi_hi0 = hop(q_lo, q_hi, kv0, rank)
     ones = jnp.ones((b, h, half), jnp.float32)

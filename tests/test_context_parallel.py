@@ -403,19 +403,25 @@ def test_zigzag_split_merge_roundtrip():
         np.testing.assert_array_equal(lo, np.asarray(x[:, :, :sc]))
 
 
+def _padding_bias(key, p_keep=0.75):
+    """Random (B, 1, 1, S) key-padding mask; global key 0 always kept so
+    no row is fully masked."""
+    from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
+
+    keep = jax.random.bernoulli(
+        key, p_keep, (B, 1, 1, S)
+    ).at[..., 0].set(True)
+    return jnp.where(keep, 0.0, MASK_VALUE)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_key_padding_bias_matches_full(eight_devices, causal):
     """A per-rank (B, 1, 1, S_local) key-padding bias rotates around the
     ring with kv: result == full attention under the GLOBAL mask
     (values and grads) — variable-length long-document batches."""
-    from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
-
     cp = 4
     q, k, v = _qkv(jax.random.PRNGKey(13))
-    keep = jax.random.bernoulli(
-        jax.random.PRNGKey(14), 0.75, (B, 1, 1, S)
-    ).at[..., 0].set(True)  # every row keeps global key 0
-    bias = jnp.where(keep, 0.0, MASK_VALUE)
+    bias = _padding_bias(jax.random.PRNGKey(14))
 
     mesh = ps.initialize_model_parallel(context_parallel_size=cp)
 
@@ -460,6 +466,79 @@ def test_ring_key_padding_bias_matches_full(eight_devices, causal):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(r), atol=5e-4, rtol=1e-3
         )
+
+
+def test_ring_zigzag_key_padding_bias_matches_full(eight_devices):
+    """Key-padding bias under the zigzag layout: the per-rank mask's
+    halves ride the kv halves around the ring == full causal attention
+    under the global mask — values AND grads (the bias halves ride the
+    checkpointed hop and the ppermute scan carry in backward).  Also
+    pins the broadcast (..., 1) mask branch."""
+    from apex_tpu.transformer.context_parallel import (
+        zigzag_merge,
+        zigzag_shard,
+        zigzag_split,
+    )
+
+    cp = 4
+    q, k, v = _qkv(jax.random.PRNGKey(16))
+    bias = _padding_bias(jax.random.PRNGKey(17))
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=cp)
+    qs, ks, vs = (zigzag_split(x, cp) for x in (q, k, v))
+
+    def f(q, k, v, bias):
+        rank = jax.lax.axis_index(ps.CONTEXT_PARALLEL_AXIS)
+        bias_local = zigzag_shard(bias, rank, cp, axis=3)
+
+        def ring_loss(args):
+            o = ring_attention(
+                args[0], args[1], args[2], bias_local,
+                causal=True, layout="zigzag",
+            )
+            return jax.lax.psum(
+                jnp.sum(o.astype(jnp.float32) ** 2), "cp"
+            ) / cp, o
+
+        (_, o), (gq, gk, gv) = jax.value_and_grad(
+            ring_loss, has_aux=True
+        )((q[0], k[0], v[0]))
+        # broadcast (..., 1) mask branch: a zero bias must be a no-op
+        o_b1 = ring_attention(
+            q[0], k[0], v[0], jnp.zeros((B, 1, 1, 1)),
+            causal=True, layout="zigzag",
+        )
+        o_nb = ring_attention(
+            q[0], k[0], v[0], causal=True, layout="zigzag"
+        )
+        return o[None], gq[None], gk[None], gv[None], o_b1[None], o_nb[None]
+
+    o, gq, gk, gv, o_b1, o_nb = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P("cp"),) * 3 + (P(),),
+            out_specs=(P("cp"),) * 6, check_vma=False,
+        )
+    )(qs, ks, vs, bias)
+    ps.destroy_model_parallel()
+
+    def golden(args):
+        o = mha_reference(*args, bias, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    (_, ow), (rq, rk, rv) = jax.value_and_grad(golden, has_aux=True)(
+        (q, k, v)
+    )
+    np.testing.assert_allclose(
+        np.asarray(zigzag_merge(o, cp)), np.asarray(ow),
+        atol=2e-5, rtol=2e-5,
+    )
+    for g, r in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(
+            zigzag_merge(g, cp), np.asarray(r), atol=5e-4, rtol=1e-3
+        )
+    np.testing.assert_allclose(
+        np.asarray(o_b1), np.asarray(o_nb), atol=1e-6, rtol=1e-6
+    )
 
 
 def test_ring_bias_rejects_query_dependent_shape(eight_devices):
