@@ -6,6 +6,7 @@ from apex_tpu.transformer.pipeline_parallel import (  # noqa: F401
 from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_no_pipelining,
     forward_backward_pipelining_1f1b,
+    forward_backward_pipelining_interleaved_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,

@@ -50,6 +50,7 @@ __all__ = [
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_1f1b",
     "forward_backward_pipelining_with_interleaving",
+    "forward_backward_pipelining_interleaved_1f1b",
     "get_forward_backward_func",
 ]
 
@@ -380,12 +381,24 @@ def forward_backward_pipelining_1f1b(
         # residual LEAVES line up one-to-one with the template's — that
         # is what the ring relies on, so pin it structurally.
         f_leaves, f_def = tree.tree_flatten(vjp_f)
-        assert [(l.shape, l.dtype) for l in f_leaves] == [
+        # Explicit raises, not asserts: these guard tracer-identity
+        # invariants a future JAX change could break silently, and must
+        # survive ``python -O`` (they run at trace time, so they're free
+        # at execution time).
+        if [(l.shape, l.dtype) for l in f_leaves] != [
             (l.shape, l.dtype) for l in t_leaves
-        ], "vjp residual structure changed across ticks"
-        assert [
+        ]:
+            raise RuntimeError(
+                "hand-1F1B ring invariant violated: vjp residual "
+                "structure changed across ticks"
+            )
+        if [
             i for i, l in enumerate(f_leaves) if id(l) not in param_ids
-        ] == varying, "param-passthrough residual positions changed"
+        ] != varying:
+            raise RuntimeError(
+                "hand-1F1B ring invariant violated: param-passthrough "
+                "residual positions changed across ticks"
+            )
         slot_f = t % window
         if stash == "residuals":
             ring = [
@@ -473,6 +486,346 @@ def _loss_and_head_grads(lfn, params, y, tgt, loss_takes_params):
     loss, dvjp = jax.vjp(lambda y_: lfn(params, y_, tgt), y)
     (dy,) = dvjp(jnp.ones((), loss.dtype))
     return loss, (None, dy)
+
+
+# ---------------------------------------------------------------------------
+# hand-scheduled interleaved 1F1B: chunk-granular stash ring, three phases
+# ---------------------------------------------------------------------------
+
+
+def forward_backward_pipelining_interleaved_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params,
+    batch: Tuple[Any, Any],
+    *,
+    num_microbatches: int,
+    num_model_chunks: Optional[int] = None,
+    axis_name: str = _PP,
+    forward_only: bool = False,
+    stash: str = "residuals",
+    remat: bool = False,
+    remat_policy=None,
+    loss_takes_params: bool = False,
+):
+    """True interleaved (virtual-stage) 1F1B with an explicit chunk-stash
+    ring and NO autodiff over the tick loop — ≙ the reference's
+    ``_forward_backward_pipelining_with_interleaving`` memory/compute
+    point (SURVEY §2.3, §3.5): bubble **(pp−1)/vpp** per direction with
+    no recompute premium, in-flight stashes bounded independent of
+    ``num_microbatches``.
+
+    This extends :func:`forward_backward_pipelining_1f1b`'s machinery to
+    model chunks.  ``params`` hold this rank's ``num_model_chunks`` stage
+    chunks stacked on a leading axis (rank ``r`` owns virtual stages
+    ``r, r+pp, …``, exactly like the lockstep interleaved schedule).  A
+    tick is **chunk-granular** (1/vpp of a stage) and the program runs
+    three lockstep phases so warmup/cooldown ticks never pay for a
+    masked opposite-direction lane:
+
+    * warmup — ``V−1`` fwd-only ticks (``V = pp·vpp``): the virtual pipe
+      fills at one virtual stage per tick;
+    * steady — ``nm·vpp + pp − V`` fwd+bwd ticks: each tick runs one
+      chunk forward AND one chunk backward (on a different microbatch),
+      the 1F1B overlap;
+    * cooldown — ``V−1`` bwd-only ticks: the cotangent drains.
+
+    Wall = ``(V−1)·t_f/vpp + (nm·vpp+pp−V)·(t_f+t_b)/vpp + (V−1)·t_b/vpp
+    = nm·(t_f+t_b) + (pp−1)·(t_f+t_b)/vpp`` — the Megatron interleaving
+    bubble exactly, vs ``2(pp−1)·(t_f+t_b)`` for the single-phase plain
+    hand schedule (docs/pipeline-schedules.md has the derivation and the
+    measured memory frontier).
+
+    Timetable.  Forward: rank ``r`` runs chunk ``c`` of microbatch
+    ``m = g·pp + j`` at tick ``t = g·pp·vpp + c·pp + j + r`` (Megatron's
+    round-robin order — groups of ``pp`` microbatches per chunk).
+    Backward mirrors at one virtual stage per tick:
+    ``T_b(m,v) = T_f(m,V−1) + (V−1−v)`` for global virtual stage
+    ``v = c·pp + r``, i.e. rank ``r`` backwards ``(c_b, m_b)`` at tick
+    ``t`` where ``w = t + r − (V+pp−2)``, ``c_b = vpp−1 − (w mod V)//pp``,
+    ``m_b = (w//V)·pp + (w mod pp)``.  Cotangents ride a **cyclic**
+    reversed ppermute (rank 0 → pp−1 wraps to the previous chunk), the
+    dual of the forward wrap.
+
+    The stash ring has ``W = 2V−1`` chunk-granular slots (max in-flight
+    span ``T_b−T_f = 2(V−1−v) ≤ W−1``): forward at tick ``t`` writes slot
+    ``t mod W``; backward reads slot ``(t + 2·v_b + 1) mod W``.  Ring
+    memory ≈ ``2V × (stage residuals / vpp) = 2pp × stage residuals`` —
+    the SAME total as the plain hand schedule, and flat in ``nm``
+    (matching Megatron interleaved's O(pp·vpp) in-flight chunk window).
+
+    Chunk-param handling: the per-tick vjp is taken wrt the *sliced*
+    chunk params, so residual leaves that are chunk-param passthroughs
+    cannot be detected against the stacked tree by tracer identity the
+    way the plain schedule does.  Instead the template trace records, for
+    each passthrough residual position, WHICH chunk-param leaf flows
+    through it; at backward time that position is re-materialized by
+    dynamically indexing the backward tick's chunk — so weights are never
+    ring-stashed.  Param-derived (non-passthrough) residuals are stashed
+    per chunk, which is exactly what correctness requires (they were
+    computed from that chunk's weights).
+
+    ``stash``/``remat``/``remat_policy``/``loss_takes_params`` as in
+    :func:`forward_backward_pipelining_1f1b`.  Requires
+    ``num_microbatches % pp == 0`` (the reference's interleaving
+    constraint).
+    """
+    if stash not in ("residuals", "input"):
+        raise ValueError(f"unknown stash mode {stash!r}")
+    inputs, targets = batch
+    nm = num_microbatches
+    if num_model_chunks is None:
+        num_model_chunks = ps.get_virtual_pipeline_model_parallel_world_size()
+    vpp = num_model_chunks
+    if vpp is None or vpp < 1:
+        raise ValueError("num_model_chunks (virtual pipeline size) required")
+    run = _wrap_remat(stage_fn, remat, remat_policy)
+    lfn = loss_fn if loss_takes_params else (lambda p, y, t: loss_fn(y, t))
+
+    if forward_only:
+        losses, _ = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, params, batch, num_microbatches=nm,
+            num_model_chunks=vpp, axis_name=axis_name, forward_only=True,
+            remat=False, loss_takes_params=loss_takes_params,
+        )
+        return losses, None
+
+    pp = jax.lax.axis_size(axis_name)
+    if nm % pp != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches ({nm}) to "
+            f"be a multiple of pipeline_parallel_size ({pp})"
+        )
+    stage = jax.lax.axis_index(axis_name)
+    is_first = stage == 0
+    is_last = stage == pp - 1
+    V = pp * vpp           # virtual pipeline depth == round-robin cycle
+    W = 2 * V - 1          # ring slots: max in-flight span + 1
+    tree = jax.tree_util
+
+    h0 = tree.tree_map(lambda x: jnp.zeros_like(x[0]), inputs)
+
+    def chunk_at(idx):
+        return tree.tree_map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False),
+            params,
+        )
+
+    def stage_vjp(p, x):
+        return jax.vjp(lambda p_, x_: run(p_, x_), p, x)
+
+    # Template trace (outside the loop): pins the residual pytree
+    # structure and maps each chunk-param passthrough residual position
+    # to the chunk-param leaf that flows through it.
+    chunk_t = tree.tree_map(lambda x: x[0], params)
+    y_t, vjp_t = stage_vjp(chunk_t, h0)
+    t_leaves, _ = tree.tree_flatten(vjp_t)
+    cp_pos_t = {id(l): i for i, l in enumerate(tree.tree_leaves(chunk_t))}
+    passthrough = {
+        pos: cp_pos_t[id(l)]
+        for pos, l in enumerate(t_leaves)
+        if id(l) in cp_pos_t
+    }
+    varying = [p for p in range(len(t_leaves)) if p not in passthrough]
+    t_shapes = [(l.shape, l.dtype) for l in t_leaves]
+
+    def check_residual_contract(f_leaves, cp_leaves):
+        # Explicit raises (not asserts — must survive ``python -O``):
+        # trace-time guards on the tracer-identity invariants the ring
+        # substitution relies on.
+        if [(l.shape, l.dtype) for l in f_leaves] != t_shapes:
+            raise RuntimeError(
+                "interleaved hand-1F1B ring invariant violated: vjp "
+                "residual structure changed across ticks"
+            )
+        cp_pos = {id(l): i for i, l in enumerate(cp_leaves)}
+        got = {
+            pos: cp_pos[id(l)]
+            for pos, l in enumerate(f_leaves)
+            if id(l) in cp_pos
+        }
+        if got != passthrough:
+            raise RuntimeError(
+                "interleaved hand-1F1B ring invariant violated: "
+                "chunk-param passthrough residual positions changed"
+            )
+
+    if stash == "residuals":
+        ring0 = [
+            jnp.zeros((W,) + t_leaves[i].shape, t_leaves[i].dtype)
+            for i in varying
+        ]
+    else:
+        ring0 = [
+            jnp.zeros((W,) + l.shape, l.dtype)
+            for l in tree.tree_leaves(h0)
+        ]
+    x_def = tree.tree_structure(h0)
+    g0 = tree.tree_map(jnp.zeros_like, y_t)
+    dp0 = tree.tree_map(jnp.zeros_like, params)
+
+    def scatter_add(acc, d, idx):
+        cur = jax.lax.dynamic_index_in_dim(acc, idx, 0, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(acc, cur + d, idx, 0)
+
+    def make_tick(do_fwd, do_bwd):
+        def tick(carry, t):
+            h_recv, g_recv, ring, dp_acc, losses = carry
+            dy = None
+            f_pack = None
+
+            if do_fwd:
+                # ---- forward lane: chunk c_f of microbatch m_f ---------
+                u = t - stage
+                c_f = jnp.clip(jnp.mod(u, V) // pp, 0, vpp - 1)
+                m_f = jnp.floor_divide(u, V) * pp + jnp.mod(u, pp)
+                active_f = (u >= 0) & (u < nm * vpp)
+                m_f_c = jnp.clip(m_f, 0, nm - 1)
+                injecting = is_first & (c_f == 0) & active_f
+                inject = tree.tree_map(lambda x: x[m_f_c], inputs)
+                x_in = tree.tree_map(
+                    lambda a, b: jnp.where(injecting, a, b), inject, h_recv
+                )
+                cp_f = chunk_at(c_f)
+                y, vjp_f = stage_vjp(cp_f, x_in)
+                f_leaves, f_def = tree.tree_flatten(vjp_f)
+                check_residual_contract(f_leaves, tree.tree_leaves(cp_f))
+                slot_f = jnp.mod(t, W)
+                if stash == "residuals":
+                    ring = [
+                        r.at[slot_f].set(f_leaves[i])
+                        for r, i in zip(ring, varying)
+                    ]
+                else:
+                    ring = [
+                        r.at[slot_f].set(l)
+                        for r, l in zip(ring, tree.tree_leaves(x_in))
+                    ]
+                f_pack = (f_leaves, f_def)
+
+                # ---- loss lane: last rank finishing its last chunk -----
+                finishing = active_f & is_last & (c_f == vpp - 1)
+                tgt = tree.tree_map(lambda x: x[m_f_c], targets)
+                loss, (dhead, dy) = _loss_and_head_grads(
+                    lfn, cp_f, y, tgt, loss_takes_params
+                )
+                losses = losses.at[m_f_c].add(
+                    jnp.where(finishing, loss, 0.0)
+                )
+                wt = jnp.where(finishing, 1.0 / nm, 0.0)
+                # dy may be non-finite on bubble ticks; every consumer
+                # SELECTS with where() (finishing/active_b below).  dhead
+                # is accumulated, so it needs a select, not the multiply.
+                dy = tree.tree_map(lambda g: g * wt, dy)
+                if dhead is not None:
+                    dp_acc = tree.tree_map(
+                        lambda a, d: scatter_add(
+                            a,
+                            jnp.where(
+                                finishing, d * (1.0 / nm), jnp.zeros_like(d)
+                            ),
+                            c_f,
+                        ),
+                        dp_acc, dhead,
+                    )
+                h_next = p2p.send_forward_recv_forward(
+                    y, axis_name, cyclic=True
+                )
+            else:
+                h_next = h_recv
+
+            if do_bwd:
+                # ---- backward lane: mirror timetable -------------------
+                w = t + stage - (V + pp - 2)
+                active_b = (w >= 0) & (w < nm * vpp)
+                c_b = jnp.clip(
+                    vpp - 1 - jnp.mod(w, V) // pp, 0, vpp - 1
+                )
+                cp_b = chunk_at(c_b)
+                v_b = c_b * pp + stage
+                slot_b = jnp.mod(t + 2 * v_b + 1, W)
+                if stash == "residuals":
+                    if f_pack is not None:
+                        leaves_b, f_def = list(f_pack[0]), f_pack[1]
+                    else:
+                        # cooldown: no forward lane this tick, so trace a
+                        # dummy vjp purely for a fresh treedef — every
+                        # residual leaf is substituted below, so the dummy
+                        # forward is dead code and XLA DCEs it.
+                        _, vjp_d = stage_vjp(cp_b, h0)
+                        leaves_d, f_def = tree.tree_flatten(vjp_d)
+                        check_residual_contract(
+                            leaves_d, tree.tree_leaves(cp_b)
+                        )
+                        leaves_b = list(leaves_d)
+                    # chunk-param passthroughs: re-materialize from the
+                    # BACKWARD tick's chunk (never ring-stashed)
+                    cpb_leaves = tree.tree_leaves(cp_b)
+                    for pos, pidx in passthrough.items():
+                        leaves_b[pos] = cpb_leaves[pidx]
+                    for r, pos in zip(ring, varying):
+                        leaves_b[pos] = r[slot_b]
+                    vjp_b = tree.tree_unflatten(f_def, leaves_b)
+                else:
+                    x_b = tree.tree_unflatten(
+                        x_def, [r[slot_b] for r in ring]
+                    )
+                    _, vjp_b = stage_vjp(cp_b, x_b)
+                if do_fwd:
+                    # rank pp−1 backwarding chunk vpp−1 consumes the dy
+                    # its OWN forward lane produced this very tick
+                    g_in = tree.tree_map(
+                        lambda a, b: jnp.where(
+                            is_last & (c_b == vpp - 1), a, b
+                        ),
+                        dy, g_recv,
+                    )
+                else:
+                    g_in = g_recv
+                g_in = tree.tree_map(
+                    lambda g: jnp.where(active_b, g, jnp.zeros_like(g)),
+                    g_in,
+                )
+                dp, dx = vjp_b(g_in)
+                # Zero cotangent is NOT enough to null a bubble tick (a
+                # zero ring slot can make the vjp emit 0*inf=NaN) — mask
+                # the OUTPUTS too.
+                dp = tree.tree_map(
+                    lambda d: jnp.where(active_b, d, jnp.zeros_like(d)),
+                    dp,
+                )
+                dx = tree.tree_map(
+                    lambda d: jnp.where(active_b, d, jnp.zeros_like(d)),
+                    dx,
+                )
+                dp_acc = tree.tree_map(
+                    lambda a, d: scatter_add(a, d, c_b), dp_acc, dp
+                )
+                g_next = p2p.send_backward_recv_backward(
+                    dx, axis_name, cyclic=True
+                )
+            else:
+                g_next = g_recv
+
+            return (h_next, g_next, ring, dp_acc, losses), None
+
+        return tick
+
+    total = nm * vpp + V + pp - 2
+    b1 = V - 1               # warmup end: fwd-only ticks [0, b1)
+    b2 = nm * vpp + pp - 1   # steady end: fwd+bwd ticks [b1, b2)
+    carry = (h0, g0, ring0, dp0, jnp.zeros((nm,), jnp.float32))
+    carry, _ = jax.lax.scan(
+        make_tick(True, False), carry, jnp.arange(0, b1)
+    )
+    carry, _ = jax.lax.scan(
+        make_tick(True, True), carry, jnp.arange(b1, b2)
+    )
+    carry, _ = jax.lax.scan(
+        make_tick(False, True), carry, jnp.arange(b2, total)
+    )
+    _, _, _, grads, losses = carry
+    return jax.lax.psum(losses, axis_name), grads
 
 
 # ---------------------------------------------------------------------------
@@ -623,10 +976,11 @@ def get_forward_backward_func(
 ):
     """≙ schedules/__init__.py :: get_forward_backward_func.
 
-    ``hand_scheduled=True`` opts the non-interleaved case into
-    :func:`forward_backward_pipelining_1f1b` (explicit O(pp) stash ring,
-    no autodiff over the tick loop) — the reference's 1F1B memory point;
-    see docs/pipeline-schedules.md for when each wins."""
+    ``hand_scheduled=True`` opts into the explicit-stash-ring schedules
+    (no autodiff over the tick loop — the reference's 1F1B memory
+    points): :func:`forward_backward_pipelining_1f1b` without virtual
+    stages, :func:`forward_backward_pipelining_interleaved_1f1b` with
+    them; see docs/pipeline-schedules.md for when each wins."""
     if pipeline_model_parallel_size is None and ps.model_parallel_is_initialized():
         pipeline_model_parallel_size = ps.get_pipeline_model_parallel_world_size()
     if virtual_pipeline_model_parallel_size is None and ps.model_parallel_is_initialized():
@@ -637,7 +991,9 @@ def get_forward_backward_func(
         return forward_backward_no_pipelining
     if virtual_pipeline_model_parallel_size is not None:
         return functools.partial(
-            forward_backward_pipelining_with_interleaving,
+            forward_backward_pipelining_interleaved_1f1b
+            if hand_scheduled
+            else forward_backward_pipelining_with_interleaving,
             num_model_chunks=virtual_pipeline_model_parallel_size,
         )
     if hand_scheduled:

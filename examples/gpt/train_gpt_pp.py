@@ -60,6 +60,7 @@ from apex_tpu.models.bert import BertConfig, BertEncoderCore
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_pipelining_1f1b,
+    forward_backward_pipelining_interleaved_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
 )
@@ -71,9 +72,10 @@ def parse_args():
     p.add_argument("--vpp", type=int, default=0,
                    help="virtual chunks/rank (0 = non-interleaved)")
     p.add_argument("--hand-1f1b", action="store_true",
-                   help="hand-scheduled 1F1B (O(pp) stash ring, flat in "
-                        "--nm; see docs/pipeline-schedules.md) instead "
-                        "of the lockstep scan; excludes --vpp")
+                   help="hand-scheduled 1F1B (explicit stash ring, flat "
+                        "in --nm; see docs/pipeline-schedules.md) instead "
+                        "of the lockstep scan; with --vpp, uses the hand "
+                        "interleaved schedule (bubble (pp-1)/vpp)")
     p.add_argument("--stash", choices=["residuals", "input"],
                    default="residuals",
                    help="hand-1F1B ring contents (residuals = "
@@ -98,8 +100,6 @@ def main():
         raise SystemExit("--layers must divide pp * max(vpp, 1)")
     if vpp and args.nm % pp:
         raise SystemExit("interleaving requires --nm divisible by --pp")
-    if args.hand_1f1b and vpp:
-        raise SystemExit("--hand-1f1b does not interleave; drop --vpp")
 
     mesh = ps.initialize_model_parallel(
         pipeline_model_parallel_size=pp,
@@ -179,7 +179,13 @@ def main():
         }
 
     def train_step(params, opt_state, xs, tgts):
-        if vpp:
+        if vpp and args.hand_1f1b:
+            losses, grads = forward_backward_pipelining_interleaved_1f1b(
+                stage_fn, loss_fn, params, (xs, tgts),
+                num_microbatches=args.nm, num_model_chunks=vpp,
+                loss_takes_params=True, stash=args.stash,
+            )
+        elif vpp:
             losses, grads = forward_backward_pipelining_with_interleaving(
                 stage_fn, loss_fn, params, (xs, tgts),
                 num_microbatches=args.nm, num_model_chunks=vpp,
@@ -251,7 +257,9 @@ def main():
     )
     params, opt_state = boot(jax.random.PRNGKey(0))
 
-    if vpp:
+    if vpp and args.hand_1f1b:
+        sched = f"hand-interleaved-1F1B vpp={vpp} stash={args.stash}"
+    elif vpp:
         sched = f"interleaved vpp={vpp}"
     elif args.hand_1f1b:
         sched = f"hand-1F1B stash={args.stash}"

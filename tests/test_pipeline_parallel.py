@@ -678,6 +678,304 @@ def test_hand_1f1b_forward_only(eight_devices):
     )
 
 
+# ---------------------------------------------------------------------------
+# hand-scheduled interleaved 1F1B (chunk stash ring, three lockstep phases)
+# ---------------------------------------------------------------------------
+
+
+def _run_hand_interleaved(mesh, pp, vpp, stacked, inputs, targets, nm, **kw):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_interleaved_1f1b,
+    )
+
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, grads = forward_backward_pipelining_interleaved_1f1b(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=nm, num_model_chunks=vpp, **kw,
+        )
+        grads = jax.tree_util.tree_map(lambda v: v[:, None], grads)
+        return losses, grads
+
+    return jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P(None, "pp"), P(), P()),
+            out_specs=(P(), P(None, "pp")), check_vma=False,
+        )
+    )(regrouped, inputs, targets)
+
+
+@pytest.mark.parametrize("stash", ["residuals", "input"])
+def test_hand_interleaved_matches_sequential(eight_devices, stash):
+    """The hand interleaved schedule (chunk-granular stash ring, three
+    lockstep phases, grads computed with no autodiff over the tick
+    loop) reproduces the sequential golden for both stash modes."""
+    pp, vpp, nm = 2, 2, 6
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual)
+    inputs, targets = make_batch()
+    losses, grads = _run_hand_interleaved(
+        mesh, pp, vpp, stacked, inputs, targets, nm, stash=stash
+    )
+    ref_losses, ref_grads = sequential_reference(
+        stacked, inputs, targets, n_virtual
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(n_virtual, *stacked[k].shape[1:])
+        np.testing.assert_allclose(
+            got, np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+        )
+
+
+@pytest.mark.slow
+def test_hand_interleaved_deep_virtual_pipe(eight_devices):
+    """pp=4, vpp=2 (8 virtual stages): warmup/cooldown span V-1=7 chunk
+    ticks and the ring wraps its full 2V-1 window."""
+    pp, vpp, nm = 4, 2, 8
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual, seed=11)
+    rng = np.random.RandomState(12)
+    inputs = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    losses, grads = _run_hand_interleaved(
+        mesh, pp, vpp, stacked, inputs, targets, nm, stash="residuals"
+    )
+    ref_losses, ref_grads = sequential_reference(
+        stacked, inputs, targets, n_virtual
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(n_virtual, *stacked[k].shape[1:])
+        np.testing.assert_allclose(
+            got, np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_hand_interleaved_loss_takes_params(eight_devices):
+    """Megatron post-process head: loss-side grads land on the LAST
+    model chunk (index vpp-1) of the last rank via the scatter lane."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_interleaved_1f1b,
+    )
+
+    pp, vpp, nm = 2, 2, 4
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual)
+    rng = np.random.RandomState(2)
+    inputs = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def head_loss(p, y, t):
+        return jnp.mean((y + p["b"] - t) ** 2)
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, grads = forward_backward_pipelining_interleaved_1f1b(
+            stage_fn, head_loss, params, (inputs, targets),
+            num_microbatches=nm, num_model_chunks=vpp,
+            loss_takes_params=True,
+        )
+        return losses, jax.tree_util.tree_map(lambda v: v[:, None], grads)
+
+    losses, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P(None, "pp"), P(), P()),
+            out_specs=(P(), P(None, "pp")), check_vma=False,
+        )
+    )(regrouped, inputs, targets)
+
+    def seq_loss(stacked):
+        def one(x, t):
+            for s in range(n_virtual):
+                p_s = jax.tree_util.tree_map(lambda v: v[s], stacked)
+                x = stage_fn(p_s, x)
+            p_last = jax.tree_util.tree_map(
+                lambda v: v[n_virtual - 1], stacked
+            )
+            return head_loss(p_last, x, t)
+
+        losses = jax.vmap(one)(inputs, targets)
+        return jnp.mean(losses), losses
+
+    (_, ref_losses), ref_grads = jax.value_and_grad(
+        seq_loss, has_aux=True
+    )(stacked)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(n_virtual, *stacked[k].shape[1:])
+        np.testing.assert_allclose(
+            got, np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+        )
+    # head grads reached the last VIRTUAL stage's b
+    assert not np.allclose(got[-1], 0.0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(4))
+def test_hand_interleaved_config_fuzz(eight_devices, seed):
+    """Seeded (pp, vpp, nm, stash, remat, head) draws — hand interleaved
+    vs the lockstep interleaved golden on identical params/inputs
+    (losses AND grads).  Includes nm=pp (minimal steady phase) and
+    vpp=1 (reduces to plain 1F1B with three phases)."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_interleaved_1f1b,
+    )
+
+    rng = np.random.RandomState(97 + seed)
+    if seed == 0:
+        pp, vpp, nm = 2, 3, 2       # nm == pp: minimal steady phase
+    elif seed == 1:
+        pp, vpp, nm = 4, 1, 8       # vpp=1 degenerate
+    else:
+        pp = int(rng.choice([2, 4]))
+        vpp = int(rng.choice([2, 3, 4]))
+        nm = pp * int(rng.randint(1, 4))
+    stash = str(rng.choice(["residuals", "input"]))
+    remat = bool(rng.randint(0, 2)) and stash == "residuals"
+    takes_params = bool(rng.randint(0, 2))
+    desc = (
+        f"pp={pp} vpp={vpp} nm={nm} stash={stash} remat={remat} "
+        f"head={takes_params}"
+    )
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual, seed=seed)
+    inputs = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(nm, MB, D), jnp.float32)
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    if takes_params:
+        def lfn(p, y, t):
+            return jnp.mean((y + p["b"] - t) ** 2)
+    else:
+        lfn = loss_fn
+
+    def run(schedule, **kw):
+        def body(local, inputs, targets):
+            params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+            losses, grads = schedule(
+                stage_fn, lfn, params, (inputs, targets),
+                num_microbatches=nm, num_model_chunks=vpp,
+                loss_takes_params=takes_params, **kw,
+            )
+            return losses, jax.tree_util.tree_map(
+                lambda v: v[:, None], grads
+            )
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P(None, "pp"), P(), P()),
+                out_specs=(P(), P(None, "pp")), check_vma=False,
+            )
+        )(regrouped, inputs, targets)
+
+    losses, grads = run(
+        forward_backward_pipelining_interleaved_1f1b, stash=stash,
+        remat=remat, remat_policy="dots" if remat else None,
+    )
+    ref_losses, ref_grads = run(
+        forward_backward_pipelining_with_interleaving, remat=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses),
+        rtol=1e-5, atol=1e-7, err_msg=desc,
+    )
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]),
+            rtol=1e-4, atol=1e-6, err_msg=desc,
+        )
+
+
+def test_hand_interleaved_forward_only(eight_devices):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_interleaved_1f1b,
+    )
+
+    pp, vpp, nm = 2, 2, 6
+    n_virtual = pp * vpp
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(n_virtual)
+    inputs, targets = make_batch()
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, grads = forward_backward_pipelining_interleaved_1f1b(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=nm, num_model_chunks=vpp, forward_only=True,
+        )
+        assert grads is None
+        return losses
+
+    losses = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=(P(None, "pp"), P(), P()),
+            out_specs=P(), check_vma=False,
+        )
+    )(regrouped, inputs, targets)
+    ref_losses, _ = sequential_reference(
+        stacked, inputs, targets, n_virtual
+    )
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(ref_losses), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_hand_interleaved_rejects_indivisible_microbatches(eight_devices):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_interleaved_1f1b,
+    )
+
+    pp, vpp = 2, 2
+    mesh = ps.initialize_model_parallel(1, pp)
+    stacked = make_stages(pp * vpp)
+    rng = np.random.RandomState(3)
+    inputs = jnp.asarray(rng.randn(3, MB, D), jnp.float32)
+    targets = jnp.asarray(rng.randn(3, MB, D), jnp.float32)
+    regrouped = jax.tree_util.tree_map(
+        lambda v: v.reshape(vpp, pp, *v.shape[1:]), stacked
+    )
+
+    def run(local, inputs, targets):
+        params = jax.tree_util.tree_map(lambda v: v[:, 0], local)
+        losses, _ = forward_backward_pipelining_interleaved_1f1b(
+            stage_fn, loss_fn, params, (inputs, targets),
+            num_microbatches=3, num_model_chunks=vpp,
+        )
+        return losses
+
+    with pytest.raises(ValueError, match="multiple of pipeline"):
+        jax.jit(
+            jax.shard_map(
+                run, mesh=mesh,
+                in_specs=(P(None, "pp"), P(), P()), out_specs=P(),
+                check_vma=False,
+            )
+        )(regrouped, inputs, targets)
+
+
 @pytest.mark.parametrize("carry_chunk", [2, 5, 100])
 def test_interleaved_carry_chunk_matches_sequential(
     eight_devices, carry_chunk
@@ -772,11 +1070,17 @@ def test_get_forward_backward_func(eight_devices):
     ps.initialize_model_parallel(1, 2)
     from apex_tpu.transformer.pipeline_parallel import (
         forward_backward_pipelining_1f1b,
+        forward_backward_pipelining_interleaved_1f1b,
     )
     assert (
         get_forward_backward_func(hand_scheduled=True)
         is forward_backward_pipelining_1f1b
     )
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(1, 2, virtual_pipeline_model_parallel_size=2)
+    f = get_forward_backward_func(hand_scheduled=True)
+    assert f.func is forward_backward_pipelining_interleaved_1f1b
+    assert f.keywords["num_model_chunks"] == 2
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,7 @@ from apex_tpu import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_no_pipelining,
     forward_backward_pipelining_1f1b,
+    forward_backward_pipelining_interleaved_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
 )
@@ -230,27 +231,38 @@ def run_lockstep_nm(pp, nm, remat=True):
 FRONTIER_HIDDEN = 256  # 1/4 the compute of HIDDEN=512; same memory SHAPE
 
 
-def run_schedule(pp, nm, schedule, **kw):
+def run_schedule(pp, nm, schedule, vpp=None, **kw):
     """Wall + compile-time memory for one schedule at (pp, nm) — the
-    frontier measurement (VERDICT r3 #5): lockstep variants vs the
-    hand-scheduled 1F1B at grad-accumulation scale.  One compile serves
-    both the memory analysis and the (single-rep: 1-core container, the
-    memory column is the trustworthy one) wall timing."""
+    frontier measurement (VERDICT r3 #5, r4 #2): lockstep variants vs
+    the hand-scheduled 1F1B family at grad-accumulation scale.  With
+    ``vpp`` the rank's params are ``vpp`` stacked chunks and
+    ``num_model_chunks`` is passed through (the interleaved frontier).
+    One compile serves both the memory analysis and the (single-rep:
+    1-core container, the memory column is the trustworthy one) wall
+    timing."""
+    n_chunks = vpp or 1
+    if LAYERS % (pp * n_chunks):
+        # a silent clamp here would compare different model sizes across
+        # rows — refuse instead
+        raise ValueError(
+            f"LAYERS={LAYERS} not divisible by pp*vpp={pp * n_chunks}"
+        )
+    per_chunk = LAYERS // (pp * n_chunks)
     devices = jax.devices()[:pp]
     ps.destroy_model_parallel()
     ps.initialize_model_parallel(
         pipeline_model_parallel_size=pp, devices=devices
     )
     mesh = Mesh(devices, (ps.PIPELINE_PARALLEL_AXIS,))
-    stage = make_stage_fn(LAYERS // pp)
+    stage = make_stage_fn(per_chunk)
     key = jax.random.PRNGKey(0)
     h = FRONTIER_HIDDEN
     scale = 1.0 / (h ** 0.5)
     x = jax.random.normal(key, (nm, MB, SEQ, h), jnp.float32)
     t = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
 
-    def frontier_params(k):
-        ks = jax.random.split(k, 2 * (LAYERS // pp))
+    def chunk_params(k):
+        ks = jax.random.split(k, 2 * per_chunk)
         return [
             (
                 jax.random.normal(ks[2 * i], (h, 4 * h), jnp.float32)
@@ -258,14 +270,26 @@ def run_schedule(pp, nm, schedule, **kw):
                 jax.random.normal(ks[2 * i + 1], (4 * h, h), jnp.float32)
                 * scale,
             )
-            for i in range(LAYERS // pp)
+            for i in range(per_chunk)
         ]
 
     def sharded_step(x, t):
         rank = jax.lax.axis_index(ps.PIPELINE_PARALLEL_AXIS)
-        params = frontier_params(jax.random.fold_in(key, rank))
+        if vpp:
+            chunks = [
+                chunk_params(jax.random.fold_in(key, rank + pp * k))
+                for k in range(vpp)
+            ]
+            params = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *chunks
+            )
+            extra = dict(num_model_chunks=vpp)
+        else:
+            params = chunk_params(jax.random.fold_in(key, rank))
+            extra = {}
         losses, grads = schedule(
-            stage, loss_fn, params, (x, t), num_microbatches=nm, **kw
+            stage, loss_fn, params, (x, t), num_microbatches=nm,
+            **extra, **kw
         )
         return jnp.sum(losses), sum(
             jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(grads)
@@ -304,6 +328,54 @@ FRONTIER_POINTS = [
      forward_backward_pipelining_1f1b,
      dict(stash="input")),
 ]
+
+
+VPP_FRONTIER_POINTS = [
+    ("interleaved remat",
+     forward_backward_pipelining_with_interleaving,
+     dict(remat=True)),
+    ("interleaved carry_chunk",
+     forward_backward_pipelining_with_interleaving,
+     dict(remat=True, carry_chunk="sqrt")),
+    ("hand intlv residuals",
+     forward_backward_pipelining_interleaved_1f1b,
+     dict(stash="residuals")),
+    ("hand intlv input",
+     forward_backward_pipelining_interleaved_1f1b,
+     dict(stash="input")),
+]
+
+
+def run_frontier_vpp():
+    """The virtual-stage frontier: (pp, vpp) in {(2,2), (2,4), (4,2)}
+    (every grid point keeps LAYERS/(pp·vpp) whole so rows stay
+    like-for-like), nm in {32, 64} — the hand interleaved schedule's
+    memory must be flat in nm (explicit chunk-stash ring) where the
+    lockstep family's autodiff carries grow O(nm·vpp).  Decision
+    recorded in docs/pipeline-schedules.md."""
+    print(
+        f"{'schedule':<26}{'pp':>4}{'vpp':>5}{'nm':>5}{'wall ms':>10}"
+        f"{'mem MB':>9}",
+        flush=True,
+    )
+    for pp, vpp in ((2, 2), (2, 4), (4, 2)):
+        for nm in (32, 64):
+            for label, schedule, kw in VPP_FRONTIER_POINTS:
+                kw = dict(kw)
+                if kw.get("carry_chunk") == "sqrt":
+                    kw["carry_chunk"] = max(
+                        2, int(round((nm * vpp + pp - 1) ** 0.5))
+                    )
+                try:
+                    wall, mem = run_schedule(pp, nm, schedule, vpp=vpp, **kw)
+                except Exception as e:
+                    print(f"{label:<26}{pp:>4}{vpp:>5}{nm:>5}  FAILED: {e}")
+                    continue
+                print(
+                    f"{label:<26}{pp:>4}{vpp:>5}{nm:>5}{wall*1e3:>10.1f}"
+                    f"{mem:>9.1f}",
+                    flush=True,
+                )
 
 
 def run_frontier():
@@ -385,6 +457,11 @@ def main():
         print("memory/compute frontier at grad-accumulation scale:",
               flush=True)
         run_frontier()
+
+    if mode in ("all", "frontier-vpp"):
+        print()
+        print("virtual-stage (interleaved) frontier:", flush=True)
+        run_frontier_vpp()
 
     if mode in ("all", "nm-sweep"):
         print()

@@ -1,0 +1,32 @@
+#!/bin/sh
+# Round-5 tunnel watcher: probe the axon TPU tunnel on a loop; the moment a
+# probe succeeds, fire the staged on-chip queue (tools/onchip_queue.sh) and
+# exit. Bounded by MAX_SECONDS so it never outlives the round.
+#
+#   sh tools/tunnel_watch.sh [ROUND] [MAX_SECONDS]
+#
+# Writes a heartbeat to tunnel_watch_r{N}.log so progress is inspectable.
+set -u
+ROUND="${1:-5}"
+MAX="${2:-39600}"   # 11h default
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO" || exit 1
+LOG="tunnel_watch_r$(printf %02d "$ROUND").log"
+START=$(date +%s)
+echo "watch start $(date -u)" >>"$LOG"
+while :; do
+    NOW=$(date +%s)
+    ELAPSED=$((NOW - START))
+    if [ "$ELAPSED" -ge "$MAX" ]; then
+        echo "watch giving up after ${ELAPSED}s $(date -u)" >>"$LOG"
+        exit 3
+    fi
+    if sh tools/tpu_probe.sh 90; then
+        echo "tunnel OPEN at $(date -u) (elapsed ${ELAPSED}s) - firing queue" >>"$LOG"
+        sh tools/onchip_queue.sh "$ROUND" >>"$LOG" 2>&1
+        echo "queue done rc=$? $(date -u)" >>"$LOG"
+        exit 0
+    fi
+    echo "probe down $(date -u) (elapsed ${ELAPSED}s)" >>"$LOG"
+    sleep 420
+done
