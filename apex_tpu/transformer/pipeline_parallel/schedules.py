@@ -381,17 +381,12 @@ def forward_backward_pipelining_1f1b(
         # residual LEAVES line up one-to-one with the template's — that
         # is what the ring relies on, so pin it structurally.
         f_leaves, f_def = tree.tree_flatten(vjp_f)
-        # Explicit raises, not asserts: these guard tracer-identity
-        # invariants a future JAX change could break silently, and must
-        # survive ``python -O`` (they run at trace time, so they're free
-        # at execution time).
-        if [(l.shape, l.dtype) for l in f_leaves] != [
-            (l.shape, l.dtype) for l in t_leaves
-        ]:
-            raise RuntimeError(
-                "hand-1F1B ring invariant violated: vjp residual "
-                "structure changed across ticks"
-            )
+        _check_vjp_leaf_shapes(
+            f_leaves, [(l.shape, l.dtype) for l in t_leaves], "hand-1F1B"
+        )
+        # Explicit raise, not assert (same rationale as the helper):
+        # guards a tracer-identity invariant a future JAX change could
+        # break silently.
         if [
             i for i, l in enumerate(f_leaves) if id(l) not in param_ids
         ] != varying:
@@ -475,6 +470,18 @@ def forward_backward_pipelining_1f1b(
         tick, carry0, jnp.arange(ticks)
     )
     return jax.lax.psum(losses, axis_name), grads
+
+
+def _check_vjp_leaf_shapes(f_leaves, expected_shapes, schedule_name):
+    """Trace-time guard shared by the hand schedules' stash rings: the
+    per-tick vjp's residual leaves must line up one-to-one with the
+    template's.  Explicit raise (not assert) so it survives ``python
+    -O``; free at execution time."""
+    if [(l.shape, l.dtype) for l in f_leaves] != expected_shapes:
+        raise RuntimeError(
+            f"{schedule_name} ring invariant violated: vjp residual "
+            "structure changed across ticks"
+        )
 
 
 def _loss_and_head_grads(lfn, params, y, tgt, loss_takes_params):
@@ -630,14 +637,10 @@ def forward_backward_pipelining_interleaved_1f1b(
     t_shapes = [(l.shape, l.dtype) for l in t_leaves]
 
     def check_residual_contract(f_leaves, cp_leaves):
-        # Explicit raises (not asserts — must survive ``python -O``):
-        # trace-time guards on the tracer-identity invariants the ring
-        # substitution relies on.
-        if [(l.shape, l.dtype) for l in f_leaves] != t_shapes:
-            raise RuntimeError(
-                "interleaved hand-1F1B ring invariant violated: vjp "
-                "residual structure changed across ticks"
-            )
+        _check_vjp_leaf_shapes(f_leaves, t_shapes, "interleaved hand-1F1B")
+        # Explicit raise, not assert (same rationale as the helper):
+        # guards the tracer-identity mapping the ring substitution
+        # relies on.
         cp_pos = {id(l): i for i, l in enumerate(cp_leaves)}
         got = {
             pos: cp_pos[id(l)]

@@ -1,0 +1,204 @@
+"""int8-wire gradient-sync sensitivity sweep (VERDICT r4 #7).
+
+``parallel.quantized.quantized_all_reduce_gradients`` trades exactness
+for ~4x wire-byte reduction; its convergence test pins ONE operating
+point.  This sweep maps the envelope: block size x model scale ->
+
+- one-sync relative gradient error vs the exact psum (mean + max over
+  elements, worst leaf), and
+- the N-step training-loss delta vs exact sync from the same init
+  (the number that actually matters),
+
+on the dp=8 CPU mesh.  Results + the when-NOT-to-use-it guidance live in
+docs/parallel.md next to the module's contract.
+
+Run:  python tools/int8wire_sensitivity.py
+"""
+
+import os
+import sys
+import json
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+from apex_tpu.parallel import (
+    all_reduce_gradients,
+    quantized_all_reduce_gradients,
+)
+from apex_tpu.optimizers import fused_sgd
+
+DP = 8
+N_STEPS = 30
+
+# model scales: (hidden, depth, lr) of a tanh MLP regression net.
+# "small" has mixed tiny/large leaves in one bucket; "large" spans many
+# blocks per leaf so per-block scaling is exercised both within and
+# across leaves.  lr is tuned per scale so the EXACT baseline converges
+# (momentum-SGD at lr=0.05 diverges at hidden=512 regardless of sync —
+# a divergent baseline measures nothing about quantization).
+SCALES = {
+    "small (9.5k params)": (48, 2, 0.05),
+    "medium (54k params)": (128, 3, 0.05),
+    "large (528k params)": (512, 2, 0.005),
+}
+BLOCKS = (256, 1024, 4096)
+
+
+def _mlp_init(key, d_in, hidden, depth):
+    params = []
+    dims = [d_in] + [hidden] * depth + [1]
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        k = jax.random.fold_in(key, i)
+        params.append({
+            "w": jax.random.normal(k, (a, b), jnp.float32) / np.sqrt(a),
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def measure(hidden, depth, lr, block):
+    """(worst-leaf mean rel err, worst-leaf max rel err, loss_delta)."""
+    d_in = 16
+    key = jax.random.PRNGKey(7)
+    xs = jax.random.normal(jax.random.fold_in(key, 1), (DP, 64, d_in))
+    w_true = jax.random.normal(jax.random.fold_in(key, 2), (d_in, 1))
+    ys = jnp.einsum("rbd,do->rbo", xs, w_true) + 0.01 * jax.random.normal(
+        jax.random.fold_in(key, 3), (DP, 64, 1)
+    )
+    tx = fused_sgd(learning_rate=lr, momentum=0.9)
+
+    def one_sync_err(x, y):
+        x, y = x[0], y[0]
+        params = _mlp_init(key, d_in, hidden, depth)
+        grads = jax.grad(
+            lambda p: jnp.mean((_mlp_apply(p, x) - y) ** 2)
+        )(params)
+        exact = all_reduce_gradients(grads)
+        quant = quantized_all_reduce_gradients(
+            grads, min_size=1, block=block
+        )
+        errs = []
+        for e, q in zip(
+            jax.tree_util.tree_leaves(exact),
+            jax.tree_util.tree_leaves(quant),
+        ):
+            denom = jnp.mean(jnp.abs(e)) + 1e-12
+            errs.append(
+                (jnp.mean(jnp.abs(q - e)) / denom,
+                 jnp.max(jnp.abs(q - e)) / denom)
+            )
+        mean_rel = jnp.max(jnp.stack([a for a, _ in errs]))
+        max_rel = jnp.max(jnp.stack([b for _, b in errs]))
+        return mean_rel[None], max_rel[None]
+
+    def train_hist(x, y, sync):
+        x, y = x[0], y[0]
+        params = _mlp_init(key, d_in, hidden, depth)
+        opt = tx.init(params)
+
+        def step(carry, _):
+            params, opt = carry
+            loss, grads = jax.value_and_grad(
+                lambda p: jnp.mean((_mlp_apply(p, x) - y) ** 2)
+            )(params)
+            grads = sync(grads)
+            upd, opt = tx.update(grads, opt, params)
+            params = jax.tree_util.tree_map(jnp.add, params, upd)
+            return (params, opt), loss
+
+        _, hist = jax.lax.scan(step, (params, opt), None, length=N_STEPS)
+        return jax.lax.pmean(hist, ps.DATA_PARALLEL_AXIS)[None]
+
+    mesh = ps.initialize_model_parallel(devices=jax.devices()[:DP])
+
+    def run(f, *args):
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P("dp"),) * len(args),
+                out_specs=P("dp"), check_vma=False,
+            )
+        )(*args)
+
+    mean_rel, max_rel = run(one_sync_err, xs, ys)
+    h_exact = np.asarray(
+        run(lambda x, y: train_hist(x, y, all_reduce_gradients), xs, ys)
+    )[0]
+    h_quant = np.asarray(
+        run(
+            lambda x, y: train_hist(
+                x, y,
+                lambda g: quantized_all_reduce_gradients(
+                    g, min_size=1, block=block
+                ),
+            ),
+            xs, ys,
+        )
+    )[0]
+    ps.destroy_model_parallel()
+    loss_delta = float(h_quant[-1] - h_exact[-1]) / float(h_exact[0])
+    return (
+        float(np.asarray(mean_rel)[0]),
+        float(np.asarray(max_rel)[0]),
+        float(h_exact[-1]),
+        float(h_quant[-1]),
+        loss_delta,
+    )
+
+
+def main():
+    print(
+        f"{'model':<22}{'block':>7}{'rel_err_mean':>14}{'rel_err_max':>13}"
+        f"{'exact_loss':>12}{'quant_loss':>12}{'loss_delta':>12}",
+        flush=True,
+    )
+    rows = []
+    for name, (hidden, depth, lr) in SCALES.items():
+        for block in BLOCKS:
+            m, mx, le, lq, dl = measure(hidden, depth, lr, block)
+            rows.append({
+                "model": name, "block": block,
+                "rel_err_mean_worst_leaf": round(m, 5),
+                "rel_err_max_worst_leaf": round(mx, 5),
+                "exact_final_loss": round(le, 6),
+                "quant_final_loss": round(lq, 6),
+                "loss_delta_frac_of_init": round(dl, 6),
+            })
+            print(
+                f"{name:<22}{block:>7}{m:>14.5f}{mx:>13.5f}"
+                f"{le:>12.6f}{lq:>12.6f}{dl:>12.6f}",
+                flush=True,
+            )
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "INT8WIRE_SENSITIVITY.json",
+    )
+    with open(out, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    print(f"[int8wire_sensitivity] wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
