@@ -72,16 +72,11 @@ def _time_scan(step, q, k, v, iters=8, trials=3):
     return times[len(times) // 2]
 
 
-def sweep(name, bwd):
-    b, h, sq, d, causal = SHAPES[name]
-    key = jax.random.PRNGKey(0)
-    kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (b * h, sq, d), jnp.bfloat16)
-    k = jax.random.normal(kk, (b * h, sq, d), jnp.bfloat16)
-    v = jax.random.normal(kv, (b * h, sq, d), jnp.bfloat16)
-    scale = d ** -0.5
-    flops = _flops(b, h, sq, d, causal, bwd)
-    mode = "fwd+bwd" if bwd else "fwd"
+def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v):
+    """Shared (bq, bk) grid driver: divisibility filter, timing,
+    FAILED formatting, best tracking, auto-heuristic footer.
+    ``make_step(bq, bk)`` returns a q-shaped-output step for
+    :func:`_time_scan`."""
     print(f"\n== {name} {SHAPES[name]} {mode} ==")
     print(f"{'bq':>5} {'bk':>5} {'ms':>9} {'TFLOP/s':>9}")
     best = (None, 0.0)
@@ -91,30 +86,8 @@ def sweep(name, bwd):
         for bk in BLOCKS:
             if bk > sq or sq % bk:
                 continue
-
-            if bwd:
-                # fwd + the recomputation backward, kernels called
-                # directly (the public custom_vjp sits a layer up);
-                # returns dq — q-shaped, as _time_scan's carry needs
-                def step(q, k, v, _bq=bq, _bk=bk):
-                    o, lse = fa.flash_fwd(
-                        q, k, v, None, scale=scale, causal=causal,
-                        block_q=_bq, block_k=_bk,
-                    )
-                    dq, _, _ = fa.flash_bwd(
-                        q, k, v, o, lse, 2.0 * o, None, scale=scale,
-                        causal=causal, block_q=_bq, block_k=_bk,
-                    )
-                    return dq
-            else:
-                def step(q, k, v, _bq=bq, _bk=bk):
-                    o, _ = fa.flash_fwd(
-                        q, k, v, None, scale=scale, causal=causal,
-                        block_q=_bq, block_k=_bk,
-                    )
-                    return o
             try:
-                t = _time_scan(step, q, k, v)
+                t = _time_scan(make_step(bq, bk), q, k, v)
             except Exception as e:
                 print(f"{bq:5d} {bk:5d}   FAILED  {type(e).__name__}:"
                       f" {str(e)[:60]}")
@@ -131,12 +104,93 @@ def sweep(name, bwd):
     return best
 
 
+def _qkv(name):
+    b, h, sq, d, causal = SHAPES[name]
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b * h, sq, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b * h, sq, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b * h, sq, d), jnp.bfloat16)
+    return q, k, v, sq, d, causal, d ** -0.5
+
+
+def sweep(name, bwd):
+    b, h, sq_, d_, causal_ = SHAPES[name]
+    q, k, v, sq, d, causal, scale = _qkv(name)
+    flops = _flops(b, h, sq, d, causal, bwd)
+
+    def make_step(bq, bk):
+        if bwd:
+            # fwd + the recomputation backward, kernels called directly
+            # (the public custom_vjp sits a layer up).  ALL outputs are
+            # folded into the q-shaped carry — returning dq alone lets
+            # XLA DCE the entire dkdv pallas_call (two independent
+            # side-effect-free calls) and the sweep would time only dq.
+            def step(q, k, v):
+                o, lse = fa.flash_fwd(
+                    q, k, v, None, scale=scale, causal=causal,
+                    block_q=bq, block_k=bk,
+                )
+                dq, dk, dv = fa.flash_bwd(
+                    q, k, v, o, lse, 2.0 * o, None, scale=scale,
+                    causal=causal, block_q=bq, block_k=bk,
+                )
+                return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
+        else:
+            def step(q, k, v):
+                o, _ = fa.flash_fwd(
+                    q, k, v, None, scale=scale, causal=causal,
+                    block_q=bq, block_k=bk,
+                )
+                return o
+        return step
+
+    mode = "fwd+bwd" if bwd else "fwd"
+    return _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v)
+
+
+def sweep_bwd_only(name):
+    """Isolate the backward kernels (dkdv + dq pallas_calls, ~2/3 of a
+    train step's attention time): time ``flash_bwd`` alone against
+    constant precomputed (o, lse, do).  Values are garbage after the
+    first carry feedback — timing-only, same shapes/FLOPs — but this
+    splits the fwd+bwd sweep's confound: a (bq, bk) that wins fwd+bwd
+    may be carrying a fwd win over a bwd loss."""
+    b, h, _, _, _ = SHAPES[name]
+    q, k, v, sq, d, causal, scale = _qkv(name)
+    o, lse = jax.jit(
+        lambda q, k, v: fa.flash_fwd(
+            q, k, v, None, scale=scale, causal=causal
+        )
+    )(q, k, v)
+    o, lse = jax.block_until_ready((o, lse))
+    flops = _flops(b, h, sq, d, causal, bwd=True) * 2.0 / 3.0  # bwd share
+
+    def make_step(bq, bk):
+        def step(q, k, v):
+            dq, dk, dv = fa.flash_bwd(
+                q, k, v, o, lse, 2.0 * o, None, scale=scale,
+                causal=causal, block_q=bq, block_k=bk,
+            )
+            # fold dk/dv in: dq alone would DCE the dkdv pallas_call
+            return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
+        return step
+
+    return _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--shapes", default="mha,long")
     ap.add_argument("--fwd-only", action="store_true")
+    ap.add_argument("--bwd-only", action="store_true",
+                    help="sweep flash_bwd alone (constant o/lse/do) to "
+                         "decouple the backward tile choice from fwd")
     args = ap.parse_args()
     for name in args.shapes.split(","):
+        if args.bwd_only:
+            sweep_bwd_only(name)
+            continue
         sweep(name, bwd=False)
         if not args.fwd_only:
             sweep(name, bwd=True)
