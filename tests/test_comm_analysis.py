@@ -1,0 +1,146 @@
+"""Unit tests for the comm-structure analysis passes (tools/
+comm_structure.py) — the parsers behind COMM_STRUCTURE_r{N}.json.
+
+These run on synthetic HLO text / pure arithmetic, so regressions in the
+artifact generator fail here rather than silently skewing the recorded
+comm fractions.
+"""
+
+import os
+import sys
+
+import pytest
+
+# bare `pytest` puts tests/ (not the repo root) on sys.path; tools/ is a
+# plain directory, not an installed package
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tools.comm_structure import (  # noqa: E402
+    collect,
+    cp_ring_balance_model,
+    overlap_collect,
+    ring_traffic_bytes,
+)
+
+
+# ---------------------------------------------------------------------------
+# overlap windows
+# ---------------------------------------------------------------------------
+
+
+SYNC_OVERLAPPED = """
+ENTRY %main {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %p1 = f32[128,128]{1,0} parameter(1)
+  %ar = f32[8,128]{1,0} all-reduce(%p0), replica_groups={{0,1}}
+  %dot = f32[128,128]{1,0} dot(%p1, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %use = f32[8,128]{1,0} fusion(%ar, %dot), kind=kLoop, calls=%fc
+}
+"""
+
+SYNC_SERIAL = """
+ENTRY %main {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %ar = f32[8,128]{1,0} all-reduce(%p0), replica_groups={{0,1}}
+  %use = f32[8,128]{1,0} fusion(%ar), kind=kLoop, calls=%fc
+  %dot = f32[128,128]{1,0} dot(%use, %use), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+ASYNC_PAIR = """
+ENTRY %main {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %ar-start = (f32[8,128]{1,0}, f32[8,128]{1,0}) all-reduce-start(%p0), replica_groups={{0,1}}
+  %dot = f32[128,128]{1,0} dot(%p1, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %ar-done = f32[8,128]{1,0} all-reduce-done(%ar-start)
+  %use = f32[8,128]{1,0} fusion(%ar-done), kind=kLoop, calls=%fc
+}
+"""
+
+NO_SIGIL = """
+ENTRY main {
+  p0 = f32[8,128]{1,0} parameter(0)
+  p1 = f32[128,128]{1,0} parameter(1)
+  ar = f32[8,128]{1,0} all-reduce(p0), replica_groups={{0,1}}
+  use = f32[8,128]{1,0} fusion(ar), kind=kLoop, calls=fc
+  dot.1 = f32[128,128]{1,0} dot(p1, p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+BYTES_8x128_F32 = 8 * 128 * 4
+
+
+def test_sync_window_with_independent_compute_is_overlapped():
+    ov = overlap_collect(SYNC_OVERLAPPED)
+    assert ov["sync_count"] == 1
+    assert ov["sync_bytes"] == BYTES_8x128_F32
+    assert ov["overlapped_count"] == 1
+    assert ov["overlapped_bytes"] == BYTES_8x128_F32
+
+
+def test_sync_window_closed_at_first_consumer_is_serial():
+    """Compute AFTER the first consumer is outside the window — the
+    collective blocks its consumer and cannot be hidden behind it."""
+    ov = overlap_collect(SYNC_SERIAL)
+    assert ov["sync_count"] == 1
+    assert ov["overlapped_count"] == 0
+    assert ov["overlapped_bytes"] == 0
+
+
+def test_async_pair_with_compute_in_window():
+    ov = overlap_collect(ASYNC_PAIR)
+    assert ov["async_pairs"] == 1
+    assert ov["async_bytes"] == BYTES_8x128_F32  # result element only
+    assert ov["overlapped_count"] == 1
+
+
+def test_sigil_free_hlo_still_closes_windows():
+    """HLO printed without '%' name sigils: the first-consumer search
+    must still close the window (the regression the sigil-optional
+    consumer regex exists for) — compute after first use stays serial."""
+    ov = overlap_collect(NO_SIGIL)
+    assert ov["sync_count"] == 1
+    assert ov["overlapped_count"] == 0
+
+
+def test_collect_and_traffic_model_consistent():
+    kinds = collect(SYNC_OVERLAPPED)
+    assert kinds["all-reduce"]["count"] == 1
+    assert kinds["all-reduce"]["bytes"] == BYTES_8x128_F32
+    # ring all-reduce moves 2*(w-1)/w of the operand per chip
+    t = ring_traffic_bytes(kinds, world=8)
+    assert t == pytest.approx(2 * BYTES_8x128_F32 * 7 / 8)
+
+
+# ---------------------------------------------------------------------------
+# zigzag causal balance model
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cp", [2, 4, 8])
+def test_balance_model_invariants(cp):
+    m = cp_ring_balance_model(cp)
+    cont, zz = m["contiguous"], m["zigzag"]
+    # both layouts do the same useful work: the full causal triangle
+    # over 2cp chunks = 2cp*(2cp+1)/2 half-tiles... in tile units:
+    # cp^2 full tiles + 2cp diagonals*0.5 -> 2cp^2 per the derivation
+    assert cont["useful_tiles_total"] == zz["useful_tiles_total"] == 2 * cp * cp
+    # zigzag is perfectly balanced: 2 tiles per hop, every hop
+    assert zz["per_hop_max_tiles"] == [2.0] * cp
+    assert zz["utilization"] == 1.0
+    # contiguous: diagonal hop 2, then full-block hops 4
+    assert cont["per_hop_max_tiles"] == [2.0] + [4.0] * (cp - 1)
+    # the headline: wall ratio = 2 - 1/cp
+    assert m["wall_ratio_contiguous_over_zigzag"] == pytest.approx(
+        2.0 - 1.0 / cp
+    )
+
+
+def test_balance_model_wall_is_sum_of_hop_maxima():
+    m = cp_ring_balance_model(4)
+    for layout in ("contiguous", "zigzag"):
+        assert m[layout]["lockstep_wall_tiles"] == sum(
+            m[layout]["per_hop_max_tiles"]
+        )

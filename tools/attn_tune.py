@@ -111,12 +111,11 @@ def _qkv(name):
     q = jax.random.normal(kq, (b * h, sq, d), jnp.bfloat16)
     k = jax.random.normal(kk, (b * h, sq, d), jnp.bfloat16)
     v = jax.random.normal(kv, (b * h, sq, d), jnp.bfloat16)
-    return q, k, v, sq, d, causal, d ** -0.5
+    return b, h, q, k, v, sq, d, causal, d ** -0.5
 
 
 def sweep(name, bwd):
-    b, h, sq_, d_, causal_ = SHAPES[name]
-    q, k, v, sq, d, causal, scale = _qkv(name)
+    b, h, q, k, v, sq, d, causal, scale = _qkv(name)
     flops = _flops(b, h, sq, d, causal, bwd)
 
     def make_step(bq, bk):
@@ -156,8 +155,7 @@ def sweep_bwd_only(name):
     first carry feedback — timing-only, same shapes/FLOPs — but this
     splits the fwd+bwd sweep's confound: a (bq, bk) that wins fwd+bwd
     may be carrying a fwd win over a bwd loss."""
-    b, h, _, _, _ = SHAPES[name]
-    q, k, v, sq, d, causal, scale = _qkv(name)
+    b, h, q, k, v, sq, d, causal, scale = _qkv(name)
     o, lse = jax.jit(
         lambda q, k, v: fa.flash_fwd(
             q, k, v, None, scale=scale, causal=causal

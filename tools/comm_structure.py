@@ -73,7 +73,9 @@ def _async_start_result(shape: str) -> str:
     """Result element of an async ``-start`` op's tuple shape
     ``(operand(s), result(s)[, contexts...])`` — the second TOP-LEVEL
     element, which for a variadic combined op is itself a tuple whose
-    arrays all count."""
+    arrays all count.  Depth tracking covers ALL bracket kinds: shape
+    strings carry commas inside dims (``[8,128]``) and layouts
+    (``{1,0}``), not just nested tuples."""
     if not shape.startswith("("):
         return shape
     parts, depth, cur = [], 0, []
@@ -82,9 +84,9 @@ def _async_start_result(shape: str) -> str:
             parts.append("".join(cur))
             cur = []
             continue
-        if ch == "(":
+        if ch in "([{":
             depth += 1
-        elif ch == ")":
+        elif ch in ")]}":
             depth -= 1
         cur.append(ch)
     parts.append("".join(cur))
