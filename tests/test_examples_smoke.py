@@ -140,6 +140,20 @@ def test_gpt_train_pp_hand_1f1b_smoke():
     assert "step   2" in out, out[-500:]
 
 
+def test_gpt_train_pp_hand_interleaved_smoke():
+    """Hand-scheduled INTERLEAVED 1F1B (chunk stash ring, --vpp composed
+    with --hand-1f1b) LM example end-to-end."""
+    out = _run_example(
+        "examples/gpt/train_gpt_pp.py",
+        ["--pp", "2", "--vpp", "2", "--hand-1f1b", "--steps", "3",
+         "--layers", "4", "--seq", "16", "--hidden", "32",
+         "--vocab", "64", "--nm", "4"],
+        n_devices=2,
+    )
+    assert "hand-interleaved-1F1B vpp=2 stash=residuals" in out, out[-500:]
+    assert "step   2" in out, out[-500:]
+
+
 def test_gpt_train_cp_ring_smoke():
     """Context-parallel ring attention end-to-end in the example."""
     out = _run_example(
