@@ -534,12 +534,13 @@ def _dq_kernel(
     jax.jit,
     static_argnames=(
         "scale", "causal", "block_q", "block_k", "causal_offset",
-        "dropout_p",
+        "dropout_p", "block_q_dq", "block_k_dq",
     ),
 )
 def flash_bwd(
     q, k, v, o, lse, do, bias, *, scale, causal, block_q=None, block_k=None,
     dlse=None, causal_offset=None, dropout_p=0.0, dropout_seed=None,
+    block_q_dq=None, block_k_dq=None,
 ):
     """Returns (dq, dk, dv).  Recomputation backward: only lse was saved.
 
@@ -558,12 +559,23 @@ def flash_bwd(
     uses the UNPADDED geometry (default: ``sk - sq``).  The fully-masked-
     row closed form keeps ``sk`` itself — callers never pad Sk in the
     Sq > Sk causal geometry where it applies (``_pallas_eligible``).
+
+    ``block_q_dq``/``block_k_dq`` override the tile sizes of the **dq**
+    pallas_call independently of the dkdv one (default: same as
+    ``block_q``/``block_k``).  The two backward kernels iterate the
+    grid transposed (dkdv: k-tiles outer, q inner; dq: q outer, k
+    inner), so their optimal tiles can differ; ``tools/attn_tune.py
+    --bwd-only`` sweeps them.  Safe under dropout: the keep-mask hash
+    keys on absolute element coordinates, not tile geometry.
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = min(block_q, sq) if block_q else _auto_block(sq, d)
     bk = min(block_k, sk) if block_k else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    bq_dq = min(block_q_dq, sq) if block_q_dq else bq
+    bk_dq = min(block_k_dq, sk) if block_k_dq else bk
+    nq_dq, nk_dq = pl.cdiv(sq, bq_dq), pl.cdiv(sk, bk_dq)
     offset = causal_offset if causal_offset is not None else sk - sq
     sk_total = sk
     if dropout_p > 0.0 and dropout_seed is None:
@@ -629,27 +641,28 @@ def flash_bwd(
         interpret=pallas_interpret(),
     )(*args)
 
-    # --- dq: grid (BH, nq, nk), k innermost ---
-    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0))
+    # --- dq: grid (BH, nq, nk), k innermost; independent tile sizes ---
+    kern_kw_dq = dict(kern_kw, bq=bq_dq, bk=bk_dq)
+    q_spec = pl.BlockSpec((1, bq_dq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk_dq, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq_dq, _LANES), lambda b, i, j: (b, i, 0))
     in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
     args = list(common)
     if bias is not None:
-        in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
+        in_specs.append(_bias_spec(bias, bh, bq_dq, bk_dq, "ij"))
         args.append(bias)
     in_specs += seed_specs
     args += seed_args
     dq_kernel = functools.partial(
-        _dq_entry, nk=nk, offset=offset, **kern_kw
+        _dq_entry, nk=nk_dq, offset=offset, **kern_kw_dq
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, nq, nk),
+        grid=(bh, nq_dq, nk_dq),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq_dq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq_dq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),

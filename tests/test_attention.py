@@ -579,3 +579,41 @@ class TestFlashAttentionWithLse:
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5
             )
+
+
+class TestIndependentDqTiles:
+    """flash_bwd's dq pallas_call can take tile sizes independent of the
+    dkdv one (block_q_dq/block_k_dq — the tuner's backward lever); the
+    results must be bitwise-insensitive to the tile choice."""
+
+    @pytest.mark.parametrize("dropout_p", [0.0, 0.2])
+    def test_dq_tiles_do_not_change_grads(self, force_pallas, dropout_p):
+        from apex_tpu.ops.pallas import flash_attention as fa
+
+        sq = 256
+        q, k, v = _rand_qkv(jax.random.PRNGKey(9), b=1, h=2, sq=sq, sk=sq)
+        q, k, v = (x.reshape(2, sq, 64) for x in (q, k, v))
+        scale = 64 ** -0.5
+        kw = dict(scale=scale, causal=True, dropout_p=dropout_p)
+        seed = dict(dropout_seed=7) if dropout_p else {}
+        o, lse = fa.flash_fwd(
+            q, k, v, None, block_q=128, block_k=128, **kw, **seed
+        )
+        do = 2.0 * o
+        base = fa.flash_bwd(
+            q, k, v, o, lse, do, None, block_q=128, block_k=128,
+            **kw, **seed,
+        )
+        for bq_dq, bk_dq in ((256, 128), (128, 256), (256, 256)):
+            alt = fa.flash_bwd(
+                q, k, v, o, lse, do, None, block_q=128, block_k=128,
+                block_q_dq=bq_dq, block_k_dq=bk_dq, **kw, **seed,
+            )
+            # dq numerics may differ only by f32 accumulation order
+            np.testing.assert_allclose(
+                np.asarray(alt[0]), np.asarray(base[0]),
+                atol=2e-5, rtol=2e-5,
+            )
+            # dk/dv come from the UNCHANGED dkdv call: bit-identical
+            for a, b in zip(alt[1:], base[1:]):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

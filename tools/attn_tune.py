@@ -174,7 +174,35 @@ def sweep_bwd_only(name):
             return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
         return step
 
-    return _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
+    best = _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
+
+    # phase 2: pin the dkdv tiles at the winner, sweep the dq call's
+    # independent tiles (block_q_dq/block_k_dq) — the two kernels walk
+    # the grid transposed, so their optima can differ
+    if best[0] is None:
+        return best
+    dkdv_bq, dkdv_bk = best[0]
+
+    def make_step_dq(bq, bk):
+        def step(q, k, v):
+            dq, dk, dv = fa.flash_bwd(
+                q, k, v, o, lse, 2.0 * o, None, scale=scale,
+                causal=causal, block_q=dkdv_bq, block_k=dkdv_bk,
+                block_q_dq=bq, block_k_dq=bk,
+            )
+            return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
+        return step
+
+    best_dq = _grid_sweep(
+        name, f"bwd-only dq-tiles (dkdv pinned {dkdv_bq},{dkdv_bk})",
+        make_step_dq, flops, sq, d, q, k, v,
+    )
+    # explicit config dict so consumers can't misread which pair is
+    # which: apply as flash_bwd(block_q=.., block_k=.., block_q_dq=..,
+    # block_k_dq=..)
+    return {
+        "dkdv": best[0], "dq": best_dq[0], "tflops": best_dq[1],
+    }
 
 
 if __name__ == "__main__":
