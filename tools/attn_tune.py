@@ -176,13 +176,16 @@ def sweep_bwd_only(name):
 
     best = _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
 
+    # Explicit config dict on EVERY path so consumers can't misread
+    # which pair is which: apply as flash_bwd(block_q=.., block_k=..,
+    # block_q_dq=.., block_k_dq=..).
+    if best[0] is None:
+        return {"dkdv": None, "dq": None, "tflops": 0.0}
+    dkdv_bq, dkdv_bk = best[0]
+
     # phase 2: pin the dkdv tiles at the winner, sweep the dq call's
     # independent tiles (block_q_dq/block_k_dq) — the two kernels walk
     # the grid transposed, so their optima can differ
-    if best[0] is None:
-        return best
-    dkdv_bq, dkdv_bk = best[0]
-
     def make_step_dq(bq, bk):
         def step(q, k, v):
             dq, dk, dv = fa.flash_bwd(
@@ -197,12 +200,11 @@ def sweep_bwd_only(name):
         name, f"bwd-only dq-tiles (dkdv pinned {dkdv_bq},{dkdv_bk})",
         make_step_dq, flops, sq, d, q, k, v,
     )
-    # explicit config dict so consumers can't misread which pair is
-    # which: apply as flash_bwd(block_q=.., block_k=.., block_q_dq=..,
-    # block_k_dq=..)
-    return {
-        "dkdv": best[0], "dq": best_dq[0], "tflops": best_dq[1],
-    }
+    if best_dq[0] is None:
+        # every phase-2 cell failed: the shared-tile phase-1 winner is
+        # still a valid measured config — don't discard it
+        return {"dkdv": best[0], "dq": best[0], "tflops": best[1]}
+    return {"dkdv": best[0], "dq": best_dq[0], "tflops": best_dq[1]}
 
 
 if __name__ == "__main__":
