@@ -69,6 +69,33 @@ def _dot_precision(dtype):
 
 
 
+# Per-shape tuned tile sizes — ≙ the reference's per-shape kernel-traits
+# tables (fmha's fixed-seqlen kernels / multihead_attn launch configs),
+# and the same pattern as layer_norm._TUNED_BLOCK_ROWS.  A SOURCE-level
+# table: commit tools/attn_tune.py winners here (the entry points are
+# jitted with static tile args, so runtime mutation would not retrace
+# already-compiled shapes); absent shapes fall back to the _auto_block
+# heuristic.  Keys: (sq, d, causal) -> {"fwd": (bq, bk),
+# "bwd": (bq, bk), "bwd_dq": (bq, bk)}; the "bwd_dq" pair feeds
+# flash_bwd's independent dq-call tiles.
+_TUNED_TILES: dict = {}
+
+
+def _tuned_tile(mode, sq, sk, d, causal):
+    """(bq, bk) from the tuned table, or (None, None) → heuristic.
+
+    The table is keyed on the q-side shape; a tile is only returned if
+    it divides the ACTUAL axis it will tile (the kernels have no
+    partial-tile masking), so a self-attention-tuned entry can never
+    hand a non-dividing bk to a cross-attention call's sk."""
+    tq, tk = _TUNED_TILES.get((sq, d, causal), {}).get(mode) or (None, None)
+    if tq and sq % tq:
+        tq = None
+    if tk and sk % tk:
+        tk = None
+    return tq, tk
+
+
 def _auto_block(seq, d):
     """Default tile size: large enough to amortize per-tile grid overhead.
 
@@ -287,8 +314,9 @@ def flash_fwd(
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq) if block_q else _auto_block(sq, d)
-    bk = min(block_k, sk) if block_k else _auto_block(sk, d)
+    tq, tk = _tuned_tile("fwd", sq, sk, d, causal)
+    bq = min(block_q or tq, sq) if (block_q or tq) else _auto_block(sq, d)
+    bk = min(block_k or tk, sk) if (block_k or tk) else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
     grid = (bh, nq, nk)
     offset = causal_offset if causal_offset is not None else sk - sq
@@ -570,11 +598,20 @@ def flash_bwd(
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq) if block_q else _auto_block(sq, d)
-    bk = min(block_k, sk) if block_k else _auto_block(sk, d)
+    tq, tk = _tuned_tile("bwd", sq, sk, d, causal)
+    bq = min(block_q or tq, sq) if (block_q or tq) else _auto_block(sq, d)
+    bk = min(block_k or tk, sk) if (block_k or tk) else _auto_block(sk, d)
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
-    bq_dq = min(block_q_dq, sq) if block_q_dq else bq
-    bk_dq = min(block_k_dq, sk) if block_k_dq else bk
+    if block_q or block_k:
+        # caller pinned the shared tiles: keep the documented contract
+        # (dq tiles default to block_q/block_k) — a bwd_dq table entry
+        # must not silently override an explicit choice, or tuner
+        # phase-1 sweeps would mis-measure once an entry is committed
+        tq_dq = tk_dq = None
+    else:
+        tq_dq, tk_dq = _tuned_tile("bwd_dq", sq, sk, d, causal)
+    bq_dq = min(block_q_dq or tq_dq or bq, sq)
+    bk_dq = min(block_k_dq or tk_dq or bk, sk)
     nq_dq, nk_dq = pl.cdiv(sq, bq_dq), pl.cdiv(sk, bk_dq)
     offset = causal_offset if causal_offset is not None else sk - sq
     sk_total = sk

@@ -617,3 +617,81 @@ class TestIndependentDqTiles:
             # dk/dv come from the UNCHANGED dkdv call: bit-identical
             for a, b in zip(alt[1:], base[1:]):
                 np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestTunedTileTable:
+    """_TUNED_TILES (the attn_tune → kernel landing table, ≙ the
+    reference's per-shape kernel-traits tables): entries route tile
+    selection away from the _auto_block heuristic without changing
+    numerics."""
+
+    def test_table_entries_are_consulted_and_numerics_unchanged(
+        self, force_pallas, monkeypatch
+    ):
+        from apex_tpu.ops.pallas import flash_attention as fa
+
+        sq, d = 256, 64
+        q, k, v = _rand_qkv(jax.random.PRNGKey(12), b=1, h=2, sq=sq, sk=sq)
+        q, k, v = (x.reshape(2, sq, d) for x in (q, k, v))
+        kw = dict(scale=d ** -0.5, causal=True)
+        o_ref, lse_ref = fa.flash_fwd(q, k, v, None, **kw)
+        base = fa.flash_bwd(q, k, v, o_ref, lse_ref, 2.0 * o_ref, None, **kw)
+
+        monkeypatch.setitem(
+            fa._TUNED_TILES, (sq, d, True),
+            {"fwd": (128, 128), "bwd": (128, 128), "bwd_dq": (256, 128)},
+        )
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "_auto_block consulted despite a tuned-table entry"
+            )
+
+        monkeypatch.setattr(fa, "_auto_block", boom)
+        # fresh shapes would hit the jit cache of the un-patched trace;
+        # clear so the lookup runs under the patched table — and ALWAYS
+        # clear again on exit so a failing assert can't leave
+        # tuned-tile traces live for later tests of the same shape
+        fa.flash_fwd.clear_cache()
+        fa.flash_bwd.clear_cache()
+        try:
+            o, lse = fa.flash_fwd(q, k, v, None, **kw)
+            alt = fa.flash_bwd(q, k, v, o, lse, 2.0 * o, None, **kw)
+            np.testing.assert_allclose(
+                np.asarray(o), np.asarray(o_ref), atol=2e-5, rtol=2e-5
+            )
+            for a, b in zip(alt, base):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+                )
+        finally:
+            fa.flash_fwd.clear_cache()
+            fa.flash_bwd.clear_cache()
+
+    def test_cross_attention_nondividing_tuned_tile_falls_back(
+        self, force_pallas, monkeypatch
+    ):
+        """A tuned entry measured on self-attention must not hand a
+        non-dividing bk to a cross-attention call's sk (the kernels
+        have no partial-tile masking): the per-axis divisibility guard
+        drops the tile and numerics stay correct."""
+        from apex_tpu.ops.pallas import flash_attention as fa
+
+        sq, sk, d = 256, 384, 64  # sk % 256 != 0
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(13), 3)
+        q = jax.random.normal(kq, (2, sq, d))
+        k = jax.random.normal(kk, (2, sk, d))
+        v = jax.random.normal(kv, (2, sk, d))
+        kw = dict(scale=d ** -0.5, causal=False)
+        base, _ = fa.flash_fwd(q, k, v, None, block_q=128, block_k=128, **kw)
+        monkeypatch.setitem(
+            fa._TUNED_TILES, (sq, d, False), {"fwd": (256, 256)}
+        )
+        fa.flash_fwd.clear_cache()
+        try:
+            o, _ = fa.flash_fwd(q, k, v, None, **kw)
+            np.testing.assert_allclose(
+                np.asarray(o), np.asarray(base), atol=2e-5, rtol=2e-5
+            )
+        finally:
+            fa.flash_fwd.clear_cache()
