@@ -349,9 +349,16 @@ def test_flash_dropout_on_chip(causal):
     np.testing.assert_allclose(
         np.asarray(o_k), np.asarray(o_g), atol=2e-5, rtol=2e-5
     )
+    # Grad tolerance is calibrated to both backends: the flash backward
+    # recomputes p and groups the ds = p*(dp - delta) cancellation
+    # differently from the golden einsum, and causal near-diagonal rows
+    # (few visible keys, true grad ~0) amplify it — measured max dev
+    # 6.9e-5 rel on v5e Mosaic, 4.8e-4 abs on CPU interpret.  A
+    # keep-mask flip would show O(|grad|)≈1e-2+ diffs, well above atol;
+    # mask identity is already pinned by the 2e-5 forward check above.
     for a, b_ in zip(g_k, g_g):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5
+            np.asarray(a), np.asarray(b_), atol=1e-3, rtol=2e-4
         )
 
 
@@ -426,8 +433,11 @@ def test_with_lse_dropout_on_chip():
 def test_sums_remat_policy_on_chip():
     """remat_policy='sums' (named saves freeing matmul epilogues, r3) must
     compile under Mosaic/XLA-TPU and reproduce the 'dots' loss and grads
-    bit-comparably on the real chip — guards against TPU-specific issues
-    with save_only_these_names before the policy is benched."""
+    numerically on the real chip — guards against TPU-specific issues
+    with save_only_these_names before the policy is benched.  Unlike the
+    CPU parity test (bit-identical), the chip cannot be: the two save
+    sets draw different fusion boundaries, so bf16 rounding differs
+    (measured loss rel dev 4.9e-5 on v5e)."""
     from apex_tpu.models import (
         BertConfig,
         BertForPreTraining,
@@ -458,14 +468,27 @@ def test_sums_remat_policy_on_chip():
 
     l_d, g_d = loss_and_grads("dots")
     l_s, g_s = loss_and_grads("sums")
-    np.testing.assert_allclose(float(l_d), float(l_s), rtol=1e-5)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a, np.float32), np.asarray(b, np.float32),
-            rtol=2e-3, atol=2e-4,
-        ),
-        g_d, g_s,
-    )
+    np.testing.assert_allclose(float(l_d), float(l_s), rtol=2e-4)
+
+    # Per-leaf relative L2, not elementwise rel: this model is bf16, and
+    # the two policies recompute different subgraphs, so near-zero grad
+    # elements carry cancellation noise that elementwise relative error
+    # amplifies without bound (measured: 6.6% rel on a 0.007-magnitude
+    # element).  Worst measured leaf rel-L2: 9.7e-3 (CPU interpret) —
+    # the bf16 noise floor (eps ~ 8e-3); bound at 2x.  Exact f32 parity
+    # vs no-remat is pinned separately in
+    # tests/test_models.py::test_remat_policy_preserves_values.
+    def _leaf_rel_l2(path, a, b):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        denom = max(float(np.linalg.norm(a)), 1e-12)
+        rel = float(np.linalg.norm(a - b)) / denom
+        assert rel < 2e-2, (
+            f"grad leaf {jax.tree_util.keystr(path)} rel-L2 {rel:.2e}"
+            f" >= 2e-2 (dots {a.ravel()[:4]}... vs sums {b.ravel()[:4]}...)"
+        )
+
+    jax.tree_util.tree_map_with_path(_leaf_rel_l2, g_d, g_s)
 
 
 def test_flash_bwd_independent_dq_tiles_on_chip():
