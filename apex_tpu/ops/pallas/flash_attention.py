@@ -78,7 +78,29 @@ def _dot_precision(dtype):
 # heuristic.  Keys: (sq, d, causal) -> {"fwd": (bq, bk),
 # "bwd": (bq, bk), "bwd_dq": (bq, bk)}; the "bwd_dq" pair feeds
 # flash_bwd's independent dq-call tiles.
-_TUNED_TILES: dict = {}
+_TUNED_TILES: dict = {
+    # tools/attn_tune.py on v5e, 2026-08-01 (onchip_r05.attn_tune.log +
+    # attn_bwd_r05.log).  Long-context bench shape: fwd 30.1 -> 43.3
+    # TFLOP/s, fwd+bwd 45.7 -> 60.2 at the shared (1024, 1024) winner;
+    # bwd-only phase-2 confirmed the dq call's optimum coincides
+    # (49.9 TFLOP/s).  The heuristic's (512, 512) loses ~25% at long
+    # sequence: tile-grid fixed costs amortize all the way up to
+    # 1024-wide blocks on this kernel.
+    (16384, 128, True): {
+        "fwd": (1024, 1024),
+        "bwd": (1024, 1024),
+        "bwd_dq": (1024, 1024),
+    },
+    # BASELINE #4 mha microbench shape: fwd 6.0 -> 6.9 TFLOP/s.  The
+    # bwd pair is the best of the 9 bwd-only cells measured before the
+    # tunnel dropped (9.2 TFLOP/s at (256, 1024) vs 3.8 at (128, 128));
+    # the (512|1024, *) rows are unmeasured — re-sweep on the next
+    # window if chasing the last few percent.
+    (2048, 64, True): {
+        "fwd": (1024, 1024),
+        "bwd": (256, 1024),
+    },
+}
 
 
 def _tuned_tile(mode, sq, sk, d, causal):

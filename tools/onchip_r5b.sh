@@ -1,0 +1,44 @@
+#!/bin/sh
+# Round-5b staged queue: everything the mid-round tunnel drop cut short,
+# in value order.  Assumes the r5a queue (tools/onchip_queue.sh 5)
+# already ran: tests_tpu 54/54, grid2 A/B, tile sweeps, BENCH_all_r05.
+#
+#   sh tools/onchip_r5b.sh
+#
+#   1. bench_all --round 5  — refresh: flash_attention._TUNED_TILES is
+#      now populated from the r5a sweeps, so the long_attn line should
+#      move ~43 -> ~60 TFLOP/s and the mha line may improve too.
+#   2. trace capture + summary on the headline — the docs/mfu.md
+#      lever-#2 (copies) attribution input.
+#   3. attn_tune --bwd-only --shapes mha — the (512|1024, *) bwd cells
+#      the tunnel drop left unmeasured.
+# Logs land in onchip_r5b.*.log at the repo root.
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO" || exit 1
+
+step() {
+    name="$1"; shift
+    log="onchip_r5b.$name.log"
+    if ! sh tools/tpu_probe.sh 120; then
+        echo "[$name] SKIPPED: probe failed (tunnel down)" | tee -a "$log"
+        return 1
+    fi
+    echo "[$name] start $(date -u +%H:%M:%S)" | tee -a "$log"
+    timeout 2700 "$@" >>"$log" 2>&1
+    rc=$?
+    echo "[$name] done rc=$rc $(date -u +%H:%M:%S)" | tee -a "$log"
+    return $rc
+}
+
+# Preserve the complete r5a artifact before the refresh: bench_all
+# writes BENCH_all_r05.json even on partial failure, and a mid-bench
+# tunnel drop must not clobber the round's only complete line set.
+[ -f BENCH_all_r05.json ] && [ ! -f BENCH_all_r05a.json ] \
+    && cp BENCH_all_r05.json BENCH_all_r05a.json
+step bench_all python tools/bench_all.py --round 5
+step trace python bench.py --config bert_lamb --trace trace_r05
+step trace_summary python tools/trace_summary.py trace_r05 -n 40
+step attn_tune_mha python tools/attn_tune.py --bwd-only --shapes mha
+echo "r5b queue finished $(date -u)"

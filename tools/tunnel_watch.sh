@@ -1,16 +1,25 @@
 #!/bin/sh
 # Round-5 tunnel watcher: probe the axon TPU tunnel on a loop; the moment a
-# probe succeeds, fire the staged on-chip queue (tools/onchip_queue.sh) and
-# exit. Bounded by MAX_SECONDS so it never outlives the round.
+# probe succeeds, fire the staged on-chip queue (tools/onchip_queue.sh, or
+# the QUEUE script passed as $3) and exit. Bounded by MAX_SECONDS so it
+# never outlives the round.
 #
-#   sh tools/tunnel_watch.sh [ROUND] [MAX_SECONDS]
+#   sh tools/tunnel_watch.sh [ROUND] [MAX_SECONDS] [QUEUE_SCRIPT]
 #
 # Writes a heartbeat to tunnel_watch_r{N}.log so progress is inspectable.
 set -u
 ROUND="${1:-5}"
 MAX="${2:-39600}"   # 11h default
+QUEUE="${3:-}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO" || exit 1
+# Fail a bad queue path NOW, not after an hours-long tunnel wait: the
+# path is resolved relative to the repo root just cd'd into (matching
+# how the fire step invokes it).
+if [ -n "$QUEUE" ] && [ ! -f "$QUEUE" ]; then
+    echo "tunnel_watch: queue script not found: $QUEUE" >&2
+    exit 2
+fi
 LOG="tunnel_watch_r$(printf %02d "$ROUND").log"
 START=$(date +%s)
 echo "watch start $(date -u)" >>"$LOG"
@@ -23,7 +32,11 @@ while :; do
     fi
     if sh tools/tpu_probe.sh 90; then
         echo "tunnel OPEN at $(date -u) (elapsed ${ELAPSED}s) - firing queue" >>"$LOG"
-        sh tools/onchip_queue.sh "$ROUND" >>"$LOG" 2>&1
+        if [ -n "$QUEUE" ]; then
+            sh "$QUEUE" >>"$LOG" 2>&1
+        else
+            sh tools/onchip_queue.sh "$ROUND" >>"$LOG" 2>&1
+        fi
         echo "queue done rc=$? $(date -u)" >>"$LOG"
         exit 0
     fi
