@@ -273,6 +273,16 @@ _SLOW_EXACT = {
     # also proves consultation) carries the quick signal; the full
     # heuristic-must-not-be-called probe rides the full tier
     "test_table_entries_are_consulted_and_numerics_unchanged",
+    # r5b margin trim (watcher-free standalone 223.6 s vs the 240 s
+    # budget, but a concurrently-probing tunnel watcher inflated
+    # same-day readings to 246-265 s — buy headroom without losing a
+    # family): channels-first instance norm is a layout transpose over
+    # the functional path whose [bfloat16] id stays quick; the with-lse
+    # key-padding parity is re-proven through the quick ring test
+    # (test_ring_key_padding_bias_matches_full[False]) and the
+    # kernel-level bias tests.
+    "test_instance_norm_channels_first_parity",
+    "test_key_padding_bias_matches_reference",
 }
 
 

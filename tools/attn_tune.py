@@ -33,6 +33,20 @@ SHAPES = {
 }
 BLOCKS = [128, 256, 512, 1024]
 
+# r5a measured: every kernel at the long shape wants the LARGEST swept
+# tile (1024, 1024) — the optimum may sit beyond the default grid.
+# --blocks 512,1024,2048 probes past it (the divisibility filter
+# already drops tiles the seq doesn't divide; VMEM is the real bound:
+# a (1024, 2048) f32 score tile is 8 MB).
+#
+# Known caveat: the COMBINED fwd+bwd sweep mis-times at the mha shape
+# (d=64) on the real chip — 0.01 ms cells, i.e. block_until_ready
+# returned without waiting (onchip_r05.attn_tune.log); the long shape
+# (d=128) times sanely, and fwd-only and --bwd-only are sane at BOTH
+# shapes (attn_bwd_r05.log).  Until the d=64 combined-mode interaction
+# with the remote runtime is understood, trust fwd-only + --bwd-only
+# for mha-shape decisions.
+
 
 def _flops(b, h, sq, d, causal, bwd):
     # scores + PV matmuls, causal halves the live area; bwd ~2x fwd
@@ -214,7 +228,12 @@ if __name__ == "__main__":
     ap.add_argument("--bwd-only", action="store_true",
                     help="sweep flash_bwd alone (constant o/lse/do) to "
                          "decouple the backward tile choice from fwd")
+    ap.add_argument("--blocks", default=None,
+                    help="comma-separated tile grid override, e.g. "
+                         "512,1024,2048 (default: 128,256,512,1024)")
     args = ap.parse_args()
+    if args.blocks:
+        BLOCKS = [int(x) for x in args.blocks.split(",")]
     for name in args.shapes.split(","):
         if args.bwd_only:
             sweep_bwd_only(name)

@@ -41,4 +41,10 @@ step bench_all python tools/bench_all.py --round 5
 step trace python bench.py --config bert_lamb --trace trace_r05
 step trace_summary python tools/trace_summary.py trace_r05 -n 40
 step attn_tune_mha python tools/attn_tune.py --bwd-only --shapes mha
+#   4. probe past the 1024 tile cap at the long shape: r5a's optimum sat
+#      at the edge of the swept grid on every kernel.
+step attn_big_fwd python tools/attn_tune.py --fwd-only --shapes long \
+    --blocks 1024,2048
+step attn_big_bwd python tools/attn_tune.py --bwd-only --shapes long \
+    --blocks 1024,2048
 echo "r5b queue finished $(date -u)"
