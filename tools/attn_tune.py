@@ -43,9 +43,11 @@ BLOCKS = [128, 256, 512, 1024]
 # (d=64) on the real chip — 0.01 ms cells, i.e. block_until_ready
 # returned without waiting (onchip_r05.attn_tune.log); the long shape
 # (d=128) times sanely, and fwd-only and --bwd-only are sane at BOTH
-# shapes (attn_bwd_r05.log).  Until the d=64 combined-mode interaction
-# with the remote runtime is understood, trust fwd-only + --bwd-only
-# for mha-shape decisions.
+# shapes (attn_bwd_r05.log).  Ruled out: trace-level DCE — the traced
+# combined step's jaxpr carries all 3 pallas_calls (fwd, dkdv, dq) at
+# the exact mha shape, so this is a runtime synchronization artifact
+# of the remote backend, not a program bug.  Until it is understood,
+# trust fwd-only + --bwd-only for mha-shape decisions.
 
 
 def _flops(b, h, sq, d, causal, bwd):
