@@ -74,12 +74,56 @@ def _backend_watchdog(seconds: float, metric: str = _METRIC_NAMES["bert_lamb"]):
             )
             # one honest JSON line so the driver records the outage as an
             # explicit non-measurement instead of silence (value null —
-            # never a stale number)
+            # never a stale number).  Point at the newest mid-round
+            # on-chip capture of THIS metric so the null line still
+            # carries the round's real evidence.
+            last = "; see BENCH_all artifacts for the last measured round"
+            # Nothing below may take the watchdog down with it: a dead
+            # watchdog thread means no null line, no os._exit, and a
+            # driver recording silence — the exact failure this thread
+            # exists to prevent.
+            try:
+                import glob as _glob
+                import re as _re
+                # Artifacts live at the repo root (tools/bench_all.py
+                # anchors there), not in the driver's cwd.  Order by the
+                # round number in the name, newest first (git checkouts
+                # scramble mtimes; mtime only breaks ties like
+                # BENCH_all_r05.json vs its r05a pre-refresh backup),
+                # falling through to older files if the newest lacks
+                # this metric (e.g. a partial mid-outage write).
+                root = os.path.dirname(os.path.abspath(__file__))
+                def _round_key(p):
+                    m = _re.search(r"_r(\d+)", os.path.basename(p))
+                    return (int(m.group(1)) if m else -1,
+                            os.path.getmtime(p))
+                paths = sorted(
+                    _glob.glob(os.path.join(root, "BENCH_all_r*.json")),
+                    key=_round_key, reverse=True,
+                )
+                for path in paths:
+                    if "last on-chip" in last:
+                        break
+                    with open(path) as f:
+                        for line in f:
+                            try:
+                                rec = json.loads(line)
+                            except ValueError:
+                                continue
+                            if isinstance(rec, dict) and (
+                                rec.get("metric") == metric
+                            ) and rec.get("value") is not None:
+                                last = (
+                                    f"; last on-chip: {rec['value']} "
+                                    f"({os.path.basename(path)})"
+                                )
+                                break
+            except Exception:
+                pass
             _emit(
                 metric, None,
                 "NOT MEASURED: TPU tunnel unresponsive "
-                f"(backend init > {seconds:.0f}s); see BENCH_all artifacts "
-                "for the last measured round", None,
+                f"(backend init > {seconds:.0f}s)" + last, None,
             )
             os._exit(3)
 
