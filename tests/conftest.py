@@ -283,6 +283,16 @@ _SLOW_EXACT = {
     # kernel-level bias tests.
     "test_instance_norm_channels_first_parity",
     "test_key_padding_bias_matches_reference",
+    # second r5b pass, with three watcher-free measurements in hand
+    # (251 / 262 / 283 s — this shared core's wall clock wobbles ±30 s
+    # run-to-run with zero background load, so the 240 s budget is a
+    # ~4.5 min budget in practice): the sharded-reshard checkpoint case
+    # rides full (quick keeps manager retention/raises + the
+    # full-training-state resume, the strongest checkpoint signal); the
+    # Elman activation-override review pin is a stable regression guard,
+    # full tier is where pins live once the fix has soaked.
+    "test_sharded_roundtrip_and_reshard",
+    "test_elman_activation_override_respected",
 }
 
 
