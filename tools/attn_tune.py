@@ -33,6 +33,13 @@ SHAPES = {
 }
 BLOCKS = [128, 256, 512, 1024]
 
+# Any cell whose implied rate beats the chip's peak plus margin is a
+# mis-timed cell, not a fast one — see the under-wait caveat below.
+# Default assumes v5e (~197 TFLOP/s bf16) with ~1.27x margin for
+# FLOP-count conventions; on other chips pass --peak-tflops (e.g. 459
+# for v5p), matching tools/comm_structure.py's knob.
+_PEAK_TFLOPS_BOUND = 250.0
+
 # r5a measured: every kernel at the long shape wants the LARGEST swept
 # tile (1024, 1024) — the optimum may sit beyond the default grid.
 # --blocks 512,1024,2048 probes past it (the divisibility filter
@@ -109,6 +116,14 @@ def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v):
                       f" {str(e)[:60]}")
                 continue
             tflops = flops / t / 1e12
+            # Plausibility gate for the remote runtime's under-wait
+            # artifact (see module caveat): no real cell can beat the
+            # chip's peak; an "impossible" rate means block_until_ready
+            # returned early and the cell must not become a winner.
+            if tflops > _PEAK_TFLOPS_BOUND:
+                print(f"{bq:5d} {bk:5d} {t * 1e3:9.2f} {tflops:9.1f}"
+                      "  IMPLAUSIBLE (under-wait; excluded)")
+                continue
             mark = ""
             if tflops > best[1]:
                 best = ((bq, bk), tflops)
@@ -233,9 +248,13 @@ if __name__ == "__main__":
     ap.add_argument("--blocks", default=None,
                     help="comma-separated tile grid override, e.g. "
                          "512,1024,2048 (default: 128,256,512,1024)")
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="chip peak bf16 TFLOP/s for the under-wait "
+                         "plausibility gate (default v5e 197; v5p 459)")
     args = ap.parse_args()
     if args.blocks:
         BLOCKS = [int(x) for x in args.blocks.split(",")]
+    _PEAK_TFLOPS_BOUND = 1.27 * args.peak_tflops
     for name in args.shapes.split(","):
         if args.bwd_only:
             sweep_bwd_only(name)
