@@ -45,3 +45,33 @@ def use_pallas() -> bool:
 def pallas_interpret() -> bool:
     """Interpret mode: needed whenever the backend is not a real TPU."""
     return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# Trace-time path triage (ADVICE r4: the pallas and jnp paths draw
+# DIFFERENT dropout streams by documented contract, so when a shape or
+# backend change silently flips the dispatch, reproducibility debugging
+# needs to see which path a call actually took).
+# --------------------------------------------------------------------------
+
+_PATH_LOG: dict = {}
+
+
+def record_path(op: str, path: str) -> None:
+    """Record which implementation ``op`` selected ("pallas" | "jnp").
+
+    Called by the dispatching ops at TRACE time — a cached jit execution
+    does not re-trace and therefore does not re-record; the log answers
+    "which path did the most recent trace of this op take", which is the
+    question cross-backend reproducibility triage asks."""
+    _PATH_LOG[op] = path
+
+
+def last_paths() -> dict:
+    """op name -> "pallas" | "jnp" for every op traced since import (or
+    the last :func:`clear_paths`)."""
+    return dict(_PATH_LOG)
+
+
+def clear_paths() -> None:
+    _PATH_LOG.clear()

@@ -284,12 +284,14 @@ def flash_attention(
         # a fully-masked row yields a uniform average of V on both paths.
         bias = jnp.maximum(bias, _pallas.MASK_VALUE)
     if not _pallas_eligible(q, k, v, dropout_p, causal):
+        _dispatch.record_path("flash_attention", "jnp")
         return mha_reference(
             q, k, v, bias, causal=causal, scale=scale,
             dropout_p=dropout_p, dropout_rng=dropout_rng,
         )
     if dropout_p > 0.0 and dropout_rng is None:
         raise ValueError("dropout_p > 0 requires dropout_rng")
+    _dispatch.record_path("flash_attention", "pallas")
     seed = _derive_dropout_seed(dropout_rng, dropout_p)
 
     b, h, sq, d = q.shape
@@ -416,6 +418,7 @@ def flash_attention_with_lse(q, k, v, bias=None, *, causal=False,
         and not _seq_pad(sk)
         and _pallas_eligible(q, k, v, dropout_p, causal)
     ):
+        _dispatch.record_path("flash_attention_with_lse", "pallas")
         seed = _derive_dropout_seed(dropout_rng, dropout_p)
         qf, kf, vf = (_pad_head_dim(_flatten_bh(x)) for x in (q, k, v))
         bias_f = (
@@ -429,6 +432,7 @@ def flash_attention_with_lse(q, k, v, bias=None, *, causal=False,
             o[..., :d].reshape(b, h, sq, d),
             lse.reshape(b, h, sq),
         )
+    _dispatch.record_path("flash_attention_with_lse", "jnp")
     return mha_reference_with_lse(
         q, k, v, bias, causal=causal, scale=scale, dropout_p=dropout_p,
         dropout_rng=dropout_rng,

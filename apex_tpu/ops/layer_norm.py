@@ -105,10 +105,13 @@ def _norm2d(x2d, w, b, eps, rms, memory_efficient):
 
 def _norm2d_fwd_impl(x2d, w, b, eps, rms):
     hidden = x2d.shape[-1]
+    op = "rms_norm" if rms else "layer_norm"
     if _pallas_eligible(hidden):
         from apex_tpu.ops.pallas import layer_norm as _k
 
+        _dispatch.record_path(op, "pallas")
         return _k.layer_norm_fwd(x2d, w, b, eps=eps, rms=rms)
+    _dispatch.record_path(op, "jnp")
     return _jnp_fwd(x2d, w, b, eps, rms)
 
 

@@ -1,0 +1,52 @@
+"""Trace-time dispatch triage (ADVICE r4 on flash-dropout streams).
+
+The pallas and jnp paths draw DIFFERENT dropout streams by documented
+contract, so when a shape or backend change silently flips the
+dispatch, reproducibility debugging needs `_dispatch.last_paths()` to
+say which implementation the most recent trace actually took.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _dispatch
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+
+def _qkv(s=128, d=64):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (1, 2, s, d), jnp.float32) for k in ks)
+
+
+def test_records_attention_and_norm_paths():
+    q, k, v = _qkv()
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 128), jnp.float32)
+    w = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+
+    _dispatch.clear_paths()
+    _dispatch.set_use_pallas(False)
+    try:
+        flash_attention(q, k, v, None)
+        fused_layer_norm_affine(x, w, b, (128,))
+        assert _dispatch.last_paths()["flash_attention"] == "jnp"
+        assert _dispatch.last_paths()["layer_norm"] == "jnp"
+
+        # Forced pallas bypasses the short-sequence auto heuristic, so
+        # the same tiny shapes flip paths — exactly the silent flip the
+        # triage log exists to expose.
+        _dispatch.set_use_pallas(True)
+        flash_attention(q, k, v, None)
+        fused_layer_norm_affine(x, w, b, (128,))
+        assert _dispatch.last_paths()["flash_attention"] == "pallas"
+        assert _dispatch.last_paths()["layer_norm"] == "pallas"
+    finally:
+        _dispatch.set_use_pallas(None)
+
+    # auto mode at a short sequence routes attention back to jnp
+    flash_attention(q, k, v, None)
+    assert _dispatch.last_paths()["flash_attention"] == "jnp"
+
+    _dispatch.clear_paths()
+    assert _dispatch.last_paths() == {}
