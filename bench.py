@@ -295,6 +295,16 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
         )
         return (params, opt_state), losses[-1]
 
+    hlo_out = os.environ.get("APEX_TPU_BENCH_HLO_OUT")
+    if hlo_out:
+        # Compiled-HLO text of the headline step, for the trace↔source
+        # join (tools/trace_summary.py TRACE --hlo FILE — the docs/mfu.md
+        # lever-#2 copies attribution).  AOT lower+compile shares the
+        # compile cache with the timed call below and does not execute,
+        # so the donated buffers stay live.
+        with open(hlo_out, "w") as f:
+            f.write(train_chunk.lower(params, opt_state).compile().as_text())
+
     profile = apex_tpu.utils.trace(trace_dir) if trace_dir else None
     step_time, carry, loss = _time_chunks(
         train_chunk, (params, opt_state), chunk, trials, profile=profile
@@ -746,5 +756,15 @@ if __name__ == "__main__":
         default=None,
         help="collect a jax.profiler trace of the timed window into DIR",
     )
+    ap.add_argument(
+        "--hlo-out",
+        metavar="FILE",
+        default=None,
+        help="write the compiled headline step's optimized-HLO text to "
+        "FILE (bert_lamb config; feeds tools/trace_summary.py --hlo). "
+        "Equivalent to APEX_TPU_BENCH_HLO_OUT, the programmatic channel.",
+    )
     args = ap.parse_args()
+    if args.hlo_out:
+        os.environ["APEX_TPU_BENCH_HLO_OUT"] = args.hlo_out
     main(config=args.config, trace_dir=args.trace)

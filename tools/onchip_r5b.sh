@@ -38,8 +38,10 @@ step() {
 [ -f BENCH_all_r05.json ] && [ ! -f BENCH_all_r05a.json ] \
     && cp BENCH_all_r05.json BENCH_all_r05a.json
 step bench_all python tools/bench_all.py --round 5
-step trace python bench.py --config bert_lamb --trace trace_r05
-step trace_summary python tools/trace_summary.py trace_r05 -n 40
+step trace python bench.py --config bert_lamb --trace trace_r05 \
+    --hlo-out hlo_r05.txt
+step trace_summary python tools/trace_summary.py trace_r05 -n 40 \
+    --hlo hlo_r05.txt
 step attn_tune_mha python tools/attn_tune.py --bwd-only --shapes mha
 #   4. probe past the 1024 tile cap at the long shape: r5a's optimum sat
 #      at the edge of the swept grid on every kernel.

@@ -140,5 +140,14 @@ if __name__ == "__main__":
         " source line",
     )
     args = ap.parse_args()
-    meta = load_hlo_metadata(args.hlo) if args.hlo else None
+    meta = None
+    if args.hlo:
+        # Degrade, don't die: in a staged queue the HLO-dump step can be
+        # skipped by a tunnel drop while an older trace still exists —
+        # an un-attributed summary beats no summary.
+        if os.path.exists(args.hlo):
+            meta = load_hlo_metadata(args.hlo)
+        else:
+            print(f"[trace_summary] --hlo {args.hlo} not found; "
+                  "printing un-attributed summary")
     summarize(load_trace(args.log_dir), args.n, args.like, hlo_meta=meta)
