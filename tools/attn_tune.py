@@ -72,6 +72,13 @@ def _time_scan(step, q, k, v, iters=8, trials=3):
     data-dependent on the previous output — execution serializes on
     device and chunk_time/iters is honest.  ``step(q, k, v)`` must
     return a q-shaped tensor (o for fwd, dq for fwd+bwd).
+
+    Sync discipline: each timed chunk ends with a device->host VALUE
+    pull (float(sum)), not bare block_until_ready — the remote runtime
+    has been observed returning early from block_until_ready for some
+    program shapes (the r5 "0.01 ms cells", see module caveat), while
+    fetching a value cannot complete before the producing execution
+    has.  bench.py times the same way (its `last_sync` scalar).
     """
 
     @jax.jit
@@ -81,15 +88,17 @@ def _time_scan(step, q, k, v, iters=8, trials=3):
             return carry + out * jnp.asarray(1e-8, carry.dtype), None
 
         carry, _ = jax.lax.scan(body, q, None, length=iters)
-        return carry
+        # f32 scalar alongside the carry: the value the host pulls to
+        # prove the chunk executed (negligible: one pass over carry)
+        return carry, jnp.sum(carry.astype(jnp.float32))
 
-    carry = chunk(q)
-    jax.block_until_ready(carry)
+    carry, sync = chunk(q)
+    float(sync)  # warmup/compile, synced
     times = []
     for _ in range(trials):
         t0 = time.perf_counter()
-        carry = chunk(carry)
-        jax.block_until_ready(carry)
+        carry, sync = chunk(carry)
+        float(sync)  # device->host: the sync point
         times.append((time.perf_counter() - t0) / iters)
     times.sort()
     return times[len(times) // 2]

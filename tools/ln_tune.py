@@ -43,15 +43,19 @@ def _time_scan(step, x, args, iters=24, trials=3):
             out = step(carry, *args)
             return out[0], out[1]
         carry, last = jax.lax.scan(body, x, None, length=iters)
-        return carry, last
+        # f32 scalar the host pulls to prove the chunk executed: the
+        # remote runtime has been observed returning early from bare
+        # block_until_ready (attn_tune's r5 under-wait caveat), while a
+        # value fetch cannot complete before the producing execution.
+        return carry, jnp.sum(last.astype(jnp.float32))
 
-    carry, last = chunk(x)
-    jax.block_until_ready((carry, last))
+    carry, sync = chunk(x)
+    float(sync)  # warmup/compile, synced
     times = []
     for _ in range(trials):
         t0 = time.perf_counter()
-        carry, last = chunk(carry)
-        jax.block_until_ready((carry, last))
+        carry, sync = chunk(carry)
+        float(sync)  # device->host: the sync point
         times.append((time.perf_counter() - t0) / iters)
     times.sort()
     return times[len(times) // 2]
