@@ -49,4 +49,8 @@ step attn_big_fwd python tools/attn_tune.py --fwd-only --shapes long \
     --blocks 1024,2048
 step attn_big_bwd python tools/attn_tune.py --bwd-only --shapes long \
     --blocks 1024,2048
+#   5. one combined fwd+bwd cell at the winner tiles: validates the
+#      value-pull sync fix on chip (the pre-fix combined mode
+#      under-waited; post-fix it should land near fwd + bwd-only sums)
+step attn_combined python tools/attn_tune.py --shapes long --blocks 1024
 echo "r5b queue finished $(date -u)"
