@@ -25,13 +25,20 @@ Subpackages
 - :mod:`apex_tpu.contrib` — contrib parity layer (≙ ``apex/contrib``).
 - :mod:`apex_tpu.models` — reference models used by the benchmark configs
   (BERT-Large, GPT, ResNet-50).
+- :mod:`apex_tpu.checkpoint` — sharded save/restore + step-numbered
+  checkpoint management (orbax-backed).
+- :mod:`apex_tpu.resilience` — fault injection, guarded steps,
+  retry/backoff, and the preemption-safe auto-resume loop.
 """
 
 __version__ = "0.1.0"
 
 # Light-weight eager imports only; heavy subpackages are imported lazily so
 # `import apex_tpu` stays cheap (the reference's `apex/__init__.py` likewise
-# defers contrib imports behind availability probes).
+# defers contrib imports behind availability probes).  _compat must come
+# first: it grafts jax.shard_map / jax.lax.axis_size / jax.lax.pcast onto
+# pinned jax releases that predate them, which everything else assumes.
+from apex_tpu import _compat  # noqa: F401
 from apex_tpu import parallel_state  # noqa: F401
 
 _LAZY_SUBMODULES = (
@@ -46,6 +53,8 @@ _LAZY_SUBMODULES = (
     "normalization",
     "mlp",
     "fused_dense",
+    "checkpoint",
+    "resilience",
 )
 
 

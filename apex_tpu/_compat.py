@@ -1,0 +1,131 @@
+"""Pinned-jax compatibility shims.
+
+The library is written against the current jax surface — ``jax.shard_map``
+with vma (``check_vma``) semantics, ``jax.lax.axis_size`` and
+``jax.lax.pcast`` — but must run on the pinned release (jax 0.4.37 at the
+time of writing), where ``shard_map`` still lives in
+``jax.experimental.shard_map`` with ``check_rep`` semantics and the two lax
+helpers do not exist yet.  This module is the single place that knows the
+difference:
+
+- :func:`shard_map` — top-level ``jax.shard_map`` when present, otherwise
+  the experimental one.  The ``check_vma`` keyword is translated: on the
+  old API the replication checker predates the vma rewrite machinery and
+  rejects (or mis-handles) code that is valid under vma typing, so both
+  ``check_vma=True`` and ``False`` map to ``check_rep=False`` — collectives
+  are unchanged, only the static replication *checker* is off.
+- :func:`axis_size` — ``jax.lax.axis_size`` when present, else
+  ``lax.psum(1, axis)``, which constant-folds to the bound size and raises
+  the same ``NameError`` on an unbound name.
+- :func:`pcast` — native when present.  On pre-vma jax values inside
+  ``shard_map`` carry no replication type, and autodiff never inserts the
+  implicit cross-shard psum that ``to='varying'`` exists to suppress, so
+  the cast is an identity there.  Code that *relies* on the vma auto-psum
+  (grads of replicated inputs) must psum explicitly when
+  :data:`HAS_VMA` is False — see
+  ``apex_tpu.parallel.distributed.DistributedDataParallel``.
+
+:func:`install` grafts the missing names onto ``jax`` / ``jax.lax`` so the
+examples, tools and tests — which use the modern spellings directly — run
+unmodified on the pinned release.  It runs once at ``import apex_tpu``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["HAS_VMA", "shard_map", "axis_size", "pcast", "install"]
+
+_NATIVE_SHARD_MAP = getattr(jax, "shard_map", None)
+
+
+def _native_has_vma() -> bool:
+    # jax.shard_map existing is NOT enough: some releases promoted the name
+    # before the vma rewrite landed.  Probe the signature for check_vma —
+    # the keyword and the typing machinery shipped together.
+    if _NATIVE_SHARD_MAP is None:
+        return False
+    try:
+        import inspect
+
+        return "check_vma" in inspect.signature(_NATIVE_SHARD_MAP).parameters
+    except (TypeError, ValueError):  # C-accelerated / unsignaturable wrapper
+        return True
+
+
+#: True on jax releases with vma-typed shard_map (``jax.shard_map`` accepts
+#: ``check_vma``).  Pre-vma releases have no implicit psum in the transpose
+#: of replicated inputs — gradient-sync code keys manual psums off this
+#: flag.
+HAS_VMA = _native_has_vma()
+
+if not HAS_VMA:
+    if _NATIVE_SHARD_MAP is not None:
+        # promoted-but-pre-vma window: the top-level function exists but
+        # speaks check_rep; route it through the same translation as the
+        # experimental one.
+        _experimental_shard_map = _NATIVE_SHARD_MAP
+    else:
+        from jax.experimental.shard_map import (
+            shard_map as _experimental_shard_map,
+        )
+
+# Bind natives ONCE, before install() grafts the fallbacks onto jax.lax —
+# a dynamic getattr inside the fallbacks would find the graft itself and
+# recurse.
+_NATIVE_AXIS_SIZE = getattr(jax.lax, "axis_size", None)
+_NATIVE_PCAST = getattr(jax.lax, "pcast", None)
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+    """``jax.shard_map`` with ``check_vma`` accepted on every jax."""
+    if HAS_VMA:
+        return _NATIVE_SHARD_MAP(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    kwargs.pop("check_vma", None)
+    if kwargs:
+        # Refuse rather than silently run with different semantics on the
+        # pinned release — the divergence this layer exists to prevent.
+        raise TypeError(
+            "shard_map compat fallback does not support kwargs "
+            f"{sorted(kwargs)} on jax {jax.__version__}"
+        )
+    return _experimental_shard_map(
+        f, mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
+def axis_size(axis_name):
+    """Size of a bound mesh axis; ``NameError`` when unbound."""
+    if _NATIVE_AXIS_SIZE is not None:
+        return _NATIVE_AXIS_SIZE(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, *, to):
+    """``jax.lax.pcast`` (vma re-typing); identity on pre-vma jax."""
+    if _NATIVE_PCAST is not None:
+        return _NATIVE_PCAST(x, axis_name, to=to)
+    if to not in ("varying", "invariant"):
+        raise ValueError(f"pcast: unknown target {to!r}")
+    return x
+
+
+def install() -> None:
+    """Graft the modern spellings onto ``jax`` / ``jax.lax`` when absent.
+
+    Idempotent; touches nothing on releases that already ship the names.
+    Lets test/example/tool code keep the one modern spelling
+    (``jax.shard_map`` / ``jax.lax.axis_size`` / ``jax.lax.pcast``)
+    everywhere.
+    """
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = axis_size
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = pcast
+
+
+install()

@@ -38,6 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import pallas_interpret
 
+# pinned-jax compat: the class was TPUCompilerParams before the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 # Large negative finite (not -inf: keeps exp() well-defined in f32 after the
 # running-max subtraction, same trick as the reference's softmax kernels).
 MASK_VALUE = -1e9
@@ -383,7 +388,7 @@ def flash_fwd(
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=pallas_interpret(),
@@ -694,7 +699,7 @@ def flash_bwd(
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=pallas_interpret(),
@@ -722,7 +727,7 @@ def flash_bwd(
         out_specs=pl.BlockSpec((1, bq_dq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq_dq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=pallas_interpret(),
@@ -938,7 +943,7 @@ def flash_dbias(
         out_specs=out_spec,
         out_shape=out_shape,
         scratch_shapes=[acc_shape],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary"
             ),

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu import _compat
 from apex_tpu import parallel_state as ps
 
 __all__ = ["all_reduce_gradients", "DistributedDataParallel", "Reducer"]
@@ -37,7 +38,7 @@ def all_reduce_gradients(
     ``gradient_predivide_factor`` handling in
     apex/parallel/distributed.py :: DistributedDataParallel.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = _compat.axis_size(axis_name)
 
     def f(g):
         gf = g
@@ -105,7 +106,7 @@ class DistributedDataParallel:
         """
         if self.delay_allreduce or self.gradient_predivide_factor is not None:
             params_v = jax.tree_util.tree_map(
-                lambda p: jax.lax.pcast(p, self.axis_name, to="varying"),
+                lambda p: _compat.pcast(p, self.axis_name, to="varying"),
                 params,
             )
             loss, grads = jax.value_and_grad(self.loss_fn)(params_v, *batch)
@@ -119,8 +120,15 @@ class DistributedDataParallel:
                 loss = jax.lax.pmean(loss, self.axis_name)
             return loss, grads
         loss, grads = jax.value_and_grad(self.loss_fn)(params, *batch)
+        if not _compat.HAS_VMA:
+            # pre-vma jax inserts no implicit psum in the transpose of
+            # replicated params — reduce by hand to keep the fast-path
+            # contract (grads arrive dp-summed) identical across releases
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, self.axis_name), grads
+            )
         if self.gradient_average:
-            world = jax.lax.axis_size(self.axis_name)
+            world = _compat.axis_size(self.axis_name)
             grads = jax.tree_util.tree_map(lambda g: g / world, grads)
             loss = jax.lax.pmean(loss, self.axis_name)
         return loss, grads
@@ -140,7 +148,7 @@ class DistributedDataParallel:
             return params, opt_state, loss
 
         batch_spec = P(self.axis_name)
-        smapped = jax.shard_map(
+        smapped = _compat.shard_map(
             _step,
             mesh=mesh,
             in_specs=(P(), P(), batch_spec),
@@ -163,7 +171,7 @@ class Reducer:
         return params  # replicated by construction
 
     def reduce(self, tree, average: bool = True):
-        world = jax.lax.axis_size(self.axis_name)
+        world = _compat.axis_size(self.axis_name)
 
         def f(x):
             s = jax.lax.psum(x, self.axis_name)

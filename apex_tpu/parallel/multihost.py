@@ -37,9 +37,32 @@ __all__ = [
     "initialize_distributed",
     "distributed_is_initialized",
     "finalize_distributed",
+    "cluster_env_hints",
+    "host_barrier",
 ]
 
 _INITIALIZED = False
+
+#: Env vars whose presence means "this process was launched into a cluster"
+#: — the discriminator between a benign single-process run and a pod join
+#: that actually failed.
+_CLUSTER_ENV_HINTS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "SLURM_JOB_NUM_NODES",
+)
+
+
+def cluster_env_hints() -> Tuple[str, ...]:
+    """Names of the cluster-environment variables set for this process.
+
+    Non-empty means a failed ``jax.distributed.initialize`` is a real
+    error (a pod member degrading to single-process), not a laptop run.
+    """
+    import os
+
+    return tuple(k for k in _CLUSTER_ENV_HINTS if os.environ.get(k))
 
 
 def initialize_distributed(
@@ -47,6 +70,7 @@ def initialize_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[Sequence[int]] = None,
+    strict: bool = False,
 ) -> Tuple[int, int]:
     """Join the global JAX runtime; returns ``(process_index, process_count)``.
 
@@ -61,6 +85,12 @@ def initialize_distributed(
     test-fixture shim that builds and returns a *Mesh*, mirroring the
     reference's testing commons of the same name) — this one joins the
     process runtime and returns rank info.
+
+    ``strict=True`` turns the "cluster env hints present but the join
+    failed" path from a ``RuntimeWarning`` into a raised ``RuntimeError``
+    — the contract :func:`apex_tpu.resilience.retry
+    .robust_initialize_distributed` needs to retry the rendezvous instead
+    of letting a pod member silently degrade to single-process.
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -81,37 +111,43 @@ def initialize_distributed(
             # Autodetect (TPU pod metadata / cluster env).  Raises when no
             # cluster environment is present (the one-process case) or the
             # backend is already live — both leave the runtime as-is.
-            jax.distributed.initialize()
+            # Explicit world parameters are forwarded so a caller-supplied
+            # rank/size is never silently overridden by env autodetect.
+            jax.distributed.initialize(
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
             _INITIALIZED = True
         except Exception as e:
             # Distinguish "no cluster env" (fine: single-process) from
             # "cluster env present but the join failed" — the latter would
             # otherwise silently degrade a pod job into N independent
             # single-process runs training divergent copies.
-            import os
-
-            hints = [
-                k
-                for k in (
-                    "JAX_COORDINATOR_ADDRESS",
-                    "COORDINATOR_ADDRESS",
-                    "MEGASCALE_COORDINATOR_ADDRESS",
-                    "SLURM_JOB_NUM_NODES",
-                )
-                if os.environ.get(k)
-            ]
+            hints = cluster_env_hints()
             if hints:
+                msg = (
+                    "cluster environment detected "
+                    f"({', '.join(hints)}) but jax.distributed.initialize "
+                    f"failed ({type(e).__name__}: {e})"
+                )
+                if strict:
+                    raise RuntimeError(msg) from e
                 import warnings
 
                 warnings.warn(
-                    "cluster environment detected "
-                    f"({', '.join(hints)}) but jax.distributed.initialize "
-                    f"failed ({type(e).__name__}: {e}); continuing "
-                    "SINGLE-process — multi-host collectives will NOT span "
-                    "hosts",
+                    msg + "; continuing SINGLE-process — multi-host "
+                    "collectives will NOT span hosts",
                     RuntimeWarning,
                     stacklevel=2,
                 )
+            elif strict and (
+                num_processes is not None or process_id is not None
+            ):
+                raise RuntimeError(
+                    "explicit rendezvous parameters given but "
+                    f"initialization failed ({type(e).__name__}: {e})"
+                ) from e
     return jax.process_index(), jax.process_count()
 
 
@@ -136,11 +172,49 @@ def distributed_is_initialized() -> bool:
         return False
 
 
+def host_barrier(tag: str, step: int = 0) -> None:
+    """Block until every process reaches the barrier named ``tag``.
+
+    A no-op in a single-process run; multi-process it is
+    ``multihost_utils.sync_global_devices`` — the host-side collective a
+    resilient loop uses to agree "everyone stopped at step N" before the
+    final checkpoint (see :func:`apex_tpu.resilience.runner.run_resilient`).
+
+    This is the chaos ``COLLECTIVE`` site: an injected ``raise`` fault
+    stands in for a collective abort (propagates — a real abort kills the
+    job), ``stall`` for a slow straggler (sleeps, then proceeds).
+    """
+    from apex_tpu.resilience import chaos
+
+    chaos.maybe_fail(chaos.COLLECTIVE, step)
+    if distributed_is_initialized():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
 def finalize_distributed() -> None:
-    """≙ ``torch.distributed.destroy_process_group`` (idempotent)."""
+    """≙ ``torch.distributed.destroy_process_group`` (idempotent).
+
+    Teardown is best-effort: when ``jax.distributed.shutdown`` raises
+    mid-teardown (coordinator already gone, socket torn down by a
+    preemption notice, ...) the module still resets its state and only
+    *warns* — a dying run must be able to reach its final checkpoint
+    instead of tripping over distributed cleanup, and a later
+    re-initialize must not be wedged by a stale ``_INITIALIZED`` flag.
+    """
     global _INITIALIZED
     if _INITIALIZED:
         try:
             jax.distributed.shutdown()
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                "jax.distributed.shutdown failed mid-teardown "
+                f"({type(e).__name__}: {e}); distributed state reset anyway",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         finally:
             _INITIALIZED = False

@@ -37,6 +37,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from apex_tpu import _compat
 from apex_tpu import parallel_state as ps
 
 __all__ = ["quantized_all_reduce_gradients"]
@@ -127,7 +128,7 @@ def quantized_all_reduce_gradients(
     bucket and exactly two collectives.  ``block`` elements share one
     quantization scale.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = _compat.axis_size(axis_name)
     post = 1.0
     if gradient_average:
         post = (

@@ -41,6 +41,8 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu import _compat
+
 __all__ = [
     "DATA_PARALLEL_AXIS",
     "PIPELINE_PARALLEL_AXIS",
@@ -311,7 +313,7 @@ def axis_is_bound(axis: str) -> bool:
     """Whether ``axis`` is a bound mesh axis here (inside shard_map) —
     regardless of its size (a bound size-1 axis is still bound)."""
     try:
-        jax.lax.axis_size(axis)
+        _compat.axis_size(axis)
         return True
     except (NameError, KeyError):
         return False
@@ -326,7 +328,7 @@ def bound_axis_size(axis: str) -> int:
     here".
     """
     try:
-        return jax.lax.axis_size(axis)
+        return _compat.axis_size(axis)
     except (NameError, KeyError):
         return 1
 

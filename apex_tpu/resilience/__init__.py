@@ -1,0 +1,54 @@
+"""Fault tolerance — make a run survive what production throws at it.
+
+Four layers, composable or standalone:
+
+- :mod:`apex_tpu.resilience.chaos` — deterministic, seedable fault
+  injection (NaN grads, checkpoint I/O failure, collective stall/abort,
+  host preemption), driven from tests or the ``APEX_TPU_CHAOS`` env var.
+- :mod:`apex_tpu.resilience.guards` — a guarded optimizer step over
+  ``amp_update``: overflow *and* grad-norm-spike detection with a
+  consecutive-skip budget; bad steps are skipped device-side, params
+  untouched.
+- :mod:`apex_tpu.resilience.retry` — bounded-backoff retry for the
+  distributed rendezvous (retry-then-raise, never silent single-process
+  degrade) and checkpoint I/O.
+- :mod:`apex_tpu.resilience.runner` — ``run_resilient``: SIGTERM-safe
+  training loop with ``latest_step()`` auto-resume and skip-budget
+  rollback to the last complete checkpoint.
+
+See ``docs/resilience.md`` for the failure model and recovery semantics.
+"""
+
+from apex_tpu.resilience import chaos  # noqa: F401
+from apex_tpu.resilience.guards import (  # noqa: F401
+    GradGuard,
+    GuardState,
+    GuardVerdict,
+    guarded_amp_update,
+)
+from apex_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    retry_call,
+    robust_initialize_distributed,
+)
+from apex_tpu.resilience.runner import (  # noqa: F401
+    PreemptionHandler,
+    ResilientCheckpointManager,
+    RunResult,
+    run_resilient,
+)
+
+__all__ = [
+    "chaos",
+    "GradGuard",
+    "GuardState",
+    "GuardVerdict",
+    "guarded_amp_update",
+    "RetryPolicy",
+    "retry_call",
+    "robust_initialize_distributed",
+    "PreemptionHandler",
+    "ResilientCheckpointManager",
+    "RunResult",
+    "run_resilient",
+]

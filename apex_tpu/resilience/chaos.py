@@ -1,0 +1,319 @@
+"""Deterministic fault injection — the test double for everything that can
+go wrong in a long training run.
+
+A *fault* names an injection site, the steps (or a seeded per-step
+probability) at which it fires, and a mode (what failure to fake).  The
+schedule is a pure function of ``(seed, site, mode, step)`` — a SHA-256
+coin, not ``random`` — so every host of a multi-process job, and every
+re-execution of a test, injects the exact same faults.
+
+Sites and their modes:
+
+========================  ==========================================
+``GRADS``                 ``nan`` / ``inf`` poison a gradient pytree
+``CHECKPOINT_SAVE``       ``raise`` / ``partial`` (debris then raise)
+``CHECKPOINT_RESTORE``    ``raise``
+``COLLECTIVE``            ``raise`` / ``stall``
+``RENDEZVOUS``            ``raise`` / ``stall``
+``PREEMPTION``            SIGTERM to the current process
+========================  ==========================================
+
+Activation is explicit (:func:`configure` / the :func:`inject` context
+manager, used by tests) or ambient via ``APEX_TPU_CHAOS`` for real runs::
+
+    APEX_TPU_CHAOS="grads:nan@3,7;checkpoint_save:raise@5;preemption@12"
+    APEX_TPU_CHAOS="grads:nan:p=0.001;seed=42"
+
+Hooks are host-side and fire only where training code calls them
+(``apex_tpu.resilience.guards`` / ``runner`` / ``retry`` are the built-in
+call sites); with no faults configured every hook is a cheap no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GRADS",
+    "CHECKPOINT_SAVE",
+    "CHECKPOINT_RESTORE",
+    "COLLECTIVE",
+    "RENDEZVOUS",
+    "PREEMPTION",
+    "Fault",
+    "InjectedFault",
+    "configure",
+    "clear",
+    "inject",
+    "faults",
+    "active",
+    "parse_spec",
+    "corrupt_tree",
+    "maybe_fail",
+    "maybe_stall",
+    "maybe_preempt",
+]
+
+GRADS = "grads"
+CHECKPOINT_SAVE = "checkpoint_save"
+CHECKPOINT_RESTORE = "checkpoint_restore"
+COLLECTIVE = "collective"
+RENDEZVOUS = "rendezvous"
+PREEMPTION = "preemption"
+
+_SITES = (
+    GRADS,
+    CHECKPOINT_SAVE,
+    CHECKPOINT_RESTORE,
+    COLLECTIVE,
+    RENDEZVOUS,
+    PREEMPTION,
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a chaos hook standing in for a real infrastructure error."""
+
+    def __init__(self, site: str, step: int, mode: str):
+        super().__init__(
+            f"injected {mode!r} fault at site {site!r}, step {step}"
+        )
+        self.site = site
+        self.step = step
+        self.mode = mode
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One injection rule.
+
+    ``steps`` wins over ``probability``; ``max_hits`` bounds how many times
+    the rule fires over its lifetime (e.g. ``max_hits=1`` makes a save fail
+    once and heal on retry).  ``stall_seconds`` applies to ``stall`` mode.
+    """
+
+    site: str
+    steps: Tuple[int, ...] = ()
+    probability: float = 0.0
+    mode: str = "raise"
+    max_hits: Optional[int] = None
+    stall_seconds: float = 0.05
+
+    def __post_init__(self):
+        if self.site not in _SITES:
+            raise ValueError(
+                f"unknown chaos site {self.site!r}; one of {_SITES}"
+            )
+
+
+_FAULTS: List[Fault] = []
+_SEED: int = 0
+_HITS: Dict[int, int] = {}  # id(index in _FAULTS) -> times fired
+_ENV_LOADED = False
+
+
+def configure(*new_faults: Fault, seed: int = 0) -> None:
+    """Replace the active fault set (and reset hit counters)."""
+    global _SEED
+    _FAULTS[:] = list(new_faults)
+    _SEED = seed
+    _HITS.clear()
+
+
+def clear() -> None:
+    """Remove every active fault."""
+    configure()
+
+
+class inject:
+    """Context manager: activate faults inside, restore the prior set after.
+
+    >>> with chaos.inject(chaos.Fault(chaos.GRADS, steps=(3,), mode="nan")):
+    ...     train()
+    """
+
+    def __init__(self, *new_faults: Fault, seed: int = 0):
+        self._new = new_faults
+        self._seed = seed
+
+    def __enter__(self):
+        self._prev = (list(_FAULTS), _SEED, dict(_HITS))
+        configure(*self._new, seed=self._seed)
+        return self
+
+    def __exit__(self, *exc):
+        prev_faults, prev_seed, prev_hits = self._prev
+        configure(*prev_faults, seed=prev_seed)
+        _HITS.update(prev_hits)
+
+
+def _load_env() -> None:
+    """One-shot pickup of ``APEX_TPU_CHAOS`` (real runs, no code changes)."""
+    global _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    _ENV_LOADED = True
+    spec = os.environ.get("APEX_TPU_CHAOS")
+    if spec and not _FAULTS:
+        env_faults, seed = parse_spec(spec)
+        configure(*env_faults, seed=seed)
+
+
+def parse_spec(spec: str) -> Tuple[Tuple[Fault, ...], int]:
+    """Parse an ``APEX_TPU_CHAOS`` spec string.
+
+    ``;``-separated clauses of ``site[:mode][:p=0.01][:xN][@s1,s2]`` plus
+    an optional ``seed=N`` clause (``xN`` bounds the fault to N firings —
+    a transient that heals on retry).  Examples::
+
+        grads:nan@3,7               # NaN grads at steps 3 and 7
+        checkpoint_save:raise:x1@5  # ONE save IO error at step 5 (heals)
+        preemption@12               # SIGTERM at step 12
+        grads:inf:p=0.001           # seeded 0.1%-per-step Inf burst
+    """
+    out: List[Fault] = []
+    seed = 0
+    for clause in filter(None, (c.strip() for c in spec.split(";"))):
+        if clause.startswith("seed="):
+            seed = int(clause[len("seed=") :])
+            continue
+        steps: Tuple[int, ...] = ()
+        probability = 0.0
+        max_hits: Optional[int] = None
+        if "@" in clause:
+            clause, _, steplist = clause.partition("@")
+            steps = tuple(int(s) for s in steplist.split(",") if s)
+        parts = clause.split(":")
+        site, rest = parts[0], parts[1:]
+        mode = None
+        for token in rest:
+            if token.startswith("p="):
+                probability = float(token[2:])
+            elif token.startswith("x") and token[1:].isdigit():
+                max_hits = int(token[1:])
+            else:
+                mode = token
+        if mode is None:
+            mode = "nan" if site == GRADS else "raise"
+        out.append(
+            Fault(
+                site=site,
+                steps=steps,
+                probability=probability,
+                mode=mode,
+                max_hits=max_hits,
+            )
+        )
+    return tuple(out), seed
+
+
+def faults() -> Tuple[Fault, ...]:
+    _load_env()
+    return tuple(_FAULTS)
+
+
+def _coin(site: str, mode: str, step: int, p: float) -> bool:
+    digest = hashlib.sha256(
+        f"{_SEED}:{site}:{mode}:{step}".encode()
+    ).digest()
+    frac = int.from_bytes(digest[:8], "big") / 2.0**64
+    return frac < p
+
+
+def active(site: str, step: int) -> Optional[Fault]:
+    """The fault scheduled at ``(site, step)``, if any (counts the hit)."""
+    _load_env()
+    for i, f in enumerate(_FAULTS):
+        if f.site != site:
+            continue
+        if f.max_hits is not None and _HITS.get(i, 0) >= f.max_hits:
+            continue
+        # steps wins over probability (the Fault contract): an explicit
+        # schedule pins the fault to exactly those steps.
+        if f.steps:
+            hit = step in f.steps
+        else:
+            hit = f.probability > 0.0 and _coin(
+                f.site, f.mode, step, f.probability
+            )
+        if hit:
+            _HITS[i] = _HITS.get(i, 0) + 1
+            return f
+    return None
+
+
+# ---------------------------------------------------------------------------
+# hooks
+# ---------------------------------------------------------------------------
+
+
+def corrupt_tree(tree, step: int, site: str = GRADS):
+    """Return ``tree`` with its first leaf poisoned when scheduled.
+
+    One leaf is enough to trip every downstream non-finite detector
+    (``scale_with_overflow_check`` reduces over the whole tree) while
+    keeping the rest of the pipeline realistic.  No-op when idle.
+    """
+    fault = active(site, step)
+    if fault is None:
+        return tree
+    poison = jnp.nan if fault.mode == "nan" else jnp.inf
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if leaves:
+        leaves[0] = jnp.full_like(leaves[0], poison)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def maybe_fail(site: str, step: int, partial_dir=None) -> None:
+    """Raise :class:`InjectedFault` when a ``raise``/``partial`` fault is
+    scheduled at ``(site, step)``; stall (and return) in ``stall`` mode.
+
+    ``partial`` mode first drops orbax-style uncommitted debris
+    (``<step>.orbax-checkpoint-tmp-*``) under ``partial_dir`` — the
+    on-disk shape of a host that died mid-write — then raises.
+    """
+    fault = active(site, step)
+    if fault is None:
+        return
+    if fault.mode == "stall":
+        time.sleep(fault.stall_seconds)
+        return
+    if fault.mode == "partial" and partial_dir is not None:
+        debris = os.path.join(
+            os.fspath(partial_dir),
+            f"{step}.orbax-checkpoint-tmp-{os.getpid()}",
+        )
+        os.makedirs(debris, exist_ok=True)
+        with open(os.path.join(debris, "params"), "w") as f:
+            f.write("torn write\n")
+    raise InjectedFault(site, step, fault.mode)
+
+
+def maybe_stall(site: str, step: int) -> float:
+    """Sleep when a ``stall`` fault is scheduled; returns seconds slept."""
+    fault = active(site, step)
+    if fault is not None and fault.mode == "stall":
+        time.sleep(fault.stall_seconds)
+        return fault.stall_seconds
+    return 0.0
+
+
+def maybe_preempt(step: int) -> bool:
+    """Deliver SIGTERM to this process when a preemption is scheduled.
+
+    Goes through the real signal machinery so the handler installed by
+    :class:`apex_tpu.resilience.runner.PreemptionHandler` is exercised
+    exactly as a cloud preemption notice would exercise it.
+    """
+    if active(PREEMPTION, step) is None:
+        return False
+    os.kill(os.getpid(), signal.SIGTERM)
+    return True

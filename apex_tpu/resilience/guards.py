@@ -1,0 +1,183 @@
+"""Guarded optimizer step — skip-don't-poison for bad batches and blown-up
+kernels.
+
+Layers two detectors over :func:`apex_tpu.amp.scaler.amp_update`:
+
+- **non-finite grads** — the scaler's fused ``found_inf`` flag (NaN/Inf
+  anywhere in the gradient tree), exactly as plain ``amp_update``;
+- **grad-norm spikes** — an EMA of the global gradient norm; a *finite*
+  gradient whose norm exceeds ``spike_factor`` × EMA (after
+  ``warmup_steps``) marks a poisoned batch that would pass the overflow
+  check but still wreck the params.
+
+Either detector skips the step the same way the scaler does: a
+``where``-select over the param/opt-state trees, device-side and
+branch-free — no host sync, no divergence between data-parallel replicas
+(the flags are computed from all-reduced grads, so every replica selects
+identically).  Only a true overflow feeds the loss-scale hysteresis; a
+spike skip leaves the scale alone.
+
+The guard also keeps a **consecutive-skip budget**: ``budget_exhausted``
+turns True once ``max_consecutive_skips`` steps in a row were skipped,
+which is the signal :func:`apex_tpu.resilience.runner.run_resilient` uses
+to roll back to the last complete checkpoint instead of burning data
+forever (a persistent blow-up is a bug or corrupted state, not a bad
+batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.multi_tensor import global_norm
+
+__all__ = [
+    "GradGuard",
+    "GuardState",
+    "GuardVerdict",
+    "guarded_amp_update",
+]
+
+
+class GuardState(NamedTuple):
+    norm_ema: jax.Array  # f32: EMA of accepted global grad norms
+    step: jax.Array  # i32: guarded steps seen (accepted or skipped)
+    consecutive_skips: jax.Array  # i32
+    total_skips: jax.Array  # i32
+
+
+class GuardVerdict(NamedTuple):
+    """Per-step diagnostics (device arrays; cheap to ignore)."""
+
+    skipped: jax.Array  # f32 {0,1}: this step was dropped
+    found_inf: jax.Array  # f32 {0,1}: non-finite grads
+    spike: jax.Array  # bool: finite but > spike_factor x EMA
+    grad_norm: jax.Array  # f32: unscaled global grad norm
+
+
+class GradGuard:
+    """Config + state factory for :func:`guarded_amp_update`.
+
+    ``spike_factor`` trades false positives against containment: 10-20x is
+    far outside the step-to-step variation of a healthy run but well
+    inside what a corrupted batch produces.  ``warmup_steps`` suspends
+    spike detection while the EMA is still learning the run's scale
+    (overflow skipping is active from step 0).
+    """
+
+    def __init__(
+        self,
+        spike_factor: float = 20.0,
+        ema_beta: float = 0.99,
+        warmup_steps: int = 10,
+        max_consecutive_skips: int = 10,
+    ):
+        if spike_factor <= 1.0:
+            raise ValueError("spike_factor must be > 1")
+        if not 0.0 < ema_beta < 1.0:
+            raise ValueError("ema_beta must be in (0, 1)")
+        self.spike_factor = spike_factor
+        self.ema_beta = ema_beta
+        self.warmup_steps = warmup_steps
+        self.max_consecutive_skips = max_consecutive_skips
+
+    def init(self) -> GuardState:
+        return GuardState(
+            norm_ema=jnp.zeros((), jnp.float32),
+            step=jnp.zeros((), jnp.int32),
+            consecutive_skips=jnp.zeros((), jnp.int32),
+            total_skips=jnp.zeros((), jnp.int32),
+        )
+
+    def budget_exhausted(self, state: GuardState) -> jax.Array:
+        """True once the consecutive-skip budget is spent (rollback cue)."""
+        return state.consecutive_skips >= self.max_consecutive_skips
+
+
+def guarded_amp_update(
+    tx,
+    scaler,
+    guard: GradGuard,
+    scaled_grads,
+    opt_state,
+    params,
+    scaler_state,
+    guard_state: GuardState,
+) -> Tuple[Any, Any, Any, GuardState, GuardVerdict]:
+    """``amp_update`` with spike detection and a consecutive-skip budget.
+
+    Returns ``(params, opt_state, scaler_state, guard_state, verdict)``.
+    On a skipped step params and opt state come back untouched (the same
+    ``where``-select contract as ``amp_update``); the loss scale reacts
+    only to genuine overflow, and the guard EMA only to accepted steps.
+    """
+    grads, found_inf = scaler.unscale(scaled_grads, scaler_state)
+    norm = global_norm(grads)
+
+    warm = guard_state.step >= guard.warmup_steps
+    have_ema = guard_state.norm_ema > 0.0
+    spike = (
+        warm
+        & have_ema
+        & jnp.isfinite(norm)
+        & (norm > guard.spike_factor * guard_state.norm_ema)
+    )
+    skip = (found_inf > 0.0) | spike
+    accept = jnp.logical_not(skip)
+
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, params
+    )
+    updates, new_opt_state = tx.update(grads, opt_state, params)
+
+    def sel(new, old):
+        return jnp.where(skip, old, new)
+
+    new_params = jax.tree_util.tree_map(
+        lambda p, u: sel(p + u.astype(p.dtype), p), params, updates
+    )
+    new_opt_state = jax.tree_util.tree_map(sel, new_opt_state, opt_state)
+    # Only genuine overflow feeds the scaler.  A spike skip must freeze the
+    # whole scaler state — letting update() run would count the skipped step
+    # as *clean* (growth_tracker += 1) and eventually grow the scale off a
+    # step whose update was discarded.  spike and found_inf are mutually
+    # exclusive (spike requires a finite norm), so the freeze never masks a
+    # real overflow reaction.
+    new_scaler_state = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(spike, old, new),
+        scaler.update(scaler_state, found_inf),
+        scaler_state,
+    )
+
+    # EMA over accepted norms only (a skipped step must not teach the guard
+    # that huge norms are normal); first accepted norm seeds it directly.
+    seeded = jnp.where(have_ema, guard_state.norm_ema, norm)
+    new_ema = jnp.where(
+        accept,
+        jnp.where(
+            have_ema,
+            guard.ema_beta * guard_state.norm_ema
+            + (1.0 - guard.ema_beta) * norm,
+            seeded,
+        ),
+        guard_state.norm_ema,
+    )
+    skip_i = skip.astype(jnp.int32)
+    new_guard_state = GuardState(
+        norm_ema=new_ema,
+        step=guard_state.step + 1,
+        consecutive_skips=jnp.where(
+            skip, guard_state.consecutive_skips + 1, 0
+        ),
+        total_skips=guard_state.total_skips + skip_i,
+    )
+    verdict = GuardVerdict(
+        skipped=skip.astype(jnp.float32),
+        found_inf=found_inf,
+        spike=spike,
+        grad_norm=norm,
+    )
+    return new_params, new_opt_state, new_scaler_state, new_guard_state, verdict

@@ -1,0 +1,130 @@
+"""Retry/backoff wrappers for the two flakiest host-side operations of a
+pod-scale run: the distributed rendezvous and checkpoint I/O.
+
+The policy is deliberately boring — bounded attempts, exponential backoff,
+then *raise*.  The one behavior change worth naming:
+:func:`robust_initialize_distributed` replaces the bootstrap's historical
+"warn and silently degrade to single-process" response to a failed pod
+join with retry-then-raise, because N pod members each quietly training
+their own divergent copy is strictly worse than a crashed job.
+
+Chaos integration: every attempt consults
+:mod:`apex_tpu.resilience.chaos` (``RENDEZVOUS`` site, step = attempt
+index), so tests drive the fail-then-heal path without a real flaky
+coordinator.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import warnings
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+from apex_tpu.resilience import chaos
+
+__all__ = ["RetryPolicy", "retry_call", "robust_initialize_distributed"]
+
+
+class RetryPolicy:
+    """Bounded exponential backoff: ``backoff * factor**attempt``, capped.
+
+    ``max_attempts`` counts total tries (first try included), so
+    ``max_attempts=1`` means no retry.  ``sleep`` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        backoff: float = 0.5,
+        factor: float = 2.0,
+        max_backoff: float = 30.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.backoff = backoff
+        self.factor = factor
+        self.max_backoff = max_backoff
+        self.sleep = sleep
+
+    def delay(self, attempt: int) -> float:
+        return min(self.backoff * self.factor**attempt, self.max_backoff)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: Optional[RetryPolicy] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    describe: str = "",
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``; retry per ``policy`` on ``retry_on``.
+
+    Each failed attempt emits a ``RuntimeWarning`` naming the attempt and
+    the error (a silent retry hides a sick filesystem until the run dies);
+    the final failure re-raises the last exception unchanged.
+    """
+    policy = policy or RetryPolicy()
+    what = describe or getattr(fn, "__name__", repr(fn))
+    last: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            last = e
+            if attempt + 1 >= policy.max_attempts:
+                break
+            pause = policy.delay(attempt)
+            warnings.warn(
+                f"{what} failed (attempt {attempt + 1}/"
+                f"{policy.max_attempts}: {type(e).__name__}: {e}); "
+                f"retrying in {pause:.2g}s",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            policy.sleep(pause)
+    assert last is not None
+    raise last
+
+
+def robust_initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+    policy: Optional[RetryPolicy] = None,
+) -> Tuple[int, int]:
+    """Join the global JAX runtime, retrying a flaky rendezvous.
+
+    Semantics vs :func:`apex_tpu.parallel.initialize_distributed`:
+
+    - no cluster environment, no coordinator given → same benign
+      single-process no-op, ``(0, 1)``, no retries burned;
+    - cluster env present (or explicit coordinator) and the join fails →
+      retry with backoff, then **raise** — never the reference's silent
+      single-process degrade.
+    """
+    from apex_tpu.parallel import multihost
+
+    policy = policy or RetryPolicy()
+    attempts = itertools.count()  # chaos attempt index across retries
+
+    def _join():
+        chaos.maybe_fail(chaos.RENDEZVOUS, next(attempts))
+        return multihost.initialize_distributed(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            strict=True,
+        )
+
+    return retry_call(
+        _join,
+        policy=policy,
+        retry_on=(RuntimeError, chaos.InjectedFault),
+        describe="distributed rendezvous",
+    )

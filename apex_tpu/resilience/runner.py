@@ -1,0 +1,315 @@
+"""Preemption-safe auto-resume training loop.
+
+:func:`run_resilient` composes the rest of the resilience stack into the
+loop a production job actually runs:
+
+- **auto-resume** — on startup, ``latest_step()`` of the checkpoint
+  directory decides where training continues; a fresh directory starts at
+  step 0.  Restarting the same command after any crash/preemption resumes
+  from the last *complete* checkpoint (orbax commits atomically; an
+  interrupted save is invisible to ``latest_step``).
+- **guarded steps** — the caller's ``step_fn`` reports whether the step
+  was skipped (e.g. the ``GuardVerdict`` from
+  :func:`apex_tpu.resilience.guards.guarded_amp_update`); after
+  ``rollback_after`` consecutive skips the loop restores the last
+  checkpoint and replays, instead of skipping forever on corrupted state.
+- **preemption** — SIGTERM (the cloud eviction notice) sets a flag via
+  :class:`PreemptionHandler`; the loop finishes the in-flight step, writes
+  a final checkpoint, and returns cleanly with ``preempted=True``.
+- **retries** — checkpoint I/O goes through
+  :class:`ResilientCheckpointManager`, which wraps save/restore in
+  :func:`apex_tpu.resilience.retry.retry_call` and honors the chaos
+  ``CHECKPOINT_SAVE`` / ``CHECKPOINT_RESTORE`` sites.
+
+``step_fn(state, batch) -> (state, info)`` with ``info`` anything that has
+a ``skipped`` entry/attribute (or None).  ``batch_fn(step) -> batch`` is
+indexed by step so replay after rollback/resume feeds the same data.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+from apex_tpu.checkpoint import CheckpointManager
+from apex_tpu.resilience import chaos
+from apex_tpu.resilience.retry import RetryPolicy, retry_call
+
+__all__ = [
+    "PreemptionHandler",
+    "ResilientCheckpointManager",
+    "RunResult",
+    "run_resilient",
+]
+
+
+class PreemptionHandler:
+    """Context manager turning SIGTERM into a queryable flag.
+
+    The handler only records the request (async-signal-safe); the training
+    loop decides when to act — after the in-flight step, before the next.
+    Outside the main thread (where CPython forbids ``signal.signal``) it
+    degrades to a never-set flag instead of failing.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = signals
+        self._prev = {}
+        self._event = threading.Event()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def _on_signal(self, signum, frame):
+        self._event.set()
+
+    def __enter__(self):
+        for s in self._signals:
+            try:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            except ValueError:  # not the main thread
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+
+
+class ResilientCheckpointManager:
+    """:class:`apex_tpu.checkpoint.CheckpointManager` + retry + chaos.
+
+    Save/restore I/O errors are retried per ``policy`` and only then
+    raised.  The chaos ``partial`` save mode drops orbax-style
+    uncommitted debris (``<step>.orbax-checkpoint-tmp-*``) into the
+    directory before failing — the on-disk shape of a host that died
+    mid-write — which is exactly what ``latest_step`` must ignore.
+
+    Scope note: orbax saves are *async* — ``save`` returns after the
+    enqueue, so the retry here covers the enqueue path (plus any deferred
+    error orbax surfaces at the next ``save`` call; retrying that call
+    clears the stale error and re-queues the current step).  A background
+    write that fails permanently loses that one step's checkpoint, never
+    crash consistency: the incomplete step stays invisible to
+    ``latest_step`` and resume falls back one interval.
+    """
+
+    def __init__(
+        self,
+        directory,
+        *,
+        max_to_keep: Optional[int] = None,
+        save_interval_steps: int = 1,
+        policy: Optional[RetryPolicy] = None,
+    ):
+        self._directory = os.path.abspath(os.fspath(directory))
+        self._inner = CheckpointManager(
+            self._directory,
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+        )
+        self._policy = policy or RetryPolicy(backoff=0.05, max_backoff=1.0)
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self):
+        self._inner.close()
+
+    def wait_until_finished(self):
+        self._inner.wait_until_finished()
+
+    # -- delegated queries -------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        return self._inner.latest_step()
+
+    def all_steps(self):
+        return self._inner.all_steps()
+
+    def should_save(self, step: int) -> bool:
+        return self._inner.should_save(step)
+
+    # -- guarded io --------------------------------------------------------
+    def save(self, step: int, state, *, force: bool = False) -> bool:
+        def _save():
+            chaos.maybe_fail(
+                chaos.CHECKPOINT_SAVE, step, partial_dir=self._directory
+            )
+            return self._inner.save(step, state, force=force)
+
+        return retry_call(
+            _save,
+            policy=self._policy,
+            describe=f"checkpoint save (step {step})",
+        )
+
+    def restore(self, step: Optional[int] = None, *, template=None):
+        def _restore():
+            chaos.maybe_fail(
+                chaos.CHECKPOINT_RESTORE,
+                step if step is not None else (self.latest_step() or 0),
+            )
+            return self._inner.restore(step, template=template)
+
+        return retry_call(
+            _restore,
+            policy=self._policy,
+            describe=f"checkpoint restore (step {step})",
+        )
+
+
+class RunResult(NamedTuple):
+    state: Any
+    last_step: int  # last completed step index; -1 when nothing ran
+    resumed_from: Optional[int]  # checkpoint step training continued from
+    steps_run: int  # steps executed by THIS invocation
+    skipped_steps: int  # steps the guard dropped (this invocation)
+    rollbacks: int  # checkpoint rollbacks (this invocation)
+    preempted: bool  # stopped early on SIGTERM
+
+
+def _skipped(info) -> bool:
+    if info is None:
+        return False
+    if hasattr(info, "skipped"):
+        return bool(info.skipped)
+    try:
+        return bool(info["skipped"])
+    except (TypeError, KeyError, IndexError):
+        return False
+
+
+def run_resilient(
+    step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+    init_state: Any,
+    batch_fn: Callable[[int], Any],
+    *,
+    directory,
+    num_steps: int,
+    save_interval_steps: int = 1,
+    max_to_keep: Optional[int] = None,
+    rollback_after: Optional[int] = None,
+    max_rollbacks: int = 3,
+    policy: Optional[RetryPolicy] = None,
+    signals=(signal.SIGTERM,),
+) -> RunResult:
+    """Drive ``step_fn`` for ``num_steps`` with auto-resume, preemption
+    handling, checkpoint retries, and skip-budget rollback.
+
+    Idempotent by construction: call it again after any interruption and
+    it continues from the last complete checkpoint.  Returns a
+    :class:`RunResult`; ``preempted=True`` means SIGTERM arrived, the
+    final checkpoint is on disk, and a relaunch will resume within one
+    step of where training stopped.
+
+    Rollback replays the same step-indexed data, so a *deterministic*
+    skip cause (a permanently bad batch, not transient state corruption)
+    would replay-and-skip forever; after ``max_rollbacks`` rollbacks the
+    loop raises instead of livelocking.
+    """
+    state = init_state
+    resumed_from = None
+    steps_run = skipped_steps = rollbacks = 0
+    consecutive_skips = 0
+
+    with ResilientCheckpointManager(
+        directory,
+        max_to_keep=max_to_keep,
+        save_interval_steps=save_interval_steps,
+        policy=policy,
+    ) as mgr, PreemptionHandler(signals=signals) as preempt:
+        latest = mgr.latest_step()
+        if latest is not None:
+            state = mgr.restore(latest, template=state)
+            resumed_from = latest
+        start = (latest + 1) if latest is not None else 0
+        completed = start - 1
+
+        step = start
+        while step < num_steps and not preempt.requested:
+            state, info = step_fn(state, batch_fn(step))
+            steps_run += 1
+            # Simulated eviction lands "while the step runs": checking the
+            # flag only after step_fn means the interrupted step still
+            # completes and checkpoints, so a relaunch under the same
+            # chaos spec (preemption@N fires again in the new process)
+            # always makes at least one step of progress.
+            chaos.maybe_preempt(step)
+            if _skipped(info):
+                # A skipped step is never checkpointed: its state is by
+                # contract unchanged, and recording it would drag the
+                # rollback anchor into the middle of the skip streak —
+                # the replay must restart from the last ACCEPTED step.
+                skipped_steps += 1
+                consecutive_skips += 1
+                if (
+                    rollback_after is not None
+                    and consecutive_skips >= rollback_after
+                ):
+                    if rollbacks >= max_rollbacks:
+                        raise RuntimeError(
+                            f"step {step}: skip budget exhausted again "
+                            f"after {rollbacks} rollbacks — the failure "
+                            "replays deterministically; refusing to "
+                            "livelock"
+                        )
+                    mgr.wait_until_finished()
+                    anchor = mgr.latest_step()
+                    rollbacks += 1
+                    consecutive_skips = 0
+                    if anchor is not None:
+                        state = mgr.restore(anchor, template=init_state)
+                        completed = anchor
+                        step = anchor + 1
+                    else:
+                        # no checkpoint yet: restart from the initial state
+                        state = init_state
+                        completed = -1
+                        step = 0
+                    continue
+            else:
+                consecutive_skips = 0
+                completed = step
+                mgr.save(step, state)
+            step += 1
+
+        if preempt.requested and completed >= 0:
+            # Final checkpoint so a relaunch resumes within one step.  The
+            # step may already be on disk when save_interval_steps == 1.
+            # Barrier first (chaos COLLECTIVE site): every host agrees
+            # training stopped at `completed` — but best-effort, because a
+            # peer already torn down by the eviction must not keep THIS
+            # host from reaching its final checkpoint.
+            try:
+                from apex_tpu.parallel import multihost
+
+                multihost.host_barrier(f"resilient-stop-{completed}", completed)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"pre-checkpoint host barrier failed ({type(e).__name__}:"
+                    f" {e}); writing the final checkpoint anyway",
+                    RuntimeWarning,
+                )
+            mgr.wait_until_finished()
+            if completed not in mgr.all_steps():
+                mgr.save(completed, state, force=True)
+        mgr.wait_until_finished()
+        return RunResult(
+            state=state,
+            last_step=completed,
+            resumed_from=resumed_from,
+            steps_run=steps_run,
+            skipped_steps=skipped_steps,
+            rollbacks=rollbacks,
+            preempted=preempt.requested,
+        )

@@ -46,6 +46,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (apex_tpu.resilience.chaos) — "
+        "select with `pytest -m chaos`",
+    )
 
 
 # Tiering (VERDICT r2 item 8): everything that measured >= ~10 s on this

@@ -108,6 +108,82 @@ def test_scaler_min_max_clamps():
     assert float(st.loss_scale) == 4.0
 
 
+def test_hysteresis_exhaustion_then_recovery():
+    """A full overflow burst walks hysteresis to zero, backs off once,
+    restores the budget — and a subsequent clean stretch grows again."""
+    s = amp.DynamicLossScaler(
+        init_scale=4096.0, hysteresis=3, growth_interval=2
+    )
+    st = s.init()
+    inf, one = jnp.ones(()), jnp.zeros(())
+    st = s.update(st, inf)  # 3 -> 2, scale held
+    st = s.update(st, inf)  # 2 -> 1, scale held
+    assert float(st.loss_scale) == 4096.0
+    st = s.update(st, inf)  # exhausted: backoff, budget restored
+    assert float(st.loss_scale) == 2048.0
+    assert int(st.hysteresis) == 3
+    # recovery: growth_interval clean steps regrow the scale
+    st = s.update(st, one)
+    st = s.update(st, one)
+    assert float(st.loss_scale) == 4096.0
+    assert int(st.hysteresis) == 3
+    assert int(st.growth_tracker) == 0
+
+
+def test_min_loss_scale_clamp_under_sustained_overflow():
+    """A pathological run (every step overflows) floors at min_loss_scale
+    instead of underflowing the scale to zero."""
+    s = amp.DynamicLossScaler(
+        init_scale=8.0, hysteresis=1, min_loss_scale=2.0
+    )
+    st = s.init()
+    inf = jnp.ones(())
+    for _ in range(10):
+        st = s.update(st, inf)
+        assert float(st.loss_scale) >= 2.0
+    assert float(st.loss_scale) == 2.0  # clamped, not 8/2**10
+
+
+def test_amp_update_skipped_step_is_bit_identical():
+    """On overflow, params AND opt state come back bit-for-bit unchanged —
+    the where-select must not even round-trip values through an op that
+    could re-normalize them."""
+    tx = fused_adam(1e-3)
+    # awkward values: denormal-adjacent, negative zero, bf16 param
+    params = {
+        "w": jnp.asarray([1e-38, -0.0, 3.1415927, -2.718], jnp.float32),
+        "h": jnp.asarray([0.1, -7.0], jnp.bfloat16),
+    }
+    scaler = amp.DynamicLossScaler(init_scale=8.0, hysteresis=1)
+    sstate = scaler.init()
+    ostate = tx.init(params)
+    # advance one clean step so opt state is non-trivial
+    good = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 8.0), params)
+    params, ostate, sstate, found = amp.amp_update(
+        tx, scaler, good, ostate, params, sstate
+    )
+    assert float(found) == 0.0
+
+    def bits(tree):
+        return [
+            (np.asarray(x).dtype.str, np.asarray(x).tobytes())
+            for x in jax.tree_util.tree_leaves(tree)
+        ]
+
+    p_bits, o_bits = bits(params), bits(ostate)
+    bad = {
+        "w": jnp.asarray([1.0, jnp.nan, 1.0, 1.0], jnp.float32),
+        "h": jnp.ones((2,), jnp.bfloat16),
+    }
+    new_params, new_ostate, new_sstate, found = amp.amp_update(
+        tx, scaler, bad, ostate, params, sstate
+    )
+    assert float(found) == 1.0
+    assert bits(new_params) == p_bits
+    assert bits(new_ostate) == o_bits
+    assert float(new_sstate.loss_scale) == float(sstate.loss_scale) / 2
+
+
 def test_amp_update_skips_step_on_overflow():
     tx = fused_sgd(0.1)
     params = {"w": jnp.ones((4,))}

@@ -70,6 +70,30 @@ def test_manager_retention_and_latest(tmp_path):
         np.testing.assert_allclose(np.asarray(out["w"]), [4.0, 4.0])
 
 
+def test_latest_step_ignores_uncommitted_debris(tmp_path):
+    """Crash consistency: orbax-style temp directories from an interrupted
+    save (and other non-step junk) are invisible to step enumeration, and
+    restore of the newest COMPLETE step still works."""
+    import os
+
+    state = {"w": jnp.zeros((2,))}
+    with ckpt.CheckpointManager(tmp_path) as mgr:
+        for step in range(3):
+            mgr.save(step, {"w": state["w"] + step})
+        mgr.wait_until_finished()
+    # a host died mid-save of step 3: uncommitted tmp dir + stray file
+    debris = tmp_path / f"3.orbax-checkpoint-tmp-{os.getpid()}"
+    debris.mkdir()
+    (debris / "params").write_text("torn write")
+    (tmp_path / "not_a_step").mkdir()
+    assert ckpt.all_steps(tmp_path) == [0, 1, 2]
+    assert ckpt.latest_step(tmp_path) == 2
+    with ckpt.CheckpointManager(tmp_path) as mgr:
+        assert mgr.latest_step() == 2
+        out = mgr.restore(template=state)
+        np.testing.assert_allclose(np.asarray(out["w"]), [2.0, 2.0])
+
+
 def test_manager_restore_empty_raises(tmp_path):
     with ckpt.CheckpointManager(tmp_path / "empty") as mgr:
         with pytest.raises(FileNotFoundError):

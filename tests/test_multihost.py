@@ -37,6 +37,73 @@ def test_single_process_noop():
     assert not distributed_is_initialized()
 
 
+def test_warns_when_cluster_env_present_but_join_fails(monkeypatch):
+    """The 'pod member silently degrading to single-process' path must at
+    least shout: hints set + failed join -> RuntimeWarning naming them."""
+    from apex_tpu.parallel import multihost
+
+    def failing_initialize(*a, **k):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setattr(jax.distributed, "initialize", failing_initialize)
+    assert multihost.cluster_env_hints() == ("JAX_COORDINATOR_ADDRESS",)
+    with pytest.warns(RuntimeWarning, match="JAX_COORDINATOR_ADDRESS"):
+        idx, count = initialize_distributed()
+    assert (idx, count) == (0, 1)
+    assert not distributed_is_initialized()  # degraded, and knows it
+
+
+def test_strict_raises_when_cluster_env_present_but_join_fails(monkeypatch):
+    from apex_tpu.parallel import multihost
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setattr(
+        jax.distributed,
+        "initialize",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("unreachable")),
+    )
+    with pytest.raises(RuntimeError, match="cluster environment detected"):
+        multihost.initialize_distributed(strict=True)
+    assert not distributed_is_initialized()
+
+
+def test_no_hints_no_warning(monkeypatch, recwarn):
+    """Without cluster env hints a failed autodetect is the benign
+    single-process path: silent, strict or not."""
+    from apex_tpu.parallel import multihost
+
+    for k in multihost._CLUSTER_ENV_HINTS:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setattr(
+        jax.distributed,
+        "initialize",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("no cluster")),
+    )
+    assert initialize_distributed() == (0, 1)
+    assert multihost.initialize_distributed(strict=True) == (0, 1)
+    assert not any(
+        issubclass(w.category, RuntimeWarning) for w in recwarn.list
+    )
+
+
+def test_finalize_resets_state_when_shutdown_raises(monkeypatch):
+    """A teardown error (coordinator already gone) must not wedge the
+    module: warn, reset, stay idempotent."""
+    from apex_tpu.parallel import multihost
+
+    monkeypatch.setattr(
+        jax.distributed,
+        "shutdown",
+        lambda: (_ for _ in ()).throw(RuntimeError("socket closed")),
+    )
+    monkeypatch.setattr(multihost, "_INITIALIZED", True)
+    with pytest.warns(RuntimeWarning, match="mid-teardown"):
+        multihost.finalize_distributed()
+    assert multihost._INITIALIZED is False
+    multihost.finalize_distributed()  # second call: clean no-op
+
+
 def test_dcn_mesh_falls_back_on_single_granule():
     """dcn_data_parallel on a 1-process backend warns and still yields a
     working mesh (the single-granule ICI layout)."""

@@ -1,0 +1,575 @@
+"""Resilience subsystem: chaos injection, guarded steps, retry, auto-resume.
+
+The three end-to-end acceptance paths:
+
+- an injected NaN step is skipped with params bit-identical (guards);
+- a simulated preemption (real SIGTERM through the signal machinery)
+  checkpoints, and a relaunch resumes within one step (runner);
+- a failed-then-healed rendezvous succeeds via retry instead of silently
+  degrading to single-process (retry + multihost strict mode).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_sgd
+from apex_tpu.resilience import (
+    GradGuard,
+    PreemptionHandler,
+    ResilientCheckpointManager,
+    RetryPolicy,
+    chaos,
+    guarded_amp_update,
+    retry_call,
+    robust_initialize_distributed,
+    run_resilient,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _bits(tree):
+    return [
+        (np.asarray(x).dtype.str, np.asarray(x).tobytes())
+        for x in jax.tree_util.tree_leaves(tree)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_step_schedule_and_max_hits():
+    f = chaos.Fault(chaos.GRADS, steps=(3, 5), mode="nan", max_hits=1)
+    chaos.configure(f)
+    assert chaos.active(chaos.GRADS, 2) is None
+    assert chaos.active(chaos.GRADS, 3) is f  # first hit
+    assert chaos.active(chaos.GRADS, 5) is None  # budget spent
+    assert chaos.active(chaos.CHECKPOINT_SAVE, 3) is None  # wrong site
+
+
+@pytest.mark.chaos
+def test_chaos_probability_is_deterministic():
+    f = chaos.Fault(chaos.GRADS, probability=0.5, mode="nan")
+    chaos.configure(f, seed=7)
+    first = [chaos.active(chaos.GRADS, s) is not None for s in range(64)]
+    chaos.configure(f, seed=7)
+    again = [chaos.active(chaos.GRADS, s) is not None for s in range(64)]
+    assert first == again
+    assert any(first) and not all(first)  # a real coin, not a constant
+    chaos.configure(f, seed=8)
+    other = [chaos.active(chaos.GRADS, s) is not None for s in range(64)]
+    assert first != other  # seed moves the schedule
+
+
+@pytest.mark.chaos
+def test_chaos_parse_spec():
+    faults, seed = chaos.parse_spec(
+        "grads:nan@3,7;checkpoint_save:raise:x1@5;preemption@12;"
+        "collective:stall:p=0.25;seed=42"
+    )
+    assert seed == 42
+    by_site = {f.site: f for f in faults}
+    assert by_site[chaos.GRADS].steps == (3, 7)
+    assert by_site[chaos.GRADS].mode == "nan"
+    assert by_site[chaos.GRADS].max_hits is None
+    assert by_site[chaos.CHECKPOINT_SAVE].steps == (5,)
+    assert by_site[chaos.CHECKPOINT_SAVE].max_hits == 1
+    assert by_site[chaos.PREEMPTION].mode == "raise"
+    assert by_site[chaos.COLLECTIVE].mode == "stall"
+    assert by_site[chaos.COLLECTIVE].probability == 0.25
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_tree_and_inject_restores():
+    tree = {"w": jnp.ones((4,)), "b": jnp.zeros((2,))}
+    with chaos.inject(chaos.Fault(chaos.GRADS, steps=(1,), mode="nan")):
+        same = chaos.corrupt_tree(tree, 0)
+        assert _bits(same) == _bits(tree)
+        bad = chaos.corrupt_tree(tree, 1)
+        assert not np.all(np.isfinite(np.asarray(bad["w"]))) or not np.all(
+            np.isfinite(np.asarray(bad["b"]))
+        )
+    assert chaos.faults() == ()  # restored on exit
+
+
+@pytest.mark.chaos
+def test_chaos_steps_win_over_probability():
+    """An explicit step schedule pins the fault to exactly those steps —
+    a also-set probability must not add extra firings."""
+    f = chaos.Fault(chaos.GRADS, steps=(3,), probability=1.0, mode="nan")
+    chaos.configure(f)
+    fired = [s for s in range(10) if chaos.active(chaos.GRADS, s)]
+    assert fired == [3]
+
+
+@pytest.mark.chaos
+def test_host_barrier_is_collective_chaos_site():
+    """host_barrier: single-process no-op, chaos stall returns, chaos
+    raise propagates (a collective abort kills the job)."""
+    from apex_tpu.parallel import multihost
+
+    multihost.host_barrier("clean", 0)  # no faults: plain no-op
+    with chaos.inject(
+        chaos.Fault(
+            chaos.COLLECTIVE, steps=(1,), mode="stall", stall_seconds=0.01
+        ),
+        chaos.Fault(chaos.COLLECTIVE, steps=(2,), mode="raise"),
+    ):
+        multihost.host_barrier("stalls-then-proceeds", 1)
+        with pytest.raises(chaos.InjectedFault):
+            multihost.host_barrier("aborts", 2)
+
+
+@pytest.mark.chaos
+def test_chaos_unknown_site_rejected():
+    with pytest.raises(ValueError):
+        chaos.Fault("not_a_site", steps=(1,))
+
+
+# ---------------------------------------------------------------------------
+# guarded step
+# ---------------------------------------------------------------------------
+
+
+def _guarded_setup(init_scale=4.0):
+    tx = fused_sgd(0.1)
+    params = {"w": jnp.asarray([1.0, 2.0, 3.0, 4.0], jnp.float32)}
+    scaler = amp.DynamicLossScaler(init_scale=init_scale, hysteresis=1)
+    guard = GradGuard(spike_factor=10.0, warmup_steps=2, ema_beta=0.5,
+                      max_consecutive_skips=3)
+    return tx, params, scaler, guard, tx.init(params), scaler.init(), guard.init()
+
+
+@pytest.mark.chaos
+def test_injected_nan_step_skipped_params_untouched():
+    """Acceptance: a NaN burst skips the step; params/opt bit-identical."""
+    tx, params, scaler, guard, ostate, sstate, gstate = _guarded_setup()
+    good = {"w": jnp.full((4,), 4.0)}  # unscales to 1.0
+
+    with chaos.inject(chaos.Fault(chaos.GRADS, steps=(2,), mode="nan")):
+        for step in range(5):
+            grads = chaos.corrupt_tree(good, step)
+            p_bits, o_bits = _bits(params), _bits(ostate)
+            params, ostate, sstate, gstate, verdict = guarded_amp_update(
+                tx, scaler, guard, grads, ostate, params, sstate, gstate
+            )
+            if step == 2:
+                assert float(verdict.skipped) == 1.0
+                assert float(verdict.found_inf) == 1.0
+                assert _bits(params) == p_bits  # bit-identical
+                assert _bits(ostate) == o_bits
+            else:
+                assert float(verdict.skipped) == 0.0
+                assert _bits(params) != p_bits  # training moved
+    assert int(gstate.total_skips) == 1
+    assert int(gstate.step) == 5
+
+
+def test_spike_skip_is_not_an_overflow():
+    """A finite 1000x grad spike skips the step but leaves the loss scale
+    alone (only real overflow feeds the hysteresis)."""
+    tx, params, scaler, guard, ostate, sstate, gstate = _guarded_setup()
+    good = {"w": jnp.full((4,), 4.0)}
+    for _ in range(3):  # past warmup; EMA learns the healthy norm
+        params, ostate, sstate, gstate, v = guarded_amp_update(
+            tx, scaler, guard, good, ostate, params, sstate, gstate
+        )
+        assert float(v.skipped) == 0.0
+    scale_before = float(sstate.loss_scale)
+    p_bits, s_bits = _bits(params), _bits(sstate)
+    spike = {"w": jnp.full((4,), 4000.0)}  # finite, 1000x
+    params, ostate, sstate, gstate, v = guarded_amp_update(
+        tx, scaler, guard, spike, ostate, params, sstate, gstate
+    )
+    assert bool(v.spike)
+    assert float(v.found_inf) == 0.0
+    assert float(v.skipped) == 1.0
+    assert _bits(params) == p_bits
+    assert _bits(sstate) == s_bits  # WHOLE scaler state frozen: a spike
+    # skip must not tick growth_tracker toward a scale growth either
+    assert float(sstate.loss_scale) == scale_before
+    # EMA untouched by the skipped step: the same spike still skips
+    params, ostate, sstate, gstate, v = guarded_amp_update(
+        tx, scaler, guard, spike, ostate, params, sstate, gstate
+    )
+    assert float(v.skipped) == 1.0
+    assert int(gstate.consecutive_skips) == 2
+
+
+def test_guard_budget_exhaustion_and_reset():
+    tx, params, scaler, guard, ostate, sstate, gstate = _guarded_setup()
+    good = {"w": jnp.full((4,), 4.0)}
+    bad = {"w": jnp.asarray([jnp.nan, 0.0, 0.0, 0.0])}
+    for _ in range(3):
+        params, ostate, sstate, gstate, _ = guarded_amp_update(
+            tx, scaler, guard, bad, ostate, params, sstate, gstate
+        )
+    assert bool(guard.budget_exhausted(gstate))
+    params, ostate, sstate, gstate, _ = guarded_amp_update(
+        tx, scaler, guard, good, ostate, params, sstate, gstate
+    )
+    assert not bool(guard.budget_exhausted(gstate))
+    assert int(gstate.consecutive_skips) == 0
+    assert int(gstate.total_skips) == 3
+
+
+def test_guarded_update_is_jittable():
+    tx, params, scaler, guard, ostate, sstate, gstate = _guarded_setup()
+
+    @jax.jit
+    def step(g, o, p, s, gs):
+        return guarded_amp_update(tx, scaler, guard, g, o, p, s, gs)
+
+    good = {"w": jnp.full((4,), 4.0)}
+    p1, o1, s1, g1, v = step(good, ostate, params, sstate, gstate)
+    assert float(v.skipped) == 0.0
+    bad = {"w": jnp.full((4,), jnp.inf)}
+    p2, _, _, _, v2 = step(bad, o1, p1, s1, g1)
+    assert float(v2.skipped) == 1.0
+    assert _bits(p2) == _bits(p1)
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+
+def test_retry_call_heals_and_backs_off():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(
+        max_attempts=4, backoff=0.1, factor=2.0, sleep=sleeps.append
+    )
+    with pytest.warns(RuntimeWarning, match="retrying"):
+        assert retry_call(flaky, policy=policy) == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [0.1, 0.2]  # exponential
+
+
+def test_retry_call_raises_after_budget():
+    def always():
+        raise OSError("down")
+
+    policy = RetryPolicy(max_attempts=3, backoff=0.0, sleep=lambda _: None)
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(OSError, match="down"):
+            retry_call(always, policy=policy)
+
+
+@pytest.mark.chaos
+def test_rendezvous_fails_then_heals_via_retry(monkeypatch):
+    """Acceptance: two injected rendezvous failures, third attempt joins —
+    no silent single-process degrade, no exception."""
+    from apex_tpu.parallel import multihost
+
+    policy = RetryPolicy(max_attempts=3, backoff=0.0, sleep=lambda _: None)
+    with chaos.inject(
+        chaos.Fault(chaos.RENDEZVOUS, steps=(0, 1), mode="raise")
+    ):
+        with pytest.warns(RuntimeWarning, match="rendezvous"):
+            idx, count = robust_initialize_distributed(policy=policy)
+    # this harness has no cluster env: the healed attempt is the benign
+    # single-process join
+    assert (idx, count) == (0, 1)
+    assert not multihost.distributed_is_initialized()
+
+
+@pytest.mark.chaos
+def test_rendezvous_exhausted_raises_not_degrades(monkeypatch):
+    policy = RetryPolicy(max_attempts=2, backoff=0.0, sleep=lambda _: None)
+    with chaos.inject(
+        chaos.Fault(chaos.RENDEZVOUS, steps=(0, 1, 2, 3), mode="raise")
+    ):
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(chaos.InjectedFault):
+                robust_initialize_distributed(policy=policy)
+
+
+def test_robust_rendezvous_strict_on_real_failure(monkeypatch):
+    """With cluster hints present and a join that fails then heals, the
+    retry path lands on the joined runtime instead of degrading."""
+    from apex_tpu.parallel import multihost
+
+    attempts = {"n": 0}
+
+    def fake_initialize(*a, **k):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    policy = RetryPolicy(max_attempts=3, backoff=0.0, sleep=lambda _: None)
+    try:
+        with pytest.warns(RuntimeWarning, match="rendezvous"):
+            idx, count = robust_initialize_distributed(policy=policy)
+        assert attempts["n"] == 3
+        assert (idx, count) == (0, 1)  # single-process fake backend
+        assert multihost.distributed_is_initialized()
+    finally:
+        multihost._INITIALIZED = False
+
+
+# ---------------------------------------------------------------------------
+# runner: auto-resume, preemption, rollback, checkpoint retry
+# ---------------------------------------------------------------------------
+
+
+def _counting_job():
+    """A deterministic toy job: state counts accepted steps and folds the
+    batch value in, so any divergence between runs is visible bitwise."""
+
+    def batch_fn(step):
+        return jnp.asarray(float(step + 1), jnp.float32)
+
+    def step_fn(state, batch):
+        return (
+            {"acc": state["acc"] + batch, "n": state["n"] + 1},
+            {"skipped": False},
+        )
+
+    return {"acc": jnp.zeros((), jnp.float32), "n": jnp.zeros((), jnp.int32)}, (
+        step_fn,
+        batch_fn,
+    )
+
+
+def test_run_resilient_fresh_run_completes(tmp_path):
+    init, (step_fn, batch_fn) = _counting_job()
+    res = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path, num_steps=5
+    )
+    assert res.last_step == 4
+    assert res.steps_run == 5
+    assert res.resumed_from is None
+    assert not res.preempted
+    assert float(res.state["acc"]) == sum(range(1, 6))
+    with ResilientCheckpointManager(tmp_path) as mgr:
+        assert mgr.latest_step() == 4
+
+
+def test_run_resilient_auto_resumes_without_rerunning(tmp_path):
+    init, (step_fn, batch_fn) = _counting_job()
+    run_resilient(step_fn, init, batch_fn, directory=tmp_path, num_steps=3)
+    res = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path, num_steps=6
+    )
+    assert res.resumed_from == 2
+    assert res.steps_run == 3  # only the new steps
+    assert float(res.state["acc"]) == sum(range(1, 7))
+
+
+@pytest.mark.chaos
+def test_preemption_checkpoints_and_resumes_within_one_step(tmp_path):
+    """Acceptance: SIGTERM lands while step 5 runs (an off-interval step)
+    -> the in-flight step completes, a final checkpoint is forced, and a
+    relaunch resumes exactly one step later with a final state bitwise
+    identical to an uninterrupted run."""
+    init, (step_fn, batch_fn) = _counting_job()
+    with chaos.inject(chaos.Fault(chaos.PREEMPTION, steps=(5,))):
+        res1 = run_resilient(
+            step_fn, init, batch_fn, directory=tmp_path, num_steps=10,
+            save_interval_steps=2,
+        )
+    assert res1.preempted
+    assert res1.last_step == 5  # the interrupted step still completed
+    assert res1.steps_run == 6
+    with ResilientCheckpointManager(tmp_path) as mgr:
+        # 5 is off-interval (saves land on 0,2,4): the forced final
+        # checkpoint must cover it anyway
+        assert mgr.latest_step() == 5
+
+    res2 = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path, num_steps=10,
+        save_interval_steps=2,
+    )
+    assert res2.resumed_from == 5  # within one step of the preemption
+    assert res2.steps_run == 4
+    assert not res2.preempted
+
+    ref = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path / "uninterrupted",
+        num_steps=10,
+    )
+    assert _bits(res2.state) == _bits(ref.state)
+
+
+@pytest.mark.chaos
+def test_preemption_spec_cannot_livelock_resume(tmp_path):
+    """Relaunching under the SAME chaos spec (preemption fires again in
+    the new process) still makes progress every launch — the simulated
+    eviction lands after the step computes, never before."""
+    init, (step_fn, batch_fn) = _counting_job()
+    fault = chaos.Fault(chaos.PREEMPTION, steps=(4,))
+    with chaos.inject(fault):
+        res1 = run_resilient(
+            step_fn, init, batch_fn, directory=tmp_path, num_steps=8
+        )
+    assert res1.preempted and res1.last_step == 4
+    # relaunch with the fault still configured: resumes PAST the fault
+    # step (start=5 > 4, so it never re-fires) and completes
+    with chaos.inject(fault):
+        res2 = run_resilient(
+            step_fn, init, batch_fn, directory=tmp_path, num_steps=8
+        )
+    assert res2.resumed_from == 4
+    assert not res2.preempted
+    assert res2.last_step == 7
+
+
+def test_preemption_handler_sets_flag_and_restores(tmp_path):
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+@pytest.mark.chaos
+def test_rollback_after_consecutive_skips(tmp_path):
+    """Three poisoned steps in a row exhaust the budget; the loop rolls
+    back to the last complete checkpoint and replays the (now healed)
+    steps."""
+    init, (step_fn, batch_fn) = _counting_job()
+
+    def guarded_step(state, batch):
+        step = int(state["n"])
+        poisoned = chaos.active(chaos.GRADS, step) is not None
+        if poisoned:
+            return state, {"skipped": True}  # step dropped, state frozen
+        return step_fn(state, batch)
+
+    # fault fires once per step 5,6,7 then is exhausted (the transient heals)
+    with chaos.inject(
+        chaos.Fault(chaos.GRADS, steps=(5, 6, 7), mode="nan", max_hits=3)
+    ):
+        res = run_resilient(
+            guarded_step, init, batch_fn, directory=tmp_path, num_steps=10,
+            rollback_after=3,
+        )
+    assert res.rollbacks == 1
+    assert res.skipped_steps == 3
+    assert res.last_step == 9
+    # replayed cleanly: same state as a faultless run
+    ref = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path / "ref", num_steps=10
+    )
+    assert _bits(res.state) == _bits(ref.state)
+
+
+@pytest.mark.chaos
+def test_rollback_budget_refuses_to_livelock(tmp_path):
+    """A deterministic skip cause (fault with unbounded hits) would
+    replay-and-skip forever; max_rollbacks converts that into an error."""
+    init, (step_fn, batch_fn) = _counting_job()
+
+    def guarded_step(state, batch):
+        if chaos.active(chaos.GRADS, int(state["n"])) is not None:
+            return state, {"skipped": True}
+        return step_fn(state, batch)
+
+    with chaos.inject(
+        chaos.Fault(chaos.GRADS, steps=(5, 6, 7), mode="nan")  # no max_hits
+    ):
+        with pytest.raises(RuntimeError, match="livelock"):
+            run_resilient(
+                guarded_step, init, batch_fn, directory=tmp_path,
+                num_steps=10, rollback_after=3, max_rollbacks=2,
+            )
+
+
+@pytest.mark.chaos
+def test_checkpoint_save_fault_heals_on_retry(tmp_path):
+    init, (step_fn, batch_fn) = _counting_job()
+    policy = RetryPolicy(max_attempts=3, backoff=0.0, sleep=lambda _: None)
+    with chaos.inject(
+        chaos.Fault(chaos.CHECKPOINT_SAVE, steps=(2,), mode="raise", max_hits=1)
+    ):
+        with pytest.warns(RuntimeWarning, match="checkpoint save"):
+            res = run_resilient(
+                step_fn, init, batch_fn, directory=tmp_path, num_steps=4,
+                policy=policy,
+            )
+    assert res.last_step == 3
+    with ResilientCheckpointManager(tmp_path) as mgr:
+        assert mgr.all_steps() == [0, 1, 2, 3]  # step 2 made it via retry
+
+
+@pytest.mark.chaos
+def test_interrupted_save_never_corrupts_latest(tmp_path):
+    """Acceptance (crash consistency): a save that dies mid-write (debris
+    on disk, exception raised, retries exhausted) leaves latest_step()
+    pointing at the previous COMPLETE checkpoint, and restore from it
+    works; the relaunch then finishes the run."""
+    init, (step_fn, batch_fn) = _counting_job()
+    policy = RetryPolicy(max_attempts=2, backoff=0.0, sleep=lambda _: None)
+    with chaos.inject(
+        chaos.Fault(chaos.CHECKPOINT_SAVE, steps=(3,), mode="partial")
+    ):
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(chaos.InjectedFault):
+                run_resilient(
+                    step_fn, init, batch_fn, directory=tmp_path,
+                    num_steps=6, policy=policy,
+                )
+    # the torn write left orbax-style debris behind...
+    debris = [p for p in os.listdir(tmp_path) if "tmp" in p]
+    assert debris, os.listdir(tmp_path)
+    # ...which step enumeration must ignore
+    with ResilientCheckpointManager(tmp_path) as mgr:
+        assert mgr.latest_step() == 2
+        assert mgr.all_steps() == [0, 1, 2]
+        out = mgr.restore(2, template=init)
+        assert int(out["n"]) == 3  # three steps applied
+
+    res = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path, num_steps=6
+    )
+    assert res.resumed_from == 2
+    assert res.last_step == 5
+    ref = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path / "ref", num_steps=6
+    )
+    assert _bits(res.state) == _bits(ref.state)
+
+
+@pytest.mark.chaos
+def test_restore_fault_heals_on_retry(tmp_path):
+    init, (step_fn, batch_fn) = _counting_job()
+    run_resilient(step_fn, init, batch_fn, directory=tmp_path, num_steps=3)
+    policy = RetryPolicy(max_attempts=2, backoff=0.0, sleep=lambda _: None)
+    with chaos.inject(
+        chaos.Fault(
+            chaos.CHECKPOINT_RESTORE, steps=(2,), mode="raise", max_hits=1
+        )
+    ):
+        with pytest.warns(RuntimeWarning, match="checkpoint restore"):
+            res = run_resilient(
+                step_fn, init, batch_fn, directory=tmp_path, num_steps=5,
+                policy=policy,
+            )
+    assert res.resumed_from == 2
+    assert res.last_step == 4

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Tier-1 verification — the exact ROADMAP.md command, wrapped for CI.
+#
+# Runs the quick test tier on CPU, prints DOTS_PASSED (count of passing
+# tests parsed from pytest's progress dots, the same metric the roadmap
+# tracks), and exits non-zero on any failure.
+#
+# Usage:
+#   tools/verify_tier1.sh              # full quick tier
+#   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
+#
+# Env:
+#   T1_LOG      log path        (default /tmp/_t1.log)
+#   T1_TIMEOUT  seconds         (default 870)
+
+set -o pipefail
+
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="${T1_LOG:-/tmp/_t1.log}"
+TIMEOUT="${T1_TIMEOUT:-870}"
+
+cd "$REPO_ROOT" || exit 2
+rm -f "$LOG"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+echo "DOTS_PASSED=$dots"
+if [ "$rc" -eq 0 ]; then
+    echo "TIER1: PASS"
+else
+    echo "TIER1: FAIL (pytest rc=$rc)"
+fi
+exit "$rc"
