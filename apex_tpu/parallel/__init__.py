@@ -1,5 +1,8 @@
 """Data-parallel layer — ≙ apex/parallel.
 
+- :mod:`apex_tpu.parallel.comm` — the ONE gradient-sync engine (wire
+  formats f32/bf16/int8, chunked overlap, HLO verification hooks) that
+  DDP and the ZeRO optimizers share (see ``docs/comm.md``);
 - :class:`DistributedDataParallel`, :func:`all_reduce_gradients`,
   :class:`Reducer` (≙ apex/parallel/distributed.py);
 - :class:`SyncBatchNorm`, :func:`convert_syncbn_model`
@@ -18,6 +21,13 @@ and keeps model axes on ICI.
 """
 
 from apex_tpu.optimizers.larc import LARC, larc  # noqa: F401
+from apex_tpu.parallel import comm  # noqa: F401  (the shared sync engine)
+from apex_tpu.parallel.comm import (  # noqa: F401
+    all_gather_flat,
+    collective_summary,
+    reduce_scatter_flat,
+    sync_gradients,
+)
 from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     Reducer,

@@ -1,0 +1,547 @@
+"""One gradient-sync engine for DP all-reduce and ZeRO weight-update
+sharding — wire format x chunking x verification, in one place.
+
+Both :class:`apex_tpu.parallel.DistributedDataParallel` and the ZeRO
+optimizers (:class:`apex_tpu.parallel.DistributedFusedAdam` /
+``DistributedFusedLAMB``) call into this module, so the dominant
+off-chip cost of the data-parallel step — gradient synchronization — is
+tuned in exactly one place.  Three independent knobs:
+
+**Wire format** (``wire="f32" | "bf16" | "int8"``).  ``f32`` is the
+exact path (``psum`` / ``psum_scatter`` / ``all_gather``).  ``bf16``
+halves wire bytes; ``int8`` is the blockwise-scaled code of EQuARX
+(arXiv 2506.17615, generalized from ``parallel/quantized.py``): every
+``block`` elements share one f32 ``max/127`` scale, and the scales'
+raw bytes ride the same payload as the codes so each phase stays ONE
+collective.  Whatever the wire, per-shard accumulation happens in f32
+(codes are decoded before the sum), so only the wire — never the
+reduction — loses precision.  Wire bytes: 4 / 2 / ~1.016 per element
+(int8 pays 4 bytes per ``block`` for the scale).
+
+**Chunking** (``chunks=K``).  The flat buffer is split into K
+near-equal chunks synced in an unrolled loop, so XLA may schedule chunk
+N's collective concurrently with chunk N-1's dequant / optimizer math
+(the overlap the reference's bucketed NCCL pipeline builds by hand).
+``K`` defaults to a bandwidth/latency heuristic seeded from the
+``tools/comm_structure.py`` ICI model (v5e, 90 GB/s per chip on one
+mesh axis): target ~4 MiB of wire per chunk, i.e. ~45 us of streaming —
+two orders of magnitude above per-collective launch latency, so the
+latency overhead of splitting stays in the noise while buffers >= 8 MiB
+get at least two overlap windows.  ``APEX_TPU_COMM_CHUNKS`` overrides
+everything (read at trace time — retrace to apply).
+
+**Verification hooks**.  :func:`collective_summary` /
+:func:`compiled_collectives` read every collective (count + bytes) out
+of compiled HLO and :func:`ring_wire_bytes` turns them into per-chip
+wire traffic under ring algorithms — so "exactly 2K collectives per
+sync, ~1/4 the bytes" is a regression test (``tests/test_comm.py``),
+not a docstring.  ``tools/comm_structure.py`` builds its artifact on
+the same parser.
+
+See ``docs/comm.md`` for the full model, tuning guidance, and when NOT
+to quantize.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import _compat
+from apex_tpu import parallel_state as ps
+
+__all__ = [
+    "WIRE_FORMATS",
+    "DEFAULT_BLOCK",
+    "sync_gradients",
+    "reduce_scatter_flat",
+    "all_gather_flat",
+    "resolve_chunks",
+    "chunks_requested",
+    "wire_bytes_per_element",
+    "quantize_blocks",
+    "dequantize_blocks",
+    "pack_int8",
+    "unpack_int8",
+    "collective_summary",
+    "compiled_collectives",
+    "ring_wire_bytes",
+]
+
+WIRE_FORMATS = ("f32", "bf16", "int8")
+
+_QMAX = 127.0
+DEFAULT_BLOCK = 256
+
+#: Chunking heuristic target: ~4 MiB of wire per chunk = ~45 us at the
+#: tools/comm_structure.py ICI model's 90 GB/s — bandwidth-dominated,
+#: yet small enough that a >= 8 MiB sync gets overlap windows.
+TARGET_CHUNK_BYTES = 4 << 20
+_MAX_HEURISTIC_CHUNKS = 16
+_MAX_CHUNKS = 64
+ENV_CHUNKS = "APEX_TPU_COMM_CHUNKS"
+
+
+def check_wire(wire: str) -> str:
+    if wire not in WIRE_FORMATS:
+        raise ValueError(
+            f"wire must be one of {WIRE_FORMATS}, got {wire!r}"
+        )
+    return wire
+
+
+def wire_bytes_per_element(wire: str, block: int = DEFAULT_BLOCK) -> float:
+    """Wire bytes one f32 element costs under ``wire`` (int8 includes
+    the amortized 4-byte/block scale)."""
+    check_wire(wire)
+    if wire == "f32":
+        return 4.0
+    if wire == "bf16":
+        return 2.0
+    return 1.0 + 4.0 / block
+
+
+def chunks_requested(chunks: Optional[int]) -> bool:
+    """True when chunking was explicitly asked for (arg or env) rather
+    than left to the heuristic."""
+    return chunks is not None or bool(os.environ.get(ENV_CHUNKS))
+
+
+def resolve_chunks(wire_nbytes: int, chunks: Optional[int] = None) -> int:
+    """Chunk count K: env ``APEX_TPU_COMM_CHUNKS`` > explicit ``chunks``
+    > the bandwidth/latency heuristic (ceil(bytes / 4 MiB), capped at
+    16).  Always >= 1."""
+    env = os.environ.get(ENV_CHUNKS)
+    if env:
+        k = int(env)
+    elif chunks is not None:
+        k = int(chunks)
+    else:
+        k = min(
+            -(-max(int(wire_nbytes), 1) // TARGET_CHUNK_BYTES),
+            _MAX_HEURISTIC_CHUNKS,
+        )
+    return max(1, min(k, _MAX_CHUNKS))
+
+
+def _chunk_bounds(n: int, k: int, align: int = 1):
+    """Up to K near-equal (lo, hi) spans covering [0, n); interior edges
+    round up to ``align`` (quantized wires align to ``block`` so only
+    the final chunk can carry a padded tail block) and empty spans drop,
+    so ragged sizes, k > n, and n < k*align are all safe — a buffer too
+    small to fill K aligned chunks just gets fewer."""
+    bounds, prev = [], 0
+    for i in range(1, k + 1):
+        edge = n if i == k else min(n, -(-((i * n) // k) // align) * align)
+        if edge > prev:
+            bounds.append((prev, edge))
+        prev = max(prev, edge)
+    return bounds
+
+
+# ---------------------------------------------------------------------------
+# blockwise int8 codec (generalized from parallel/quantized.py)
+# ---------------------------------------------------------------------------
+
+
+def _padded_len(n: int, block: int) -> int:
+    return n + (-n) % block
+
+
+def quantize_blocks(x, block: int = DEFAULT_BLOCK):
+    """``x (..., n)`` f32 -> int8 codes ``(..., n_pad)`` + f32 scales
+    ``(..., n_pad/block)`` with ``scale = max|block|/127``.
+
+    Tail-safe: ``n`` need not divide ``block`` — the tail is zero-padded
+    into its own block internally (padding zeros never raise a block
+    max, so real elements keep their scale).  Zero-safe: an all-zero
+    block gets scale 1.0 — never 0 or a subnormal — so the dequant path
+    cannot produce NaN/Inf from ``0/0`` or overflow from ``x/tiny``.
+    """
+    n = x.shape[-1]
+    pad = (-n) % block
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((*x.shape[:-1], pad), x.dtype)], axis=-1
+        )
+    xb = x.reshape(*x.shape[:-1], -1, block)
+    m = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.maximum(m / _QMAX, jnp.finfo(jnp.float32).tiny)
+    scale = jnp.where(m > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xb / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q.reshape(*x.shape[:-1], n + pad), scale[..., 0]
+
+
+def dequantize_blocks(q, scale, block: int = DEFAULT_BLOCK,
+                      n: Optional[int] = None):
+    """Inverse of :func:`quantize_blocks`; ``n`` slices the zero-pad
+    back off.  Dequantized values sit exactly on the int8 grid, so a
+    second quantize/dequantize round-trip is bit-identical (the
+    fixed-point property ``tests/test_quantized_allreduce.py`` pins)."""
+    shape = q.shape
+    xb = q.reshape(*shape[:-1], -1, block).astype(jnp.float32)
+    out = (xb * scale[..., None]).reshape(shape)
+    if n is not None and n != shape[-1]:
+        out = out[..., :n]
+    return out
+
+
+def pack_int8(q, scale):
+    """Append the f32 scales' raw bytes to the int8 codes so codes and
+    scales ride ONE collective payload."""
+    sbytes = jax.lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.int8
+    ).reshape(*q.shape[:-1], -1)
+    return jnp.concatenate([q, sbytes], axis=-1)
+
+
+def unpack_int8(payload, n: int, block: int = DEFAULT_BLOCK):
+    """Split a packed payload back into (codes, scales) for ``n`` real
+    elements quantized at ``block``."""
+    n_pad = _padded_len(n, block)
+    q, sbytes = payload[..., :n_pad], payload[..., n_pad:]
+    scale = jax.lax.bitcast_convert_type(
+        sbytes.reshape(*sbytes.shape[:-1], -1, 4), jnp.float32
+    )
+    return q, scale
+
+
+def _encode(x, wire: str, block: int):
+    """f32 ``(..., n)`` -> wire payload (same leading shape)."""
+    if wire == "f32":
+        return x
+    if wire == "bf16":
+        return x.astype(jnp.bfloat16)
+    return pack_int8(*quantize_blocks(x, block))
+
+
+def _decode(payload, wire: str, block: int, n: int):
+    """Wire payload -> f32 ``(..., n)``."""
+    if wire == "f32":
+        return payload
+    if wire == "bf16":
+        return payload.astype(jnp.float32)
+    q, scale = unpack_int8(payload, n, block)
+    return dequantize_blocks(q, scale, block, n)
+
+
+# ---------------------------------------------------------------------------
+# flat-buffer collectives (the ZeRO building blocks)
+# ---------------------------------------------------------------------------
+
+
+def reduce_scatter_flat(
+    flat,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    *,
+    wire: str = "f32",
+    chunks: Optional[int] = None,
+    block: int = DEFAULT_BLOCK,
+):
+    """SUM-reduce a flat f32 buffer over ``axis_name`` and return my
+    contiguous shard (``flat.size / world`` elements, f32).
+
+    ``flat.size`` must divide the axis size.  ``wire="f32"`` lowers to
+    ``psum_scatter``; quantized wires use one ``all_to_all`` of encoded
+    payloads per chunk with f32 shard-local dequant-accumulate.  Call
+    inside ``shard_map``.
+    """
+    check_wire(wire)
+    world = _compat.axis_size(axis_name)
+    n = flat.shape[0]
+    if n == 0 or world == 1:
+        return flat.astype(jnp.float32)
+    if n % world:
+        raise ValueError(f"flat size {n} not divisible by world {world}")
+    shard = n // world
+    k = min(
+        resolve_chunks(int(n * wire_bytes_per_element(wire, block)), chunks),
+        shard,
+    )
+    rows = flat.reshape(world, shard).astype(jnp.float32)
+    outs = []
+    with jax.named_scope(f"comm_rs_{wire}"):
+        for lo, hi in _chunk_bounds(shard, k, 1 if wire == "f32" else block):
+            seg = rows[:, lo:hi]  # row j = rank j's slice of this chunk
+            if wire == "f32":
+                outs.append(
+                    jax.lax.psum_scatter(
+                        seg.reshape(-1), axis_name,
+                        scatter_dimension=0, tiled=True,
+                    )
+                )
+            else:
+                recv = jax.lax.all_to_all(
+                    _encode(seg, wire, block), axis_name, 0, 0, tiled=False
+                )
+                outs.append(
+                    jnp.sum(_decode(recv, wire, block, hi - lo), axis=0)
+                )
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
+def all_gather_flat(
+    shard,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    *,
+    wire: str = "f32",
+    chunks: Optional[int] = None,
+    block: int = DEFAULT_BLOCK,
+):
+    """All-gather per-rank contiguous shards back into the full flat f32
+    buffer (``world * shard.size`` elements, rank-major).
+
+    Quantized wires encode the local shard and every rank decodes the
+    SAME payloads — including its own — so the gathered buffer is
+    bit-identical across replicas (the invariant that keeps ZeRO params
+    replicated).  Call inside ``shard_map``.
+    """
+    check_wire(wire)
+    world = _compat.axis_size(axis_name)
+    s = shard.shape[0]
+    if s == 0 or world == 1:
+        return shard.astype(jnp.float32)
+    k = min(
+        resolve_chunks(
+            int(world * s * wire_bytes_per_element(wire, block)), chunks
+        ),
+        s,
+    )
+    shard = shard.astype(jnp.float32)
+    parts = []
+    with jax.named_scope(f"comm_ag_{wire}"):
+        for lo, hi in _chunk_bounds(s, k, 1 if wire == "f32" else block):
+            g = jax.lax.all_gather(
+                _encode(shard[lo:hi], wire, block), axis_name,
+                axis=0, tiled=False,
+            )
+            parts.append(_decode(g, wire, block, hi - lo))  # (world, cs)
+    full = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    return full.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# tree-level gradient sync (the DDP entry point)
+# ---------------------------------------------------------------------------
+
+
+def sync_gradients(
+    grads: Any,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    *,
+    wire: str = "f32",
+    chunks: Optional[int] = None,
+    block: int = DEFAULT_BLOCK,
+    min_size: int = 1024,
+    gradient_average: bool = True,
+    gradient_predivide_factor: Optional[float] = None,
+):
+    """Sync a gradient pytree over ``axis_name`` (call inside
+    ``shard_map``) with the engine's wire/chunking knobs; a drop-in for
+    :func:`apex_tpu.parallel.all_reduce_gradients` (same averaging /
+    predivide semantics).
+
+    ``wire="f32"`` with no chunking request is the exact per-leaf psum.
+    Otherwise every leaf of >= ``min_size`` elements joins ONE flat
+    bucket synced as a chunked reduce-scatter + all-gather (2K
+    collectives total, independent of leaf count); leaves under
+    ``min_size`` — biases, LN scales: latency-dominated and the most
+    noise-sensitive — always ride the exact psum.
+    """
+    check_wire(wire)
+    world = _compat.axis_size(axis_name)
+    post = 1.0
+    if gradient_average:
+        post = (
+            world / gradient_predivide_factor
+            if gradient_predivide_factor is not None
+            else world
+        )
+
+    def pre(g):
+        # a numerical no-op inside the quantized path (constant scaling
+        # commutes with max/127 quantization), but it keeps
+        # half-precision INPUT grads from overflowing before the cast,
+        # exactly as in all_reduce_gradients
+        if gradient_predivide_factor is not None:
+            return g / gradient_predivide_factor
+        return g
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    big = [
+        i for i, l in enumerate(leaves)
+        if l.size >= min_size and l.size > 0 and world > 1
+    ]
+    resolved = None
+    if big:
+        nbytes = int(
+            sum(leaves[i].size for i in big)
+            * wire_bytes_per_element(wire, block)
+        )
+        resolved = resolve_chunks(nbytes, chunks)
+    bucketed = bool(big) and (
+        wire != "f32" or (chunks_requested(chunks) and resolved > 1)
+    )
+    synced_by_idx = {}
+    out = []
+    with jax.named_scope(f"comm_sync_{wire}"):
+        if bucketed:
+            flat = jnp.concatenate(
+                [pre(leaves[i]).reshape(-1).astype(jnp.float32)
+                 for i in big]
+            )
+            n = flat.shape[0]
+            padded = n + (-n) % world
+            if padded != n:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((padded - n,), jnp.float32)]
+                )
+            my_shard = reduce_scatter_flat(
+                flat, axis_name, wire=wire, chunks=resolved, block=block
+            )
+            synced = all_gather_flat(
+                my_shard, axis_name, wire=wire, chunks=resolved, block=block
+            )[:n] / post
+            offs = 0
+            for i in big:
+                sz = leaves[i].size
+                synced_by_idx[i] = (
+                    synced[offs:offs + sz]
+                    .reshape(leaves[i].shape)
+                    .astype(leaves[i].dtype)
+                )
+                offs += sz
+        for i, l in enumerate(leaves):
+            if i in synced_by_idx:
+                out.append(synced_by_idx[i])
+            else:
+                out.append(jax.lax.psum(pre(l), axis_name) / post)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# verification hooks: collectives + wire bytes out of compiled HLO
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4,
+    "u16": 2, "u8": 1, "pred": 1,
+}
+
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def _shape_bytes(shape: str) -> int:
+    """bytes of an HLO shape string like 'bf16[8,128,1024]' (tuples:
+    sum of elements)."""
+    total = 0
+    for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", shape):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _async_start_result(shape: str) -> str:
+    """Result element of an async ``-start`` op's tuple shape
+    ``(operand(s), result(s)[, contexts...])`` — the second TOP-LEVEL
+    element, which for a variadic combined op is itself a tuple whose
+    arrays all count.  Depth tracking covers ALL bracket kinds: shape
+    strings carry commas inside dims (``[8,128]``) and layouts
+    (``{1,0}``), not just nested tuples."""
+    if not shape.startswith("("):
+        return shape
+    parts, depth, cur = [], 0, []
+    for ch in shape[1:-1]:
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        cur.append(ch)
+    parts.append("".join(cur))
+    return parts[1] if len(parts) > 1 else parts[0]
+
+
+def collective_summary(hlo_text: str) -> dict:
+    """Per-kind ``{count, bytes}`` for every collective in optimized HLO.
+
+    Bytes are the shape printed at each op's definition site — the
+    RESULT: the full buffer for all-gather/all-to-all, the local shard
+    for reduce-scatter (feed :func:`ring_wire_bytes` for a
+    notation-normalized traffic number).  Async ``-start``/``-done``
+    pairs count once, at ``-start``, with the result element of the
+    start tuple.
+    """
+    out = {}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        # shape alternative allows one level of tuple nesting: variadic
+        # combined async ops (XLA's collective combiners) print
+        # ((op0, op1), (res0, res1)) — a flat [^)]* would stop at the
+        # first ')' and silently drop the op from the count
+        m = re.match(
+            r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*"
+            r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
+            r"(all-reduce|all-gather|reduce-scatter|"
+            r"collective-permute|all-to-all)(-start|-done)?\(",
+            line)
+        if not m:
+            continue
+        shape, kind, variant = m.group(1), m.group(2), m.group(3)
+        if variant == "-done":
+            # async pairs are counted once, at -start
+            continue
+        if variant == "-start":
+            # -start returns (operand(s), result(s)[, contexts]); keep
+            # only the result element so bytes match the sync form
+            shape = _async_start_result(shape)
+        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += _shape_bytes(shape)
+    return out
+
+
+def compiled_collectives(fn, *args, **kwargs) -> dict:
+    """:func:`collective_summary` of a jitted callable compiled on
+    ``args`` — the hook regression tests assert on.  ``fn`` must carry
+    ``.lower`` (i.e. be ``jax.jit``-wrapped)."""
+    hlo = fn.lower(*args, **kwargs).compile().as_text()
+    return collective_summary(hlo)
+
+
+def ring_wire_bytes(summary: dict, world: int) -> float:
+    """Per-chip wire traffic (bytes sent) implied by a
+    :func:`collective_summary`, under ring algorithms — normalized for
+    XLA's result-shape notation so f32 and quantized paths compare
+    apples-to-apples: reduce-scatter prints the SHARD (traffic =
+    ``(world-1) * shard``), all-gather/all-to-all print the FULL buffer
+    (traffic = ``(world-1)/world * full``), all-reduce streams twice.
+    """
+    t = 0.0
+    for kind, rec in summary.items():
+        b = rec["bytes"]
+        if kind == "all-reduce":
+            t += 2.0 * b * (world - 1) / world
+        elif kind == "reduce-scatter":
+            t += b * (world - 1)
+        elif kind in ("all-gather", "all-to-all"):
+            t += b * (world - 1) / world
+        elif kind == "collective-permute":
+            t += b  # one hop
+    return t

@@ -19,10 +19,34 @@ Mapping to XLA collectives (inside ``shard_map`` over the ``dp`` axis):
 - param all-gather (``full_ar=False`` path) → ``jax.lax.all_gather(...,
   tiled=True)``.
 
-Optimizer state (m, v) lives permanently sharded: global arrays of shape
-``(padded_size,)`` with sharding ``P("dp")`` — each device owns
-``padded_size // world`` elements, the 1/N memory footprint that is the
-point of ZeRO.
+Optimizer state (m, v, and the f32 ``master`` params) lives permanently
+sharded: global arrays of shape ``(padded_size,)`` with sharding
+``P("dp")`` — each device owns ``padded_size // world`` elements, the
+1/N memory footprint that is the point of ZeRO.
+
+The ``master`` shard is the AUTHORITATIVE param value (classic ZeRO
+master weights): the update applies to it in f32 every step, and the
+all-gathered replicated tree is only the working copy the next
+forward/backward reads.  That is what makes a lossy ``param_wire``
+safe — a bf16 gather rounds the working copy, never the accumulator,
+so updates smaller than a wire ulp still accumulate instead of being
+re-rounded away step after step.  (Consequence: edits to the replicated
+params tree between steps are ignored; reinitialize via :meth:`init`
+to reset the masters.)
+
+Both collectives run through :mod:`apex_tpu.parallel.comm` (the engine
+shared with ``DistributedDataParallel`` — see ``docs/comm.md``):
+``wire="bf16" | "int8"`` swaps the f32 wire for a quantized one (f32
+shard-local accumulation either way; ~2x / ~4x fewer sync bytes — the
+analog of the reference LAMB's ``fp16 compressed allgather`` knob, which
+r0 recorded as having "no XLA analog": it does now), and ``chunks=K``
+splits the flat buffer so XLA can overlap chunk N's collective with
+chunk N-1's dequant/optimizer math.  ``param_wire`` overrides the wire
+for the param all-gather alone — it sets the precision of the WORKING
+copy the forward/backward reads (the f32 masters below are never
+rounded), so ``wire="int8", param_wire="bf16"`` is the recommended
+aggressive setting: grads tolerate coarse wires, activations want the
+params at >= bf16.
 """
 
 from __future__ import annotations
@@ -39,6 +63,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from apex_tpu import _compat
 from apex_tpu import parallel_state as ps
 from apex_tpu._tree_util import to_f32
+from apex_tpu.parallel import comm
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
 
@@ -83,16 +108,32 @@ def _flatten_pad(tree, spec: _FlatSpec):
 
 
 class _DistributedFusedBase:
-    def __init__(self, axis_name: str = ps.DATA_PARALLEL_AXIS):
+    def __init__(
+        self,
+        axis_name: str = ps.DATA_PARALLEL_AXIS,
+        wire: str = "f32",
+        chunks: int | None = None,
+        block: int = comm.DEFAULT_BLOCK,
+        param_wire: str | None = None,
+    ):
         self.axis_name = axis_name
+        self.wire = comm.check_wire(wire)
+        self.chunks = chunks
+        self.block = block
+        self.param_wire = (
+            comm.check_wire(param_wire) if param_wire is not None else None
+        )
         self._spec: _FlatSpec | None = None
 
     # -- host-side ------------------------------------------------------
     def init(self, params, world: int | None = None):
-        """Returns the sharded state pytree (place with sharding P(dp))."""
+        """Returns the sharded state pytree (place with sharding P(dp));
+        ``state.master`` is seeded with the flattened f32 params — the
+        authoritative copy every later update applies to."""
         world = world or ps.get_data_parallel_world_size()
         self._spec = _make_spec(params, world)
-        return self._init_state(self._spec)
+        state = self._init_state(self._spec)
+        return state._replace(master=_flatten_pad(params, self._spec))
 
     def state_sharding(self, mesh=None):
         """NamedShardings for the state (flat arrays sharded over dp)."""
@@ -111,11 +152,14 @@ class _DistributedFusedBase:
 
     # -- device-side (inside shard_map over the dp axis) ----------------
     def reduce_scatter_grads(self, grads, gradient_average: bool = True):
-        """Local grads tree -> my reduced flat shard (f32)."""
+        """Local grads tree -> my reduced flat shard (f32), via the comm
+        engine's (possibly quantized, possibly chunked) reduce-scatter
+        with f32 shard-local accumulation."""
         spec = self.spec
         flat = _flatten_pad(grads, spec)
-        shard = jax.lax.psum_scatter(
-            flat, self.axis_name, scatter_dimension=0, tiled=True
+        shard = comm.reduce_scatter_flat(
+            flat, self.axis_name,
+            wire=self.wire, chunks=self.chunks, block=self.block,
         )
         if gradient_average:
             shard = shard / spec.world
@@ -134,10 +178,16 @@ class _DistributedFusedBase:
         return jax.lax.dynamic_slice(seg, (rank * spec.shard_size,), (spec.shard_size,))
 
     def gather_params(self, new_param_shard, params_template):
-        """All-gather updated shards and rebuild the (dtype-cast) tree."""
+        """All-gather updated shards and rebuild the (dtype-cast) tree.
+
+        Runs at ``param_wire`` (default: follow ``wire``); every rank
+        decodes the same payloads — its own included — so params stay
+        bit-identical across replicas whatever the wire."""
         spec = self.spec
-        flat = jax.lax.all_gather(
-            new_param_shard, self.axis_name, axis=0, tiled=True
+        flat = comm.all_gather_flat(
+            new_param_shard, self.axis_name,
+            wire=self.param_wire or self.wire,
+            chunks=self.chunks, block=self.block,
         )
         tree = spec.unravel(flat[: spec.flat_size])
         return jax.tree_util.tree_map(
@@ -153,12 +203,16 @@ class _DistributedFusedBase:
         (``_compat.pcast(p, axis, to='varying')``) or jax's autodiff will
         have already all-reduced them and the reduce-scatter here would
         double-count.
+
+        The update applies to ``state.master`` (the f32 shard), never to
+        the possibly-wire-rounded ``params`` — ``params`` only supplies
+        the tree structure/dtypes for the gathered working copy.
         """
         g_shard = self.reduce_scatter_grads(grads, gradient_average)
-        p_shard = self.my_param_shard(params)
         new_p_shard, new_state = self._shard_update(
-            g_shard, state, p_shard
+            g_shard, state, state.master
         )
+        new_state = new_state._replace(master=new_p_shard)
         return self.gather_params(new_p_shard, params), new_state
 
     # -- convenience ----------------------------------------------------
@@ -199,6 +253,7 @@ class _AdamState(NamedTuple):
     count: jax.Array
     m: jax.Array  # (padded,) sharded over dp
     v: jax.Array
+    master: jax.Array  # (padded,) f32 authoritative params, sharded over dp
 
 
 class DistributedFusedAdam(_DistributedFusedBase):
@@ -213,8 +268,13 @@ class DistributedFusedAdam(_DistributedFusedBase):
         adam_w_mode: bool = True,
         bias_correction: bool = True,
         axis_name: str = ps.DATA_PARALLEL_AXIS,
+        wire: str = "f32",
+        chunks: int | None = None,
+        block: int = comm.DEFAULT_BLOCK,
+        param_wire: str | None = None,
     ):
-        super().__init__(axis_name)
+        super().__init__(axis_name, wire=wire, chunks=chunks, block=block,
+                         param_wire=param_wire)
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -227,6 +287,7 @@ class DistributedFusedAdam(_DistributedFusedBase):
             count=jnp.zeros((), jnp.int32),
             m=jnp.zeros((spec.padded_size,), jnp.float32),
             v=jnp.zeros((spec.padded_size,), jnp.float32),
+            master=jnp.zeros((spec.padded_size,), jnp.float32),
         )
 
     def _shard_update(self, g, state: _AdamState, p):
@@ -241,13 +302,16 @@ class DistributedFusedAdam(_DistributedFusedBase):
         u = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
         if self.adam_w_mode and self.weight_decay != 0.0:
             u = u + self.weight_decay * p
-        return p - self.lr * u, _AdamState(count=count, m=m, v=v)
+        return p - self.lr * u, _AdamState(
+            count=count, m=m, v=v, master=state.master
+        )
 
 
 class _LambState(NamedTuple):
     count: jax.Array
     m: jax.Array
     v: jax.Array
+    master: jax.Array  # (padded,) f32 authoritative params, sharded over dp
 
 
 class DistributedFusedLAMB(_DistributedFusedBase):
@@ -255,8 +319,9 @@ class DistributedFusedLAMB(_DistributedFusedBase):
 
     The reference's ``clip_after_ar`` (clip by the global grad norm after
     the all-reduce), per-tensor trust ratios across shard boundaries, and
-    nvlamb gating are reproduced; its fp16 compressed-allgather knob is a
-    wire-format optimization with no XLA analog.
+    nvlamb gating are reproduced; its fp16 compressed-allgather knob maps
+    to ``param_wire="bf16"`` (and grads go further: ``wire="int8"`` —
+    see ``docs/comm.md``).
     """
 
     def __init__(
@@ -271,8 +336,13 @@ class DistributedFusedLAMB(_DistributedFusedBase):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         axis_name: str = ps.DATA_PARALLEL_AXIS,
+        wire: str = "f32",
+        chunks: int | None = None,
+        block: int = comm.DEFAULT_BLOCK,
+        param_wire: str | None = None,
     ):
-        super().__init__(axis_name)
+        super().__init__(axis_name, wire=wire, chunks=chunks, block=block,
+                         param_wire=param_wire)
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -288,6 +358,7 @@ class DistributedFusedLAMB(_DistributedFusedBase):
             count=jnp.zeros((), jnp.int32),
             m=jnp.zeros((spec.padded_size,), jnp.float32),
             v=jnp.zeros((spec.padded_size,), jnp.float32),
+            master=jnp.zeros((spec.padded_size,), jnp.float32),
         )
 
     def _shard_update(self, g, state: _LambState, p):
@@ -328,4 +399,6 @@ class DistributedFusedLAMB(_DistributedFusedBase):
         if not self.use_nvlamb and self.weight_decay == 0.0:
             ratio = jnp.ones_like(ratio)
         r = ratio[seg]
-        return p - self.lr * r * u, _LambState(count=count, m=m, v=v)
+        return p - self.lr * r * u, _LambState(
+            count=count, m=m, v=v, master=state.master
+        )

@@ -6,6 +6,12 @@ width).  Pattern: EQuARX — Efficient Quantized AllReduce in XLA
 the all-reduce's two phases at minor quality cost.  This is an
 independent TPU-native implementation of that idea with jax collectives.
 
+Since the comm-layer refactor the actual machinery — the blockwise int8
+codec, the bucketed reduce-scatter/all-gather, chunking, and the HLO
+verification hooks — lives in :mod:`apex_tpu.parallel.comm` (see
+``docs/comm.md``), where the ZeRO optimizers share it.  This module
+keeps the historical entry point with its historical contract:
+
 Structure: every eligible gradient leaf is flattened into ONE bucket, so
 the whole tree costs exactly two collectives —
 
@@ -32,81 +38,12 @@ reproducibility matters.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
-import jax
-import jax.numpy as jnp
-
-from apex_tpu import _compat
 from apex_tpu import parallel_state as ps
+from apex_tpu.parallel import comm
 
 __all__ = ["quantized_all_reduce_gradients"]
-
-_QMAX = 127.0
-
-
-def _quantize_blocks(x, block):
-    """x (..., n·block) -> int8 codes (same shape) + f32 scales
-    (..., n) with scale = max|block|/127."""
-    shape = x.shape
-    xb = x.reshape(*shape[:-1], -1, block)
-    scale = jnp.max(jnp.abs(xb), axis=-1, keepdims=True) / _QMAX
-    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
-    q = jnp.clip(jnp.round(xb / scale), -_QMAX, _QMAX).astype(jnp.int8)
-    return q.reshape(shape), scale[..., 0]
-
-
-def _dequantize_blocks(q, scale, block):
-    shape = q.shape
-    xb = q.reshape(*shape[:-1], -1, block).astype(jnp.float32)
-    return (xb * scale[..., None]).reshape(shape)
-
-
-def _pack(q, scale):
-    """Append the scales' raw bytes to the int8 codes, so codes and
-    scales ride ONE collective."""
-    sbytes = jax.lax.bitcast_convert_type(
-        scale.astype(jnp.float32), jnp.int8
-    ).reshape(*q.shape[:-1], -1)
-    return jnp.concatenate([q, sbytes], axis=-1)
-
-
-def _unpack(payload, n_codes):
-    q, sbytes = payload[..., :n_codes], payload[..., n_codes:]
-    scale = jax.lax.bitcast_convert_type(
-        sbytes.reshape(*sbytes.shape[:-1], -1, 4), jnp.float32
-    )
-    return q, scale
-
-
-def _qar_flat(flat, axis_name, world, block):
-    """Raw SUM of a flat f32 vector over the axis in two int8-wire
-    collectives (averaging is a post-scale at the caller — constant
-    scaling commutes exactly with max/127 quantization)."""
-    n = flat.shape[0]
-    pad = (-n) % (world * block)
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-    chunks = flat.reshape(world, -1)  # row j = the shard rank j will own
-    csize = chunks.shape[1]
-
-    # phase 1 (reduce-scatter shape): one all_to_all, dequant-accumulate
-    recv = jax.lax.all_to_all(
-        _pack(*_quantize_blocks(chunks, block)), axis_name, 0, 0,
-        tiled=False,
-    )
-    q_recv, s_recv = _unpack(recv, csize)
-    shard = jnp.sum(_dequantize_blocks(q_recv, s_recv, block), axis=0)
-
-    # phase 2: re-quantize the reduced shard, one all_gather
-    gathered = jax.lax.all_gather(
-        _pack(*_quantize_blocks(shard, block)), axis_name
-    )
-    q_all, s_all = _unpack(gathered, csize)
-    out = _dequantize_blocks(q_all, s_all, block).reshape(-1)
-    if pad:
-        out = out[:n]
-    return out
 
 
 def quantized_all_reduce_gradients(
@@ -116,6 +53,7 @@ def quantized_all_reduce_gradients(
     gradient_predivide_factor=None,
     min_size: int = 1024,
     block: int = 256,
+    chunks: Optional[int] = 1,
 ):
     """int8-wire gradient sync over ``axis_name`` (call inside
     shard_map); a drop-in for :func:`parallel.all_reduce_gradients`
@@ -125,53 +63,20 @@ def quantized_all_reduce_gradients(
     Leaves smaller than ``min_size`` elements go through the exact psum
     (their wire cost is latency-dominated and tiny tensors — biases, LN
     scales — are the most noise-sensitive); everything else shares one
-    bucket and exactly two collectives.  ``block`` elements share one
-    quantization scale.
+    bucket.  ``block`` elements share one quantization scale.
+    ``chunks=1`` (the default) keeps the historical exactly-two-
+    collectives contract; pass ``chunks=None`` for the comm layer's
+    overlap heuristic, or any K explicitly (``APEX_TPU_COMM_CHUNKS``
+    overrides either).  Equivalent to
+    :func:`apex_tpu.parallel.comm.sync_gradients` with ``wire="int8"``.
     """
-    world = _compat.axis_size(axis_name)
-    post = 1.0
-    if gradient_average:
-        post = (
-            world / gradient_predivide_factor
-            if gradient_predivide_factor is not None
-            else world
-        )
-
-    def pre(g):
-        if gradient_predivide_factor is not None:
-            # a numerical no-op inside the quantized path (constant
-            # scaling commutes with max/127 quantization), but it keeps
-            # half-precision INPUT grads from overflowing before the
-            # cast, exactly as in all_reduce_gradients
-            return g / gradient_predivide_factor
-        return g
-
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
-    with jax.named_scope("ddp_quantized_allreduce"):
-        out = []
-        big = [
-            i for i, l in enumerate(leaves)
-            if l.size >= min_size and world > 1
-        ]
-        if big:
-            flat = jnp.concatenate(
-                [pre(leaves[i]).reshape(-1).astype(jnp.float32)
-                 for i in big]
-            )
-            synced = _qar_flat(flat, axis_name, world, block) / post
-            offs = 0
-            synced_by_idx = {}
-            for i in big:
-                n = leaves[i].size
-                synced_by_idx[i] = (
-                    synced[offs:offs + n]
-                    .reshape(leaves[i].shape)
-                    .astype(leaves[i].dtype)
-                )
-                offs += n
-        for i, l in enumerate(leaves):
-            if big and i in synced_by_idx:
-                out.append(synced_by_idx[i])
-            else:
-                out.append(jax.lax.psum(pre(l), axis_name) / post)
-        return jax.tree_util.tree_unflatten(treedef, out)
+    return comm.sync_gradients(
+        grads,
+        axis_name,
+        wire="int8",
+        chunks=chunks,
+        block=block,
+        min_size=min_size,
+        gradient_average=gradient_average,
+        gradient_predivide_factor=gradient_predivide_factor,
+    )

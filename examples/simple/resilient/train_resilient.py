@@ -10,16 +10,36 @@ or let chaos do it), run it again — it continues where it stopped::
     # with injected faults (deterministic; the x1 save fault heals on retry):
     APEX_TPU_CHAOS="grads:nan@7,8;checkpoint_save:raise:x1@5;preemption@42" \
         python train_resilient.py --steps 200 --dir /tmp/resilient_demo
+
+Gradient accumulation rides the DDP comm layer (``docs/comm.md``):
+``--accum K`` splits each optimizer step into K microbatches whose grads
+accumulate LOCALLY (``DistributedDataParallel.no_sync`` semantics —
+Apex's ``delay_allreduce``), paying ONE gradient sync on the boundary;
+``--wire int8`` makes that boundary sync quantized.  The loss runs
+through a ``shard_map`` over the dp mesh, so the same script spans
+1..N devices (``XLA_FLAGS=--xla_force_host_platform_device_count=4`` to
+try a 4-way mesh on CPU)::
+
+    python train_resilient.py --steps 100 --accum 4 --wire int8
 """
 
 import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "../../.."))
+)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from apex_tpu import amp
+from apex_tpu import parallel_state as ps
 from apex_tpu.optimizers import fused_adam
+from apex_tpu.parallel import DistributedDataParallel
 from apex_tpu.resilience import GradGuard, chaos, guarded_amp_update, run_resilient
 
 
@@ -28,7 +48,25 @@ def main():
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--dir", default="/tmp/apex_tpu_resilient_demo")
     ap.add_argument("--save-every", type=int, default=10)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="microbatches accumulated locally per optimizer "
+                    "step (one gradient sync on the boundary)")
+    ap.add_argument("--wire", default="f32",
+                    choices=["f32", "bf16", "int8"],
+                    help="wire format of the boundary gradient sync "
+                    "(docs/comm.md; tiny leaves stay on the exact psum)")
     args = ap.parse_args()
+
+    mesh = ps.initialize_model_parallel()  # all devices -> dp axis
+    dp = ps.get_data_parallel_world_size()
+    micro = 64  # rows per microbatch, per replica
+    rows = micro * dp * args.accum  # rows consumed per optimizer step
+    if rows > 4096:  # the toy dataset below
+        raise SystemExit(
+            f"--accum {args.accum} x dp={dp} needs {rows} rows per step "
+            "but the toy dataset has 4096; lower --accum or the mesh size"
+        )
+    print(f"devices: dp={dp}, accum={args.accum}, wire={args.wire}")
 
     rs = np.random.RandomState(0)
     x_all = jnp.asarray(rs.randn(4096, 8), jnp.float32)
@@ -47,22 +85,42 @@ def main():
         "guard": guard.init(),
     }
 
-    @jax.jit
-    def compute_grads(params, scaler_state, batch):
-        x, y = batch
+    ddp = DistributedDataParallel(
+        lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+        wire=args.wire,
+    )
 
-        def loss_fn(p):
-            return jnp.mean((x @ p["w"] - y) ** 2)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+    def grads_fn(params, scaler_state, batch):
+        # batch leaves: (accum, micro*dp, ...); microbatch grads stay
+        # LOCAL inside the scan (no_sync), ONE engine sync at the end
+        if args.accum == 1:
+            loss, grads = ddp.value_and_grad(
+                params, jax.tree_util.tree_map(lambda x: x[0], batch)
+            )
+        else:
+            loss, grads = ddp.accum_value_and_grad(params, batch)
         scaled = jax.tree_util.tree_map(
             lambda g: scaler.scale(g, scaler_state), grads
         )
         return loss, scaled
 
+    compute_grads = jax.jit(
+        jax.shard_map(
+            grads_fn,
+            mesh=mesh,
+            in_specs=(P(), P(), P(None, "dp")),
+            out_specs=(P(), P()),
+        )
+    )
+
     def batch_fn(step):
-        lo = (step * 64) % (4096 - 64)
-        return x_all[lo : lo + 64], y_all[lo : lo + 64]
+        span = x_all.shape[0] - rows  # 0 when one step eats the dataset
+        lo = (step * rows) % span if span > 0 else 0
+        shape = (args.accum, micro * dp)
+        return (
+            x_all[lo: lo + rows].reshape(*shape, 8),
+            y_all[lo: lo + rows].reshape(*shape, 4),
+        )
 
     def step_fn(state, batch):
         loss, scaled = compute_grads(state["params"], state["scaler"], batch)

@@ -83,6 +83,19 @@ def test_simple_ddp_smoke():
     assert "devices: 2" in out, out[-500:]
 
 
+def test_simple_resilient_accum_smoke(tmp_path):
+    """Resilient loop + DDP gradient accumulation (no_sync boundary
+    sync, int8 wire) over a 2-device dp mesh."""
+    out = _run_example(
+        "examples/simple/resilient/train_resilient.py",
+        ["--steps", "8", "--accum", "2", "--wire", "int8",
+         "--save-every", "4", "--dir", str(tmp_path / "demo")],
+        n_devices=2,
+    )
+    assert "dp=2, accum=2, wire=int8" in out, out[-500:]
+    assert "final loss" in out, out[-500:]
+
+
 def test_bert_pretrain_tiny_smoke():
     # default path: packed masked-position MLM head (the recipe input)
     _run_example("examples/bert/pretrain_bert.py", ["--tiny"])

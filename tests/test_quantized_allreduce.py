@@ -222,3 +222,72 @@ def test_ddp_training_converges_with_quantized_sync(eight_devices, block):
     assert h_quant[-1] < h_quant[0] * 0.15, (h_quant[0], h_quant[-1])
     # trajectories track each other to a few percent
     assert abs(h_quant[-1] - h_exact[-1]) < 0.1 * h_exact[0]
+
+
+# ---------------------------------------------------------------------------
+# codec edge cases (ISSUE 2 satellite): zero/empty blocks, tail blocks
+# ---------------------------------------------------------------------------
+
+
+def test_all_zero_and_empty_leaves_stay_finite_and_exact(eight_devices):
+    """All-zero blocks must not mint NaN/Inf scales (max==0 ->
+    scale=max/127=0 was the trap), and zero-size leaves must pass
+    through untouched."""
+    g = {
+        "zeros": jnp.zeros((DP, 4096), jnp.float32),
+        "empty": jnp.zeros((DP, 0), jnp.float32),
+        "w": _per_rank_grads(jax.random.PRNGKey(11), (2048,)),
+    }
+    got = _run(lambda t: quantized_all_reduce_gradients(t, min_size=1), g)
+    z = np.asarray(got["zeros"])
+    assert np.all(np.isfinite(z))
+    np.testing.assert_array_equal(z, 0.0)
+    assert got["empty"].shape == (DP, 0)
+    assert np.all(np.isfinite(np.asarray(got["w"])))
+
+
+def test_tail_block_roundtrip(eight_devices):
+    """flat_size % block != 0: the tail block must quantize on its own
+    scale (no wraparound into pad), and — because dequantized values sit
+    exactly on the int8 grid — a second quantize/dequantize pass must be
+    bit-identical (the fixed-point property)."""
+    from apex_tpu.parallel import comm
+
+    n, block = 300, 256  # 44-element tail block
+    x = jax.random.normal(jax.random.PRNGKey(12), (n,), jnp.float32)
+    q, s = comm.quantize_blocks(x, block)
+    assert q.shape == (512,) and s.shape == (2,)
+    # pad region encodes to zero codes
+    np.testing.assert_array_equal(np.asarray(q[n:]), 0)
+    y = comm.dequantize_blocks(q, s, block, n)
+    assert y.shape == (n,)
+    # per-block error bound: half an ulp of that block's own max
+    for lo, hi in ((0, 256), (256, n)):
+        blk = np.asarray(x[lo:hi])
+        err = np.abs(np.asarray(y[lo:hi]) - blk).max()
+        assert err <= 0.5 * np.abs(blk).max() / 127.0 + 1e-7, (lo, err)
+    # fixed point: re-quantizing the dequantized values is exact
+    q2, s2 = comm.quantize_blocks(y, block)
+    y2 = comm.dequantize_blocks(q2, s2, block, n)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+    # and through the full sync: a tail-carrying tree matches the exact
+    # psum within the usual bound
+    g = {"x": _per_rank_grads(jax.random.PRNGKey(13), (1021 * 3,))}
+    got = _run(lambda t: quantized_all_reduce_gradients(t, min_size=1), g)
+    want = _run(all_reduce_gradients, g)
+    gmax = np.abs(np.asarray(g["x"])).max()
+    assert (
+        np.abs(np.asarray(got["x"][0]) - np.asarray(want["x"][0])).max()
+        <= 2.0 / 127.0 * gmax
+    )
+
+
+def test_all_zero_block_scale_is_one_not_tiny():
+    """Unit pin on the scale rule: max==0 -> scale exactly 1.0 (a
+    subnormal scale risks x/tiny overflow on later encodes of the same
+    grid)."""
+    from apex_tpu.parallel import comm
+
+    q, s = comm.quantize_blocks(jnp.zeros((512,), jnp.float32), 256)
+    np.testing.assert_array_equal(np.asarray(s), 1.0)
+    np.testing.assert_array_equal(np.asarray(q), 0)

@@ -40,94 +40,18 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
-    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4,
-    "u16": 2, "u8": 1, "pred": 1,
-}
-
-_COLLECTIVES = (
-    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
-    "all-to-all",
+# The HLO collective parser now lives with the gradient-sync engine
+# (apex_tpu/parallel/comm.py) so the library's regression tests and this
+# artifact generator read compiled HLO with ONE implementation; `collect`
+# keeps its name/contract here (per-kind {count, bytes}, async pairs
+# counted once at -start with the result element of the start tuple).
+from apex_tpu.parallel.comm import (  # noqa: E402
+    _async_start_result,
+    _shape_bytes,
+    collective_summary as collect,
 )
 
-
-def _shape_bytes(shape: str) -> int:
-    """bytes of an HLO shape string like 'bf16[8,128,1024]' (tuples:
-    sum of elements)."""
-    total = 0
-    for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", shape):
-        if dt not in _DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
-
-
-def _async_start_result(shape: str) -> str:
-    """Result element of an async ``-start`` op's tuple shape
-    ``(operand(s), result(s)[, contexts...])`` — the second TOP-LEVEL
-    element, which for a variadic combined op is itself a tuple whose
-    arrays all count.  Depth tracking covers ALL bracket kinds: shape
-    strings carry commas inside dims (``[8,128]``) and layouts
-    (``{1,0}``), not just nested tuples."""
-    if not shape.startswith("("):
-        return shape
-    parts, depth, cur = [], 0, []
-    for ch in shape[1:-1]:
-        if ch == "," and depth == 0:
-            parts.append("".join(cur))
-            cur = []
-            continue
-        if ch in "([{":
-            depth += 1
-        elif ch in ")]}":
-            depth -= 1
-        cur.append(ch)
-    parts.append("".join(cur))
-    return parts[1] if len(parts) > 1 else parts[0]
-
-
-def collect(hlo_text: str):
-    """Per-kind {count, bytes} for every collective in optimized HLO.
-
-    Bytes = operand bytes of each op (the data a rank contributes); for
-    all-gather the moved volume is (world-1)/world of the OUTPUT, for
-    all-reduce a ring moves ~2x the operand — the analytic model below
-    applies those factors per kind.
-    """
-    out = {}
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        # shape alternative allows one level of tuple nesting: variadic
-        # combined async ops (XLA's collective combiners) print
-        # ((op0, op1), (res0, res1)) — a flat [^)]* would stop at the
-        # first ')' and silently drop the op from the count
-        m = re.match(
-            r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*"
-            r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
-            r"(all-reduce|all-gather|reduce-scatter|"
-            r"collective-permute|all-to-all)(-start|-done)?\(",
-            line)
-        if not m:
-            continue
-        shape, kind, variant = m.group(1), m.group(2), m.group(3)
-        if variant == "-done":
-            # async pairs are counted once, at -start
-            continue
-        if variant == "-start":
-            # -start returns (operand(s), result(s)[, contexts]); keep
-            # only the result element so bytes match the sync form
-            shape = _async_start_result(shape)
-        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
-        rec["count"] += 1
-        rec["bytes"] += _shape_bytes(shape)
-    return out
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 _COMPUTE_OP_RE = re.compile(
