@@ -29,6 +29,9 @@ Subpackages
   checkpoint management (orbax-backed).
 - :mod:`apex_tpu.resilience` — fault injection, guarded steps,
   retry/backoff, and the preemption-safe auto-resume loop.
+- :mod:`apex_tpu.observability` — unified step telemetry: device-side
+  metric registry, MFU/goodput meters, JSONL/CSV/TensorBoard export,
+  and scheduled trace windows.
 """
 
 __version__ = "0.1.0"
@@ -55,6 +58,7 @@ _LAZY_SUBMODULES = (
     "fused_dense",
     "checkpoint",
     "resilience",
+    "observability",
 )
 
 

@@ -65,6 +65,20 @@ class DynamicLossScaler:
             hysteresis=jnp.asarray(self.hysteresis, jnp.int32),
         )
 
+    @staticmethod
+    def metrics(state: LossScaleState) -> dict:
+        """The scaler's device scalars, keyed for a
+        :class:`apex_tpu.observability.MetricRegistry` (gauges; feed to
+        ``registry.update`` inside the jitted step).  The scale and its
+        hysteresis trackers are the earliest public symptom of numeric
+        trouble — a scale walking down means overflows are recurring
+        before any loss divergence is visible."""
+        return {
+            "amp/loss_scale": state.loss_scale,
+            "amp/growth_tracker": state.growth_tracker,
+            "amp/hysteresis": state.hysteresis,
+        }
+
     def scale(self, loss, state: LossScaleState):
         """≙ scale_loss ctx-mgr entry (apex/amp/handle.py :: scale_loss).
 

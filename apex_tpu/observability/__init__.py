@@ -1,0 +1,84 @@
+"""Unified step telemetry — the shared reporting spine of apex_tpu.
+
+One subsystem every layer reports into, so "what is my MFU, step time,
+comm volume, and goodput right now" is a query, not an archaeology
+session over bench logs:
+
+- :mod:`apex_tpu.observability.metrics` —
+  :class:`~apex_tpu.observability.metrics.MetricRegistry`: device-side
+  counters/gauges accumulated INSIDE the jitted step and fetched
+  asynchronously on a cadence (no per-step host sync; <1% step-time
+  overhead, asserted in tests), plus the host-side
+  :data:`~apex_tpu.observability.metrics.board` that
+  ``apex_tpu.parallel.comm`` publishes wire-byte/collective gauges to.
+- :mod:`apex_tpu.observability.meter` —
+  :class:`~apex_tpu.observability.meter.StepMeter` (wall-clock step
+  time, tokens/s, model-FLOPs MFU — the same FLOP/peak model as
+  ``bench.py``) and :class:`~apex_tpu.observability.meter.
+  GoodputAccountant` (productive vs. skipped/rolled-back/replayed
+  steps, fed by ``run_resilient`` observer events).
+- :mod:`apex_tpu.observability.export` — JSONL (bench.py line schema),
+  CSV, and TensorBoard-event sinks behind one
+  :class:`~apex_tpu.observability.export.Reporter` ``report()`` API.
+- :mod:`apex_tpu.observability.trace` — NVTX-style annotation hooks
+  (absorbing ``apex_tpu/utils/profiling.py``) plus
+  :class:`~apex_tpu.observability.trace.TraceScheduler`: "profile
+  steps N..N+K to this dir" via ``APEX_TPU_TRACE_STEPS``, no script
+  edits.
+
+See ``docs/observability.md`` for the full tour.
+"""
+
+from apex_tpu.observability.export import (  # noqa: F401
+    CSVSink,
+    JSONLSink,
+    Reporter,
+    TensorBoardSink,
+    bench_record,
+)
+from apex_tpu.observability.meter import (  # noqa: F401
+    GoodputAccountant,
+    StepMeter,
+    chip_peak_flops,
+    total_peak_flops,
+    transformer_train_flops,
+)
+from apex_tpu.observability.metrics import (  # noqa: F401
+    Board,
+    MetricRegistry,
+    board,
+)
+# NOTE: the trace() context manager is deliberately NOT re-exported
+# here — it would shadow the `apex_tpu.observability.trace` SUBMODULE
+# attribute on the package.  Reach it as `observability.trace.trace`
+# or via the long-standing `apex_tpu.utils.trace` alias.
+from apex_tpu.observability import trace  # noqa: F401
+from apex_tpu.observability.trace import (  # noqa: F401
+    TraceScheduler,
+    annotate,
+    nvtx_range,
+    range_pop,
+    range_push,
+)
+
+__all__ = [
+    "MetricRegistry",
+    "Board",
+    "board",
+    "StepMeter",
+    "GoodputAccountant",
+    "chip_peak_flops",
+    "total_peak_flops",
+    "transformer_train_flops",
+    "Reporter",
+    "JSONLSink",
+    "CSVSink",
+    "TensorBoardSink",
+    "bench_record",
+    "TraceScheduler",
+    "annotate",
+    "nvtx_range",
+    "range_push",
+    "range_pop",
+    "trace",  # the submodule (holding the trace() context manager)
+]

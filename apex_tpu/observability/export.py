@@ -1,0 +1,334 @@
+"""Structured metric export — one ``report()`` API, three sinks.
+
+Every record is the ``bench.py`` metric-line schema::
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+plus a ``"step"`` key on telemetry lines, so a live ``--metrics-out``
+JSONL and the ``BENCH_*.json`` trajectory artifacts are the same
+language — a regression is a diff between two JSONL files, not prose.
+
+Sinks:
+
+- :class:`JSONLSink` — one JSON object per line (the canonical form);
+- :class:`CSVSink` — spreadsheet-friendly, columns fixed by the first
+  record;
+- :class:`TensorBoardSink` — real ``events.out.tfevents.*`` scalar
+  files, written directly (TFRecord framing + masked CRC32C + a
+  hand-encoded ``Event`` proto), because this environment must not grow
+  a tensorboard/tensorflow dependency.  Any TensorBoard install reads
+  the output.
+
+:class:`Reporter` fans one step's values out to every sink, pulling
+from the attached sources (:class:`~apex_tpu.observability.metrics.
+MetricRegistry`, :class:`~apex_tpu.observability.meter.StepMeter`,
+:class:`~apex_tpu.observability.meter.GoodputAccountant`, and the
+module :data:`~apex_tpu.observability.metrics.board`).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import struct
+import time
+from typing import Any, Dict, IO, Iterable, Mapping, Optional, Union
+
+__all__ = [
+    "bench_record",
+    "JSONLSink",
+    "CSVSink",
+    "TensorBoardSink",
+    "Reporter",
+]
+
+
+def bench_record(
+    metric: str,
+    value,
+    unit: str = "",
+    vs_baseline=None,
+    **extra,
+) -> Dict[str, Any]:
+    """A record in the bench.py line schema; ``extra`` keys (``step``,
+    ...) append after the four contract keys."""
+    rec: Dict[str, Any] = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+    }
+    rec.update(extra)
+    return rec
+
+
+class _FileSink:
+    """Shared open/close plumbing: path or open file object."""
+
+    def __init__(self, target: Union[str, os.PathLike, IO], mode: str = "a"):
+        if hasattr(target, "write"):
+            self._f, self._owns = target, False
+        else:
+            path = os.fspath(target)
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._f, self._owns = open(path, mode), True
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JSONLSink(_FileSink):
+    """One JSON object per line, flushed per write (a killed run keeps
+    every completed line — the property resume debugging relies on).
+
+    Opens in APPEND mode deliberately: a preempted job relaunched on
+    the same ``--metrics-out`` path continues its telemetry stream the
+    way its checkpoints continue training (and the ``BENCH_all_*``
+    artifacts accrete lines the same way).  Consumers wanting "this
+    run only" should take the last matching record, as
+    ``tools/verify_tier1.sh`` does.
+
+    Non-finite floats (a NaN grad norm on a skipped step, an untouched
+    min/max seed at ±inf) are written as JSON ``null`` — bare ``NaN``
+    is invalid JSON that jq/JS parsers reject wholesale, and in the
+    bench schema null already means "no measurement"."""
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        clean = {
+            k: (None if isinstance(v, float) and (v != v or v in (
+                float("inf"), float("-inf"))) else v)
+            for k, v in record.items()
+        }
+        self._f.write(json.dumps(clean, allow_nan=False) + "\n")
+        self._f.flush()
+
+
+class CSVSink(_FileSink):
+    """Columns are the FIRST record's keys; later extras are dropped
+    and missing keys left blank (csv needs a stable header).
+
+    Unlike :class:`JSONLSink` this TRUNCATES an existing path: a CSV
+    cannot tolerate a second header row mid-file or a column set fixed
+    by some earlier run's first record."""
+
+    def __init__(self, target):
+        super().__init__(target, mode="w")
+        self._writer: Optional[csv.DictWriter] = None
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        if self._writer is None:
+            self._writer = csv.DictWriter(
+                self._f, fieldnames=list(record), extrasaction="ignore"
+            )
+            self._writer.writeheader()
+        self._writer.writerow(
+            {k: record.get(k, "") for k in self._writer.fieldnames}
+        )
+        self._f.flush()
+
+
+# -- TensorBoard event encoding (no tensorflow/tensorboard dependency) ------
+
+_CRC_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC32C (Castagnoli) — the TFRecord checksum.  Table built once;
+    called only on the report cadence, so pure Python is fine."""
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _pb_bytes(field: int, payload: bytes) -> bytes:
+    return _pb_varint_tag(field, 2) + _pb_varint(len(payload)) + payload
+
+
+def _pb_varint_tag(field: int, wire: int) -> bytes:
+    return _pb_varint(field << 3 | wire)
+
+
+def _pb_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _event_proto(
+    wall_time: float, step: int, scalars: Mapping[str, float] = (),
+    file_version: Optional[str] = None,
+) -> bytes:
+    # Event{1: double wall_time, 2: int64 step, 3: string file_version,
+    #       5: Summary{repeated 1: Value{1: string tag,
+    #                                    2: float simple_value}}}
+    ev = _pb_varint_tag(1, 1) + struct.pack("<d", wall_time)
+    ev += _pb_varint_tag(2, 0) + _pb_varint(step & 0xFFFFFFFFFFFFFFFF)
+    if file_version is not None:
+        ev += _pb_bytes(3, file_version.encode())
+    if scalars:
+        summary = b""
+        for tag, value in scalars.items():
+            val = _pb_bytes(1, tag.encode())
+            val += _pb_varint_tag(2, 5) + struct.pack("<f", float(value))
+            summary += _pb_bytes(1, val)
+        ev += _pb_bytes(5, summary)
+    return ev
+
+
+class TensorBoardSink:
+    """Scalar summaries into ``logdir/events.out.tfevents.<ts>.<pid>``.
+
+    ``write`` takes a bench-schema record: non-numeric values are
+    skipped (TensorBoard scalars are floats), the ``step`` key (default
+    0) becomes the global step, and the metric name becomes the tag.
+    """
+
+    def __init__(self, logdir: Union[str, os.PathLike]):
+        os.makedirs(os.fspath(logdir), exist_ok=True)
+        self.path = os.path.join(
+            os.fspath(logdir),
+            f"events.out.tfevents.{int(time.time())}.{os.getpid()}",
+        )
+        self._f = open(self.path, "ab")
+        self._record(_event_proto(time.time(), 0, file_version="brain.Event:2"))
+
+    def _record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+        self._f.flush()
+
+    def add_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
+        numeric = {
+            k: float(v)
+            for k, v in scalars.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        if numeric:
+            self._record(_event_proto(time.time(), int(step), numeric))
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        value = record.get("value")
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        self.add_scalars(
+            int(record.get("step", 0) or 0), {record["metric"]: value}
+        )
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Reporter:
+    """Fan one step's telemetry out to every sink.
+
+    ``report(step)`` merges, in order (later wins on key collisions):
+    the registry's latest fetched values, the step meter summary, the
+    goodput summary, the board snapshot, then ``extra`` — and writes
+    one bench-schema record per metric to each sink.  Units come from
+    the registry where declared.
+    """
+
+    def __init__(
+        self,
+        sinks: Iterable,
+        *,
+        registry=None,
+        meter=None,
+        goodput=None,
+        include_board: bool = True,
+    ):
+        self.sinks = list(sinks)
+        self.registry = registry
+        self.meter = meter
+        self.goodput = goodput
+        self.include_board = include_board
+
+    _UNITS = {
+        "train/step_time_ms": "ms",
+        "train/tokens_per_sec": "tokens/s",
+        "train/mfu": "MFU",
+        "train/goodput": "fraction (productive/executed)",
+    }
+
+    def collect(self) -> Dict[str, Any]:
+        values: Dict[str, Any] = {}
+        if self.registry is not None:
+            values.update(self.registry.values())
+        if self.meter is not None:
+            values.update(self.meter.summary())
+        if self.goodput is not None:
+            values.update(self.goodput.summary())
+        if self.include_board:
+            from apex_tpu.observability.metrics import board
+
+            values.update(board.snapshot())
+        return values
+
+    def report(
+        self, step: int, extra: Optional[Mapping[str, Any]] = None
+    ) -> Dict[str, Any]:
+        values = self.collect()
+        if extra:
+            values.update(extra)
+        for name, value in values.items():
+            unit = self._UNITS.get(name, "")
+            if not unit and self.registry is not None:
+                unit = self.registry.unit(name)
+            rec = bench_record(name, value, unit, None, step=int(step))
+            for sink in self.sinks:
+                sink.write(rec)
+        return values
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,243 @@
+"""Step meters: wall-clock step time, tokens/s, MFU, and goodput.
+
+:class:`StepMeter` answers "how fast is this run right now" from the
+host side — mark each completed step with :meth:`StepMeter.tick` and
+read step time (median over a sliding window, robust to the dispatch
+hiccups a remote TPU tunnel injects), tokens/s, and model-FLOPs
+utilization.  The FLOP/peak model is the SAME one ``bench.py`` /
+``tools/mfu_sweep.py`` use for the headline (per-chip dense bf16 peak
+by device kind; 6·N·T for transformer training), moved here so live
+telemetry and the benchmark artifacts can never disagree on the
+denominator.
+
+:class:`GoodputAccountant` answers "how much of that speed is real
+progress".  It is fed by :func:`apex_tpu.resilience.run_resilient`'s
+``observer`` events (accepted/skipped steps, rollbacks with their
+discarded work, checkpoint retries, resume replay) and reduces them to
+one number::
+
+    goodput = (accepted - discarded_by_rollback) / executed_steps
+
+which is exactly the "productive steps / all steps paid for" ratio a
+capacity dashboard wants.  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "PEAK_BF16_FLOPS",
+    "chip_peak_flops",
+    "total_peak_flops",
+    "transformer_train_flops",
+    "StepMeter",
+    "GoodputAccountant",
+]
+
+#: Per-chip dense bf16 peak FLOP/s by device kind (public specs) — the
+#: single source bench.py's MFU headline and live telemetry share.
+PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e (Trillium)
+}
+
+#: Unknown device kinds (CPU, new chips) fall back conservatively.
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+def chip_peak_flops(device) -> float:
+    """Dense bf16 peak FLOP/s of one device (conservative default for
+    unknown kinds — an MFU from it is a floor, not a lie)."""
+    kind = getattr(device, "device_kind", "")
+    for key, val in PEAK_BF16_FLOPS.items():
+        if kind.startswith(key):
+            return val
+    return DEFAULT_PEAK_FLOPS
+
+
+def total_peak_flops(devices=None) -> float:
+    """Summed peak over ``devices`` (default: all visible devices)."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    return sum(chip_peak_flops(d) for d in devices)
+
+
+def transformer_train_flops(n_params: int, tokens: int) -> float:
+    """The 6·N·T training-FLOPs model (BASELINE.md's MFU contract)."""
+    return 6.0 * float(n_params) * float(tokens)
+
+
+class StepMeter:
+    """Wall-clock step meter: tick once per completed step.
+
+    The first :meth:`tick` only arms the clock (it closes no interval);
+    step time is the median of the last ``window`` intervals, so a
+    single stalled dispatch does not poison the rate.  ``peak_flops``
+    defaults lazily to the visible devices' summed peak — pass it
+    explicitly when metering a sub-mesh.
+    """
+
+    def __init__(
+        self,
+        *,
+        tokens_per_step: float = 0.0,
+        flops_per_step: float = 0.0,
+        peak_flops: Optional[float] = None,
+        window: int = 32,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        self.tokens_per_step = float(tokens_per_step)
+        self.flops_per_step = float(flops_per_step)
+        self._peak_flops = peak_flops
+        self._window = window
+        self._clock = clock
+        self._last: Optional[float] = None
+        self._times: list = []
+        self.steps = 0  # completed (timed) intervals
+
+    @property
+    def peak_flops(self) -> float:
+        if self._peak_flops is None:
+            self._peak_flops = total_peak_flops()
+        return self._peak_flops
+
+    def tick(self) -> Optional[float]:
+        """Mark a step boundary; returns the closed interval in seconds
+        (None on the arming call)."""
+        now = self._clock()
+        if self._last is None:
+            self._last = now
+            return None
+        dt = now - self._last
+        self._last = now
+        self._times.append(dt)
+        if len(self._times) > self._window:
+            self._times.pop(0)
+        self.steps += 1
+        return dt
+
+    @property
+    def step_time(self) -> float:
+        """Median step seconds over the window (0.0 before any tick)."""
+        if not self._times:
+            return 0.0
+        s = sorted(self._times)
+        return s[len(s) // 2]
+
+    @property
+    def tokens_per_sec(self) -> float:
+        t = self.step_time
+        return self.tokens_per_step / t if t > 0 else 0.0
+
+    @property
+    def mfu(self) -> float:
+        t = self.step_time
+        if t <= 0 or self.flops_per_step <= 0:
+            return 0.0
+        return self.flops_per_step / (t * self.peak_flops)
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "train/step": float(self.steps),
+            "train/step_time_ms": self.step_time * 1e3,
+            "train/tokens_per_sec": self.tokens_per_sec,
+            "train/mfu": self.mfu,
+        }
+
+
+class GoodputAccountant:
+    """Productive-work ledger over ``run_resilient`` observer events.
+
+    Implements the observer protocol (every method optional on other
+    observers): ``on_step`` / ``on_rollback`` / ``on_retry`` /
+    ``on_resume`` / ``on_preempt``.  Counting rules:
+
+    - an accepted step is *provisionally* productive;
+    - a skipped step is executed-but-wasted;
+    - a rollback discards the accepted-but-unsaved steps behind it —
+      ``run_resilient`` passes the exact count (it tracks accepted
+      steps against actual save results); when an older caller omits
+      it, the fallback ``(step - anchor) - skips`` over-charges spans
+      containing skip streaks broken by accepted steps, never
+      under-charges;
+    - a resume after restart only bumps ``resumes`` — work before the
+      restart was paid for by a previous process, so charging it here
+      would double-count across the job's lifetime.
+    """
+
+    def __init__(self):
+        self.accepted = 0
+        self.skipped = 0
+        self.discarded = 0  # accepted steps a rollback threw away
+        self.rollbacks = 0
+        self.retries = 0
+        self.resumes = 0
+        self.preempted = False
+
+    # -- observer protocol -------------------------------------------------
+    def on_step(self, step: int, skipped: bool, info=None) -> None:
+        if skipped:
+            self.skipped += 1
+        else:
+            self.accepted += 1
+
+    def on_rollback(
+        self,
+        step: int,
+        anchor: int,
+        skips: int = 0,
+        discarded: Optional[int] = None,
+    ) -> None:
+        self.rollbacks += 1
+        if discarded is None:
+            # legacy fallback: the replay span minus the final skip
+            # streak (an upper bound when the span holds earlier,
+            # broken skip streaks)
+            discarded = max(0, (step - anchor) - skips)
+        self.discarded += discarded
+
+    def on_retry(self, what: str = "", attempt: int = 0, error=None) -> None:
+        self.retries += 1
+
+    def on_resume(self, step: int) -> None:
+        self.resumes += 1
+
+    def on_preempt(self, step: int) -> None:
+        self.preempted = True
+
+    # -- ledger ------------------------------------------------------------
+    @property
+    def executed(self) -> int:
+        return self.accepted + self.skipped
+
+    @property
+    def productive(self) -> int:
+        return max(0, self.accepted - self.discarded)
+
+    def goodput(self) -> float:
+        """Productive fraction of executed steps (1.0 before any work —
+        an idle job has wasted nothing yet)."""
+        if self.executed == 0:
+            return 1.0
+        return self.productive / self.executed
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "train/goodput": self.goodput(),
+            "train/steps_accepted": float(self.accepted),
+            "train/steps_skipped": float(self.skipped),
+            "train/steps_discarded": float(self.discarded),
+            "train/rollbacks": float(self.rollbacks),
+            "train/retries": float(self.retries),
+            "train/resumes": float(self.resumes),
+        }

@@ -38,6 +38,14 @@ sync, ~1/4 the bytes" is a regression test (``tests/test_comm.py``),
 not a docstring.  ``tools/comm_structure.py`` builds its artifact on
 the same parser.
 
+**Telemetry**.  Every sync publishes its plan — wire format, payload
+bytes, collective count, chunk count — as gauges on the observability
+board (``apex_tpu.observability.metrics.board``) at trace time, and
+:func:`publish_collective_summary` pushes a parsed-HLO summary the same
+way, so a live ``--metrics-out`` JSONL carries continuously measured
+wire traffic next to MFU/goodput instead of a one-time HLO assertion
+(``docs/observability.md``).
+
 See ``docs/comm.md`` for the full model, tuning guidance, and when NOT
 to quantize.
 """
@@ -70,6 +78,7 @@ __all__ = [
     "collective_summary",
     "compiled_collectives",
     "ring_wire_bytes",
+    "publish_collective_summary",
 ]
 
 WIRE_FORMATS = ("f32", "bf16", "int8")
@@ -141,6 +150,22 @@ def _chunk_bounds(n: int, k: int, align: int = 1):
             bounds.append((prev, edge))
         prev = max(prev, edge)
     return bounds
+
+
+def _publish_stats(prefix: str, **stats) -> None:
+    """Gauge the plan of a sync onto the observability board.
+
+    Host-side and trace-time only (the values are static per compiled
+    program): retracing republishes, steady-state steps never touch it.
+    Import is deferred so the comm engine stays importable even if the
+    observability package is stripped from a deployment.
+    """
+    try:
+        from apex_tpu.observability.metrics import board
+    except ImportError:  # pragma: no cover - partial install
+        return
+    for key, value in stats.items():
+        board.set(f"{prefix}/{key}", value)
 
 
 # ---------------------------------------------------------------------------
@@ -263,9 +288,15 @@ def reduce_scatter_flat(
         shard,
     )
     rows = flat.reshape(world, shard).astype(jnp.float32)
+    bounds = _chunk_bounds(shard, k, 1 if wire == "f32" else block)
+    _publish_stats(
+        "comm/rs", wire=wire, world=world, elements=n,
+        chunks=len(bounds), collectives=len(bounds),
+        wire_bytes=int(n * wire_bytes_per_element(wire, block)),
+    )
     outs = []
     with jax.named_scope(f"comm_rs_{wire}"):
-        for lo, hi in _chunk_bounds(shard, k, 1 if wire == "f32" else block):
+        for lo, hi in bounds:
             seg = rows[:, lo:hi]  # row j = rank j's slice of this chunk
             if wire == "f32":
                 outs.append(
@@ -312,9 +343,15 @@ def all_gather_flat(
         s,
     )
     shard = shard.astype(jnp.float32)
+    bounds = _chunk_bounds(s, k, 1 if wire == "f32" else block)
+    _publish_stats(
+        "comm/ag", wire=wire, world=world, elements=world * s,
+        chunks=len(bounds), collectives=len(bounds),
+        wire_bytes=int(world * s * wire_bytes_per_element(wire, block)),
+    )
     parts = []
     with jax.named_scope(f"comm_ag_{wire}"):
-        for lo, hi in _chunk_bounds(s, k, 1 if wire == "f32" else block):
+        for lo, hi in bounds:
             g = jax.lax.all_gather(
                 _encode(shard[lo:hi], wire, block), axis_name,
                 axis=0, tiled=False,
@@ -385,6 +422,20 @@ def sync_gradients(
         resolved = resolve_chunks(nbytes, chunks)
     bucketed = bool(big) and (
         wire != "f32" or (chunks_requested(chunks) and resolved > 1)
+    )
+    big_set = set(big) if bucketed else set()
+    bucket_elems = sum(leaves[i].size for i in big_set)
+    psum_bytes = sum(
+        leaves[i].size * 4 for i in range(len(leaves)) if i not in big_set
+    )
+    _publish_stats(
+        "comm/sync", wire=wire, world=world,
+        bucket_elements=int(bucket_elems),
+        chunks=int(resolved or 1),
+        psum_leaves=len(leaves) - len(big_set),
+        wire_bytes=int(
+            bucket_elems * wire_bytes_per_element(wire, block) + psum_bytes
+        ),
     )
     synced_by_idx = {}
     out = []
@@ -523,6 +574,27 @@ def compiled_collectives(fn, *args, **kwargs) -> dict:
     ``.lower`` (i.e. be ``jax.jit``-wrapped)."""
     hlo = fn.lower(*args, **kwargs).compile().as_text()
     return collective_summary(hlo)
+
+
+def publish_collective_summary(
+    summary: dict, world: Optional[int] = None, prefix: str = "comm/hlo"
+) -> None:
+    """Gauge a :func:`collective_summary` onto the observability board.
+
+    Per-kind ``{prefix}/<kind>_count`` / ``{prefix}/<kind>_bytes``
+    gauges plus — when ``world`` is given — the ring-model
+    ``{prefix}/ring_wire_bytes``, so a compiled program's MEASURED
+    collective structure rides the same telemetry stream as the
+    trace-time plan (``docs/observability.md``).
+    """
+    stats = {}
+    for kind, rec in summary.items():
+        key = kind.replace("-", "_")
+        stats[f"{key}_count"] = rec["count"]
+        stats[f"{key}_bytes"] = rec["bytes"]
+    if world is not None:
+        stats["ring_wire_bytes"] = ring_wire_bytes(summary, world)
+    _publish_stats(prefix, **stats)
 
 
 def ring_wire_bytes(summary: dict, world: int) -> float:

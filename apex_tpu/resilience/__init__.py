@@ -24,10 +24,13 @@ from apex_tpu.resilience.guards import (  # noqa: F401
     GradGuard,
     GuardState,
     GuardVerdict,
+    guard_metrics,
     guarded_amp_update,
 )
 from apex_tpu.resilience.retry import (  # noqa: F401
     RetryPolicy,
+    add_retry_listener,
+    remove_retry_listener,
     retry_call,
     robust_initialize_distributed,
 )
@@ -43,8 +46,11 @@ __all__ = [
     "GradGuard",
     "GuardState",
     "GuardVerdict",
+    "guard_metrics",
     "guarded_amp_update",
     "RetryPolicy",
+    "add_retry_listener",
+    "remove_retry_listener",
     "retry_call",
     "robust_initialize_distributed",
     "PreemptionHandler",

@@ -39,6 +39,7 @@ __all__ = [
     "GuardState",
     "GuardVerdict",
     "guarded_amp_update",
+    "guard_metrics",
 ]
 
 
@@ -95,6 +96,22 @@ class GradGuard:
     def budget_exhausted(self, state: GuardState) -> jax.Array:
         """True once the consecutive-skip budget is spent (rollback cue)."""
         return state.consecutive_skips >= self.max_consecutive_skips
+
+
+def guard_metrics(verdict: GuardVerdict, state: GuardState) -> dict:
+    """The guard's device scalars, keyed for a
+    :class:`apex_tpu.observability.MetricRegistry` (declare
+    ``guard/skipped`` as a counter and the rest as gauges; feed the
+    result to ``registry.update`` INSIDE the jitted step)."""
+    return {
+        "guard/skipped": verdict.skipped,
+        "guard/found_inf": verdict.found_inf,
+        "guard/spike": verdict.spike,
+        "guard/grad_norm": verdict.grad_norm,
+        "guard/norm_ema": state.norm_ema,
+        "guard/consecutive_skips": state.consecutive_skips,
+        "guard/total_skips": state.total_skips,
+    }
 
 
 def guarded_amp_update(

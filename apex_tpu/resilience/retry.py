@@ -23,7 +23,30 @@ from typing import Callable, Optional, Sequence, Tuple, Type
 
 from apex_tpu.resilience import chaos
 
-__all__ = ["RetryPolicy", "retry_call", "robust_initialize_distributed"]
+__all__ = [
+    "RetryPolicy",
+    "retry_call",
+    "robust_initialize_distributed",
+    "add_retry_listener",
+    "remove_retry_listener",
+]
+
+# Observability bridge: each about-to-be-retried failure is announced to
+# the registered listeners as ``fn(what, attempt, error)`` (attempt is
+# 0-based).  run_resilient registers its observer's ``on_retry`` here
+# for the duration of a run, so retry churn lands in the goodput ledger
+# (apex_tpu.observability.GoodputAccountant) without threading a
+# callback through every call site.
+_LISTENERS: list = []
+
+
+def add_retry_listener(fn: Callable) -> None:
+    _LISTENERS.append(fn)
+
+
+def remove_retry_listener(fn: Callable) -> None:
+    if fn in _LISTENERS:
+        _LISTENERS.remove(fn)
 
 
 class RetryPolicy:
@@ -77,6 +100,8 @@ def retry_call(
             last = e
             if attempt + 1 >= policy.max_attempts:
                 break
+            for listener in list(_LISTENERS):
+                listener(what, attempt, e)
             pause = policy.delay(attempt)
             warnings.warn(
                 f"{what} failed (attempt {attempt + 1}/"

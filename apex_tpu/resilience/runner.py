@@ -24,6 +24,19 @@ loop a production job actually runs:
 ``step_fn(state, batch) -> (state, info)`` with ``info`` anything that has
 a ``skipped`` entry/attribute (or None).  ``batch_fn(step) -> batch`` is
 indexed by step so replay after rollback/resume feeds the same data.
+
+The loop narrates itself to an optional ``observer`` (duck-typed; every
+method optional): ``on_step(step, skipped, info)`` per executed step,
+``on_rollback(step, anchor, skips, discarded)``, ``on_resume(step)``,
+``on_preempt(step)``, and ``on_retry(what, attempt, error)`` for
+checkpoint-I/O retries (bridged from
+:mod:`apex_tpu.resilience.retry` for the duration of the run).
+``discarded`` is the EXACT count of accepted-but-unsaved steps the
+rollback threw away — the runner tracks them against actual save
+results, so interleaved skip/accept streaks inside the replay span are
+priced correctly.  :class:`apex_tpu.observability.GoodputAccountant`
+implements the whole protocol and turns the stream into a goodput
+number.
 """
 
 from __future__ import annotations
@@ -35,6 +48,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 from apex_tpu.checkpoint import CheckpointManager
 from apex_tpu.resilience import chaos
+from apex_tpu.resilience import retry as _retry
 from apex_tpu.resilience.retry import RetryPolicy, retry_call
 
 __all__ = [
@@ -176,6 +190,17 @@ class RunResult(NamedTuple):
     preempted: bool  # stopped early on SIGTERM
 
 
+def _notify(observer, event: str, *args) -> None:
+    """Invoke ``observer.<event>(*args)`` if present.  Observer errors
+    propagate — a telemetry bug must not silently corrupt the ledger it
+    exists to keep honest."""
+    if observer is None:
+        return
+    fn = getattr(observer, event, None)
+    if fn is not None:
+        fn(*args)
+
+
 def _skipped(info) -> bool:
     if info is None:
         return False
@@ -200,6 +225,7 @@ def run_resilient(
     max_rollbacks: int = 3,
     policy: Optional[RetryPolicy] = None,
     signals=(signal.SIGTERM,),
+    observer: Any = None,
 ) -> RunResult:
     """Drive ``step_fn`` for ``num_steps`` with auto-resume, preemption
     handling, checkpoint retries, and skip-budget rollback.
@@ -215,10 +241,41 @@ def run_resilient(
     would replay-and-skip forever; after ``max_rollbacks`` rollbacks the
     loop raises instead of livelocking.
     """
+    on_retry = getattr(observer, "on_retry", None)
+    if on_retry is not None:
+        _retry.add_retry_listener(on_retry)
+    try:
+        return _run_resilient_inner(
+            step_fn, init_state, batch_fn, directory=directory,
+            num_steps=num_steps, save_interval_steps=save_interval_steps,
+            max_to_keep=max_to_keep, rollback_after=rollback_after,
+            max_rollbacks=max_rollbacks, policy=policy, signals=signals,
+            observer=observer,
+        )
+    finally:
+        if on_retry is not None:
+            _retry.remove_retry_listener(on_retry)
+
+
+def _run_resilient_inner(
+    step_fn, init_state, batch_fn, *, directory, num_steps,
+    save_interval_steps, max_to_keep, rollback_after, max_rollbacks,
+    policy, signals, observer,
+) -> RunResult:
     state = init_state
     resumed_from = None
     steps_run = skipped_steps = rollbacks = 0
     consecutive_skips = 0
+    # Accepted steps that a rollback might discard, reconciled against
+    # the ACTUAL anchor at rollback time (not at save time: orbax saves
+    # are async, so save() returning True only means enqueued).  On a
+    # successful save we retain one full prior interval — exactly the
+    # "a failed background write falls back one interval" failure mode
+    # the ResilientCheckpointManager scope note documents — so the
+    # discarded count stays exact through a lost background save;
+    # memory stays bounded at ~two save intervals.
+    unsaved_accepted = []
+    prev_save_step = -1
 
     with ResilientCheckpointManager(
         directory,
@@ -230,6 +287,7 @@ def run_resilient(
         if latest is not None:
             state = mgr.restore(latest, template=state)
             resumed_from = latest
+            _notify(observer, "on_resume", latest)
         start = (latest + 1) if latest is not None else 0
         completed = start - 1
 
@@ -243,7 +301,9 @@ def run_resilient(
             # chaos spec (preemption@N fires again in the new process)
             # always makes at least one step of progress.
             chaos.maybe_preempt(step)
-            if _skipped(info):
+            was_skipped = _skipped(info)
+            _notify(observer, "on_step", step, was_skipped, info)
+            if was_skipped:
                 # A skipped step is never checkpointed: its state is by
                 # contract unchanged, and recording it would drag the
                 # rollback anchor into the middle of the skip streak —
@@ -264,7 +324,20 @@ def run_resilient(
                     mgr.wait_until_finished()
                     anchor = mgr.latest_step()
                     rollbacks += 1
+                    streak = consecutive_skips
                     consecutive_skips = 0
+                    anchor_val = anchor if anchor is not None else -1
+                    discarded = sum(
+                        1 for s in unsaved_accepted if s > anchor_val
+                    )
+                    # > anchor: discarded; <= anchor: proven durable —
+                    # either way no longer at risk
+                    unsaved_accepted = []
+                    prev_save_step = anchor_val
+                    _notify(
+                        observer, "on_rollback", step, anchor_val,
+                        streak, discarded,
+                    )
                     if anchor is not None:
                         state = mgr.restore(anchor, template=init_state)
                         completed = anchor
@@ -278,9 +351,19 @@ def run_resilient(
             else:
                 consecutive_skips = 0
                 completed = step
-                mgr.save(step, state)
+                saved = mgr.save(step, state)
+                unsaved_accepted.append(step)
+                if saved:
+                    # steps at or before the PREVIOUS save are durable
+                    # even if this enqueued save later fails on write
+                    unsaved_accepted = [
+                        s for s in unsaved_accepted if s > prev_save_step
+                    ]
+                    prev_save_step = step
             step += 1
 
+        if preempt.requested:
+            _notify(observer, "on_preempt", completed)
         if preempt.requested and completed >= 0:
             # Final checkpoint so a relaunch resumes within one step.  The
             # step may already be on disk when save_interval_steps == 1.

@@ -1,6 +1,11 @@
-"""Shared utilities (profiling/tracing hooks)."""
+"""Shared utilities (profiling/tracing hooks).
 
-from apex_tpu.utils.profiling import (
+The hooks now live in :mod:`apex_tpu.observability.trace`; this package
+keeps re-exporting them (``apex_tpu.utils.trace`` is used throughout
+bench.py and the tools) so callers need not care where they moved.
+"""
+
+from apex_tpu.observability.trace import (
     annotate,
     nvtx_range,
     range_pop,

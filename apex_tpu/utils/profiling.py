@@ -1,72 +1,19 @@
-"""Tracing / profiling hooks — the TPU analog of the reference's NVTX ranges.
+"""DEPRECATED shim — the tracing hooks moved to
+:mod:`apex_tpu.observability.trace` (where scheduled profiling windows,
+the step-telemetry registry, and the metric sinks now live together;
+see ``docs/observability.md``).
 
-The reference brackets its hot regions with ``torch.cuda.nvtx.range_push`` /
-``range_pop`` (e.g. ``apex/parallel/distributed.py``'s allreduce regions) so
-kernels group under named spans in Nsight.  The XLA equivalent is two-level:
-
-- :func:`annotate` (``jax.named_scope``) names a region of the *traced*
-  computation — the name lands in HLO metadata and therefore in the XLA
-  op-profile / Perfetto trace for every kernel fused from that region.
-- :func:`nvtx_range` / :func:`range_push` / :func:`range_pop` name a span on
-  the *host* timeline (``jax.profiler.TraceAnnotation``), for dispatch-side
-  bracketing exactly like NVTX.
-- :func:`trace` wraps a block in ``jax.profiler.trace`` and writes a
-  TensorBoard/Perfetto-viewable profile directory (bench.py --trace).
-
-All hooks are zero-cost when no profiler is attached: ``named_scope`` only
-adds HLO metadata at trace time and ``TraceAnnotation`` is a no-op without an
-active collector — matching the survey's "build them in, they're free" rule.
+This module re-exports the original five names so existing imports keep
+working; new code should import from ``apex_tpu.observability`` (or its
+``trace`` submodule) directly.
 """
 
-from __future__ import annotations
-
-import contextlib
-from typing import Iterator, List
-
-import jax
+from apex_tpu.observability.trace import (  # noqa: F401
+    annotate,
+    nvtx_range,
+    range_pop,
+    range_push,
+    trace,
+)
 
 __all__ = ["annotate", "nvtx_range", "range_push", "range_pop", "trace"]
-
-# module-level stack for the push/pop API (host-side spans, NVTX-style)
-_RANGE_STACK: List[contextlib.AbstractContextManager] = []
-
-
-def annotate(name: str):
-    """Name a traced-computation region (``jax.named_scope``).
-
-    Use inside jitted code; the name propagates into HLO metadata so the
-    XLA profiler attributes fused kernels to it.
-    """
-    return jax.named_scope(name)
-
-
-@contextlib.contextmanager
-def nvtx_range(name: str) -> Iterator[None]:
-    """Host-timeline span (≙ ``torch.cuda.nvtx.range`` context manager)."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-def range_push(name: str) -> None:
-    """≙ ``torch.cuda.nvtx.range_push`` — begin a host-timeline span."""
-    cm = jax.profiler.TraceAnnotation(name)
-    cm.__enter__()
-    _RANGE_STACK.append(cm)
-
-
-def range_pop() -> None:
-    """≙ ``torch.cuda.nvtx.range_pop`` — end the innermost span."""
-    if not _RANGE_STACK:
-        raise RuntimeError("range_pop() without matching range_push()")
-    _RANGE_STACK.pop().__exit__(None, None, None)
-
-
-@contextlib.contextmanager
-def trace(log_dir: str) -> Iterator[None]:
-    """Collect a device+host profile into ``log_dir`` (TensorBoard /
-    Perfetto viewable).  Wrap a steady-state window, not compilation."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()

@@ -138,23 +138,18 @@ _WATCHDOG_S = float(os.environ.get("APEX_TPU_BENCH_WATCHDOG_S", "900"))
 # main() fail-fast guard and bench_bert_lamb's default config.
 _BENCH_POLICY = os.environ.get("APEX_TPU_BENCH_POLICY", "dots")
 
-# per-chip dense bf16 peak FLOP/s by device kind (public specs)
-_PEAK = {
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,  # v6e (Trillium)
-}
+# Per-chip dense bf16 peak FLOP/s — ONE model shared with live
+# telemetry (apex_tpu.observability.meter), so bench artifacts and a
+# run's --metrics-out JSONL can never disagree on the MFU denominator.
+from apex_tpu.observability.meter import (  # noqa: E402
+    chip_peak_flops as _chip_peak,
+    transformer_train_flops as _train_flops,
+)
 
-
-def _chip_peak(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for key, val in _PEAK.items():
-        if kind.startswith(key):
-            return val
-    return 197e12  # conservative default
+# Optional JSONL sink mirroring every _emit line (--metrics-out): the
+# stdout contract for the driver stays byte-identical, the file gets
+# the same records for trajectory diffing.
+_METRICS_SINK = None
 
 
 def _emit(metric, value, unit, vs_baseline, degenerate=False):
@@ -170,6 +165,8 @@ def _emit(metric, value, unit, vs_baseline, degenerate=False):
     if degenerate:
         rec["degenerate"] = True
     print(json.dumps(rec), flush=True)
+    if _METRICS_SINK is not None:
+        _METRICS_SINK.write(rec)
 
 
 def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
@@ -296,19 +293,24 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
         )
         return (params, opt_state), losses[-1]
 
+    timed_fn = train_chunk
     hlo_out = os.environ.get("APEX_TPU_BENCH_HLO_OUT")
     if hlo_out:
         # Compiled-HLO text of the headline step, for the trace↔source
         # join (tools/trace_summary.py TRACE --hlo FILE — the docs/mfu.md
-        # lever-#2 copies attribution).  AOT lower+compile shares the
-        # compile cache with the timed call below and does not execute,
-        # so the donated buffers stay live.
+        # lever-#2 copies attribution).  AOT lower().compile() does NOT
+        # land in the jit dispatch cache (ADVICE r5), so dispatching
+        # train_chunk afterwards would pay a SECOND full compile inside
+        # a scarce tunnel window — time the compiled executable itself
+        # instead (same program, donation semantics preserved).
+        compiled = train_chunk.lower(params, opt_state).compile()
         with open(hlo_out, "w") as f:
-            f.write(train_chunk.lower(params, opt_state).compile().as_text())
+            f.write(compiled.as_text())
+        timed_fn = compiled
 
     profile = apex_tpu.utils.trace(trace_dir) if trace_dir else None
     step_time, carry, loss = _time_chunks(
-        train_chunk, (params, opt_state), chunk, trials, profile=profile
+        timed_fn, (params, opt_state), chunk, trials, profile=profile
     )
     del carry
 
@@ -317,7 +319,7 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
     # same accounting the reference recipe's A100 numbers use, and that
     # recipe also gathers masked positions (max_predictions_per_seq), so
     # packed-head step times are the apples-to-apples comparison.
-    flops = 6.0 * n_params * tokens
+    flops = _train_flops(n_params, tokens)
     peak = sum(_chip_peak(d) for d in jax.devices())
     mfu = flops / (step_time * peak)
     # Honesty sidecar: the packed head EXECUTES fewer decoder FLOPs than
@@ -910,7 +912,23 @@ if __name__ == "__main__":
         "FILE (bert_lamb config; feeds tools/trace_summary.py --hlo). "
         "Equivalent to APEX_TPU_BENCH_HLO_OUT, the programmatic channel.",
     )
+    ap.add_argument(
+        "--metrics-out",
+        metavar="FILE",
+        default=None,
+        help="also append every emitted metric line to FILE as JSONL "
+        "(the observability sink schema, docs/observability.md) — "
+        "stdout output is unchanged",
+    )
     args = ap.parse_args()
     if args.hlo_out:
         os.environ["APEX_TPU_BENCH_HLO_OUT"] = args.hlo_out
-    main(config=args.config, trace_dir=args.trace)
+    if args.metrics_out:
+        from apex_tpu.observability.export import JSONLSink
+
+        _METRICS_SINK = JSONLSink(args.metrics_out)
+    try:
+        main(config=args.config, trace_dir=args.trace)
+    finally:
+        if _METRICS_SINK is not None:
+            _METRICS_SINK.close()

@@ -21,6 +21,17 @@ through a ``shard_map`` over the dp mesh, so the same script spans
 try a 4-way mesh on CPU)::
 
     python train_resilient.py --steps 100 --accum 4 --wire int8
+
+``--metrics-out out.jsonl`` turns on the full observability pipe
+(``docs/observability.md``): device metrics (loss, grad norm, scaler
+scale, skip counts) accumulate INSIDE the jitted update and are fetched
+on a cadence, a ``StepMeter`` adds wall-clock step time / tokens/s /
+MFU, a ``GoodputAccountant`` rides the ``run_resilient`` observer
+events, and everything lands in the bench-schema JSONL.  The final
+``train/goodput`` line carries the exact skip/rollback/retry counts of
+the run, so a chaos drill is checkable from the artifact alone.
+``APEX_TPU_TRACE_STEPS="N+K"`` arms a profile window of steps N..N+K-1
+with no further flags.
 """
 
 import argparse
@@ -37,10 +48,17 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import amp
+from apex_tpu import observability as obs
 from apex_tpu import parallel_state as ps
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.parallel import DistributedDataParallel
-from apex_tpu.resilience import GradGuard, chaos, guarded_amp_update, run_resilient
+from apex_tpu.resilience import (
+    GradGuard,
+    chaos,
+    guard_metrics,
+    guarded_amp_update,
+    run_resilient,
+)
 
 
 def main():
@@ -55,6 +73,13 @@ def main():
                     choices=["f32", "bf16", "int8"],
                     help="wire format of the boundary gradient sync "
                     "(docs/comm.md; tiny leaves stay on the exact psum)")
+    ap.add_argument("--metrics-out", default=None,
+                    help="JSONL telemetry path — turns on the full "
+                    "observability pipe (docs/observability.md)")
+    ap.add_argument("--fetch-every", type=int, default=8,
+                    help="device->host metric fetch cadence in steps")
+    ap.add_argument("--report-every", type=int, default=10,
+                    help="steps between JSONL telemetry reports")
     args = ap.parse_args()
 
     mesh = ps.initialize_model_parallel()  # all devices -> dp axis
@@ -84,6 +109,39 @@ def main():
         "scaler": scaler.init(),
         "guard": guard.init(),
     }
+
+    # -- observability ------------------------------------------------------
+    # The registry (and its slot in the checkpointed state) exists
+    # UNCONDITIONALLY so the checkpoint tree structure never depends on
+    # the --metrics-out flag: a run interrupted without telemetry can
+    # resume with it (and vice versa) on the same --dir.  Only the
+    # reporting side — meter, goodput ledger, sinks — is gated.
+    registry = obs.MetricRegistry(fetch_every=args.fetch_every)
+    registry.gauge("train/loss", unit="mse")
+    registry.counter("guard/skipped")
+    for name in ("guard/found_inf", "guard/spike", "guard/grad_norm",
+                 "guard/norm_ema", "guard/consecutive_skips",
+                 "guard/total_skips", "amp/loss_scale",
+                 "amp/growth_tracker", "amp/hysteresis"):
+        registry.gauge(name)
+    # the metric state CHECKPOINTS with the model: a rollback that
+    # replays steps also rewinds the counters, so guard/skipped in the
+    # JSONL can never drift from guard/total_skips in state
+    state["metrics"] = registry.init()
+
+    meter = goodput = reporter = None
+    if args.metrics_out:
+        n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+        meter = obs.StepMeter(
+            tokens_per_step=rows,
+            flops_per_step=obs.transformer_train_flops(n_params, rows),
+        )
+        goodput = obs.GoodputAccountant()
+        reporter = obs.Reporter(
+            [obs.JSONLSink(args.metrics_out)],
+            registry=registry, meter=meter, goodput=goodput,
+        )
+    tracer = obs.TraceScheduler()  # armed by APEX_TPU_TRACE_STEPS, else no-op
 
     ddp = DistributedDataParallel(
         lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
@@ -122,30 +180,77 @@ def main():
             y_all[lo: lo + rows].reshape(*shape, 4),
         )
 
-    def step_fn(state, batch):
-        loss, scaled = compute_grads(state["params"], state["scaler"], batch)
-        # chaos GRADS site: poisons the tree on scheduled steps, no-op else
-        scaled = chaos.corrupt_tree(scaled, int(state["guard"].step))
+    @jax.jit
+    def apply_update(scaled, state, loss):
         p, o, s, g, verdict = guarded_amp_update(
             tx, scaler, guard, scaled, state["opt"], state["params"],
             state["scaler"], state["guard"],
         )
         new_state = {"params": p, "opt": o, "scaler": s, "guard": g}
+        # device-side metric fold, INSIDE the jitted update: no host
+        # sync — the registry fetches on its own cadence
+        new_state["metrics"] = registry.update(state["metrics"], {
+            "train/loss": loss,
+            **guard_metrics(verdict, g),
+            **amp.DynamicLossScaler.metrics(s),
+        })
+        return new_state, verdict
+
+    def step_fn(state, batch):
+        step = int(state["guard"].step)
+        tracer.on_step(step)
+        loss, scaled = compute_grads(state["params"], state["scaler"], batch)
+        # chaos GRADS site: poisons the tree on scheduled steps, no-op else
+        scaled = chaos.corrupt_tree(scaled, step)
+        new_state, verdict = apply_update(scaled, state, loss)
+        if reporter is not None:
+            registry.observe(step, new_state["metrics"])
+            meter.tick()
+            if step % args.report_every == 0:
+                reporter.report(step)
         if bool(verdict.skipped):
             print(f"  step skipped (found_inf={float(verdict.found_inf)}, "
                   f"spike={bool(verdict.spike)})")
         return new_state, {"skipped": verdict.skipped, "loss": loss}
 
-    result = run_resilient(
-        step_fn,
-        state,
-        batch_fn,
-        directory=args.dir,
-        num_steps=args.steps,
-        save_interval_steps=args.save_every,
-        max_to_keep=3,
-        rollback_after=5,
-    )
+    result = None
+    try:
+        result = run_resilient(
+            step_fn,
+            state,
+            batch_fn,
+            directory=args.dir,
+            num_steps=args.steps,
+            save_interval_steps=args.save_every,
+            max_to_keep=3,
+            rollback_after=5,
+            observer=goodput,
+        )
+    finally:
+        # even a raising run (e.g. max_rollbacks exhausted) must close
+        # an armed trace window and land its final telemetry — those
+        # are exactly the artifacts needed to debug the failure
+        tracer.stop()
+        if reporter is not None:
+            registry.fetch()  # drain the async buffers for the report
+            final_step = (
+                max(result.last_step, 0) if result is not None
+                else meter.steps
+            )
+            reporter.report(final_step)
+            # The consolidated goodput line: value + the EXACT event
+            # counts of this invocation (they match RunResult by
+            # construction — the accountant saw every on_step /
+            # on_rollback the runner counted).
+            reporter.sinks[0].write(obs.bench_record(
+                "train/goodput", goodput.goodput(),
+                "fraction (productive/executed)", None,
+                step=final_step, accepted=goodput.accepted,
+                skipped=goodput.skipped, discarded=goodput.discarded,
+                rollbacks=goodput.rollbacks, retries=goodput.retries,
+                resumes=goodput.resumes, preempted=goodput.preempted,
+            ))
+            reporter.close()
     print(
         f"done: last_step={result.last_step} resumed_from={result.resumed_from} "
         f"steps_run={result.steps_run} skipped={result.skipped_steps} "

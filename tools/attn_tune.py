@@ -54,7 +54,10 @@ _PEAK_TFLOPS_BOUND = 250.0
 # combined step's jaxpr carries all 3 pallas_calls (fwd, dkdv, dq) at
 # the exact mha shape, so this is a runtime synchronization artifact
 # of the remote backend, not a program bug.  Until it is understood,
-# trust fwd-only + --bwd-only for mha-shape decisions.
+# trust fwd-only + --bwd-only for mha-shape decisions.  Two gates keep
+# mis-timed cells out of the winners: the absolute peak-TFLOP/s bound
+# below, and the fwd-floor cross-check (a combined fwd+bwd cell must be
+# STRICTLY slower than the same tile's fwd-only cell — ADVICE r5).
 
 
 def _flops(b, h, sq, d, causal, bwd):
@@ -104,14 +107,27 @@ def _time_scan(step, q, k, v, iters=8, trials=3):
     return times[len(times) // 2]
 
 
-def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v):
+def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v, floor=None):
     """Shared (bq, bk) grid driver: divisibility filter, timing,
     FAILED formatting, best tracking, auto-heuristic footer.
     ``make_step(bq, bk)`` returns a q-shaped-output step for
-    :func:`_time_scan`."""
+    :func:`_time_scan`.
+
+    ``floor`` is the under-wait cross-check invariant (ADVICE r5):
+    ``{(bq, bk): seconds}`` of a STRICTLY-CHEAPER sweep of the same
+    shape (fwd-only vs this combined fwd+bwd).  A cell timing at or
+    under its floor is physically impossible — it means the remote
+    runtime under-waited at a *plausible* sub-peak rate the absolute
+    gate cannot catch — so it is flagged and excluded from winners.
+
+    Returns ``(best, times)`` where ``times`` maps every successfully
+    timed cell (flagged ones included) to its seconds, so a fwd sweep's
+    result can serve as the next sweep's floor.
+    """
     print(f"\n== {name} {SHAPES[name]} {mode} ==")
     print(f"{'bq':>5} {'bk':>5} {'ms':>9} {'TFLOP/s':>9}")
     best = (None, 0.0)
+    times = {}
     for bq in BLOCKS:
         if bq > sq or sq % bq:
             continue
@@ -124,6 +140,7 @@ def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v):
                 print(f"{bq:5d} {bk:5d}   FAILED  {type(e).__name__}:"
                       f" {str(e)[:60]}")
                 continue
+            times[(bq, bk)] = t
             tflops = flops / t / 1e12
             # Plausibility gate for the remote runtime's under-wait
             # artifact (see module caveat): no real cell can beat the
@@ -133,6 +150,11 @@ def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v):
                 print(f"{bq:5d} {bk:5d} {t * 1e3:9.2f} {tflops:9.1f}"
                       "  IMPLAUSIBLE (under-wait; excluded)")
                 continue
+            if floor is not None and (bq, bk) in floor and t <= floor[(bq, bk)]:
+                print(f"{bq:5d} {bk:5d} {t * 1e3:9.2f} {tflops:9.1f}"
+                      f"  UNDER-WAIT (<= fwd-only {floor[(bq, bk)] * 1e3:.2f}"
+                      " ms at this tile; excluded)")
+                continue
             mark = ""
             if tflops > best[1]:
                 best = ((bq, bk), tflops)
@@ -141,7 +163,7 @@ def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v):
     auto = fa._auto_block(sq, d)
     print(f"auto heuristic picks ({auto}, {auto}); best {best[0]} "
           f"at {best[1]:.1f} TFLOP/s")
-    return best
+    return best, times
 
 
 def _qkv(name):
@@ -154,7 +176,7 @@ def _qkv(name):
     return b, h, q, k, v, sq, d, causal, d ** -0.5
 
 
-def sweep(name, bwd):
+def sweep(name, bwd, floor=None):
     b, h, q, k, v, sq, d, causal, scale = _qkv(name)
     flops = _flops(b, h, sq, d, causal, bwd)
 
@@ -185,7 +207,9 @@ def sweep(name, bwd):
         return step
 
     mode = "fwd+bwd" if bwd else "fwd"
-    return _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v)
+    return _grid_sweep(
+        name, mode, make_step, flops, sq, d, q, k, v, floor=floor
+    )
 
 
 def sweep_bwd_only(name):
@@ -214,7 +238,7 @@ def sweep_bwd_only(name):
             return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
         return step
 
-    best = _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
+    best, _ = _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
 
     # Explicit config dict on EVERY path so consumers can't misread
     # which pair is which: apply as flash_bwd(block_q=.., block_k=..,
@@ -236,7 +260,7 @@ def sweep_bwd_only(name):
             return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
         return step
 
-    best_dq = _grid_sweep(
+    best_dq, _ = _grid_sweep(
         name, f"bwd-only dq-tiles (dkdv pinned {dkdv_bq},{dkdv_bk})",
         make_step_dq, flops, sq, d, q, k, v,
     )
@@ -268,6 +292,8 @@ if __name__ == "__main__":
         if args.bwd_only:
             sweep_bwd_only(name)
             continue
-        sweep(name, bwd=False)
+        _, fwd_times = sweep(name, bwd=False)
         if not args.fwd_only:
-            sweep(name, bwd=True)
+            # the fwd-only cells are the combined sweep's floor: a
+            # fwd+bwd cell at most as slow as fwd alone is an under-wait
+            sweep(name, bwd=True, floor=fwd_times)

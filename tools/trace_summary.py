@@ -18,6 +18,49 @@ import json
 import os
 
 
+def resolve_window(log_dir: str, step: int | None = None) -> str:
+    """Resolve a scheduled-trace base dir to one capture window.
+
+    ``apex_tpu.observability.trace.TraceScheduler`` writes each armed
+    window to ``<base>/steps_<start>_<end>/``; given the base dir this
+    lists the windows and picks the one containing ``--step`` (default:
+    the latest).  A dir without window children passes through
+    unchanged, so plain ``bench.py --trace`` dirs keep working.
+    """
+    import re
+
+    windows = []
+    if os.path.isdir(log_dir):
+        for name in sorted(os.listdir(log_dir)):
+            m = re.match(r"steps_(\d+)_(\d+)$", name)
+            if m:
+                windows.append(
+                    (int(m.group(1)), int(m.group(2)),
+                     os.path.join(log_dir, name))
+                )
+    if not windows:
+        if step is not None:
+            raise SystemExit(
+                f"--step given but {log_dir} has no steps_*_* windows"
+            )
+        return log_dir
+    # numeric order — lexicographic listdir order lies once step
+    # numbers outgrow the %06d padding (steps_1200000 < steps_999000)
+    windows.sort()
+    print(
+        "trace windows: "
+        + ", ".join(f"{s}..{e}" for s, e, _ in windows)
+    )
+    if step is None:
+        return windows[-1][2]
+    for s, e, path in windows:
+        if s <= step <= e:
+            return path
+    raise SystemExit(
+        f"no trace window contains step {step} under {log_dir}"
+    )
+
+
 def load_trace(log_dir: str) -> dict:
     paths = glob.glob(
         os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
@@ -134,12 +177,19 @@ if __name__ == "__main__":
     ap.add_argument("-n", type=int, default=30)
     ap.add_argument("--like", default=None, help="substring filter")
     ap.add_argument(
+        "--step", type=int, default=None,
+        help="pick the scheduled-trace window (steps_<start>_<end>/ "
+        "subdir, APEX_TPU_TRACE_STEPS layout) containing this step; "
+        "default: the latest window, or the dir itself if plain",
+    )
+    ap.add_argument(
         "--hlo", default=None,
         help="optimized-HLO text dump (jit_fn.lower().compile().as_text())"
         " of the traced program; attributes each op row to its op_name +"
         " source line",
     )
     args = ap.parse_args()
+    args.log_dir = resolve_window(args.log_dir, args.step)
     meta = None
     if args.hlo:
         # Degrade, don't die: in a staged queue the HLO-dump step can be

@@ -15,14 +15,21 @@
 # that ever changes, and must run even when extra pytest args (e.g.
 # `-m chaos`) filter them out of the main pass.
 #
+# A third stage drives the observability pipe end to end: the resilient
+# example runs under injected chaos with --metrics-out, and the JSONL is
+# asserted to parse, carry the bench-line schema with step/MFU/goodput
+# keys, and reflect the injected skip count EXACTLY (docs/observability.md).
+# Like the comm pass it hard-fails rather than silently skipping.
+#
 # Usage:
-#   tools/verify_tier1.sh              # full quick tier + comm pass
+#   tools/verify_tier1.sh              # full quick tier + comm + obs pass
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
 #   T1_LOG      log path        (default /tmp/_t1.log)
 #   T1_TIMEOUT  seconds         (default 870)
 #   T1_SKIP_COMM=1              skip the dedicated comm pass
+#   T1_SKIP_OBS=1               skip the observability pass
 
 set -o pipefail
 
@@ -65,10 +72,56 @@ if [ "${T1_SKIP_COMM:-0}" != "1" ]; then
     fi
 fi
 
-if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ]; then
+obs_rc=0
+if [ "${T1_SKIP_OBS:-0}" != "1" ]; then
+    OBS_OUT="$(mktemp /tmp/_t1_obs.XXXXXX.jsonl)"
+    OBS_DIR="$(mktemp -d /tmp/_t1_obs_ckpt.XXXXXX)"
+    # grads:nan@7,8 -> exactly 2 skipped steps, 0 rollbacks; the JSONL
+    # goodput line must reproduce those counts (ISSUE 3 acceptance)
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        APEX_TPU_CHAOS="grads:nan@7,8" \
+        python examples/simple/resilient/train_resilient.py \
+        --steps 20 --save-every 5 --dir "$OBS_DIR" \
+        --metrics-out "$OBS_OUT" 2>&1 | tail -n 4 | tee -a "$LOG"
+    obs_rc=${PIPESTATUS[0]}
+    if [ "$obs_rc" -eq 0 ]; then
+        python - "$OBS_OUT" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert recs, "metrics JSONL is empty"
+for r in recs:
+    assert list(r)[:4] == ["metric", "value", "unit", "vs_baseline"], r
+    assert "step" in r, f"telemetry line without step key: {r}"
+metrics = {r["metric"] for r in recs}
+for need in ("train/step_time_ms", "train/mfu", "train/goodput",
+             "train/loss", "amp/loss_scale", "guard/skipped"):
+    assert need in metrics, f"missing metric {need}; have {sorted(metrics)}"
+final = [r for r in recs if r["metric"] == "train/goodput" and "skipped" in r]
+assert final, "no consolidated goodput line with event counts"
+g = final[-1]
+assert g["skipped"] == 2, f"goodput line skipped={g['skipped']}, chaos injected 2"
+assert g["rollbacks"] == 0, f"goodput line rollbacks={g['rollbacks']}, expected 0"
+assert g["value"] == (g["accepted"] - g["discarded"]) / (g["accepted"] + g["skipped"])
+print(f"observability JSONL OK: {len(recs)} records, goodput={g['value']:.3f} "
+      f"(skipped={g['skipped']}, rollbacks={g['rollbacks']})")
+PYEOF
+        obs_rc=${PIPESTATUS[0]}
+    fi
+    rm -rf "$OBS_DIR"
+    if [ "$obs_rc" -eq 0 ]; then
+        rm -f "$OBS_OUT"
+        echo "TIER1-OBS: PASS"
+    else
+        # keep the JSONL that failed the assertions — it IS the evidence
+        echo "TIER1-OBS: FAIL (rc=$obs_rc; metrics kept at $OBS_OUT)"
+    fi
+fi
+
+if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
-exit "$comm_rc"
+[ "$comm_rc" -ne 0 ] && exit "$comm_rc"
+exit "$obs_rc"
