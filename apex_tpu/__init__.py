@@ -32,6 +32,9 @@ Subpackages
 - :mod:`apex_tpu.observability` — unified step telemetry: device-side
   metric registry, MFU/goodput meters, JSONL/CSV/TensorBoard export,
   and scheduled trace windows.
+- :mod:`apex_tpu.analysis` — jaxpr/HLO graph linter: transfer /
+  promotion / donation / retrace / collective-consistency passes over
+  traced and compiled step programs.
 """
 
 __version__ = "0.1.0"
@@ -45,6 +48,7 @@ from apex_tpu import _compat  # noqa: F401
 from apex_tpu import parallel_state  # noqa: F401
 
 _LAZY_SUBMODULES = (
+    "analysis",
     "ops",
     "optimizers",
     "amp",

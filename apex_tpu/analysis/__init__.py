@@ -1,0 +1,238 @@
+"""Static analysis of step programs — a jaxpr/HLO graph linter.
+
+Fused kernels, quantized collectives, and AMP policies only pay off if
+the *compiled* step graph has the structure we intend.  This package
+proves it statically, before a single step runs:
+
+- **transfer lint** — no host↔device transfers or python callbacks
+  inside the step (jaxpr callbacks + compiled-HLO infeed/outfeed/
+  send-recv/callback custom-calls).
+- **promotion lint** — no silent dtype widening past the active
+  ``amp`` policy, and no f64 anywhere.
+- **donation lint** — every ``donate_argnums`` buffer is actually
+  aliased in the compiled buffer assignment (a dropped donation
+  silently doubles memory).
+- **retrace sentinel** — :class:`RetraceSentinel` flags recompilation
+  across steps by hashing abstract call signatures.
+- **collective consistency** — the compiled collective schedule
+  matches the comm engine's promise (count / bytes / wire dtype),
+  on the shared HLO parser that ``apex_tpu.parallel.comm`` and
+  ``tools/comm_structure.py`` also read through.
+
+Surfaces::
+
+    from apex_tpu import analysis
+
+    report = analysis.check(step_fn, *args, policy=policy,
+                            donate_argnums=(0,),
+                            expect_collectives={"all-reduce": 2})
+    assert report.ok(), report.render()
+
+plus ``tools/graph_lint.py`` (CLI, JSON artifacts, the
+``verify_tier1.sh`` gate) and ``bench.py --lint``.  Findings publish
+onto the observability board via :func:`publish_report`, so lint
+results ride the same JSONL telemetry as MFU/goodput.  Rule catalog
+and fix hints: ``docs/analysis.md``.
+"""
+
+from __future__ import annotations
+
+import warnings as _warnings
+from typing import Optional
+
+import jax
+
+from apex_tpu.analysis.findings import (  # noqa: F401
+    ERROR,
+    INFO,
+    RULES,
+    WARNING,
+    Finding,
+    Report,
+    make_finding,
+)
+from apex_tpu.analysis.retrace import (  # noqa: F401
+    RetraceSentinel,
+    abstract_signature,
+)
+from apex_tpu.analysis.passes import (  # noqa: F401
+    PASSES,
+    StepGraph,
+    iter_eqns,
+)
+from apex_tpu.analysis import hlo  # noqa: F401
+
+__all__ = [
+    "check",
+    "lint_jaxpr",
+    "lint_hlo",
+    "publish_report",
+    "Finding",
+    "Report",
+    "RULES",
+    "ERROR",
+    "WARNING",
+    "INFO",
+    "make_finding",
+    "RetraceSentinel",
+    "abstract_signature",
+    "StepGraph",
+    "PASSES",
+    "iter_eqns",
+    "hlo",
+]
+
+
+#: passes that only have a jaxpr substrate — they cannot run (and are
+#: dropped from a report's rules_run, so the gap is visible) when
+#: tracing failed and only compiled HLO is available
+_JAXPR_ONLY = ("promotion",)
+
+
+def _select(rules) -> tuple:
+    if rules is None:
+        return tuple(PASSES)
+    unknown = [r for r in rules if r not in PASSES]
+    if unknown:
+        raise ValueError(
+            f"unknown analysis pass(es) {unknown}; have {sorted(PASSES)}"
+        )
+    return tuple(rules)
+
+
+def _run(graph: StepGraph, rules, target: str) -> Report:
+    selected = _select(rules)
+    if graph.jaxpr is None:
+        # a jaxpr-only pass that cannot run must not be REPORTED as run
+        # — a "clean" verdict would claim a property nobody checked
+        selected = tuple(r for r in selected if r not in _JAXPR_ONLY)
+    report = Report(target=target, rules_run=selected)
+    for name in selected:
+        report.extend(PASSES[name](graph))
+    return report
+
+
+def check(
+    fn,
+    *args,
+    rules=None,
+    policy=None,
+    donate_argnums=None,
+    static_argnums=None,
+    expect_collectives=None,
+    publish: bool = False,
+    name: Optional[str] = None,
+    **kwargs,
+) -> Report:
+    """Trace, lower, and compile ``fn`` on ``args``; run the selected
+    analysis passes over its jaxpr AND optimized HLO; return a
+    :class:`Report`.
+
+    ``fn`` may be a plain callable (it is jitted here, with
+    ``donate_argnums``/``static_argnums`` applied) or an
+    already-``jax.jit``-wrapped function (used as-is; pass
+    ``donate_argnums`` anyway so the donation lint knows the intent —
+    jit objects don't expose it).  ``policy`` (an ``amp.Policy``,
+    ``Properties``, or a bare dtype) arms the promotion-widen rule;
+    ``expect_collectives`` arms the collective-consistency rule
+    (see :func:`apex_tpu.analysis.passes.collective_pass` for the
+    expectation schema).  Compilation happens once, AOT — nothing is
+    executed and no buffer is consumed (donation only affects the
+    compiled program's aliasing, not tracing).
+
+    ``publish=True`` gauges the finding counts onto the observability
+    board so the report rides the JSONL telemetry stream.
+    """
+    if hasattr(fn, "lower"):
+        jitted = fn
+    else:
+        jitted = jax.jit(
+            fn,
+            donate_argnums=tuple(donate_argnums or ()),
+            static_argnums=tuple(static_argnums or ()),
+        )
+    target = name or getattr(fn, "__name__", None) or repr(fn)
+
+    jaxpr = None
+    try:
+        jaxpr = jax.make_jaxpr(
+            jitted, static_argnums=tuple(static_argnums or ())
+        )(*args, **kwargs)
+    except TypeError:
+        # some wrapped callables reject make_jaxpr's re-wrapping; the
+        # HLO-level passes still run
+        pass
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        compiled = jitted.lower(*args, **kwargs).compile()
+    hlo_text = compiled.as_text()
+
+    donated = None
+    if donate_argnums is not None:
+        donated = 0
+        for i in tuple(donate_argnums):
+            donated += len(jax.tree_util.tree_leaves(args[i]))
+
+    graph = StepGraph(
+        jaxpr=jaxpr,
+        hlo_text=hlo_text,
+        policy=policy,
+        donated=donated,
+        donated_argnums=tuple(donate_argnums or ()),
+        compile_warnings=tuple(str(w.message) for w in caught),
+        expect_collectives=expect_collectives,
+    )
+    report = _run(graph, rules, target)
+    if publish:
+        publish_report(report)
+    return report
+
+
+def lint_jaxpr(jaxpr, *, policy=None, rules=None, name: str = "") -> Report:
+    """Run the jaxpr-level passes (transfer callbacks, promotion) over
+    an already-traced ``ClosedJaxpr`` — for callers that trace once and
+    lint alongside other uses of the jaxpr."""
+    graph = StepGraph(jaxpr=jaxpr, policy=policy)
+    wanted = rules if rules is not None else ("transfer", "promotion")
+    return _run(graph, wanted, name or "jaxpr")
+
+
+def lint_hlo(
+    hlo_text: str,
+    *,
+    donated: Optional[int] = None,
+    expect_collectives=None,
+    rules=None,
+    name: str = "",
+) -> Report:
+    """Run the HLO-level passes (host transfers, donation aliasing,
+    collective consistency) over compiled-module text — for callers
+    that already paid the compile (``bench.py --lint`` reuses the
+    ``--hlo-out`` executable's text instead of compiling twice)."""
+    graph = StepGraph(
+        hlo_text=hlo_text,
+        donated=donated,
+        expect_collectives=expect_collectives,
+    )
+    wanted = rules if rules is not None else (
+        "transfer", "donation", "collective"
+    )
+    return _run(graph, wanted, name or "hlo")
+
+
+def publish_report(report: Report, prefix: str = "analysis") -> None:
+    """Gauge a report's finding counts onto the observability board
+    (``{prefix}/errors``, ``{prefix}/warnings``, and per-rule
+    ``{prefix}/rule/<id>``), so lint results ride the same JSONL
+    telemetry stream as MFU/goodput — mirror of
+    ``comm.publish_collective_summary``."""
+    try:
+        from apex_tpu.observability.metrics import board
+    except ImportError:  # pragma: no cover - partial install
+        return
+    board.set(f"{prefix}/target", report.target)
+    board.set(f"{prefix}/errors", len(report.errors()))
+    board.set(f"{prefix}/warnings", len(report.warnings()))
+    for rule, count in report.counts().items():
+        board.set(f"{prefix}/rule/{rule}", count)

@@ -1,0 +1,224 @@
+"""Findings — the structured currency of every analysis pass.
+
+A pass never prints: it returns :class:`Finding` records (rule id,
+severity, op path, message, fix hint) that a :class:`Report` aggregates.
+The CLI (``tools/graph_lint.py``), the benchmark harness (``bench.py
+--lint``), the CI gate (``tools/verify_tier1.sh``), and the test
+fixtures (``tests/test_analysis.py``) all consume the same records, so
+"what did the linter say" has exactly one schema.
+
+The rule catalog (:data:`RULES`) is the single source of truth for rule
+ids, default severities, and fix hints — ``docs/analysis.md`` documents
+it row by row, and a pass emitting an uncataloged rule id is a bug
+(:func:`make_finding` raises).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "ERROR",
+    "WARNING",
+    "INFO",
+    "RULES",
+    "Finding",
+    "Report",
+    "make_finding",
+]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 2, WARNING: 1, INFO: 0}
+
+#: rule id -> (default severity, what it means, how to fix it).
+#: Rule ids are namespaced ``<pass>-<defect>``; ``rules=("transfer",)``
+#: selects every rule of the transfer pass.
+RULES: Dict[str, Tuple[str, str, str]] = {
+    "transfer-callback": (
+        ERROR,
+        "host callback primitive traced into the step "
+        "(jax.debug.print / pure_callback / io_callback): every "
+        "execution round-trips device->host",
+        "move host I/O out of the jitted step; accumulate device-side "
+        "via observability.MetricRegistry and fetch on a cadence",
+    ),
+    "transfer-hlo-host": (
+        ERROR,
+        "compiled HLO contains a host transfer op (infeed/outfeed, "
+        "host send/recv, or a python-callback custom-call)",
+        "the step program must be self-contained on device; feed data "
+        "as arguments and read results from outputs",
+    ),
+    "promotion-f64": (
+        ERROR,
+        "an op inside the step produces float64 — on TPU every f64 op "
+        "is emulated and silently doubles memory and wire bytes",
+        "drop the f64 literal / enable-x64 dependence; use f32 "
+        "(or the amp policy's compute dtype) explicitly",
+    ),
+    "promotion-widen": (
+        WARNING,
+        "value widened past the active amp policy's compute dtype "
+        "(e.g. bf16 -> f32) — a silent promotion defeats the policy's "
+        "memory/MXU savings",
+        "if accidental, keep literals weakly typed (python floats) or "
+        "cast them to the compute dtype; if intentional accumulation, "
+        "wrap the region in jax.named_scope containing 'f32' "
+        "(e.g. 'f32_accum') to mark it policy-exempt",
+    ),
+    "donation-dropped": (
+        ERROR,
+        "buffers declared in donate_argnums were NOT aliased by XLA "
+        "in the compiled buffer assignment — the step silently holds "
+        "two copies (e.g. doubled optimizer memory)",
+        "make donated inputs match an output's shape/dtype/layout "
+        "exactly (return the updated buffer, keep dtypes stable), or "
+        "drop them from donate_argnums",
+    ),
+    "retrace": (
+        ERROR,
+        "the step recompiled mid-run: its abstract signature (tree "
+        "structure / shapes / dtypes / static values) changed across "
+        "calls, paying a full XLA compile each time",
+        "pad inputs to a fixed shape, hoist changing python values out "
+        "of the step or mark them static, and keep the state tree "
+        "structure constant",
+    ),
+    "collective-count": (
+        ERROR,
+        "compiled collective count differs from the comm engine's "
+        "promise (e.g. a chunked sync should compile to exactly 2K "
+        "collectives)",
+        "check wire/chunks knobs against docs/comm.md; a fused or "
+        "duplicated collective means XLA restructured the sync",
+    ),
+    "collective-bytes": (
+        ERROR,
+        "collective payload bytes differ from the promised wire plan "
+        "(quantized wires must shrink bytes, not just relabel dtypes)",
+        "verify the wire format actually applied (int8 payloads carry "
+        "codes+scales); compare against comm.ring_wire_bytes",
+    ),
+    "collective-dtype": (
+        ERROR,
+        "a collective moves a wider dtype than the configured wire "
+        "format (e.g. f32 payloads where wire='int8' was requested)",
+        "ensure encode happens before the collective; a stray cast "
+        "upstream re-widens the payload",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect: rule id + severity + where + what + how to fix."""
+
+    rule: str
+    severity: str
+    path: str  # op path: name_stack, HLO op name, or file:line
+    message: str
+    hint: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        loc = f" @ {self.path}" if self.path else ""
+        hint = f"\n    fix: {self.hint}" if self.hint else ""
+        return f"[{self.severity.upper()}] {self.rule}{loc}: " \
+               f"{self.message}{hint}"
+
+
+def make_finding(
+    rule: str,
+    path: str,
+    message: str,
+    severity: Optional[str] = None,
+    hint: Optional[str] = None,
+) -> Finding:
+    """Build a :class:`Finding` with catalog defaults for severity/hint.
+
+    Raises ``KeyError`` on a rule id missing from :data:`RULES` — passes
+    may not invent rules the catalog (and docs) don't know.
+    """
+    default_sev, _desc, default_hint = RULES[rule]
+    return Finding(
+        rule=rule,
+        severity=severity or default_sev,
+        path=path,
+        message=message,
+        hint=default_hint if hint is None else hint,
+    )
+
+
+class Report:
+    """Ordered collection of findings from one ``check()`` run."""
+
+    def __init__(
+        self,
+        findings: Optional[List[Finding]] = None,
+        target: str = "",
+        rules_run: Tuple[str, ...] = (),
+    ):
+        self.findings: List[Finding] = list(findings or [])
+        self.target = target
+        self.rules_run = tuple(rules_run)
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def rule_ids(self):
+        return sorted({f.rule for f in self.findings})
+
+    def ok(self, fail_on: str = ERROR) -> bool:
+        """True when no finding reaches ``fail_on`` severity."""
+        bar = _SEVERITY_ORDER[fail_on]
+        return not any(
+            _SEVERITY_ORDER[f.severity] >= bar for f in self.findings
+        )
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "target": self.target,
+            "rules_run": list(self.rules_run),
+            "errors": len(self.errors()),
+            "warnings": len(self.warnings()),
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+    def to_json_line(self) -> str:
+        return json.dumps(self.to_json())
+
+    def render(self) -> str:
+        head = f"graph lint: {self.target or '<step>'} — " \
+               f"{len(self.errors())} error(s), " \
+               f"{len(self.warnings())} warning(s)"
+        if not self.findings:
+            return head + " — clean"
+        return "\n".join([head] + [f"  {f.render()}" for f in self.findings])
+
+    def __repr__(self):
+        return (
+            f"Report(target={self.target!r}, errors={len(self.errors())}, "
+            f"warnings={len(self.warnings())}, rules={self.rule_ids()})"
+        )

@@ -1,0 +1,113 @@
+"""Retrace sentinel — catches silent recompilation across steps.
+
+``jax.jit`` retraces (and XLA recompiles) whenever a call's *abstract
+signature* changes: a leaf's shape or dtype, the pytree structure, a
+weak-type flag, or a static python value.  In a training loop that is
+almost always a bug — a ragged final batch, a python int threaded
+through the step, a state tree whose structure depends on a flag — and
+it costs a full compile (seconds to minutes) every occurrence, usually
+discovered as "step 1000 was mysteriously slow".
+
+:class:`RetraceSentinel` hashes the abstract signature of every
+observed call and emits a ``retrace`` finding the moment a NEW
+signature appears after the allowed budget (default: the first trace is
+free, everything after flags).  It never touches device data — hashing
+is pure host-side metadata, safe to run every step.
+
+    sentinel = RetraceSentinel()
+    for step in range(n):
+        batch = next(it)
+        f = sentinel.observe(state, batch)   # None or a Finding
+        state = train_step(state, batch)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from apex_tpu.analysis.findings import Finding, make_finding
+
+__all__ = ["abstract_signature", "RetraceSentinel"]
+
+
+def _leaf_key(leaf: Any) -> Tuple:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = bool(getattr(leaf, "weak_type", False))
+        return ("array", tuple(shape), str(dtype), weak)
+    # a non-array leaf is a static value: its VALUE is part of the
+    # signature (a changing python scalar retraces every call)
+    return ("static", repr(leaf))
+
+
+def abstract_signature(*args, **kwargs) -> Tuple:
+    """Hashable abstract signature of a call: pytree structure plus
+    (shape, dtype, weak_type) per array leaf and ``repr`` per static
+    leaf — exactly the things a changed value of forces a retrace."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef),) + tuple(_leaf_key(l) for l in leaves)
+
+
+class RetraceSentinel:
+    """Flags calls whose abstract signature changed after the budget.
+
+    ``allowed`` is the number of DISTINCT signatures that are expected
+    (default 1: one trace, then steady state).  A ragged final batch
+    can legitimately add one — pass ``allowed=2`` if the input pipeline
+    pads all but the tail.
+    """
+
+    def __init__(self, allowed: int = 1, name: str = "step"):
+        if allowed < 1:
+            raise ValueError("allowed must be >= 1")
+        self.allowed = allowed
+        self.name = name
+        self._signatures: List[Tuple] = []
+        self.findings: List[Finding] = []
+        self.calls = 0
+
+    @property
+    def signatures(self) -> int:
+        """Distinct abstract signatures seen so far."""
+        return len(self._signatures)
+
+    @property
+    def retraces(self) -> int:
+        """Signatures beyond the allowed budget (each one a compile)."""
+        return max(0, len(self._signatures) - self.allowed)
+
+    def observe(self, *args, **kwargs) -> Optional[Finding]:
+        """Record one call's signature; return a ``retrace`` finding if
+        it is a NEW signature past the allowed budget, else None."""
+        self.calls += 1
+        sig = abstract_signature(*args, **kwargs)
+        if sig in self._signatures:
+            return None
+        self._signatures.append(sig)
+        if len(self._signatures) <= self.allowed:
+            return None
+        # name the leaves that differ from the previous signature so the
+        # finding points at the culprit, not just "something changed"
+        prev, cur = self._signatures[-2], sig
+        diffs = []
+        if prev[0] != cur[0]:
+            diffs.append("pytree structure changed")
+        for i, (a, b) in enumerate(zip(prev[1:], cur[1:])):
+            if a != b:
+                diffs.append(f"leaf {i}: {a} -> {b}")
+        if len(prev) != len(cur):
+            diffs.append(f"leaf count {len(prev) - 1} -> {len(cur) - 1}")
+        finding = make_finding(
+            "retrace",
+            path=f"{self.name} call #{self.calls}",
+            message=(
+                f"abstract signature #{len(self._signatures)} (allowed "
+                f"{self.allowed}) — this call RECOMPILES: "
+                + ("; ".join(diffs[:4]) or "signature changed")
+            ),
+        )
+        self.findings.append(finding)
+        return finding

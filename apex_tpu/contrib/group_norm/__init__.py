@@ -40,15 +40,20 @@ def group_norm(
         raise ValueError(f"channels {c} not divisible by num_groups {num_groups}")
     orig_dtype = x.dtype
     n = x.shape[0]
-    xf = x.astype(jnp.float32).reshape(n, -1, num_groups, c // num_groups)
-    mean = jnp.mean(xf, axis=(1, 3), keepdims=True)
-    var = jnp.mean(jnp.square(xf - mean), axis=(1, 3), keepdims=True)
-    y = (xf - mean) * jax.lax.rsqrt(var + eps)
-    y = y.reshape(x.shape)
-    if weight is not None:
-        y = y * weight.astype(jnp.float32)
-    if bias is not None:
-        y = y + bias.astype(jnp.float32)
+    # f32 statistics by design (keep_batchnorm_fp32); named scope =
+    # policy-exempt for analysis' promotion lint
+    with jax.named_scope("gn_f32_stats"):
+        xf = x.astype(jnp.float32).reshape(
+            n, -1, num_groups, c // num_groups
+        )
+        mean = jnp.mean(xf, axis=(1, 3), keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=(1, 3), keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps)
+        y = y.reshape(x.shape)
+        if weight is not None:
+            y = y * weight.astype(jnp.float32)
+        if bias is not None:
+            y = y + bias.astype(jnp.float32)
     return _ACTS[act](y).astype(orig_dtype)
 
 

@@ -205,7 +205,9 @@ def _scores(q, k, bias, causal, scale):
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if bias is not None:
-        s = s + bias.astype(jnp.float32)
+        # scores are f32 by design; scope = promotion-lint exempt
+        with jax.named_scope("attn_f32_scores"):
+            s = s + bias.astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)

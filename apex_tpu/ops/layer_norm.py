@@ -56,7 +56,12 @@ def _pallas_eligible(hidden: int) -> bool:
 
 
 def _jnp_fwd(x2d, w, b, eps, rms):
-    xf = x2d.astype(jnp.float32)
+    # f32 statistics by design (keep_batchnorm_fp32 analog); the named
+    # scope marks the widening policy-exempt for analysis' promotion lint
+    with jax.named_scope("ln_f32_stats"):
+        xf = x2d.astype(jnp.float32)
+        wf = w.astype(jnp.float32)
+        bf = b.astype(jnp.float32)
     if rms:
         mu = jnp.zeros((xf.shape[0], 1), jnp.float32)
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -66,16 +71,17 @@ def _jnp_fwd(x2d, w, b, eps, rms):
         var = jnp.mean(xc * xc, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(var + eps)
     xhat = (xf - mu) * rstd
-    y = xhat * w.astype(jnp.float32) + b.astype(jnp.float32)
+    y = xhat * wf + bf
     return y.astype(x2d.dtype), mu, rstd
 
 
 def _jnp_bwd(x2d, w, b, mu, rstd, g, rms, x_is_output):
-    xf = x2d.astype(jnp.float32)
-    wf = w.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    if x_is_output:
+    with jax.named_scope("ln_f32_stats"):
+        xf = x2d.astype(jnp.float32)
+        wf = w.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
         bf = b.astype(jnp.float32)
+    if x_is_output:
         wsafe = jnp.where(wf == 0.0, 1.0, wf)
         xhat = jnp.where(wf == 0.0, 0.0, (xf - bf) / wsafe)
     else:

@@ -44,7 +44,10 @@ def _apply(t, cos_, sin_):
             f"rotary dim {rot_dim} exceeds head dim {t.shape[-1]}"
         )
     t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
-    tf = t_rot.astype(jnp.float32)
+    # f32 rotation by design (reference kernel parity); named scope =
+    # policy-exempt for analysis' promotion lint
+    with jax.named_scope("rope_f32"):
+        tf = t_rot.astype(jnp.float32)
     out = tf * cos_ + rotate_half(tf) * sin_
     out = out.astype(t.dtype)
     if t_pass.shape[-1] == 0:
@@ -61,7 +64,8 @@ def _transpose_apply(g, cos_, sin_):
     tdtype = g.dtype
     rot_dim = cos_.shape[-1]
     g_rot, g_pass = g[..., :rot_dim], g[..., rot_dim:]
-    gf = g_rot.astype(jnp.float32)
+    with jax.named_scope("rope_f32"):
+        gf = g_rot.astype(jnp.float32)
     sg = sin_ * gf
     sg1, sg2 = jnp.split(sg, 2, axis=-1)
     dt = gf * cos_ + jnp.concatenate((sg2, -sg1), axis=-1)
@@ -100,12 +104,16 @@ def fused_apply_rotary_pos_emb_cached(t, cos_, sin_):
     Gradients flow to ``t`` only; the tables are treated as constants (their
     cotangents are None), matching the reference kernel.
     """
-    return _apply(t, cos_.astype(jnp.float32), sin_.astype(jnp.float32))
+    with jax.named_scope("rope_f32"):
+        return _apply(
+            t, cos_.astype(jnp.float32), sin_.astype(jnp.float32)
+        )
 
 
 def _rope_cached_fwd(t, cos_, sin_):
-    cos_f = cos_.astype(jnp.float32)
-    sin_f = sin_.astype(jnp.float32)
+    with jax.named_scope("rope_f32"):
+        cos_f = cos_.astype(jnp.float32)
+        sin_f = sin_.astype(jnp.float32)
     return _apply(t, cos_f, sin_f), (cos_f, sin_f)
 
 

@@ -44,17 +44,21 @@ def _amp(name, x):
 
 
 def _softmax_fwd(x):
-    xf = x.astype(jnp.float32)
-    m = jnp.max(xf, axis=-1, keepdims=True)
-    e = jnp.exp(xf - m)
-    y = e / jnp.sum(e, axis=-1, keepdims=True)
+    # f32 exp/sum by design (reference kernel parity); the named scope
+    # marks the widening policy-exempt for analysis' promotion lint
+    with jax.named_scope("softmax_f32_stats"):
+        xf = x.astype(jnp.float32)
+        m = jnp.max(xf, axis=-1, keepdims=True)
+        e = jnp.exp(xf - m)
+        y = e / jnp.sum(e, axis=-1, keepdims=True)
     return y
 
 
 def _softmax_bwd(y, g, scale):
-    gf = g.astype(jnp.float32)
-    yf = y.astype(jnp.float32)
-    dx = yf * (gf - jnp.sum(gf * yf, axis=-1, keepdims=True))
+    with jax.named_scope("softmax_f32_stats"):
+        gf = g.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        dx = yf * (gf - jnp.sum(gf * yf, axis=-1, keepdims=True))
     return (dx * scale).astype(g.dtype)
 
 
@@ -98,7 +102,8 @@ def scaled_masked_softmax(x, mask, scale):
 
 
 def _sms_fwd(x, mask, scale):
-    xs = x.astype(jnp.float32) * scale
+    with jax.named_scope("softmax_f32_stats"):
+        xs = x.astype(jnp.float32) * scale
     if mask is not None:
         xs = jnp.where(mask, _MASK_FILL, xs)
     y = _softmax_fwd(xs)
@@ -147,7 +152,8 @@ def _sutms_fwd(x, scale):
             f"sq={sq}, sk={sk}; use scaled_masked_softmax with an explicit "
             "mask for KV-cache decode shapes"
         )
-    xs = x.astype(jnp.float32) * scale
+    with jax.named_scope("softmax_f32_stats"):
+        xs = x.astype(jnp.float32) * scale
     xs = jnp.where(_causal_mask(sq, sk), _MASK_FILL, xs)
     y = _softmax_fwd(xs)
     # Match the reference kernel: fully-masked rows yield exact zeros is NOT

@@ -46,7 +46,10 @@ def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, ignore_idx=-100):
 
 
 def _parts(logits, labels, smoothing):
-    lf = logits.astype(jnp.float32)
+    # f32 logsumexp by design (the reference kernel accumulates in
+    # f32); named scope = policy-exempt for analysis' promotion lint
+    with jax.named_scope("xent_f32_lse"):
+        lf = logits.astype(jnp.float32)
     m = jnp.max(lf, axis=-1, keepdims=True)
     lse = jnp.squeeze(m, -1) + jnp.log(jnp.sum(jnp.exp(lf - m), axis=-1))
     n = logits.shape[0]
@@ -69,7 +72,8 @@ def _xent_fwd(logits, labels, smoothing, ignore_idx):
 
 def _xent_bwd(smoothing, ignore_idx, res, g):
     logits, labels, lse, valid = res
-    lf = logits.astype(jnp.float32)
+    with jax.named_scope("xent_f32_lse"):
+        lf = logits.astype(jnp.float32)
     n, v = logits.shape
     softmax = jnp.exp(lf - lse[:, None])
     one_hot = jax.nn.one_hot(labels, v, dtype=jnp.float32)

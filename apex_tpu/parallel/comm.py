@@ -35,8 +35,10 @@ everything (read at trace time — retrace to apply).
 of compiled HLO and :func:`ring_wire_bytes` turns them into per-chip
 wire traffic under ring algorithms — so "exactly 2K collectives per
 sync, ~1/4 the bytes" is a regression test (``tests/test_comm.py``),
-not a docstring.  ``tools/comm_structure.py`` builds its artifact on
-the same parser.
+not a docstring.  The parser itself lives with the static-analysis
+subsystem (``apex_tpu/analysis/hlo.py``): ``tools/comm_structure.py``,
+the ``analysis`` collective-consistency pass, and these hooks all read
+compiled HLO through ONE implementation.
 
 **Telemetry**.  Every sync publishes its plan — wire format, payload
 bytes, collective count, chunk count — as gauges on the observability
@@ -53,7 +55,6 @@ to quantize.
 from __future__ import annotations
 
 import os
-import re
 from typing import Any, Optional
 
 import jax
@@ -476,96 +477,18 @@ def sync_gradients(
 
 # ---------------------------------------------------------------------------
 # verification hooks: collectives + wire bytes out of compiled HLO
+#
+# The HLO text parser itself lives with the static-analysis subsystem
+# (apex_tpu/analysis/hlo.py) — ONE implementation shared by these
+# hooks, the analysis passes' collective-consistency rule, and
+# tools/comm_structure.py.  The names below remain this module's public
+# API (tests/test_comm.py and downstream callers import them here).
 # ---------------------------------------------------------------------------
 
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
-    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4,
-    "u16": 2, "u8": 1, "pred": 1,
-}
-
-COLLECTIVE_KINDS = (
-    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
-    "all-to-all",
+from apex_tpu.analysis.hlo import (  # noqa: E402
+    collective_summary,
+    ring_wire_bytes,
 )
-
-
-def _shape_bytes(shape: str) -> int:
-    """bytes of an HLO shape string like 'bf16[8,128,1024]' (tuples:
-    sum of elements)."""
-    total = 0
-    for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", shape):
-        if dt not in _DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
-
-
-def _async_start_result(shape: str) -> str:
-    """Result element of an async ``-start`` op's tuple shape
-    ``(operand(s), result(s)[, contexts...])`` — the second TOP-LEVEL
-    element, which for a variadic combined op is itself a tuple whose
-    arrays all count.  Depth tracking covers ALL bracket kinds: shape
-    strings carry commas inside dims (``[8,128]``) and layouts
-    (``{1,0}``), not just nested tuples."""
-    if not shape.startswith("("):
-        return shape
-    parts, depth, cur = [], 0, []
-    for ch in shape[1:-1]:
-        if ch == "," and depth == 0:
-            parts.append("".join(cur))
-            cur = []
-            continue
-        if ch in "([{":
-            depth += 1
-        elif ch in ")]}":
-            depth -= 1
-        cur.append(ch)
-    parts.append("".join(cur))
-    return parts[1] if len(parts) > 1 else parts[0]
-
-
-def collective_summary(hlo_text: str) -> dict:
-    """Per-kind ``{count, bytes}`` for every collective in optimized HLO.
-
-    Bytes are the shape printed at each op's definition site — the
-    RESULT: the full buffer for all-gather/all-to-all, the local shard
-    for reduce-scatter (feed :func:`ring_wire_bytes` for a
-    notation-normalized traffic number).  Async ``-start``/``-done``
-    pairs count once, at ``-start``, with the result element of the
-    start tuple.
-    """
-    out = {}
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        # shape alternative allows one level of tuple nesting: variadic
-        # combined async ops (XLA's collective combiners) print
-        # ((op0, op1), (res0, res1)) — a flat [^)]* would stop at the
-        # first ')' and silently drop the op from the count
-        m = re.match(
-            r"(?:ROOT\s+)?%?[\w.-]+\s*=\s*"
-            r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
-            r"(all-reduce|all-gather|reduce-scatter|"
-            r"collective-permute|all-to-all)(-start|-done)?\(",
-            line)
-        if not m:
-            continue
-        shape, kind, variant = m.group(1), m.group(2), m.group(3)
-        if variant == "-done":
-            # async pairs are counted once, at -start
-            continue
-        if variant == "-start":
-            # -start returns (operand(s), result(s)[, contexts]); keep
-            # only the result element so bytes match the sync form
-            shape = _async_start_result(shape)
-        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
-        rec["count"] += 1
-        rec["bytes"] += _shape_bytes(shape)
-    return out
 
 
 def compiled_collectives(fn, *args, **kwargs) -> dict:
@@ -595,25 +518,3 @@ def publish_collective_summary(
     if world is not None:
         stats["ring_wire_bytes"] = ring_wire_bytes(summary, world)
     _publish_stats(prefix, **stats)
-
-
-def ring_wire_bytes(summary: dict, world: int) -> float:
-    """Per-chip wire traffic (bytes sent) implied by a
-    :func:`collective_summary`, under ring algorithms — normalized for
-    XLA's result-shape notation so f32 and quantized paths compare
-    apples-to-apples: reduce-scatter prints the SHARD (traffic =
-    ``(world-1) * shard``), all-gather/all-to-all print the FULL buffer
-    (traffic = ``(world-1)/world * full``), all-reduce streams twice.
-    """
-    t = 0.0
-    for kind, rec in summary.items():
-        b = rec["bytes"]
-        if kind == "all-reduce":
-            t += 2.0 * b * (world - 1) / world
-        elif kind == "reduce-scatter":
-            t += b * (world - 1)
-        elif kind in ("all-gather", "all-to-all"):
-            t += b * (world - 1) / world
-        elif kind == "collective-permute":
-            t += b  # one hop
-    return t

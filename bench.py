@@ -137,6 +137,10 @@ _WATCHDOG_S = float(os.environ.get("APEX_TPU_BENCH_WATCHDOG_S", "900"))
 # Headline remat policy (dots | sums | full) — one read shared by the
 # main() fail-fast guard and bench_bert_lamb's default config.
 _BENCH_POLICY = os.environ.get("APEX_TPU_BENCH_POLICY", "dots")
+# --lint: run the apex_tpu.analysis passes (docs/analysis.md) over the
+# headline step's jaxpr + compiled HLO and emit the finding counts as a
+# metric line.  Env var so `--config all` subprocess wrappers inherit it.
+_BENCH_LINT = os.environ.get("APEX_TPU_BENCH_LINT", "") == "1"
 
 # Per-chip dense bf16 peak FLOP/s — ONE model shared with live
 # telemetry (apex_tpu.observability.meter), so bench artifacts and a
@@ -295,18 +299,46 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
 
     timed_fn = train_chunk
     hlo_out = os.environ.get("APEX_TPU_BENCH_HLO_OUT")
-    if hlo_out:
+    if hlo_out or _BENCH_LINT:
         # Compiled-HLO text of the headline step, for the trace↔source
         # join (tools/trace_summary.py TRACE --hlo FILE — the docs/mfu.md
         # lever-#2 copies attribution).  AOT lower().compile() does NOT
         # land in the jit dispatch cache (ADVICE r5), so dispatching
         # train_chunk afterwards would pay a SECOND full compile inside
         # a scarce tunnel window — time the compiled executable itself
-        # instead (same program, donation semantics preserved).
+        # instead (same program, donation semantics preserved).  --lint
+        # rides the same single compile: the analysis passes read the
+        # executable's text rather than paying their own.
         compiled = train_chunk.lower(params, opt_state).compile()
-        with open(hlo_out, "w") as f:
-            f.write(compiled.as_text())
+        if hlo_out:
+            with open(hlo_out, "w") as f:
+                f.write(compiled.as_text())
         timed_fn = compiled
+    if _BENCH_LINT:
+        from apex_tpu import analysis
+
+        donated = sum(
+            len(jax.tree_util.tree_leaves(a)) for a in (params, opt_state)
+        )
+        report = analysis.lint_hlo(
+            compiled.as_text(), donated=donated,
+            name="bert_lamb/train_chunk",
+        )
+        report.extend(analysis.lint_jaxpr(
+            jax.make_jaxpr(train_chunk)(params, opt_state),
+            name="bert_lamb/train_chunk",
+        ).findings)
+        analysis.publish_report(report)
+        print(report.render(), file=sys.stderr)
+        _emit(
+            "graph_lint_errors",
+            float(len(report.errors())),
+            "ERROR findings (bert_lamb step; warnings=%d, rules=%s; "
+            "docs/analysis.md)" % (
+                len(report.warnings()), ",".join(report.rule_ids()) or "-"
+            ),
+            None,
+        )
 
     profile = apex_tpu.utils.trace(trace_dir) if trace_dir else None
     step_time, carry, loss = _time_chunks(
@@ -920,9 +952,20 @@ if __name__ == "__main__":
         "(the observability sink schema, docs/observability.md) — "
         "stdout output is unchanged",
     )
+    ap.add_argument(
+        "--lint",
+        action="store_true",
+        help="run the apex_tpu.analysis graph-lint passes over the "
+        "headline step (transfer/donation via compiled HLO, callback "
+        "scan via jaxpr) and emit a graph_lint_errors metric line "
+        "(docs/analysis.md).  Equivalent to APEX_TPU_BENCH_LINT=1.",
+    )
     args = ap.parse_args()
     if args.hlo_out:
         os.environ["APEX_TPU_BENCH_HLO_OUT"] = args.hlo_out
+    if args.lint:
+        os.environ["APEX_TPU_BENCH_LINT"] = "1"
+        _BENCH_LINT = True
     if args.metrics_out:
         from apex_tpu.observability.export import JSONLSink
 

@@ -61,37 +61,28 @@ from apex_tpu.resilience import (
 )
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=100)
-    ap.add_argument("--dir", default="/tmp/apex_tpu_resilient_demo")
-    ap.add_argument("--save-every", type=int, default=10)
-    ap.add_argument("--accum", type=int, default=1,
-                    help="microbatches accumulated locally per optimizer "
-                    "step (one gradient sync on the boundary)")
-    ap.add_argument("--wire", default="f32",
-                    choices=["f32", "bf16", "int8"],
-                    help="wire format of the boundary gradient sync "
-                    "(docs/comm.md; tiny leaves stay on the exact psum)")
-    ap.add_argument("--metrics-out", default=None,
-                    help="JSONL telemetry path — turns on the full "
-                    "observability pipe (docs/observability.md)")
-    ap.add_argument("--fetch-every", type=int, default=8,
-                    help="device->host metric fetch cadence in steps")
-    ap.add_argument("--report-every", type=int, default=10,
-                    help="steps between JSONL telemetry reports")
-    args = ap.parse_args()
+def build_training(accum=1, wire="f32", fetch_every=8):
+    """Construct the example's full training program — mesh, toy data,
+    guarded/metered state, and the two jitted step functions.
 
+    Shared by :func:`main` and ``tools/graph_lint.py --target
+    resilient``: the CI lint gate audits EXACTLY the compiled programs
+    this example dispatches, not a lookalike.  Returns a dict with the
+    jitted ``compute_grads(params, scaler_state, batch)`` and
+    ``apply_update(scaled, state, loss)``, plus the pieces main() (or a
+    linter) needs to drive or trace them: ``state``, ``batch_fn``,
+    ``registry``, ``mesh``/``dp``/``rows``, and the raw
+    ``tx``/``scaler``/``guard``/``ddp``/``x_all``/``y_all``.
+    """
     mesh = ps.initialize_model_parallel()  # all devices -> dp axis
     dp = ps.get_data_parallel_world_size()
     micro = 64  # rows per microbatch, per replica
-    rows = micro * dp * args.accum  # rows consumed per optimizer step
+    rows = micro * dp * accum  # rows consumed per optimizer step
     if rows > 4096:  # the toy dataset below
         raise SystemExit(
-            f"--accum {args.accum} x dp={dp} needs {rows} rows per step "
+            f"--accum {accum} x dp={dp} needs {rows} rows per step "
             "but the toy dataset has 4096; lower --accum or the mesh size"
         )
-    print(f"devices: dp={dp}, accum={args.accum}, wire={args.wire}")
 
     rs = np.random.RandomState(0)
     x_all = jnp.asarray(rs.randn(4096, 8), jnp.float32)
@@ -116,7 +107,7 @@ def main():
     # the --metrics-out flag: a run interrupted without telemetry can
     # resume with it (and vice versa) on the same --dir.  Only the
     # reporting side — meter, goodput ledger, sinks — is gated.
-    registry = obs.MetricRegistry(fetch_every=args.fetch_every)
+    registry = obs.MetricRegistry(fetch_every=fetch_every)
     registry.gauge("train/loss", unit="mse")
     registry.counter("guard/skipped")
     for name in ("guard/found_inf", "guard/spike", "guard/grad_norm",
@@ -129,29 +120,15 @@ def main():
     # JSONL can never drift from guard/total_skips in state
     state["metrics"] = registry.init()
 
-    meter = goodput = reporter = None
-    if args.metrics_out:
-        n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
-        meter = obs.StepMeter(
-            tokens_per_step=rows,
-            flops_per_step=obs.transformer_train_flops(n_params, rows),
-        )
-        goodput = obs.GoodputAccountant()
-        reporter = obs.Reporter(
-            [obs.JSONLSink(args.metrics_out)],
-            registry=registry, meter=meter, goodput=goodput,
-        )
-    tracer = obs.TraceScheduler()  # armed by APEX_TPU_TRACE_STEPS, else no-op
-
     ddp = DistributedDataParallel(
         lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
-        wire=args.wire,
+        wire=wire,
     )
 
     def grads_fn(params, scaler_state, batch):
         # batch leaves: (accum, micro*dp, ...); microbatch grads stay
         # LOCAL inside the scan (no_sync), ONE engine sync at the end
-        if args.accum == 1:
+        if accum == 1:
             loss, grads = ddp.value_and_grad(
                 params, jax.tree_util.tree_map(lambda x: x[0], batch)
             )
@@ -174,7 +151,7 @@ def main():
     def batch_fn(step):
         span = x_all.shape[0] - rows  # 0 when one step eats the dataset
         lo = (step * rows) % span if span > 0 else 0
-        shape = (args.accum, micro * dp)
+        shape = (accum, micro * dp)
         return (
             x_all[lo: lo + rows].reshape(*shape, 8),
             y_all[lo: lo + rows].reshape(*shape, 4),
@@ -195,6 +172,63 @@ def main():
             **amp.DynamicLossScaler.metrics(s),
         })
         return new_state, verdict
+
+    return {
+        "mesh": mesh, "dp": dp, "micro": micro, "rows": rows,
+        "x_all": x_all, "y_all": y_all,
+        "state": state, "registry": registry,
+        "tx": tx, "scaler": scaler, "guard": guard, "ddp": ddp,
+        "compute_grads": compute_grads, "apply_update": apply_update,
+        "batch_fn": batch_fn,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--dir", default="/tmp/apex_tpu_resilient_demo")
+    ap.add_argument("--save-every", type=int, default=10)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="microbatches accumulated locally per optimizer "
+                    "step (one gradient sync on the boundary)")
+    ap.add_argument("--wire", default="f32",
+                    choices=["f32", "bf16", "int8"],
+                    help="wire format of the boundary gradient sync "
+                    "(docs/comm.md; tiny leaves stay on the exact psum)")
+    ap.add_argument("--metrics-out", default=None,
+                    help="JSONL telemetry path — turns on the full "
+                    "observability pipe (docs/observability.md)")
+    ap.add_argument("--fetch-every", type=int, default=8,
+                    help="device->host metric fetch cadence in steps")
+    ap.add_argument("--report-every", type=int, default=10,
+                    help="steps between JSONL telemetry reports")
+    args = ap.parse_args()
+
+    t = build_training(
+        accum=args.accum, wire=args.wire, fetch_every=args.fetch_every
+    )
+    dp, rows = t["dp"], t["rows"]
+    x_all, y_all = t["x_all"], t["y_all"]
+    state, registry = t["state"], t["registry"]
+    compute_grads, apply_update = t["compute_grads"], t["apply_update"]
+    batch_fn = t["batch_fn"]
+    print(f"devices: dp={dp}, accum={args.accum}, wire={args.wire}")
+
+    meter = goodput = reporter = None
+    if args.metrics_out:
+        n_params = sum(
+            p.size for p in jax.tree_util.tree_leaves(state["params"])
+        )
+        meter = obs.StepMeter(
+            tokens_per_step=rows,
+            flops_per_step=obs.transformer_train_flops(n_params, rows),
+        )
+        goodput = obs.GoodputAccountant()
+        reporter = obs.Reporter(
+            [obs.JSONLSink(args.metrics_out)],
+            registry=registry, meter=meter, goodput=goodput,
+        )
+    tracer = obs.TraceScheduler()  # armed by APEX_TPU_TRACE_STEPS, else no-op
 
     def step_fn(state, batch):
         step = int(state["guard"].step)

@@ -1,0 +1,426 @@
+"""Tests for the static-analysis subsystem (``apex_tpu/analysis/``).
+
+Each pass gets a known-bad fixture (planted host transfer, dropped
+donation, silent amp promotion, f64 literal, retrace, wrong collective
+count) asserted to produce EXACTLY the expected rule id, plus a
+clean-step fixture asserted to produce zero findings — the acceptance
+contract of ISSUE 4, and the same properties ``tools/graph_lint.py``
+gates in ``tools/verify_tier1.sh``.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import analysis
+from apex_tpu.analysis import hlo as hlo_lib
+
+
+# ---------------------------------------------------------------------------
+# transfer lint
+# ---------------------------------------------------------------------------
+
+
+def test_planted_debug_print_is_caught():
+    def step(x):
+        jax.debug.print("loss={x}", x=x.sum())
+        return x * 2.0
+
+    report = analysis.check(step, jnp.zeros((8,), jnp.float32))
+    assert "transfer-callback" in report.rule_ids()
+    # the callback also survives into compiled HLO as a custom-call
+    assert "transfer-hlo-host" in report.rule_ids()
+    assert not report.ok()
+
+
+def test_planted_pure_callback_is_caught():
+    def step(x):
+        y = jax.pure_callback(
+            lambda v: v * 2, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+        return y + 1.0
+
+    report = analysis.check(
+        step, jnp.zeros((4,), jnp.float32), rules=("transfer",)
+    )
+    assert "transfer-callback" in report.rule_ids()
+
+
+def test_callback_inside_scan_body_is_caught():
+    """A transfer buried in a scan body fires every iteration — the
+    recursive jaxpr walk must find it."""
+    def step(x):
+        def body(c, _):
+            jax.debug.print("c={c}", c=c[0])
+            return c + 1.0, None
+        out, _ = jax.lax.scan(body, x, None, length=4)
+        return out
+
+    report = analysis.check(
+        step, jnp.zeros((4,), jnp.float32), rules=("transfer",)
+    )
+    assert "transfer-callback" in report.rule_ids()
+
+
+# ---------------------------------------------------------------------------
+# promotion lint
+# ---------------------------------------------------------------------------
+
+
+def test_planted_silent_promotion_is_caught():
+    """bf16 activations meeting a NON-weak f32 constant silently widen
+    the whole downstream subgraph — the classic amp leak."""
+    def step(x):
+        return (x * jnp.float32(2.0)).sum()
+
+    report = analysis.check(
+        step, jnp.zeros((8,), jnp.bfloat16), policy=jnp.bfloat16
+    )
+    assert report.rule_ids() == ["promotion-widen"]
+
+
+def test_weak_literal_does_not_flag():
+    """A python-float literal is weakly typed: bf16 * 2.0 stays bf16 —
+    nothing to flag."""
+    def step(x):
+        return (x * 2.0).sum()
+
+    report = analysis.check(
+        step, jnp.zeros((8,), jnp.bfloat16), policy=jnp.bfloat16
+    )
+    assert report.findings == []
+
+
+def test_named_scope_marks_widening_intentional():
+    def step(x):
+        with jax.named_scope("f32_accum"):
+            acc = x.astype(jnp.float32)
+        return (acc * acc).sum()
+
+    report = analysis.check(
+        step, jnp.zeros((8,), jnp.bfloat16), policy=jnp.bfloat16
+    )
+    assert report.findings == []
+
+
+def test_reduction_upcast_idiom_is_exempt():
+    """jnp.sum on bf16 internally accumulates in f32 then narrows —
+    by-design precision, not a silent promotion."""
+    def step(x):
+        return jnp.sum(x)
+
+    report = analysis.check(
+        step, jnp.zeros((64,), jnp.bfloat16), policy=jnp.bfloat16
+    )
+    assert report.findings == []
+
+
+def test_planted_f64_is_caught():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        jaxpr = jax.make_jaxpr(
+            lambda x: x * jnp.float64(3.0)
+        )(jnp.zeros((4,), jnp.float64))
+    report = analysis.lint_jaxpr(jaxpr)
+    assert report.rule_ids() == ["promotion-f64"]
+    assert not report.ok()
+
+
+# ---------------------------------------------------------------------------
+# donation lint
+# ---------------------------------------------------------------------------
+
+
+def test_planted_dropped_donation_is_caught():
+    # both donated buffers are size-reduced away: no output matches,
+    # XLA cannot alias either one
+    def step(x, y):
+        return jnp.sum(x) + jnp.sum(y)
+
+    report = analysis.check(
+        step, jnp.zeros((64,), jnp.float32), jnp.ones((32,), jnp.float32),
+        donate_argnums=(0, 1),
+    )
+    assert report.rule_ids() == ["donation-dropped"]
+    finding = report.by_rule("donation-dropped")[0]
+    assert "2 of 2" in finding.message
+
+
+def test_clean_donation_passes():
+    def step(state):
+        return {k: v + 1.0 for k, v in state.items()}
+
+    state = {"w": jnp.zeros((16, 16)), "m": jnp.zeros((16, 16))}
+    report = analysis.check(step, state, donate_argnums=(0,))
+    assert report.findings == []
+
+
+def test_input_output_alias_parser():
+    header = (
+        "HloModule jit_f, is_scheduled=true, input_output_alias={ "
+        "{0}: (0, {}, may-alias), {1, 2}: (3, {}, must-alias) }, "
+        "entry_computation_layout={(f32[8]{0})->f32[8]{0}}"
+    )
+    aliases = hlo_lib.input_output_aliases(header)
+    assert aliases == [(0, "0"), (3, "1, 2")]
+    assert hlo_lib.input_output_aliases("HloModule jit_g") == []
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_flagged_on_shape_change():
+    s = analysis.RetraceSentinel()
+    assert s.observe(jnp.zeros((8,), jnp.float32)) is None
+    assert s.observe(jnp.zeros((8,), jnp.float32)) is None  # same sig
+    finding = s.observe(jnp.zeros((16,), jnp.float32))  # planted retrace
+    assert finding is not None and finding.rule == "retrace"
+    assert s.retraces == 1
+    assert "leaf 0" in finding.message
+
+
+def test_retrace_flagged_on_static_value_change():
+    s = analysis.RetraceSentinel()
+    assert s.observe(jnp.zeros((4,)), flag=True) is None
+    f = s.observe(jnp.zeros((4,)), flag=False)
+    assert f is not None and f.rule == "retrace"
+
+
+def test_retrace_allowed_budget():
+    s = analysis.RetraceSentinel(allowed=2)
+    assert s.observe(jnp.zeros((8,))) is None
+    assert s.observe(jnp.zeros((7,))) is None  # ragged tail, budgeted
+    assert s.observe(jnp.zeros((6,))) is not None
+
+
+def test_retrace_steady_state_never_flags():
+    s = analysis.RetraceSentinel()
+    for _ in range(10):
+        assert s.observe({"w": jnp.zeros((4, 4))}, jnp.zeros((4,))) is None
+    assert s.retraces == 0 and s.calls == 10
+
+
+# ---------------------------------------------------------------------------
+# collective consistency
+# ---------------------------------------------------------------------------
+
+_AR_HLO = """
+ENTRY %main {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %ar = f32[8,128]{1,0} all-reduce(%p0), replica_groups={{0,1}}
+  ROOT %out = f32[8,128]{1,0} add(%ar, %ar)
+}
+"""
+
+
+def test_planted_wrong_collective_count_is_caught():
+    report = analysis.lint_hlo(
+        _AR_HLO, expect_collectives={"all-reduce": 2}
+    )
+    assert report.rule_ids() == ["collective-count"]
+
+
+def test_collective_dtype_and_bytes_checks():
+    report = analysis.lint_hlo(
+        _AR_HLO,
+        expect_collectives={
+            "all-reduce": {"count": 1, "dtypes": ["s8"], "bytes": 17}
+        },
+    )
+    assert report.rule_ids() == ["collective-bytes", "collective-dtype"]
+    clean = analysis.lint_hlo(
+        _AR_HLO,
+        expect_collectives={
+            "all-reduce": {
+                "count": 1, "dtypes": ["f32"], "bytes": 8 * 128 * 4,
+            }
+        },
+    )
+    assert clean.findings == []
+
+
+def test_collective_count_live_on_mesh(eight_devices):
+    """End to end on a real compiled program: one psum over the
+    8-device mesh must be exactly one all-reduce."""
+    mesh = Mesh(eight_devices, ("dp",))
+
+    def step(x):
+        return jax.lax.psum(x, "dp")
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+            check_vma=False,
+        )
+    )
+    x = jnp.zeros((8, 16), jnp.float32)
+    ok = analysis.check(fn, x, expect_collectives={"all-reduce": 1})
+    assert ok.findings == []
+    bad = analysis.check(fn, x, expect_collectives={"all-reduce": 3})
+    assert bad.rule_ids() == ["collective-count"]
+
+
+# ---------------------------------------------------------------------------
+# host-transfer HLO scan
+# ---------------------------------------------------------------------------
+
+
+def test_host_transfer_ops_scan():
+    hlo = """
+ENTRY %main {
+  %tok = token[] after-all()
+  %in = ((f32[8]{0}), token[]) infeed(%tok)
+  %cc = () custom-call(s64[] %c, f32[8]{0} %x), custom_call_target="xla_python_cpu_callback", api_version=API_VERSION_STATUS_RETURNING
+  %send = (f32[8]{0}, u32[], token[]) send(%x, %tok), channel_id=1, is_host_transfer=true
+  %benign = f32[8]{0} custom-call(%x), custom_call_target="Sharding"
+}
+"""
+    found = hlo_lib.host_transfer_ops(hlo)
+    kinds = sorted(why for _name, why in found)
+    assert len(found) == 3
+    assert kinds[0] == "callback custom-call (xla_python_cpu_callback)"
+    assert "host send/recv" in kinds
+    assert "infeed" in kinds
+
+
+# ---------------------------------------------------------------------------
+# the clean-step fixture: a full guarded train step with zero findings
+# ---------------------------------------------------------------------------
+
+
+def test_clean_step_produces_zero_findings():
+    """A well-formed train step — donated state, policy-conformant
+    dtypes, no callbacks — must come back clean on every pass."""
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state, batch)
+        return (
+            {k: state[k] - 0.1 * grads[k] for k in state},
+            loss,
+        )
+
+    state = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    batch = (jnp.ones((16, 8)), jnp.ones((16, 4)))
+    report = analysis.check(
+        step, state, batch,
+        policy=jnp.float32, donate_argnums=(0,),
+        name="clean_step",
+    )
+    assert report.findings == [], report.render()
+    assert report.ok() and report.ok(fail_on="warning")
+
+
+# ---------------------------------------------------------------------------
+# report plumbing: JSON schema, catalog integrity, board publishing
+# ---------------------------------------------------------------------------
+
+
+def test_every_rule_is_cataloged_and_catalog_is_complete():
+    assert set(analysis.RULES) == {
+        "transfer-callback", "transfer-hlo-host",
+        "promotion-f64", "promotion-widen",
+        "donation-dropped", "retrace",
+        "collective-count", "collective-bytes", "collective-dtype",
+    }
+    for rule, (sev, desc, hint) in analysis.RULES.items():
+        assert sev in (analysis.ERROR, analysis.WARNING, analysis.INFO)
+        assert desc and hint
+    with pytest.raises(KeyError):
+        analysis.make_finding("not-a-rule", path="", message="")
+
+
+def test_report_json_roundtrip_and_severity_gate():
+    f1 = analysis.make_finding("promotion-widen", path="p", message="m")
+    f2 = analysis.make_finding("donation-dropped", path="q", message="n")
+    report = analysis.Report([f1, f2], target="t", rules_run=("promotion",))
+    blob = json.loads(report.to_json_line())
+    assert blob["target"] == "t"
+    assert blob["errors"] == 1 and blob["warnings"] == 1
+    assert blob["findings"][0]["rule"] == "promotion-widen"
+    assert not report.ok()  # one error
+    warn_only = analysis.Report([f1])
+    assert warn_only.ok()  # warnings pass the default gate
+    assert not warn_only.ok(fail_on="warning")
+
+
+def test_publish_report_rides_the_board():
+    from apex_tpu.observability.metrics import board
+
+    board.clear()
+    report = analysis.Report(
+        [analysis.make_finding("retrace", path="", message="x")],
+        target="pub",
+    )
+    analysis.publish_report(report)
+    snap = board.snapshot()
+    assert snap["analysis/errors"] == 1
+    assert snap["analysis/warnings"] == 0
+    assert snap["analysis/rule/retrace"] == 1
+    board.clear()
+
+
+def test_unknown_rule_selector_raises():
+    with pytest.raises(ValueError):
+        analysis.check(lambda x: x, jnp.zeros(()), rules=("bogus",))
+
+
+# ---------------------------------------------------------------------------
+# the lint passes on our own codebase (ISSUE 4 satellite: contrib/ops)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["layer_norm", "softmax", "xentropy",
+                                  "focal_loss", "group_norm"])
+def test_own_ops_are_promotion_clean_under_bf16(name):
+    """The promotion lint must pass on our own fused ops and contrib
+    stubs: their f32 accumulation regions are marked policy-exempt
+    (named scopes), so a bf16 policy sees zero findings."""
+    from apex_tpu import ops
+    from apex_tpu.contrib.focal_loss import sigmoid_focal_loss
+    from apex_tpu.contrib.group_norm import group_norm
+
+    bf = jnp.bfloat16
+    x = jnp.ones((4, 64), bf)
+    builders = {
+        "layer_norm": lambda: jax.make_jaxpr(
+            lambda x: jax.grad(
+                lambda xx: ops.fused_layer_norm_affine(
+                    xx, jnp.ones((64,), bf), jnp.zeros((64,), bf), 64
+                ).sum()
+            )(x).sum()
+        )(x),
+        "softmax": lambda: jax.make_jaxpr(
+            lambda s: jax.grad(
+                lambda ss: ops.scaled_masked_softmax(
+                    ss, ss > 2, 2.0
+                ).sum()
+            )(s).sum()
+        )(jnp.ones((2, 2, 8, 8), bf)),
+        "xentropy": lambda: jax.make_jaxpr(
+            lambda l: jax.grad(
+                lambda ll: ops.softmax_cross_entropy_loss(
+                    ll, jnp.zeros((8,), jnp.int32)
+                ).sum()
+            )(l).sum()
+        )(jnp.ones((8, 32), bf)),
+        "focal_loss": lambda: jax.make_jaxpr(
+            lambda l: sigmoid_focal_loss(l, jnp.zeros((4, 10), bf)).sum()
+        )(jnp.ones((4, 10), bf)),
+        "group_norm": lambda: jax.make_jaxpr(
+            lambda x: group_norm(x.reshape(4, 8, 8), 4).sum()
+        )(x),
+    }
+    report = analysis.lint_jaxpr(
+        builders[name](), policy=bf, name=f"ops/{name}"
+    )
+    assert report.findings == [], report.render()

@@ -1,5 +1,7 @@
-"""Unit tests for the comm-structure analysis passes (tools/
-comm_structure.py) — the parsers behind COMM_STRUCTURE_r{N}.json.
+"""Unit tests for the comm-structure analysis passes — the parsers
+behind COMM_STRUCTURE_r{N}.json, which live in the shared analysis core
+(``apex_tpu/analysis/hlo.py``) and are consumed by
+``tools/comm_structure.py``.
 
 These run on synthetic HLO text / pure arithmetic, so regressions in the
 artifact generator fail here rather than silently skewing the recorded
@@ -11,16 +13,20 @@ import sys
 
 import pytest
 
+from apex_tpu.analysis.hlo import (
+    collective_summary as collect,
+    overlap_collect,
+)
+
 # bare `pytest` puts tests/ (not the repo root) on sys.path; tools/ is a
-# plain directory, not an installed package
+# plain directory, not an installed package.  The balance/traffic models
+# (not regex parsers) still live with the artifact generator.
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
 from tools.comm_structure import (  # noqa: E402
-    collect,
     cp_ring_balance_model,
-    overlap_collect,
     ring_traffic_bytes,
 )
 

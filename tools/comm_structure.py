@@ -26,7 +26,6 @@ import argparse
 
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -40,122 +39,18 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
-# The HLO collective parser now lives with the gradient-sync engine
-# (apex_tpu/parallel/comm.py) so the library's regression tests and this
-# artifact generator read compiled HLO with ONE implementation; `collect`
-# keeps its name/contract here (per-kind {count, bytes}, async pairs
-# counted once at -start with the result element of the start tuple).
-from apex_tpu.parallel.comm import (  # noqa: E402
-    _async_start_result,
-    _shape_bytes,
+# The HLO parsers live with the static-analysis subsystem
+# (apex_tpu/analysis/hlo.py) so the library's regression tests, the
+# analysis passes, and this artifact generator read compiled HLO with
+# ONE implementation; `collect` and `overlap_collect` keep their
+# names/contracts here (per-kind {count, bytes} with async pairs
+# counted once at -start; schedule-overlap windows per VERDICT r4 #6).
+from apex_tpu.analysis.hlo import (  # noqa: E402
     collective_summary as collect,
+    overlap_collect,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-_COMPUTE_OP_RE = re.compile(
-    r"=\s*(?:\([^=]*\)|\S+)\s+(?:fusion|convolution|custom-call|dot)\("
-)
-
-
-def overlap_collect(hlo_text: str):
-    """Which collectives' windows overlap compute (VERDICT r4 #6).
-
-    The serial-bytes model (:func:`ring_traffic_bytes`) assumes every
-    collective blocks; XLA actually schedules collectives concurrently
-    with independent compute, so that number is an upper bound.  This
-    pass walks the optimized HLO in program order and measures each
-    collective's *window*:
-
-    * async ``-start``/``-done`` pairs (TPU-scheduled HLO): the window
-      is start→done; compute issued inside it is overlap the scheduler
-      already committed to.
-    * sync collectives (CPU HLO prints these even where the TPU backend
-      would go async): the window is the op→its first consumer; compute
-      ops strictly inside are provably independent of the result (they
-      issue before anything uses it), so an async backend can hide the
-      collective behind them — the *overlappable* fraction.
-
-    A collective is counted overlapped if ≥1 compute op (post-fusion:
-    ``fusion``/``dot``/``convolution``/``custom-call``) issues inside
-    its window.  Returns {"async_pairs", "async_bytes", "sync_count",
-    "sync_bytes", "overlapped_count", "overlapped_bytes"} where the
-    overlapped columns span both forms.
-    """
-    start_re = re.compile(
-        r"%?([\w.-]+)\s*=\s*"
-        r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
-        r"(?:all-reduce|all-gather|reduce-scatter|"
-        r"collective-permute|all-to-all)-start\("
-    )
-    done_re = re.compile(
-        r"(?:all-reduce|all-gather|reduce-scatter|"
-        r"collective-permute|all-to-all)-done\(\s*%?([\w.-]+)"
-    )
-    sync_re = re.compile(
-        r"%?([\w.-]+)\s*=\s*"
-        r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
-        r"(?:all-reduce|all-gather|reduce-scatter|"
-        r"collective-permute|all-to-all)\("
-    )
-    open_async = {}  # name -> [bytes, saw_compute]
-    open_sync = {}   # name -> [bytes, saw_compute]
-    out = {
-        "async_pairs": 0, "async_bytes": 0,
-        "sync_count": 0, "sync_bytes": 0,
-        "overlapped_count": 0, "overlapped_bytes": 0,
-    }
-
-    def _close(b, saw):
-        if saw:
-            out["overlapped_count"] += 1
-            out["overlapped_bytes"] += b
-
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        # close sync windows at their first consumer BEFORE counting
-        # this line's compute (compute at first-use is not overlap)
-        if open_sync:
-            rhs = line.split("=", 1)[1] if "=" in line else line
-            # sigil-optional, like the definition regexes above: HLO may
-            # print operand names with or without '%'
-            for name in [
-                n for n in open_sync
-                if re.search(
-                    r"(?<![\w.%-])%?" + re.escape(n) + r"(?![\w.-])", rhs
-                )
-            ]:
-                _close(*open_sync.pop(name))
-        m = start_re.search(line)
-        if m:
-            out["async_pairs"] += 1
-            b = _shape_bytes(_async_start_result(m.group(2)))
-            out["async_bytes"] += b
-            open_async[m.group(1)] = [b, False]
-            continue
-        m = done_re.search(line)
-        if m and m.group(1) in open_async:
-            _close(*open_async.pop(m.group(1)))
-            continue
-        m = sync_re.search(line)
-        if m:
-            out["sync_count"] += 1
-            b = _shape_bytes(m.group(2))
-            out["sync_bytes"] += b
-            open_sync[m.group(1)] = [b, False]
-            continue
-        if _COMPUTE_OP_RE.search(line):
-            for rec in open_async.values():
-                rec[1] = True
-            for rec in open_sync.values():
-                rec[1] = True
-    # windows that never closed in-text (result only consumed across a
-    # computation boundary / ROOT): their window extends to the end of
-    # the region, so trailing compute counts
-    for b, saw in list(open_async.values()) + list(open_sync.values()):
-        _close(b, saw)
-    return out
 
 
 def ring_traffic_bytes(kinds: dict, world: int) -> float:

@@ -1,0 +1,151 @@
+"""Graph lint CLI — run the apex_tpu.analysis passes over a step
+program and emit findings as text + a BENCH-style JSON artifact.
+
+Targets:
+
+  --target resilient   Build the resilient example's ACTUAL training
+                       step (examples/simple/resilient/train_resilient
+                       .py::build_training — the same compiled programs
+                       the example dispatches) and lint both jitted
+                       functions: compute_grads and apply_update.
+                       This is the tools/verify_tier1.sh gate: any
+                       ERROR finding fails CI.
+
+  --hlo FILE           Lint an optimized-HLO text dump (e.g. bench.py
+                       --hlo-out) with the HLO-level passes only.
+
+Options:
+
+  --wire / --accum     resilient-target knobs (forwarded to
+                       build_training, docs/comm.md)
+  --expect JSON        collective expectations, e.g.
+                       '{"all-to-all": {"count": 2, "dtypes": ["s8",
+                       "f32"]}}' (schema: analysis.passes
+                       .collective_pass)
+  --donated N          declared donated-leaf count for --hlo mode
+  --json FILE          write the full report as one JSON object
+  --fail-on LEVEL      exit 1 at this severity (default error)
+
+Exit code: 0 clean at --fail-on, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_resilient_module():
+    """Import the example script as a module (it lives outside the
+    package tree on purpose — it is user-facing sample code)."""
+    import importlib.util
+
+    path = os.path.join(
+        REPO, "examples", "simple", "resilient", "train_resilient.py"
+    )
+    spec = importlib.util.spec_from_file_location("train_resilient", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def lint_resilient(args):
+    """Check the resilient example's two jitted step functions.
+
+    ``compute_grads`` is traced on a real batch from the example's own
+    ``batch_fn``; ``apply_update`` on the abstract output shapes of
+    ``compute_grads`` (``jax.eval_shape`` — nothing executes, the lint
+    is fully static: trace + AOT compile only).
+    """
+    import jax
+
+    from apex_tpu import analysis
+
+    mod = _load_resilient_module()
+    t = mod.build_training(accum=args.accum, wire=args.wire)
+    state, batch = t["state"], t["batch_fn"](0)
+
+    grads_args = (state["params"], state["scaler"], batch)
+    report = analysis.check(
+        t["compute_grads"], *grads_args,
+        expect_collectives=args.expect,
+        name="resilient/compute_grads",
+    )
+
+    loss_shape, scaled_shape = jax.eval_shape(
+        t["compute_grads"], *grads_args
+    )
+    up = analysis.check(
+        t["apply_update"], scaled_shape, state, loss_shape,
+        name="resilient/apply_update",
+    )
+    report.extend(up.findings)
+    report.target = "resilient"
+    return report
+
+
+def lint_hlo_file(args):
+    from apex_tpu import analysis
+
+    with open(args.hlo) as f:
+        text = f.read()
+    return analysis.lint_hlo(
+        text,
+        donated=args.donated,
+        expect_collectives=args.expect,
+        name=os.path.basename(args.hlo),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="static graph lint over step programs "
+        "(rule catalog: docs/analysis.md)"
+    )
+    ap.add_argument("--target", choices=["resilient"], default=None)
+    ap.add_argument("--hlo", metavar="FILE", default=None,
+                    help="lint an optimized-HLO text dump instead of "
+                    "building a target")
+    ap.add_argument("--wire", default="f32",
+                    choices=["f32", "bf16", "int8"])
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--expect", type=json.loads, default=None,
+                    metavar="JSON", help="collective expectations")
+    ap.add_argument("--donated", type=int, default=None,
+                    help="declared donated-leaf count (--hlo mode)")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="write the report as one JSON object")
+    ap.add_argument("--fail-on", choices=["error", "warning"],
+                    default="error")
+    args = ap.parse_args()
+
+    if bool(args.target) == bool(args.hlo):
+        ap.error("exactly one of --target / --hlo is required")
+
+    report = lint_hlo_file(args) if args.hlo else lint_resilient(args)
+
+    # ride the observability board like every other subsystem, so a
+    # host process embedding this as a library sees the same gauges
+    from apex_tpu import analysis
+
+    analysis.publish_report(report)
+
+    print(report.render())
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=2)
+            f.write("\n")
+        print(f"[graph_lint] wrote {args.json}", file=sys.stderr)
+    return 0 if report.ok(fail_on=args.fail_on) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Repo lint — source-level complement of the graph linter.
+
+The graph linter (``apex_tpu/analysis/``, ``tools/graph_lint.py``)
+proves properties of TRACED programs; some defects are cheaper to
+catch at the source line, before anything traces:
+
+- ``time.time()`` / ``datetime.now`` in jitted-path packages: inside a
+  traced step these freeze at trace time (a constant baked into the
+  program), the classic "why does my timestamp never change" bug.
+  Host-side subsystems (observability, resilience, data, tools) are
+  exempt — wall clocks are their job.
+- ``float64`` literals in jitted paths: with x64 enabled they drag a
+  subgraph into emulated-f64 on TPU; with it disabled they lie about
+  precision.  (The graph linter's ``promotion-f64`` rule catches the
+  traced consequence; this catches the source.)
+- bare ``jax.device_get`` outside observability/export: a forced
+  device→host sync that serializes dispatch — telemetry must go
+  through the MetricRegistry's async fetch instead.
+
+A line carrying ``repo-lint: allow`` is waived (use sparingly, with a
+reason in the adjacent comment).  Run from anywhere::
+
+    python tools/repo_lint.py          # exit 1 on any violation
+
+Wired into tools/verify_tier1.sh (the analysis pass).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "apex_tpu")
+
+#: packages whose code runs (at least partly) inside traced steps —
+#: wall clocks and f64 literals are banned here.  Host-side subsystems
+#: (observability, resilience, checkpoint, data, _native, analysis,
+#: utils) are deliberately absent.
+JITTED_PATHS = (
+    "ops", "models", "optimizers", "parallel", "transformer", "amp",
+    "contrib", "mlp", "fused_dense", "RNN", "multi_tensor_apply",
+    "reparameterization", "fp16_utils", "normalization",
+)
+
+#: (regex, why, fix) applied only under JITTED_PATHS
+JITTED_RULES = (
+    (re.compile(r"\btime\.time\(\)"),
+     "wall clock in a jitted path freezes at trace time",
+     "hoist to the host loop or observability.MetricRegistry.timing"),
+    (re.compile(r"\bdatetime\.now\b"),
+     "wall clock in a jitted path freezes at trace time",
+     "hoist to the host loop"),
+    (re.compile(r"\bfloat64\b|\bjnp\.f64\b|\bnp\.f64\b"),
+     "f64 literal in a jitted path (emulated on TPU; see "
+     "analysis rule promotion-f64)",
+     "use float32 or the amp policy's compute dtype"),
+)
+
+#: (regex, why, fix, allowed path fragments) applied everywhere
+GLOBAL_RULES = (
+    (re.compile(r"\bjax\.device_get\b|\bjax\.device_get\("),
+     "bare jax.device_get forces a blocking device->host sync",
+     "fetch through observability.MetricRegistry (async, on a cadence)",
+     ("observability" + os.sep, "checkpoint" + os.sep)),
+)
+
+WAIVER = "repo-lint: allow"
+
+
+def _iter_sources():
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if fn.endswith(".py"):
+                yield os.path.join(root, fn)
+
+
+def lint() -> list:
+    violations = []
+    for path in _iter_sources():
+        rel = os.path.relpath(path, PKG)
+        top = rel.split(os.sep, 1)[0]
+        jitted = top in JITTED_PATHS
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                if WAIVER in line:
+                    continue
+                if jitted:
+                    for rx, why, fix in JITTED_RULES:
+                        if rx.search(line):
+                            violations.append(
+                                (rel, lineno, line.strip(), why, fix)
+                            )
+                for rx, why, fix, allowed in GLOBAL_RULES:
+                    if any(a in rel for a in allowed):
+                        continue
+                    if rx.search(line):
+                        violations.append(
+                            (rel, lineno, line.strip(), why, fix)
+                        )
+    return violations
+
+
+def main() -> int:
+    violations = lint()
+    if not violations:
+        print(f"repo lint: apex_tpu/ clean "
+              f"({len(list(_iter_sources()))} files)")
+        return 0
+    print(f"repo lint: {len(violations)} violation(s)")
+    for rel, lineno, text, why, fix in violations:
+        print(f"  apex_tpu/{rel}:{lineno}: {why}\n"
+              f"    {text}\n"
+              f"    fix: {fix}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,8 +21,15 @@
 # keys, and reflect the injected skip count EXACTLY (docs/observability.md).
 # Like the comm pass it hard-fails rather than silently skipping.
 #
+# A fourth stage is the static-analysis gate (docs/analysis.md):
+# tools/repo_lint.py greps apex_tpu/ for banned source patterns in
+# jitted paths, and tools/graph_lint.py builds the resilient example's
+# ACTUAL compiled step and runs the apex_tpu.analysis passes over its
+# jaxpr + optimized HLO — any ERROR-severity finding (host transfer,
+# dropped donation, f64, collective mismatch) hard-fails.
+#
 # Usage:
-#   tools/verify_tier1.sh              # full quick tier + comm + obs pass
+#   tools/verify_tier1.sh              # quick tier + comm + obs + lint
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -30,6 +37,7 @@
 #   T1_TIMEOUT  seconds         (default 870)
 #   T1_SKIP_COMM=1              skip the dedicated comm pass
 #   T1_SKIP_OBS=1               skip the observability pass
+#   T1_SKIP_LINT=1              skip the static-analysis pass
 
 set -o pipefail
 
@@ -117,11 +125,34 @@ PYEOF
     fi
 fi
 
-if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ]; then
+lint_rc=0
+if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
+    # source-level lint: banned patterns in jitted paths (fast, no jax)
+    python tools/repo_lint.py 2>&1 | tee -a "$LOG"
+    lint_rc=${PIPESTATUS[0]}
+    if [ "$lint_rc" -eq 0 ]; then
+        # graph lint: the resilient example's compiled step must carry
+        # zero ERROR findings (exit 1 otherwise — the acceptance gate)
+        LINT_JSON="${T1_LINT_JSON:-/tmp/_t1_graph_lint.json}"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            python tools/graph_lint.py --target resilient \
+            --json "$LINT_JSON" 2>&1 | tee -a "$LOG"
+        lint_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$lint_rc" -eq 0 ]; then
+        echo "TIER1-LINT: PASS"
+    else
+        echo "TIER1-LINT: FAIL (rc=$lint_rc; findings in ${LINT_JSON:-repo_lint output})"
+    fi
+fi
+
+if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
+    && [ "$lint_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, lint rc=$lint_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
-exit "$obs_rc"
+[ "$obs_rc" -ne 0 ] && exit "$obs_rc"
+exit "$lint_rc"
