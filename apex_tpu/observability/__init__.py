@@ -25,10 +25,42 @@ session over bench logs:
   :class:`~apex_tpu.observability.trace.TraceScheduler`: "profile
   steps N..N+K to this dir" via ``APEX_TPU_TRACE_STEPS``, no script
   edits.
+- :mod:`apex_tpu.observability.flight` —
+  :class:`~apex_tpu.observability.flight.FlightRecorder`: a ring
+  buffer of the last N steps' telemetry + event log, dumped
+  atomically to ``flight_<ts>.json`` on crash / skip-budget
+  exhaustion / SIGTERM (armed by ``APEX_TPU_FLIGHT=N[:DIR]`` or
+  ``run_resilient(flight=...)``); ``tools/flight_view.py`` renders
+  the postmortem.
+- :mod:`apex_tpu.observability.fleet` —
+  :class:`~apex_tpu.observability.fleet.FleetAggregator`: every
+  host's metric row gathered through ONE jitted collective on the
+  registry's cadence (no per-step host sync) into per-host columns
+  + min/median/max rollups on host 0's board.
+- :mod:`apex_tpu.observability.health` —
+  :class:`~apex_tpu.observability.health.Watchdog`: declarative
+  rules (straggler z-score, MFU/goodput floors, loss spike, NaN
+  rate, stale fetch, hung step) emitting structured
+  :class:`~apex_tpu.observability.health.HealthEvent` s to the
+  sinks/flight recorder, with ``on_unhealthy`` escalation (e.g.
+  arm a trace window — alert→profile in one run).
 
 See ``docs/observability.md`` for the full tour.
 """
 
+from apex_tpu.observability.fleet import (  # noqa: F401
+    FleetAggregator,
+    FleetView,
+)
+from apex_tpu.observability.flight import (  # noqa: F401
+    FlightRecorder,
+    parse_flight_spec,
+)
+from apex_tpu.observability.health import (  # noqa: F401
+    HealthEvent,
+    Watchdog,
+    default_rules,
+)
 from apex_tpu.observability.export import (  # noqa: F401
     CSVSink,
     JSONLSink,
@@ -65,6 +97,13 @@ __all__ = [
     "MetricRegistry",
     "Board",
     "board",
+    "FlightRecorder",
+    "parse_flight_spec",
+    "FleetAggregator",
+    "FleetView",
+    "Watchdog",
+    "HealthEvent",
+    "default_rules",
     "StepMeter",
     "GoodputAccountant",
     "chip_peak_flops",

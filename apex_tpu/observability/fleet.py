@@ -1,0 +1,284 @@
+"""Cross-host fleet aggregation — every host's telemetry on one board.
+
+On a multi-host topology each process owns its own
+:class:`~apex_tpu.observability.metrics.MetricRegistry` and
+:class:`~apex_tpu.observability.meter.StepMeter`: host 0's JSONL shows
+host 0's numbers, and the straggler dragging the pod runs invisibly on
+host 5.  :class:`FleetAggregator` folds every participant's metric row
+through ONE jitted all-gather (:func:`apex_tpu.parallel.comm
+.all_gather_rows` — the comm engine's collective, so it shows up in
+``collective_summary`` like any other wire traffic) into a
+``(hosts, n_metrics)`` matrix of **per-host columns**, then publishes
+min/median/max rollups on host 0's board.
+
+The cadence discipline matches the registry exactly — **no per-step
+host sync**:
+
+- ``observe(step, values)`` on an off-cadence step is one tuple
+  assignment (no device contact);
+- on the cadence (``every`` — align it with the registry's
+  ``fetch_every``) the newest row is placed on the mesh, the jitted
+  gather is *dispatched* (async), and the gather started one cadence
+  earlier is materialized — so the fleet view is at most
+  ``2 * every`` steps stale and the host never blocks between
+  cadences.
+
+Participants are the rows of the mesh axis: on a real pod each
+process's row rides its own devices
+(``jax.make_array_from_callback`` fills only addressable shards, so
+each host contributes its own values); on the single-process CPU test
+mesh every device carries the same host row, and tests inject skewed
+rows directly via :meth:`FleetAggregator.gather_rows` to simulate a
+straggling host.  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+
+__all__ = ["FleetView", "FleetAggregator"]
+
+
+class FleetView(NamedTuple):
+    """One materialized fleet snapshot: per-host columns + rollups.
+
+    ``host_ids`` labels each row with its real process index on a
+    multi-process fleet (rows are collapsed to one per host before the
+    view is built); None means row index == host label (the
+    single-process simulation, one row per mesh-axis participant).
+    """
+
+    step: int
+    names: Tuple[str, ...]
+    rows: Any  # np.ndarray (hosts, n_metrics)
+    host_ids: Optional[Tuple[int, ...]] = None
+
+    @property
+    def hosts(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def labels(self) -> Tuple[int, ...]:
+        """The host label of each row."""
+        if self.host_ids is not None:
+            return tuple(self.host_ids)
+        return tuple(range(self.hosts))
+
+    def per_host(self, name: str) -> List[float]:
+        """``name``'s value on every host (row order = :attr:`labels`)."""
+        i = self.names.index(name)
+        return [float(v) for v in self.rows[:, i]]
+
+    def rollup(self, name: str) -> Dict[str, float]:
+        vals = sorted(self.per_host(name))
+        return {
+            "min": vals[0],
+            "median": vals[len(vals) // 2],
+            "max": vals[-1],
+        }
+
+    def as_dict(self) -> Dict[str, Any]:
+        """Board-shaped flat dict: ``fleet/<name>/host<i>`` columns +
+        ``fleet/<name>/{min,median,max}`` rollups."""
+        out: Dict[str, Any] = {"fleet/step": self.step}
+        labels = self.labels
+        for name in self.names:
+            vals = self.per_host(name)
+            for label, v in zip(labels, vals):
+                out[f"fleet/{name}/host{label}"] = v
+            roll = self.rollup(name)
+            for k, v in roll.items():
+                out[f"fleet/{name}/{k}"] = v
+        return out
+
+
+class FleetAggregator:
+    """Gather each participant's metric row into per-host columns.
+
+    >>> agg = FleetAggregator(("train/step_time_ms", "train/mfu"),
+    ...                       every=32)
+    >>> # per step, on the host (cheap off-cadence):
+    >>> agg.observe(step, {**registry.values(), **meter.summary()})
+    >>> view = agg.view()           # latest materialized FleetView
+    >>> view.per_host("train/step_time_ms")
+
+    ``names`` fixes the row layout (every host must declare the same
+    names in the same order — they are SPMD programs of one job).
+    Missing values observe as NaN, which survives the gather and reads
+    back as "this host had no measurement".
+    """
+
+    def __init__(
+        self,
+        names,
+        *,
+        mesh=None,
+        axis: str = ps.DATA_PARALLEL_AXIS,
+        every: int = 32,
+        publish: bool = True,
+    ):
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.names = tuple(names)
+        if not self.names:
+            raise ValueError("need at least one metric name")
+        self.n = len(self.names)
+        self.mesh = mesh if mesh is not None else ps.get_mesh()
+        self.axis = axis
+        self.world = self.mesh.shape[axis]
+        self.every = every
+        self.publish = publish
+        self._sharding = NamedSharding(self.mesh, P(axis))
+        self._row_host = self._axis_row_hosts()
+        self._gather = self._build_gather()
+        self._pending: Optional[Tuple[int, Dict[str, float]]] = None
+        self._inflight: Optional[Tuple[int, Any]] = None
+        self._view: Optional[FleetView] = None
+
+    def _axis_row_hosts(self) -> List[int]:
+        """The owning process of each position along the axis — the map
+        that collapses per-device rows into per-host columns on a real
+        pod (each host's row rides ALL its devices on the axis, so the
+        raw gather duplicates it ``devices_per_host`` times; scoring
+        duplicated rows would dilute the straggler z-score and label
+        device indices as hosts)."""
+        try:
+            axes = list(self.mesh.axis_names)
+            devs = np.moveaxis(
+                np.asarray(self.mesh.devices), axes.index(self.axis), 0
+            ).reshape(self.world, -1)
+            return [int(d.process_index) for d in devs[:, 0]]
+        except Exception:
+            return list(range(self.world))
+
+    # -- the collective ----------------------------------------------------
+    def _build_gather(self):
+        from apex_tpu.parallel import comm
+
+        axis = self.axis
+
+        def inner(local):  # (1, n) — this participant's row
+            return comm.all_gather_rows(local[0], axis)
+
+        fn = jax.shard_map(
+            inner, mesh=self.mesh, in_specs=P(axis), out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def _place_rows(self, row: np.ndarray):
+        """A ``(world, n)`` array sharded one row per participant, each
+        process filling only ITS addressable shards with ITS row —
+        single- and multi-process uniformly."""
+
+        def fill(index):
+            rows = len(range(*index[0].indices(self.world)))
+            return np.ascontiguousarray(
+                np.broadcast_to(row, (rows, self.n))
+            )
+
+        return jax.make_array_from_callback(
+            (self.world, self.n), self._sharding, fill
+        )
+
+    def gather_rows(self, rows) -> np.ndarray:
+        """Run the jitted gather on a prepared ``(world, n)`` matrix and
+        block for the result — the synchronous path tests (and offline
+        analysis) use to inject per-host skew; production goes through
+        :meth:`observe`'s async double buffer."""
+        rows = np.asarray(rows, np.float32)
+        if rows.shape != (self.world, self.n):
+            raise ValueError(
+                f"rows must be ({self.world}, {self.n}), got {rows.shape}"
+            )
+        placed = jax.device_put(rows, self._sharding)
+        return np.asarray(self._gather(placed))
+
+    # -- cadence / double buffer ------------------------------------------
+    def observe(self, step: int, values: Mapping[str, Any]) -> None:
+        """Stash this step's host-local values; gather on the cadence.
+
+        Off-cadence: one tuple assignment.  On-cadence: dispatch the
+        gather (async) and materialize the previous one.
+        """
+        self._pending = (int(step), dict(values))
+        if step % self.every == 0:
+            self._rotate()
+
+    def _row(self, values: Mapping[str, Any]) -> np.ndarray:
+        return np.asarray(
+            [float(values.get(name, float("nan"))) for name in self.names],
+            np.float32,
+        )
+
+    def _rotate(self) -> None:
+        if self._inflight is not None:
+            self._materialize(self._inflight)
+            self._inflight = None
+        if self._pending is not None:
+            step, values = self._pending
+            self._pending = None
+            result = self._gather(self._place_rows(self._row(values)))
+            copy = getattr(result, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+            self._inflight = (step, result)
+
+    def _materialize(self, stash) -> None:
+        step, result = stash
+        self._view = self._collapse(step, np.asarray(result))
+        self._publish(self._view)
+
+    def _collapse(self, step: int, rows: np.ndarray) -> FleetView:
+        """One row per HOST.  Single-process (every row owned by
+        process 0 — the test/simulation topology where each device
+        stands in for a host) keeps the raw per-participant rows;
+        multi-process keeps the first row of each owning process and
+        labels rows with real process indices, so straggler events
+        name hosts and ``fleet/*/host<i>`` columns mean host ``i``."""
+        distinct = sorted(set(self._row_host))
+        if len(distinct) <= 1:
+            return FleetView(step, self.names, rows)
+        first_row = {}
+        for j, host in enumerate(self._row_host):
+            first_row.setdefault(host, j)
+        keep = [first_row[h] for h in distinct]
+        return FleetView(step, self.names, rows[keep], tuple(distinct))
+
+    def _publish(self, view: FleetView) -> None:
+        """Columns + rollups onto the board — host 0 only (the host
+        whose Reporter feeds the job-level JSONL/dashboard)."""
+        if not self.publish:
+            return
+        from apex_tpu.parallel import multihost
+
+        if multihost.host_id() != 0:
+            return
+        from apex_tpu.observability.metrics import board
+
+        for key, value in view.as_dict().items():
+            board.set(key, value)
+
+    def view(self) -> Optional[FleetView]:
+        """Latest materialized fleet view (no device contact; at most
+        ``2 * every`` steps stale), or None before the first cadence."""
+        return self._view
+
+    def fetch(self) -> Optional[FleetView]:
+        """Force-drain both buffers (blocks) — shutdown/dump path."""
+        if self._inflight is not None:
+            self._materialize(self._inflight)
+            self._inflight = None
+        if self._pending is not None:
+            step, values = self._pending
+            self._pending = None
+            result = self._gather(self._place_rows(self._row(values)))
+            self._materialize((step, result))
+        return self._view

@@ -1,0 +1,315 @@
+"""Flight recorder — crash forensics for training runs.
+
+When a run dies — NaN budget exhausted, preemption mid-rollback, a hung
+collective — the telemetry that explains *why* usually dies with it:
+the :class:`~apex_tpu.observability.metrics.MetricRegistry` values live
+in process memory and the JSONL reporter only writes on its cadence.
+:class:`FlightRecorder` is the black box: a bounded ring of the last
+``capacity`` steps' host-side telemetry (fetched metrics, skip flags,
+step times) plus an event log (rollbacks, resumes, retries, preemption,
+health events), dumped **atomically** to ``flight_<ts>.json`` when the
+run ends badly.
+
+Armed three ways:
+
+- **explicitly** — construct one, attach sources, pass it to
+  :func:`apex_tpu.resilience.run_resilient` via ``flight=`` (the
+  resilient example does this; ``--flight N[:DIR]``);
+- **by env** — ``APEX_TPU_FLIGHT=N[:DIR]`` arms a recorder inside any
+  ``run_resilient`` loop with no code changes (the
+  :class:`~apex_tpu.observability.trace.TraceScheduler` pattern);
+- **standalone** — ``bench.py --flight`` records every emitted metric
+  line and dumps on an unhandled exception.
+
+``run_resilient`` dumps on unhandled exceptions (which covers
+skip-budget exhaustion — the ``max_rollbacks`` ``RuntimeError``) and on
+SIGTERM/preemption.  The dump drains the registry's async fetch
+buffers first (:meth:`MetricRegistry.close` — best-effort, never
+raises), so the final frame carries the guard/scaler state *at death*,
+not one fetch cadence earlier.
+
+Recording is host-side only: a frame copies the registry's cached
+values (a dict copy — no device contact) and never forces a device
+sync.  Rollback replays that rewind the step counter are recorded
+as-is with a ``replay`` mark — the ring keeps both passes, ordered by
+a monotonic ``seq``, which is exactly what a postmortem wants to see
+(``tools/flight_view.py`` renders the timeline).
+
+See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "ENV_FLIGHT",
+    "DEFAULT_FLIGHT_DIR",
+    "DEFAULT_CAPACITY",
+    "parse_flight_spec",
+    "FlightRecorder",
+]
+
+ENV_FLIGHT = "APEX_TPU_FLIGHT"
+DEFAULT_FLIGHT_DIR = "/tmp/apex_tpu_flight"
+DEFAULT_CAPACITY = 64
+
+
+def parse_flight_spec(spec: str) -> Tuple[int, Optional[str]]:
+    """``(capacity, dir_override)`` from an ``APEX_TPU_FLIGHT`` value.
+
+    Accepted: ``"N"`` (ring of N steps) optionally followed by
+    ``:DIR``; ``"0"`` means disabled (callers treat it as unarmed).
+    """
+    spec = spec.strip()
+    dir_override = None
+    if ":" in spec:
+        head, dir_override = spec.split(":", 1)
+        spec, dir_override = head.strip(), dir_override.strip()
+    try:
+        capacity = int(spec)
+    except ValueError:
+        raise ValueError(
+            f"bad {ENV_FLIGHT} spec {spec!r}; want 'N' or 'N:DIR'"
+        )
+    if capacity < 0:
+        raise ValueError(f"flight capacity must be >= 0, got {capacity}")
+    return capacity, dir_override
+
+
+def _json_safe(value):
+    """Make ``value`` JSON-serializable without destroying forensics:
+    non-finite floats become the strings ``"NaN"`` / ``"Infinity"`` /
+    ``"-Infinity"`` (a NaN loss IS the evidence — ``null`` would erase
+    it, a bare NaN token is invalid JSON)."""
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "Infinity" if value > 0 else "-Infinity"
+        return value
+    if isinstance(value, dict):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    if isinstance(value, (str, int, bool)) or value is None:
+        return value
+    try:
+        return _json_safe(float(value))
+    except Exception:
+        return repr(value)
+
+
+class FlightRecorder:
+    """Ring buffer of recent telemetry + event log, dumped on failure.
+
+    Implements the ``run_resilient`` observer protocol (``on_step`` /
+    ``on_rollback`` / ``on_resume`` / ``on_preempt`` / ``on_retry``),
+    so arming it is just adding it to the observer fan-out — the runner
+    does that automatically when ``flight=`` is given or
+    ``APEX_TPU_FLIGHT`` is set.
+
+    ``registry`` / ``meter`` / ``goodput`` enrich frames and the dump;
+    attach them late via :meth:`attach` when the recorder is created
+    before the training program (the env-armed path).
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        directory: Optional[str] = None,
+        *,
+        registry=None,
+        meter=None,
+        goodput=None,
+        include_board: bool = True,
+        run: Optional[Mapping[str, Any]] = None,
+        _clock=time.time,
+    ):
+        if capacity < 1:
+            raise ValueError("flight capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.directory = directory or os.environ.get(
+            ENV_FLIGHT + "_DIR", DEFAULT_FLIGHT_DIR
+        )
+        self.registry = registry
+        self.meter = meter
+        self.goodput = goodput
+        self.include_board = include_board
+        self.run = dict(run or {})
+        self._clock = _clock
+        self._frames: collections.deque = collections.deque(maxlen=capacity)
+        # events are rarer than frames but must survive longer — a
+        # rollback 200 steps ago still explains a dump; bound anyway
+        self._events: collections.deque = collections.deque(
+            maxlen=max(4 * capacity, 256)
+        )
+        self._seq = 0
+        self._prev_step: Optional[int] = None
+        self.dumps: List[str] = []
+
+    @classmethod
+    def from_env(cls, spec: Optional[str] = None, **kwargs):
+        """A recorder armed by ``APEX_TPU_FLIGHT=N[:DIR]``, or ``None``
+        when the env is unset/empty/``0`` (the unarmed no-op path)."""
+        spec = spec if spec is not None else os.environ.get(ENV_FLIGHT)
+        if not spec:
+            return None
+        capacity, dir_override = parse_flight_spec(spec)
+        if capacity == 0:
+            return None
+        if dir_override:
+            kwargs["directory"] = dir_override
+        return cls(capacity, **kwargs)
+
+    def attach(self, *, registry=None, meter=None, goodput=None) -> None:
+        """Late-bind telemetry sources (env-armed recorders exist before
+        the training program does)."""
+        if registry is not None:
+            self.registry = registry
+        if meter is not None:
+            self.meter = meter
+        if goodput is not None:
+            self.goodput = goodput
+
+    # -- recording ---------------------------------------------------------
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq - 1
+
+    def on_step(self, step: int, skipped: bool = False, info=None) -> None:
+        """Record one step frame — host-side dict copies only, never a
+        device sync (the registry's cached values may be a cadence
+        stale; :meth:`dump` drains the fresh ones)."""
+        step = int(step)
+        frame: Dict[str, Any] = {
+            "seq": self._next_seq(),
+            "step": step,
+            "t": self._clock(),
+            "skipped": bool(skipped),
+        }
+        if self._prev_step is not None and step <= self._prev_step:
+            # a rollback replay rewound the counter: keep recording —
+            # both passes are evidence — but mark the frame so the
+            # timeline renders the rewind instead of hiding it
+            frame["replay"] = True
+        self._prev_step = step
+        if self.registry is not None:
+            frame["metrics"] = self.registry.values()
+            frame["fetched_step"] = self.registry.fetched_step
+        if self.meter is not None:
+            frame["step_time_ms"] = self.meter.step_time * 1e3
+        self._frames.append(frame)
+
+    def note(self, kind: str, **data) -> None:
+        """Append an event (rollback, retry, health, ...) to the log."""
+        self._events.append(
+            {"seq": self._next_seq(), "t": self._clock(), "kind": kind,
+             **data}
+        )
+
+    # observer protocol (events)
+    def on_rollback(
+        self, step: int, anchor: int, skips: int = 0,
+        discarded: Optional[int] = None,
+    ) -> None:
+        self.note(
+            "rollback", step=int(step), anchor=int(anchor),
+            skips=int(skips),
+            discarded=None if discarded is None else int(discarded),
+        )
+        # the replay restarts below the anchor; reset the rewind marker
+        # baseline so the FIRST replayed frame carries the replay mark
+        # relative to the pre-rollback position (kept as-is: on_step
+        # compares against the real previous step)
+
+    def on_resume(self, step: int) -> None:
+        self.note("resume", step=int(step))
+
+    def on_preempt(self, step: int) -> None:
+        self.note("preempt", step=int(step))
+
+    def on_retry(self, what: str = "", attempt: int = 0, error=None) -> None:
+        self.note(
+            "retry", what=str(what), attempt=int(attempt),
+            error=None if error is None else f"{type(error).__name__}: {error}",
+        )
+
+    def note_health(self, event) -> None:
+        """Record a :class:`apex_tpu.observability.health.HealthEvent`."""
+        self.note(
+            "health", rule=event.rule, severity=event.severity,
+            step=int(event.step), value=event.value,
+            threshold=event.threshold, message=event.message,
+            host=event.host,
+        )
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def frames(self) -> List[Dict[str, Any]]:
+        return list(self._frames)
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    # -- the dump ----------------------------------------------------------
+    def dump(self, reason: str, directory: Optional[str] = None) -> str:
+        """Write the black box to ``flight_<ts>.json`` atomically
+        (tmp + ``os.replace`` — a reader never sees a torn file) and
+        return the path.
+
+        Drains the registry's async buffers first via
+        :meth:`MetricRegistry.close` (best-effort — a poisoned device
+        buffer must not lose the dump) and appends a ``final`` frame
+        with the freshest values, so the last state the dump shows is
+        the state at death, not one fetch cadence earlier.
+        """
+        final: Dict[str, Any] = {"t": self._clock()}
+        if self.registry is not None:
+            final["metrics"] = self.registry.close()
+            final["fetched_step"] = self.registry.fetched_step
+        if self.meter is not None:
+            final["meter"] = self.meter.summary()
+        host = {"id": 0, "count": 1}
+        try:
+            from apex_tpu.parallel import multihost
+
+            host = {"id": multihost.host_id(), "count": multihost.host_count()}
+        except Exception:
+            pass
+        payload: Dict[str, Any] = {
+            "version": 1,
+            "reason": str(reason),
+            "wall_time": self._clock(),
+            "host": host,
+            "capacity": self.capacity,
+            "run": self.run,
+            "frames": self.frames,
+            "final": final,
+            "events": self.events,
+        }
+        if self.goodput is not None:
+            payload["goodput"] = self.goodput.snapshot()
+        if self.include_board:
+            from apex_tpu.observability.metrics import board
+
+            payload["board"] = board.snapshot()
+        directory = directory or self.directory
+        os.makedirs(directory, exist_ok=True)
+        ts = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(
+            directory, f"flight_{ts}_{os.getpid()}_{self._seq}.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(payload), f, indent=1, allow_nan=False)
+            f.write("\n")
+        os.replace(tmp, path)
+        self.dumps.append(path)
+        return path

@@ -1,0 +1,546 @@
+"""Fleet health watchdog — declarative rules over live telemetry.
+
+A production fleet does not fail loudly: it gets *slow* (one straggling
+host gates every collective), *wasteful* (goodput decays as a flaky
+guard skips), or *stale* (a hung collective stops the metric fetch
+pipeline while the process looks alive).  :class:`Watchdog` rides the
+``run_resilient`` observer protocol and evaluates a small declarative
+rule set on a check cadence:
+
+========================  =================================================
+rule                      fires when
+========================  =================================================
+:class:`StragglerRule`    a host's step time z-scores above the fleet
+                          (needs a :class:`~apex_tpu.observability.fleet.
+                          FleetAggregator` view)
+:class:`MFUFloorRule`     the live MFU sinks under a floor after warmup
+:class:`GoodputFloorRule` the goodput fraction sinks under a floor
+:class:`LossSpikeRule`    the fetched loss goes non-finite (critical) or
+                          spikes over ``factor`` x its own EMA
+:class:`NaNRateRule`      the skip rate over a sliding window exceeds a
+                          budget (a NaN *storm*, not one bad batch)
+:class:`StaleFetchRule`   the registry's fetched values fall further
+                          behind the live step than the cadence explains
+:class:`HungStepRule`     a step interval exceeds a wall-clock deadline
+                          (a hung/slow collective that eventually
+                          completed); :meth:`Watchdog.poll` covers the
+                          still-hung case from an external thread
+========================  =================================================
+
+Every firing emits a structured :class:`HealthEvent` to: the watchdog's
+``events`` ledger, the observability board (``health/<rule>``), the
+Reporter sinks (bench-schema lines with ``severity``/``message``/
+``host`` extras), the flight recorder's event log, and the
+``on_unhealthy`` callback — which is the escalation hook: pass a
+callback that arms a :class:`~apex_tpu.observability.trace.
+TraceScheduler` window and an alert turns into an on-chip profile in
+the same run.  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+import warnings
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+__all__ = [
+    "HealthEvent",
+    "Rule",
+    "StragglerRule",
+    "MFUFloorRule",
+    "GoodputFloorRule",
+    "LossSpikeRule",
+    "NaNRateRule",
+    "StaleFetchRule",
+    "HungStepRule",
+    "default_rules",
+    "Watchdog",
+]
+
+
+class HealthEvent(NamedTuple):
+    """One structured health finding."""
+
+    rule: str  # e.g. "straggler", "mfu_floor"
+    severity: str  # "warn" | "critical"
+    step: int
+    value: float  # the measurement that tripped the rule
+    threshold: float  # what it was compared against
+    message: str
+    host: Optional[int] = None  # per-host rules name the offender
+
+    def as_record(self) -> Dict[str, Any]:
+        """Extras for a bench-schema Reporter line."""
+        rec = {
+            "severity": self.severity,
+            "threshold": self.threshold,
+            "message": self.message,
+        }
+        if self.host is not None:
+            rec["host"] = self.host
+        return rec
+
+
+class Rule:
+    """Base: named check + a repeat cooldown (steps) so a persistent
+    condition emits on a heartbeat, not every check."""
+
+    name = "rule"
+    severity = "warn"
+
+    def __init__(self, cooldown: int = 64):
+        self.cooldown = cooldown
+        self._last_fired: Optional[int] = None
+
+    def check(self, wd: "Watchdog", step: int) -> List[HealthEvent]:
+        if (
+            self._last_fired is not None
+            and step - self._last_fired < self.cooldown
+        ):
+            return []
+        events = self.evaluate(wd, step)
+        if events:
+            self._last_fired = step
+        return events
+
+    def evaluate(self, wd: "Watchdog", step: int) -> List[HealthEvent]:
+        raise NotImplementedError
+
+    def _event(self, step, value, threshold, message, host=None):
+        return [
+            HealthEvent(
+                self.name, self.severity, int(step), float(value),
+                float(threshold), message, host,
+            )
+        ]
+
+
+class StragglerRule(Rule):
+    """A host whose step time z-scores above the rest of the fleet.
+
+    Leave-one-out: each host is scored against the mean/std of the
+    OTHER hosts — a pooled std would let one extreme outlier inflate
+    the denominator and hide itself (one 4x straggler among 8 hosts
+    pools to z≈2.7).  ``std`` is floored at ``rel_floor * mean`` so a
+    fleet in lockstep (std ~ 0) does not turn micro-jitter into
+    alerts.
+    """
+
+    name = "straggler"
+
+    def __init__(self, zmax: float = 3.0, key: str = "train/step_time_ms",
+                 rel_floor: float = 0.05, min_hosts: int = 2,
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.zmax = zmax
+        self.key = key
+        self.rel_floor = rel_floor
+        self.min_hosts = min_hosts
+
+    def evaluate(self, wd, step):
+        view = wd.fleet_view
+        if view is None or view.hosts < self.min_hosts:
+            return []
+        if self.key not in view.names:
+            return []
+        vals = view.per_host(self.key)
+        labels = view.labels
+        events = []
+        for row, v in enumerate(vals):
+            if v != v:
+                continue
+            others = [o for j, o in enumerate(vals) if j != row and o == o]
+            if len(others) < self.min_hosts - 1:
+                continue
+            mean = sum(others) / len(others)
+            var = sum((o - mean) ** 2 for o in others) / len(others)
+            std = max(var ** 0.5, self.rel_floor * abs(mean), 1e-12)
+            z = (v - mean) / std
+            if z > self.zmax:
+                host = labels[row]
+                events.extend(
+                    self._event(
+                        step, v, mean + self.zmax * std,
+                        f"host {host} straggling: {self.key}={v:.3f} "
+                        f"(fleet mean {mean:.3f}, z={z:.1f})",
+                        host=host,
+                    )
+                )
+        return events
+
+
+class MFUFloorRule(Rule):
+    """Live MFU under a floor once the meter window has warmed up."""
+
+    name = "mfu_floor"
+
+    def __init__(self, floor: float = 0.05, warmup_steps: int = 16,
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.floor = floor
+        self.warmup_steps = warmup_steps
+
+    def evaluate(self, wd, step):
+        meter = wd.meter
+        if meter is None or meter.flops_per_step <= 0:
+            return []
+        if meter.steps < self.warmup_steps:
+            return []
+        mfu = meter.mfu
+        if 0.0 < mfu < self.floor:
+            return self._event(
+                step, mfu, self.floor,
+                f"MFU {mfu:.4f} under floor {self.floor:.4f}",
+            )
+        return []
+
+
+class GoodputFloorRule(Rule):
+    """Productive fraction under a floor after enough executed steps."""
+
+    name = "goodput_floor"
+
+    def __init__(self, floor: float = 0.5, min_executed: int = 20,
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.floor = floor
+        self.min_executed = min_executed
+
+    def evaluate(self, wd, step):
+        acct = wd.goodput
+        if acct is None or acct.executed < self.min_executed:
+            return []
+        g = acct.goodput()
+        if g < self.floor:
+            return self._event(
+                step, g, self.floor,
+                f"goodput {g:.3f} under floor {self.floor:.3f} "
+                f"(skipped={acct.skipped}, discarded={acct.discarded})",
+            )
+        return []
+
+
+class LossSpikeRule(Rule):
+    """Fetched loss non-finite (critical) or > ``factor`` x its EMA.
+
+    The EMA folds each *newly fetched* loss value (tracked via the
+    registry's ``fetched_step``), so the stale reads between cadences
+    neither re-trigger nor re-teach the baseline.
+    """
+
+    name = "loss_spike"
+
+    def __init__(self, key: str = "train/loss", factor: float = 10.0,
+                 ema_beta: float = 0.9, warmup_fetches: int = 3,
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.key = key
+        self.factor = factor
+        self.ema_beta = ema_beta
+        self.warmup_fetches = warmup_fetches
+        self._ema: Optional[float] = None
+        self._fetches = 0
+        self._last_fetched: Optional[int] = None
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        fetched = reg.fetched_step
+        if fetched is None or fetched == self._last_fetched:
+            return []
+        value = reg.values().get(self.key)
+        if value is None:
+            return []
+        self._last_fetched = fetched
+        if value != value or value in (float("inf"), float("-inf")):
+            return [
+                HealthEvent(
+                    self.name, "critical", int(step), float("nan"),
+                    0.0, f"{self.key} non-finite at fetch {fetched}",
+                )
+            ]
+        events = []
+        if (
+            self._ema is not None
+            and self._fetches >= self.warmup_fetches
+            and value > self.factor * self._ema
+        ):
+            events = self._event(
+                step, value, self.factor * self._ema,
+                f"{self.key}={value:.4g} spiked over {self.factor}x "
+                f"EMA {self._ema:.4g}",
+            )
+            # a spike must not re-teach the baseline
+            return events
+        self._ema = (
+            value if self._ema is None
+            else self.ema_beta * self._ema + (1 - self.ema_beta) * value
+        )
+        self._fetches += 1
+        return events
+
+
+class NaNRateRule(Rule):
+    """Skip *rate* over a sliding window — a storm, not one bad batch."""
+
+    name = "nan_rate"
+
+    def __init__(self, max_rate: float = 0.25, window: int = 16,
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.max_rate = max_rate
+        self.window = window
+
+    def evaluate(self, wd, step):
+        skips = wd.skip_window
+        if len(skips) < self.window:
+            return []
+        recent = list(skips)[-self.window:]
+        rate = sum(recent) / len(recent)
+        if rate > self.max_rate:
+            return self._event(
+                step, rate, self.max_rate,
+                f"skip rate {rate:.2f} over last {self.window} steps "
+                f"exceeds {self.max_rate:.2f}",
+            )
+        return []
+
+
+class StaleFetchRule(Rule):
+    """The metric fetch pipeline wedged: fetched values lag the live
+    step beyond what the double-buffered cadence explains (default
+    budget: ``4 * fetch_every``)."""
+
+    name = "stale_fetch"
+
+    def __init__(self, max_age_steps: Optional[int] = None,
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.max_age_steps = max_age_steps
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        budget = (
+            self.max_age_steps
+            if self.max_age_steps is not None
+            else 4 * reg.fetch_every
+        )
+        fetched = reg.fetched_step
+        age = step - (fetched if fetched is not None else wd.first_step)
+        if age > budget:
+            return self._event(
+                step, age, budget,
+                f"metric fetch {age} steps stale (budget {budget}; "
+                f"fetched_step={fetched})",
+            )
+        return []
+
+
+class HungStepRule(Rule):
+    """A step interval blew through a wall-clock deadline — the shape
+    of a hung collective or a wedged host that eventually recovered.
+    For a step that never completes, call :meth:`Watchdog.poll` from
+    outside the loop (another thread, a signal handler)."""
+
+    name = "hung_step"
+    severity = "critical"
+
+    def __init__(self, deadline_s: float = 300.0, cooldown: int = 1):
+        super().__init__(cooldown)
+        self.deadline_s = deadline_s
+
+    def evaluate(self, wd, step):
+        dt = wd.last_step_seconds
+        if dt is not None and dt > self.deadline_s:
+            return self._event(
+                step, dt, self.deadline_s,
+                f"step took {dt:.1f}s (deadline {self.deadline_s:.0f}s)",
+            )
+        return []
+
+
+def default_rules(**overrides) -> List[Rule]:
+    """The standard rule set; keyword args override a rule's kwargs by
+    name, e.g. ``default_rules(straggler={"zmax": 2.5})``."""
+    specs = {
+        "straggler": StragglerRule,
+        "mfu_floor": MFUFloorRule,
+        "goodput_floor": GoodputFloorRule,
+        "loss_spike": LossSpikeRule,
+        "nan_rate": NaNRateRule,
+        "stale_fetch": StaleFetchRule,
+        "hung_step": HungStepRule,
+    }
+    unknown = set(overrides) - set(specs)
+    if unknown:
+        raise ValueError(f"unknown health rules: {sorted(unknown)}")
+    return [cls(**overrides.get(name, {})) for name, cls in specs.items()]
+
+
+class Watchdog:
+    """Evaluate health rules on a cadence; emit structured events.
+
+    Implements the ``run_resilient`` observer protocol, so wiring is
+    one entry in the observer fan-out::
+
+        wd = Watchdog(registry=reg, meter=meter, goodput=acct,
+                      reporter=reporter, flight=recorder,
+                      on_unhealthy=lambda ev: tracer.arm(ev.step + 1, 3))
+        run_resilient(..., observer=ObserverFanout([acct, wd]))
+
+    A broken rule must not kill training: rule exceptions are caught,
+    warned once per rule, and the rule is disabled for the run.
+    """
+
+    def __init__(
+        self,
+        rules: Optional[List[Rule]] = None,
+        *,
+        registry=None,
+        meter=None,
+        goodput=None,
+        fleet=None,
+        reporter=None,
+        flight=None,
+        on_unhealthy: Optional[Callable[[HealthEvent], Any]] = None,
+        check_every: int = 8,
+        window: int = 64,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.registry = registry
+        self.meter = meter
+        self.goodput = goodput
+        self.fleet = fleet
+        self.reporter = reporter
+        self.flight = flight
+        self.on_unhealthy = on_unhealthy
+        self.check_every = check_every
+        self.events: List[HealthEvent] = []
+        self.skip_window: collections.deque = collections.deque(
+            maxlen=window
+        )
+        self.first_step = 0
+        self._seen_step = False
+        self._step = 0
+        self._clock = clock
+        self._last_tick: Optional[float] = None
+        self.last_step_seconds: Optional[float] = None
+        self._broken: set = set()
+
+    @property
+    def fleet_view(self):
+        return self.fleet.view() if self.fleet is not None else None
+
+    # -- observer protocol -------------------------------------------------
+    def on_step(self, step: int, skipped: bool = False, info=None) -> None:
+        step = int(step)
+        if not self._seen_step:
+            self.first_step = step
+            self._seen_step = True
+        self._step = step
+        now = self._clock()
+        if self._last_tick is not None:
+            self.last_step_seconds = now - self._last_tick
+        self._last_tick = now
+        self.skip_window.append(bool(skipped))
+        if step % self.check_every == 0:
+            self.check(step)
+
+    def on_rollback(self, step, anchor, skips=0, discarded=None) -> None:
+        # the replay re-executes the window; a stale skip history would
+        # double-count the streak the rollback just handled
+        self.skip_window.clear()
+
+    def on_resume(self, step: int) -> None:
+        self.first_step = int(step)
+
+    # -- evaluation --------------------------------------------------------
+    def check(self, step: Optional[int] = None) -> List[HealthEvent]:
+        """Run every rule now; returns (and emits) new events."""
+        step = self._step if step is None else int(step)
+        fired: List[HealthEvent] = []
+        for rule in self.rules:
+            if rule.name in self._broken:
+                continue
+            try:
+                fired.extend(rule.check(self, step))
+            except Exception as e:  # a telemetry bug must not kill training
+                self._broken.add(rule.name)
+                warnings.warn(
+                    f"health rule {rule.name!r} raised "
+                    f"{type(e).__name__}: {e} — disabled for this run",
+                    RuntimeWarning,
+                )
+        for event in fired:
+            self._emit(event)
+        return fired
+
+    def poll(self) -> List[HealthEvent]:
+        """External deadline check — call from a monitor thread or a
+        dump path to catch a step that is hung *right now* (the in-loop
+        rules only see completed intervals).
+
+        Honors the rule's cooldown and broken-set exactly like
+        :meth:`check`: the step counter does not advance during a hang,
+        so a once-per-second monitor loop emits ONE event per hung
+        step, not one per poll.
+        """
+        fired: List[HealthEvent] = []
+        if self._last_tick is not None:
+            waiting = self._clock() - self._last_tick
+            for rule in self.rules:
+                if not isinstance(rule, HungStepRule):
+                    continue
+                if rule.name in self._broken:
+                    continue
+                if (
+                    rule._last_fired is not None
+                    and self._step - rule._last_fired < rule.cooldown
+                ):
+                    continue
+                if waiting > rule.deadline_s:
+                    rule._last_fired = self._step
+                    fired.extend(
+                        rule._event(
+                            self._step, waiting, rule.deadline_s,
+                            f"step {self._step + 1} hung for "
+                            f"{waiting:.1f}s (deadline "
+                            f"{rule.deadline_s:.0f}s)",
+                        )
+                    )
+        for event in fired:
+            self._emit(event)
+        return fired
+
+    # -- emission ----------------------------------------------------------
+    def _emit(self, event: HealthEvent) -> None:
+        self.events.append(event)
+        from apex_tpu.observability.metrics import board
+
+        board.set(f"health/{event.rule}", event.value)
+        if self.reporter is not None:
+            from apex_tpu.observability.export import bench_record
+
+            rec = bench_record(
+                f"health/{event.rule}", event.value, "", None,
+                step=event.step, **event.as_record(),
+            )
+            for sink in self.reporter.sinks:
+                sink.write(rec)
+        if self.flight is not None:
+            self.flight.note_health(event)
+        if self.on_unhealthy is not None:
+            try:
+                self.on_unhealthy(event)
+            except Exception as e:
+                warnings.warn(
+                    f"on_unhealthy callback raised {type(e).__name__}: {e}",
+                    RuntimeWarning,
+                )

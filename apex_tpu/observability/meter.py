@@ -25,7 +25,7 @@ capacity dashboard wants.  See ``docs/observability.md``.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 __all__ = [
     "PEAK_BF16_FLOPS",
@@ -230,6 +230,25 @@ class GoodputAccountant:
         if self.executed == 0:
             return 1.0
         return self.productive / self.executed
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The full ledger as plain values — monotonic event counts +
+        the derived fractions.  The stable read API for consumers that
+        would otherwise reach into fields (the flight recorder's dump,
+        fleet aggregation rows, the resilient example's final goodput
+        line): one place to keep key names honest."""
+        return {
+            "accepted": self.accepted,
+            "skipped": self.skipped,
+            "discarded": self.discarded,
+            "rollbacks": self.rollbacks,
+            "retries": self.retries,
+            "resumes": self.resumes,
+            "preempted": self.preempted,
+            "executed": self.executed,
+            "productive": self.productive,
+            "goodput": self.goodput(),
+        }
 
     def summary(self) -> Dict[str, float]:
         return {

@@ -179,13 +179,47 @@ class MetricRegistry:
 
     def fetch(self) -> Dict[str, float]:
         """Force-drain both buffers (blocks) and return the values —
-        call at checkpoints / shutdown, not per step."""
-        if self._inflight is not None:
-            self._materialize(self._inflight)
-            self._inflight = None
-        if self._pending is not None:
-            self._materialize(self._pending)
-            self._pending = None
+        call at checkpoints / shutdown, not per step.
+
+        The pending stash (the NEWEST observed state) is flushed in a
+        ``finally``: even when materializing the in-flight copy raises
+        (a device buffer poisoned by the failure being debugged), the
+        newest values still land — the flight recorder's last frame
+        must never be one cadence stale because an OLDER fetch died.
+        """
+        inflight, self._inflight = self._inflight, None
+        pending, self._pending = self._pending, None
+        try:
+            if inflight is not None:
+                self._materialize(inflight)
+        finally:
+            if pending is not None:
+                self._materialize(pending)
+        return dict(self._values)
+
+    def close(self) -> Dict[str, float]:
+        """Best-effort drain for exception paths: like :meth:`fetch`
+        but NEVER raises — per-value failures keep the previous value
+        so a partially poisoned state still yields its healthy scalars
+        (the dump path of :class:`~apex_tpu.observability.flight.
+        FlightRecorder` relies on this)."""
+        for stash in (self._inflight, self._pending):
+            if stash is None:
+                continue
+            step, state = stash
+            landed = False
+            for name, v in state.items():
+                try:
+                    self._values[name] = float(v)
+                    landed = True
+                except Exception:
+                    pass
+            # only claim the stash's freshness if something from it
+            # actually materialized — a fully poisoned stash must not
+            # stamp cadence-old values with the crash step in the dump
+            if landed:
+                self._fetched_step = step
+        self._inflight = self._pending = None
         return dict(self._values)
 
     def values(self) -> Dict[str, float]:

@@ -199,6 +199,30 @@ class TraceScheduler:
         """True when a window is configured and not yet captured."""
         return self.start is not None and not self._done
 
+    def arm(self, start: int, length: int = 1,
+            base_dir: Optional[str] = None) -> None:
+        """(Re-)arm a window of ``length`` steps from ``start`` at
+        runtime — the escalation hook a health ``on_unhealthy``
+        callback uses to turn an alert into an on-chip profile in the
+        same run (``docs/observability.md``).  An in-flight capture is
+        closed first; a window already armed for a *future* start is
+        left alone (first alert wins — re-arming per repeated alert
+        would keep pushing the window out of reach)."""
+        if length < 1:
+            raise ValueError(f"window length must be >= 1, got {length}")
+        if self.active and (
+            self._prev_step is None or self.start > self._prev_step
+        ):
+            return
+        if self._tracing:
+            self._stop_fn()
+            self._tracing = False
+        self.start, self.end = int(start), int(start) + length - 1
+        if base_dir is not None:
+            self.base_dir = base_dir
+        self.log_dir = window_dir(self.base_dir, self.start, self.end)
+        self._done = False
+
     @property
     def tracing(self) -> bool:
         return self._tracing

@@ -69,6 +69,7 @@ __all__ = [
     "sync_gradients",
     "reduce_scatter_flat",
     "all_gather_flat",
+    "all_gather_rows",
     "resolve_chunks",
     "chunks_requested",
     "wire_bytes_per_element",
@@ -360,6 +361,38 @@ def all_gather_flat(
             parts.append(_decode(g, wire, block, hi - lo))  # (world, cs)
     full = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
     return full.reshape(-1)
+
+
+def all_gather_rows(
+    row,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    *,
+    wire: str = "f32",
+):
+    """All-gather each participant's metrics row into a ``(world, n)``
+    f32 matrix — the fleet-aggregation collective
+    (:class:`apex_tpu.observability.fleet.FleetAggregator`).
+
+    Call inside ``shard_map`` with one ``(n,)`` row per participant on
+    ``axis_name``; every participant gets the identical matrix back
+    (row ``j`` = participant ``j``'s values).  One collective per call
+    — telemetry rows are tiny (tens of floats), so chunking would be
+    pure launch overhead — riding the same engine as the gradient
+    path, so it shows in ``collective_summary`` and the board gauges
+    (``comm/fleet/*``) like any other wire traffic.
+    """
+    check_wire(wire)
+    world = _compat.axis_size(axis_name)
+    flat = row.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    _publish_stats(
+        "comm/fleet", wire=wire, world=world, elements=world * n,
+        collectives=1,
+        wire_bytes=int(world * n * wire_bytes_per_element(wire)),
+    )
+    with jax.named_scope("comm_fleet_rows"):
+        full = all_gather_flat(flat, axis_name, wire=wire, chunks=1)
+    return full.reshape(world, n)
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,8 @@ __all__ = [
     "finalize_distributed",
     "cluster_env_hints",
     "host_barrier",
+    "host_id",
+    "host_count",
 ]
 
 _INITIALIZED = False
@@ -191,6 +193,30 @@ def host_barrier(tag: str, step: int = 0) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(tag)
+
+
+def host_id() -> int:
+    """This process's rank in the host fleet (``jax.process_index``;
+    0 in a single-process run).
+
+    The label the observability layer stamps on everything host-scoped:
+    fleet-aggregation rows, flight-recorder dumps, straggler health
+    events.  Safe on a torn-down runtime — a dying process writing its
+    flight dump must not crash on the label — degrading to 0.
+    """
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def host_count() -> int:
+    """Number of host processes in the fleet (1 single-process; same
+    degradation contract as :func:`host_id`)."""
+    try:
+        return int(jax.process_count())
+    except Exception:
+        return 1
 
 
 def finalize_distributed() -> None:

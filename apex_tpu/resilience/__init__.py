@@ -35,6 +35,7 @@ from apex_tpu.resilience.retry import (  # noqa: F401
     robust_initialize_distributed,
 )
 from apex_tpu.resilience.runner import (  # noqa: F401
+    ObserverFanout,
     PreemptionHandler,
     ResilientCheckpointManager,
     RunResult,
@@ -53,6 +54,7 @@ __all__ = [
     "remove_retry_listener",
     "retry_call",
     "robust_initialize_distributed",
+    "ObserverFanout",
     "PreemptionHandler",
     "ResilientCheckpointManager",
     "RunResult",

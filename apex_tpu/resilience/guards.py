@@ -98,12 +98,21 @@ class GradGuard:
         return state.consecutive_skips >= self.max_consecutive_skips
 
 
-def guard_metrics(verdict: GuardVerdict, state: GuardState) -> dict:
+def guard_metrics(
+    verdict: GuardVerdict, state: GuardState, guard: "GradGuard" = None
+) -> dict:
     """The guard's device scalars, keyed for a
     :class:`apex_tpu.observability.MetricRegistry` (declare
     ``guard/skipped`` as a counter and the rest as gauges; feed the
-    result to ``registry.update`` INSIDE the jitted step)."""
-    return {
+    result to ``registry.update`` INSIDE the jitted step).
+
+    Pass the :class:`GradGuard` itself to also get
+    ``guard/budget_left`` — consecutive skips remaining before the
+    rollback budget trips.  That is the countdown a flight-recorder
+    frame needs to show HOW CLOSE to exhaustion the run was at death,
+    not just that it skipped (``docs/observability.md``).
+    """
+    out = {
         "guard/skipped": verdict.skipped,
         "guard/found_inf": verdict.found_inf,
         "guard/spike": verdict.spike,
@@ -112,6 +121,11 @@ def guard_metrics(verdict: GuardVerdict, state: GuardState) -> dict:
         "guard/consecutive_skips": state.consecutive_skips,
         "guard/total_skips": state.total_skips,
     }
+    if guard is not None:
+        out["guard/budget_left"] = jnp.maximum(
+            guard.max_consecutive_skips - state.consecutive_skips, 0
+        )
+    return out
 
 
 def guarded_amp_update(

@@ -54,6 +54,7 @@ from apex_tpu.resilience.retry import RetryPolicy, retry_call
 __all__ = [
     "PreemptionHandler",
     "ResilientCheckpointManager",
+    "ObserverFanout",
     "RunResult",
     "run_resilient",
 ]
@@ -190,6 +191,59 @@ class RunResult(NamedTuple):
     preempted: bool  # stopped early on SIGTERM
 
 
+class ObserverFanout:
+    """Compose several ``run_resilient`` observers into one.
+
+    Each event forwards to every child that implements it, in order;
+    observer errors propagate (the same contract as a single observer —
+    a telemetry bug must not silently corrupt the ledgers).  ``None``
+    entries are dropped so call sites can write
+    ``ObserverFanout([goodput, watchdog, maybe_none])``.
+    """
+
+    def __init__(self, observers):
+        self.observers = [o for o in observers if o is not None]
+
+    def _fan(self, event: str, *args) -> None:
+        for o in self.observers:
+            fn = getattr(o, event, None)
+            if fn is not None:
+                fn(*args)
+
+    def on_step(self, *args) -> None:
+        self._fan("on_step", *args)
+
+    def on_rollback(self, *args) -> None:
+        self._fan("on_rollback", *args)
+
+    def on_resume(self, *args) -> None:
+        self._fan("on_resume", *args)
+
+    def on_preempt(self, *args) -> None:
+        self._fan("on_preempt", *args)
+
+    def on_retry(self, *args, **kwargs) -> None:
+        for o in self.observers:
+            fn = getattr(o, "on_retry", None)
+            if fn is not None:
+                fn(*args, **kwargs)
+
+
+def _safe_dump(flight, reason: str) -> None:
+    """Write the flight dump without masking the failure being dumped."""
+    try:
+        path = flight.dump(reason)
+        print(f"[flight] black box written: {path}", flush=True)
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"flight dump failed ({type(e).__name__}: {e}) — "
+            "continuing with the original failure",
+            RuntimeWarning,
+        )
+
+
 def _notify(observer, event: str, *args) -> None:
     """Invoke ``observer.<event>(*args)`` if present.  Observer errors
     propagate — a telemetry bug must not silently corrupt the ledger it
@@ -226,6 +280,7 @@ def run_resilient(
     policy: Optional[RetryPolicy] = None,
     signals=(signal.SIGTERM,),
     observer: Any = None,
+    flight: Any = None,
 ) -> RunResult:
     """Drive ``step_fn`` for ``num_steps`` with auto-resume, preemption
     handling, checkpoint retries, and skip-budget rollback.
@@ -240,21 +295,45 @@ def run_resilient(
     skip cause (a permanently bad batch, not transient state corruption)
     would replay-and-skip forever; after ``max_rollbacks`` rollbacks the
     loop raises instead of livelocking.
+
+    ``flight`` arms a :class:`apex_tpu.observability.flight.
+    FlightRecorder` as crash forensics: it joins the observer fan-out
+    (frames per step, events per rollback/resume/retry/preempt) and its
+    black box is dumped on any unhandled exception — which includes the
+    skip-budget ``RuntimeError`` above — and on SIGTERM/preemption.
+    When ``flight`` is None, ``APEX_TPU_FLIGHT=N[:DIR]`` arms one from
+    the environment with no code changes (no sources attached: frames
+    then carry steps/skips/events only).
     """
+    if flight is None:
+        from apex_tpu.observability.flight import FlightRecorder
+
+        flight = FlightRecorder.from_env()
+    if flight is not None:
+        observer = ObserverFanout([observer, flight])
     on_retry = getattr(observer, "on_retry", None)
     if on_retry is not None:
         _retry.add_retry_listener(on_retry)
     try:
-        return _run_resilient_inner(
+        result = _run_resilient_inner(
             step_fn, init_state, batch_fn, directory=directory,
             num_steps=num_steps, save_interval_steps=save_interval_steps,
             max_to_keep=max_to_keep, rollback_after=rollback_after,
             max_rollbacks=max_rollbacks, policy=policy, signals=signals,
             observer=observer,
         )
+    except BaseException as e:
+        # BaseException on purpose: KeyboardInterrupt / SystemExit are
+        # exactly the deaths a black box exists for
+        if flight is not None:
+            _safe_dump(flight, f"{type(e).__name__}: {e}")
+        raise
     finally:
         if on_retry is not None:
             _retry.remove_retry_listener(on_retry)
+    if flight is not None and result.preempted:
+        _safe_dump(flight, f"preemption (SIGTERM) at step {result.last_step}")
+    return result
 
 
 def _run_resilient_inner(

@@ -155,6 +155,12 @@ from apex_tpu.observability.meter import (  # noqa: E402
 # the same records for trajectory diffing.
 _METRICS_SINK = None
 
+# Optional flight recorder (--flight / APEX_TPU_FLIGHT): every emitted
+# metric line lands in its event log, and an unhandled exception dumps
+# the black box — the crash forensics for a bench that dies over a
+# flaky tunnel mid-config (docs/observability.md).
+_FLIGHT = None
+
 
 def _emit(metric, value, unit, vs_baseline, degenerate=False):
     """``degenerate=True`` marks a multi-device config that ran with only
@@ -171,6 +177,8 @@ def _emit(metric, value, unit, vs_baseline, degenerate=False):
     print(json.dumps(rec), flush=True)
     if _METRICS_SINK is not None:
         _METRICS_SINK.write(rec)
+    if _FLIGHT is not None:
+        _FLIGHT.note("bench_metric", **rec)
 
 
 def _time_chunks(fn, carry, chunk, trials, profile=None, reduce="median"):
@@ -953,6 +961,15 @@ if __name__ == "__main__":
         "stdout output is unchanged",
     )
     ap.add_argument(
+        "--flight",
+        metavar="N[:DIR]",
+        default=None,
+        help="arm a flight recorder: keep the last N emitted metric "
+        "lines and dump flight_<ts>.json on an unhandled exception "
+        "(crash forensics, docs/observability.md).  Equivalent to "
+        "APEX_TPU_FLIGHT=N[:DIR].",
+    )
+    ap.add_argument(
         "--lint",
         action="store_true",
         help="run the apex_tpu.analysis graph-lint passes over the "
@@ -970,8 +987,23 @@ if __name__ == "__main__":
         from apex_tpu.observability.export import JSONLSink
 
         _METRICS_SINK = JSONLSink(args.metrics_out)
+    from apex_tpu.observability.flight import FlightRecorder
+
+    _FLIGHT = FlightRecorder.from_env(
+        args.flight, run={"bench": args.config}
+    ) if args.flight else FlightRecorder.from_env(
+        run={"bench": args.config}
+    )
     try:
         main(config=args.config, trace_dir=args.trace)
+    except BaseException as e:
+        if _FLIGHT is not None:
+            from apex_tpu.resilience.runner import _safe_dump
+
+            # guarded: a failing dump (full disk, bad dir) must not
+            # demote the crash being debugged to "During handling..."
+            _safe_dump(_FLIGHT, f"{type(e).__name__}: {e}")
+        raise
     finally:
         if _METRICS_SINK is not None:
             _METRICS_SINK.close()

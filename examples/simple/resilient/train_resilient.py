@@ -29,9 +29,23 @@ on a cadence, a ``StepMeter`` adds wall-clock step time / tokens/s /
 MFU, a ``GoodputAccountant`` rides the ``run_resilient`` observer
 events, and everything lands in the bench-schema JSONL.  The final
 ``train/goodput`` line carries the exact skip/rollback/retry counts of
-the run, so a chaos drill is checkable from the artifact alone.
-``APEX_TPU_TRACE_STEPS="N+K"`` arms a profile window of steps N..N+K-1
-with no further flags.
+the run (``GoodputAccountant.snapshot()``), so a chaos drill is
+checkable from the artifact alone.  ``APEX_TPU_TRACE_STEPS="N+K"`` arms
+a profile window of steps N..N+K-1 with no further flags.
+
+Crash forensics and health monitoring are ON BY DEFAULT:
+
+- a ``FlightRecorder`` (``--flight N[:DIR]``, default ring of 64 into
+  ``<--dir>/flight/``; ``--flight 0`` disables) keeps the last steps'
+  guard/scaler/loss state and dumps ``flight_<ts>.json`` atomically
+  when the run dies — skip-budget exhaustion, an unhandled exception,
+  SIGTERM.  Render it with ``tools/flight_view.py``.
+- a health ``Watchdog`` (``--no-health`` disables) evaluates the
+  default rule set (goodput/MFU floors, loss spikes, NaN-storm rate,
+  stale fetches, hung steps — plus per-host stragglers when a
+  multi-device mesh feeds the fleet aggregator) and prints each
+  ``HealthEvent``, mirrors it into the flight recorder and — with
+  ``--metrics-out`` — the JSONL.
 """
 
 import argparse
@@ -54,6 +68,7 @@ from apex_tpu.optimizers import fused_adam
 from apex_tpu.parallel import DistributedDataParallel
 from apex_tpu.resilience import (
     GradGuard,
+    ObserverFanout,
     chaos,
     guard_metrics,
     guarded_amp_update,
@@ -112,8 +127,9 @@ def build_training(accum=1, wire="f32", fetch_every=8):
     registry.counter("guard/skipped")
     for name in ("guard/found_inf", "guard/spike", "guard/grad_norm",
                  "guard/norm_ema", "guard/consecutive_skips",
-                 "guard/total_skips", "amp/loss_scale",
-                 "amp/growth_tracker", "amp/hysteresis"):
+                 "guard/total_skips", "guard/budget_left",
+                 "amp/loss_scale", "amp/growth_tracker",
+                 "amp/hysteresis"):
         registry.gauge(name)
     # the metric state CHECKPOINTS with the model: a rollback that
     # replays steps also rewinds the counters, so guard/skipped in the
@@ -168,7 +184,7 @@ def build_training(accum=1, wire="f32", fetch_every=8):
         # sync — the registry fetches on its own cadence
         new_state["metrics"] = registry.update(state["metrics"], {
             "train/loss": loss,
-            **guard_metrics(verdict, g),
+            **guard_metrics(verdict, g, guard),
             **amp.DynamicLossScaler.metrics(s),
         })
         return new_state, verdict
@@ -202,6 +218,14 @@ def main():
                     help="device->host metric fetch cadence in steps")
     ap.add_argument("--report-every", type=int, default=10,
                     help="steps between JSONL telemetry reports")
+    ap.add_argument("--flight", default=None, metavar="N[:DIR]",
+                    help="flight-recorder ring size (+ optional dump "
+                    "dir; default 64 into <--dir>/flight; 0 disables; "
+                    "APEX_TPU_FLIGHT overrides)")
+    ap.add_argument("--no-health", action="store_true",
+                    help="disable the health watchdog (on by default: "
+                    "goodput/MFU floors, loss spike, NaN rate, stale "
+                    "fetch, hung step, straggler)")
     args = ap.parse_args()
 
     t = build_training(
@@ -214,21 +238,60 @@ def main():
     batch_fn = t["batch_fn"]
     print(f"devices: dp={dp}, accum={args.accum}, wire={args.wire}")
 
-    meter = goodput = reporter = None
+    # meter + goodput ledger run unconditionally (cheap, host-side) so
+    # the flight recorder and watchdog see them with or without a JSONL
+    # reporter; only the report fan-out is gated on --metrics-out
+    n_params = sum(
+        p.size for p in jax.tree_util.tree_leaves(state["params"])
+    )
+    meter = obs.StepMeter(
+        tokens_per_step=rows,
+        flops_per_step=obs.transformer_train_flops(n_params, rows),
+    )
+    goodput = obs.GoodputAccountant()
+    reporter = None
     if args.metrics_out:
-        n_params = sum(
-            p.size for p in jax.tree_util.tree_leaves(state["params"])
-        )
-        meter = obs.StepMeter(
-            tokens_per_step=rows,
-            flops_per_step=obs.transformer_train_flops(n_params, rows),
-        )
-        goodput = obs.GoodputAccountant()
         reporter = obs.Reporter(
             [obs.JSONLSink(args.metrics_out)],
             registry=registry, meter=meter, goodput=goodput,
         )
     tracer = obs.TraceScheduler()  # armed by APEX_TPU_TRACE_STEPS, else no-op
+
+    # flight recorder: env > --flight > default ring of 64.  Resolved
+    # to ONE spec before from_env so APEX_TPU_FLIGHT=0 genuinely
+    # disables (an `or`-chain over recorders would fall through a
+    # disabled env spec into the default and arm anyway).
+    from apex_tpu.observability.flight import ENV_FLIGHT
+
+    spec = os.environ.get(ENV_FLIGHT) or args.flight or "64"
+    flight = obs.FlightRecorder.from_env(
+        spec,
+        directory=os.path.join(args.dir, "flight"),
+        run={"example": "train_resilient", "steps": args.steps,
+             "accum": args.accum, "wire": args.wire, "dp": dp},
+    )
+    if flight is not None:
+        flight.attach(registry=registry, meter=meter, goodput=goodput)
+
+    # fleet aggregation feeds the straggler rule on a multi-device mesh
+    # (one jitted all-gather on the fetch cadence, docs/observability.md)
+    fleet = None
+    if dp > 1:
+        fleet = obs.FleetAggregator(
+            ("train/step_time_ms", "train/mfu", "train/loss"),
+            mesh=t["mesh"], every=args.fetch_every,
+        )
+
+    watchdog = None
+    if not args.no_health:
+        watchdog = obs.Watchdog(
+            registry=registry, meter=meter, goodput=goodput, fleet=fleet,
+            reporter=reporter, flight=flight,
+            on_unhealthy=lambda ev: print(
+                f"  [health/{ev.severity}] {ev.rule}: {ev.message}"
+            ),
+            check_every=max(1, args.fetch_every // 2),
+        )
 
     def step_fn(state, batch):
         step = int(state["guard"].step)
@@ -237,11 +300,12 @@ def main():
         # chaos GRADS site: poisons the tree on scheduled steps, no-op else
         scaled = chaos.corrupt_tree(scaled, step)
         new_state, verdict = apply_update(scaled, state, loss)
-        if reporter is not None:
-            registry.observe(step, new_state["metrics"])
-            meter.tick()
-            if step % args.report_every == 0:
-                reporter.report(step)
+        registry.observe(step, new_state["metrics"])
+        meter.tick()
+        if fleet is not None:
+            fleet.observe(step, {**registry.values(), **meter.summary()})
+        if reporter is not None and step % args.report_every == 0:
+            reporter.report(step)
         if bool(verdict.skipped):
             print(f"  step skipped (found_inf={float(verdict.found_inf)}, "
                   f"spike={bool(verdict.spike)})")
@@ -258,7 +322,8 @@ def main():
             save_interval_steps=args.save_every,
             max_to_keep=3,
             rollback_after=5,
-            observer=goodput,
+            observer=ObserverFanout([goodput, watchdog]),
+            flight=flight,
         )
     finally:
         # even a raising run (e.g. max_rollbacks exhausted) must close
@@ -276,13 +341,14 @@ def main():
             # counts of this invocation (they match RunResult by
             # construction — the accountant saw every on_step /
             # on_rollback the runner counted).
+            snap = goodput.snapshot()
             reporter.sinks[0].write(obs.bench_record(
-                "train/goodput", goodput.goodput(),
+                "train/goodput", snap["goodput"],
                 "fraction (productive/executed)", None,
-                step=final_step, accepted=goodput.accepted,
-                skipped=goodput.skipped, discarded=goodput.discarded,
-                rollbacks=goodput.rollbacks, retries=goodput.retries,
-                resumes=goodput.resumes, preempted=goodput.preempted,
+                step=final_step, accepted=snap["accepted"],
+                skipped=snap["skipped"], discarded=snap["discarded"],
+                rollbacks=snap["rollbacks"], retries=snap["retries"],
+                resumes=snap["resumes"], preempted=snap["preempted"],
             ))
             reporter.close()
     print(

@@ -1,0 +1,282 @@
+"""Flight recorder: ring semantics, atomic JSON-safe dumps, arming
+inside run_resilient (skip-budget exhaustion, SIGTERM/preemption, env),
+rollback replay re-arming, and the postmortem tooling
+(tools/flight_view.py, tools/trace_summary.py --flight).
+ISSUE 5 acceptance: a dying chaos run always leaves a parseable black
+box whose last frames carry the guard state that explains the failure.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.observability import (
+    FlightRecorder,
+    GoodputAccountant,
+    MetricRegistry,
+    parse_flight_spec,
+)
+from apex_tpu.observability.flight import ENV_FLIGHT, _json_safe
+from apex_tpu.resilience import ObserverFanout, chaos, run_resilient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# unit: spec parsing, ring, JSON safety
+# ---------------------------------------------------------------------------
+
+
+def test_parse_flight_spec_forms():
+    assert parse_flight_spec("64") == (64, None)
+    assert parse_flight_spec("16:/tmp/fl") == (16, "/tmp/fl")
+    assert parse_flight_spec("0") == (0, None)
+    with pytest.raises(ValueError):
+        parse_flight_spec("banana")
+
+
+def test_from_env_unset_or_zero_is_unarmed(monkeypatch):
+    monkeypatch.delenv(ENV_FLIGHT, raising=False)
+    assert FlightRecorder.from_env() is None
+    assert FlightRecorder.from_env("0") is None
+    armed = FlightRecorder.from_env("8:/tmp/fl_env")
+    assert armed.capacity == 8 and armed.directory == "/tmp/fl_env"
+
+
+def test_ring_keeps_last_capacity_frames_and_marks_replay():
+    rec = FlightRecorder(capacity=4, directory="/tmp/unused")
+    for step in range(6):
+        rec.on_step(step)
+    assert [f["step"] for f in rec.frames] == [2, 3, 4, 5]
+    # a rollback replay rewinds the counter: recording continues, the
+    # first rewound frame carries the replay mark, seq stays monotonic
+    rec.on_rollback(5, 2, 3, 0)
+    rec.on_step(3)
+    rec.on_step(4)
+    frames = rec.frames
+    assert frames[-2]["step"] == 3 and frames[-2].get("replay") is True
+    assert frames[-1]["step"] == 4 and "replay" not in frames[-1]
+    seqs = [r["seq"] for r in frames] + [e["seq"] for e in rec.events]
+    assert len(set(seqs)) == len(seqs)
+    assert rec.events[-1]["kind"] == "rollback"
+
+
+def test_json_safe_preserves_nonfinite_as_strings():
+    enc = _json_safe(
+        {"a": float("nan"), "b": float("inf"), "c": -float("inf"),
+         "d": 1.5, "e": [float("nan")], "f": jnp.float32(2.0)}
+    )
+    assert enc["a"] == "NaN" and enc["b"] == "Infinity"
+    assert enc["c"] == "-Infinity" and enc["d"] == 1.5
+    assert enc["e"] == ["NaN"] and enc["f"] == 2.0
+    json.dumps(enc, allow_nan=False)  # genuinely valid JSON
+
+
+def test_dump_is_atomic_and_drains_registry(tmp_path):
+    """The dump appends a FINAL frame with force-drained values — the
+    guard state at death, not one fetch cadence stale — and leaves no
+    tmp debris next to the artifact."""
+    reg = MetricRegistry(fetch_every=100)  # never fetches on its own
+    reg.gauge("guard/consecutive_skips")
+    state = reg.update(reg.init(), {"guard/consecutive_skips": 7.0})
+    rec = FlightRecorder(
+        capacity=8, directory=str(tmp_path), registry=reg,
+        goodput=GoodputAccountant(),
+    )
+    reg.observe(1, state)  # stashed, NOT fetched (off cadence)
+    rec.on_step(1)
+    assert rec.frames[-1]["metrics"] == {}  # stale by design pre-dump
+    path = rec.dump("unit test")
+    data = _load(path)
+    assert data["reason"] == "unit test"
+    assert data["final"]["metrics"]["guard/consecutive_skips"] == 7.0
+    assert data["goodput"]["goodput"] == 1.0
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# armed inside run_resilient
+# ---------------------------------------------------------------------------
+
+
+def _nan_job():
+    """Grads NaN via the chaos site; skip flag computed like the real
+    guard (host-side here for test cheapness)."""
+
+    def step_fn(state, batch):
+        grads = {"w": jnp.ones(())}
+        grads = chaos.corrupt_tree(grads, int(batch))
+        skipped = bool(jnp.isnan(grads["w"]) | jnp.isinf(grads["w"]))
+        if not skipped:
+            state = {"w": state["w"] + grads["w"]}
+        return state, {"skipped": skipped}
+
+    return {"w": jnp.zeros(())}, step_fn, (lambda step: step)
+
+
+@pytest.mark.chaos
+def test_skip_budget_exhaustion_always_dumps(tmp_path):
+    """ISSUE 5 acceptance: the max_rollbacks RuntimeError leaves a
+    parseable dump whose frames show the fatal skip streak and whose
+    event log prices every rollback."""
+    init, step_fn, batch_fn = _nan_job()
+    acct = GoodputAccountant()
+    rec = FlightRecorder(
+        capacity=32, directory=str(tmp_path / "fl"), goodput=acct
+    )
+    with chaos.inject(
+        chaos.Fault(chaos.GRADS, steps=(3, 4, 5), mode="nan")  # persistent
+    ):
+        with pytest.raises(RuntimeError, match="skip budget exhausted"):
+            run_resilient(
+                step_fn, init, batch_fn,
+                directory=tmp_path / "ckpt", num_steps=10,
+                save_interval_steps=2, rollback_after=3, max_rollbacks=2,
+                observer=acct, flight=rec,
+            )
+    assert len(rec.dumps) == 1
+    data = _load(rec.dumps[0])
+    assert "skip budget exhausted" in data["reason"]
+    # the last frames ARE the fatal streak
+    tail = data["frames"][-3:]
+    assert [f["skipped"] for f in tail] == [True, True, True]
+    assert [f["step"] for f in tail] == [3, 4, 5]
+    rollbacks = [e for e in data["events"] if e["kind"] == "rollback"]
+    assert len(rollbacks) == 2
+    assert all(r["skips"] == 3 for r in rollbacks)
+    # dump ledger == observer ledger == what the JSONL line would carry
+    assert data["goodput"]["skipped"] == acct.skipped == 9
+    assert data["goodput"]["rollbacks"] == acct.rollbacks == 2
+    # replay passes after each rollback are marked
+    assert any(f.get("replay") for f in data["frames"])
+
+
+@pytest.mark.chaos
+def test_preemption_dumps_after_final_checkpoint(tmp_path):
+    """SIGTERM: the loop exits cleanly (final checkpoint written) AND
+    leaves a black box with the preempt event."""
+    init, step_fn, batch_fn = _nan_job()
+    rec = FlightRecorder(capacity=16, directory=str(tmp_path / "fl"))
+    with chaos.inject(chaos.Fault(chaos.PREEMPTION, steps=(4,))):
+        res = run_resilient(
+            step_fn, init, batch_fn,
+            directory=tmp_path / "ckpt", num_steps=10,
+            save_interval_steps=2, flight=rec,
+        )
+    assert res.preempted and res.last_step == 4
+    assert len(rec.dumps) == 1
+    data = _load(rec.dumps[0])
+    assert "preemption" in data["reason"]
+    assert [e["kind"] for e in data["events"]] == ["preempt"]
+    assert data["frames"][-1]["step"] == 4
+
+
+def test_env_arms_flight_inside_run_resilient(tmp_path, monkeypatch):
+    """APEX_TPU_FLIGHT=N:DIR arms a recorder with no code changes; an
+    unhandled step exception dumps and re-raises unchanged."""
+    monkeypatch.setenv(ENV_FLIGHT, f"8:{tmp_path / 'envfl'}")
+
+    def step_fn(state, batch):
+        if int(batch) == 3:
+            raise ValueError("boom at step 3")
+        return {"w": state["w"] + 1.0}, None
+
+    with pytest.raises(ValueError, match="boom at step 3"):
+        run_resilient(
+            step_fn, {"w": jnp.zeros(())}, lambda s: s,
+            directory=tmp_path / "ckpt", num_steps=10,
+        )
+    dumps = sorted((tmp_path / "envfl").glob("flight_*.json"))
+    assert len(dumps) == 1
+    data = _load(dumps[0])
+    assert data["reason"] == "ValueError: boom at step 3"
+    assert [f["step"] for f in data["frames"]] == [0, 1, 2]
+
+
+def test_observer_fanout_forwards_to_implementers_only():
+    seen = []
+
+    class StepsOnly:
+        def on_step(self, step, skipped, info):
+            seen.append(("step", step))
+
+    class RollbacksOnly:
+        def on_rollback(self, step, anchor, skips, discarded):
+            seen.append(("rollback", step))
+
+    fan = ObserverFanout([StepsOnly(), None, RollbacksOnly()])
+    fan.on_step(1, False, None)
+    fan.on_rollback(5, 2, 3, 0)
+    fan.on_preempt(6)  # nobody implements it: silently fine
+    assert seen == [("step", 1), ("rollback", 5)]
+
+
+# ---------------------------------------------------------------------------
+# postmortem tooling
+# ---------------------------------------------------------------------------
+
+
+def _make_dump(tmp_path, steps=(10, 11, 12)):
+    rec = FlightRecorder(capacity=16, directory=str(tmp_path))
+    for s in steps:
+        rec.on_step(s, skipped=(s == steps[-1]))
+    rec.on_rollback(steps[-1], steps[0], 1, 0)
+    return rec.dump("RuntimeError: unit postmortem")
+
+
+def test_flight_view_renders_and_summarizes(tmp_path, capsys):
+    sys.path.insert(0, TOOLS)
+    try:
+        import flight_view
+    finally:
+        sys.path.remove(TOOLS)
+    path = _make_dump(tmp_path)
+
+    assert flight_view.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "unit postmortem" in out and "ROLLBACK" in out
+
+    assert flight_view.main([path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["frames"] == 3 and summary["rollbacks"] == 1
+    assert summary["frame_skips"] == 1
+
+    # unparseable input is a hard error, not a pretty empty report
+    bad = tmp_path / "not_a_dump.json"
+    bad.write_text("{}")
+    assert flight_view.main([str(bad)]) == 2
+
+
+def test_trace_summary_cross_references_flight_windows(tmp_path, capsys):
+    sys.path.insert(0, TOOLS)
+    try:
+        import trace_summary
+    finally:
+        sys.path.remove(TOOLS)
+    from apex_tpu.observability.trace import window_dir
+
+    # windows: one overlapping the incident span (10..12), one outside
+    os.makedirs(window_dir(str(tmp_path), 11, 13))
+    os.makedirs(window_dir(str(tmp_path), 40, 42))
+    dump = _make_dump(tmp_path / "fl")
+
+    assert trace_summary.flight_step_range(dump) == (10, 12)
+    hit = trace_summary.cross_reference_flight(str(tmp_path), dump)
+    out = capsys.readouterr().out
+    assert hit == window_dir(str(tmp_path), 11, 13)
+    assert "11..13: OVERLAPS" in out and "40..42: outside" in out
+
+    # no overlap at all -> None (and says so)
+    dump_far = _make_dump(tmp_path / "fl2", steps=(90, 91))
+    assert trace_summary.cross_reference_flight(str(tmp_path), dump_far) is None
+    assert "no trace window overlaps" in capsys.readouterr().out

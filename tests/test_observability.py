@@ -95,6 +95,57 @@ def test_registry_rejects_undeclared_metric():
         reg.update(reg.init(), {"typo": 1.0})
 
 
+class _DeadBuffer:
+    """An array-like whose host materialization fails — the shape of a
+    device buffer poisoned by the crash being debugged."""
+
+    def __float__(self):
+        raise RuntimeError("device buffer dead")
+
+
+def test_fetch_flushes_pending_even_when_inflight_raises():
+    """ISSUE 5 satellite pin: the NEWEST (pending) stash lands in a
+    ``finally`` — an exception materializing the OLDER in-flight copy
+    must not leave the flight recorder's last frame a cadence stale."""
+    reg = MetricRegistry(fetch_every=4)
+    reg.gauge("x")
+    reg._inflight = (0, {"x": _DeadBuffer()})
+    reg._pending = (1, {"x": 2.5})
+    with pytest.raises(RuntimeError, match="device buffer dead"):
+        reg.fetch()
+    assert reg.values()["x"] == 2.5  # the pending stash was flushed
+    assert reg.fetched_step == 1
+    # both buffers are consumed: a second fetch is clean
+    assert reg.fetch() == {"x": 2.5}
+
+
+def test_close_drains_best_effort_and_never_raises():
+    """The dump path: per-value failures keep previous values, healthy
+    scalars in the same stash still land, and close() returns."""
+    reg = MetricRegistry(fetch_every=4)
+    reg.gauge("dead")
+    reg.gauge("alive")
+    reg._inflight = (2, {"dead": 1.0, "alive": 1.0})
+    reg._pending = (3, {"dead": _DeadBuffer(), "alive": 7.0})
+    values = reg.close()
+    assert values["alive"] == 7.0  # newest healthy value won
+    assert values["dead"] == 1.0  # poisoned newest -> previous kept
+    assert reg.fetched_step == 3
+    assert reg._inflight is None and reg._pending is None
+
+
+def test_close_fully_poisoned_stash_does_not_claim_freshness():
+    """A stash where NOTHING materialized must not advance
+    fetched_step: the flight dump would otherwise stamp cadence-old
+    values with the crash step."""
+    reg = MetricRegistry(fetch_every=4)
+    reg.gauge("x")
+    reg._inflight = (8, {"x": 1.0})
+    reg._pending = (14, {"x": _DeadBuffer()})
+    assert reg.close() == {"x": 1.0}
+    assert reg.fetched_step == 8  # not 14: step 14 never landed
+
+
 def test_registry_overhead_under_one_percent():
     """ISSUE 3 acceptance: at the default fetch cadence the registry
     adds <1% step-time overhead.
@@ -275,6 +326,26 @@ def test_goodput_prices_broken_skip_streaks_exactly(tmp_path):
     assert acct.executed == result.steps_run == 17
     assert acct.accepted == 13
     assert acct.goodput() == pytest.approx(12 / 17)
+
+
+def test_goodput_snapshot_is_the_stable_read_api():
+    """ISSUE 5 satellite: snapshot() carries the monotonic counts +
+    derived fractions consumers (flight dump, fleet rows, the example's
+    final goodput line) read instead of reaching into fields."""
+    acct = GoodputAccountant()
+    for i in range(10):
+        acct.on_step(i, skipped=(i >= 8))
+    acct.on_rollback(9, 5, 2, discarded=1)
+    acct.on_retry("save", 1, OSError("disk"))
+    snap = acct.snapshot()
+    assert snap == {
+        "accepted": 8, "skipped": 2, "discarded": 1, "rollbacks": 1,
+        "retries": 1, "resumes": 0, "preempted": False,
+        "executed": 10, "productive": 7, "goodput": 0.7,
+    }
+    # a snapshot is a copy, not a live view
+    acct.on_step(10, skipped=False)
+    assert snap["accepted"] == 8
 
 
 def test_goodput_counts_checkpoint_retries(tmp_path):

@@ -6,6 +6,12 @@ Parses the Perfetto ``*.trace.json.gz`` the profiler writes and aggregates
 wall time per event name on the device tracks, so the 0.4x-MFU question
 ("where do the milliseconds go?") has a terminal-native answer — no
 TensorBoard needed in this environment.
+
+``--flight flight_<ts>.json`` cross-references a flight-recorder dump
+(``tools/flight_view.py``, ``docs/observability.md``) against the
+scheduled-trace windows under the dir: it prints which windows overlap
+the incident's step span and summarizes the latest overlapping one —
+"was anything profiling when it died, and what did the chip do?".
 """
 
 from __future__ import annotations
@@ -18,15 +24,10 @@ import json
 import os
 
 
-def resolve_window(log_dir: str, step: int | None = None) -> str:
-    """Resolve a scheduled-trace base dir to one capture window.
-
-    ``apex_tpu.observability.trace.TraceScheduler`` writes each armed
-    window to ``<base>/steps_<start>_<end>/``; given the base dir this
-    lists the windows and picks the one containing ``--step`` (default:
-    the latest).  A dir without window children passes through
-    unchanged, so plain ``bench.py --trace`` dirs keep working.
-    """
+def list_windows(log_dir: str):
+    """``[(start, end, path)]`` of the scheduled-trace windows under
+    ``log_dir`` (the ``steps_<start>_<end>/`` TraceScheduler layout),
+    numerically sorted."""
     import re
 
     windows = []
@@ -38,15 +39,69 @@ def resolve_window(log_dir: str, step: int | None = None) -> str:
                     (int(m.group(1)), int(m.group(2)),
                      os.path.join(log_dir, name))
                 )
+    windows.sort()
+    return windows
+
+
+def flight_step_range(path: str) -> tuple[int, int]:
+    """The incident's step span from a flight-recorder dump: min..max
+    over the ring frames (replay passes rewind steps, so min can sit
+    well below the crash step — that is the span worth profiling)."""
+    with open(path) as f:
+        data = json.load(f)
+    steps = [f["step"] for f in data.get("frames", ())
+             if isinstance(f.get("step"), int)]
+    final = data.get("final") or {}
+    if isinstance(final.get("fetched_step"), int):
+        steps.append(final["fetched_step"])
+    if not steps:
+        raise SystemExit(f"{path}: flight dump has no step frames")
+    return min(steps), max(steps)
+
+
+def cross_reference_flight(log_dir: str, flight_path: str) -> str | None:
+    """Print which trace windows overlap the flight dump's incident
+    span; returns the latest overlapping window's path (None when no
+    window overlaps)."""
+    lo, hi = flight_step_range(flight_path)
+    windows = list_windows(log_dir)
+    print(f"flight incident span: steps {lo}..{hi} ({flight_path})")
+    if not windows:
+        print(f"no steps_*_* trace windows under {log_dir}")
+        return None
+    hit = None
+    for s, e, path in windows:
+        overlap = s <= hi and e >= lo
+        mark = "OVERLAPS incident" if overlap else "outside"
+        print(f"  window {s}..{e}: {mark}")
+        if overlap:
+            hit = path
+    if hit is None:
+        print("no trace window overlaps the incident — nothing was "
+              "profiling when it happened (arm APEX_TPU_TRACE_STEPS or "
+              "a health-escalation window next run)")
+    return hit
+
+
+def resolve_window(log_dir: str, step: int | None = None) -> str:
+    """Resolve a scheduled-trace base dir to one capture window.
+
+    ``apex_tpu.observability.trace.TraceScheduler`` writes each armed
+    window to ``<base>/steps_<start>_<end>/``; given the base dir this
+    lists the windows and picks the one containing ``--step`` (default:
+    the latest).  A dir without window children passes through
+    unchanged, so plain ``bench.py --trace`` dirs keep working.
+    """
+    # numeric order (via list_windows) — lexicographic listdir order
+    # lies once step numbers outgrow the %06d padding
+    # (steps_1200000 < steps_999000)
+    windows = list_windows(log_dir)
     if not windows:
         if step is not None:
             raise SystemExit(
                 f"--step given but {log_dir} has no steps_*_* windows"
             )
         return log_dir
-    # numeric order — lexicographic listdir order lies once step
-    # numbers outgrow the %06d padding (steps_1200000 < steps_999000)
-    windows.sort()
     print(
         "trace windows: "
         + ", ".join(f"{s}..{e}" for s, e, _ in windows)
@@ -188,8 +243,23 @@ if __name__ == "__main__":
         " of the traced program; attributes each op row to its op_name +"
         " source line",
     )
+    ap.add_argument(
+        "--flight", default=None, metavar="FILE",
+        help="a flight-recorder dump (flight_<ts>.json): print which "
+        "trace windows overlap the incident's step span and summarize "
+        "the latest overlapping one (--step overrides the choice)",
+    )
     args = ap.parse_args()
-    args.log_dir = resolve_window(args.log_dir, args.step)
+    if args.flight:
+        hit = cross_reference_flight(args.log_dir, args.flight)
+        if args.step is None:
+            if hit is None:
+                raise SystemExit(1)
+            args.log_dir = hit
+        else:
+            args.log_dir = resolve_window(args.log_dir, args.step)
+    else:
+        args.log_dir = resolve_window(args.log_dir, args.step)
     meta = None
     if args.hlo:
         # Degrade, don't die: in a staged queue the HLO-dump step can be

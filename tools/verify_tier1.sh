@@ -21,6 +21,13 @@
 # keys, and reflect the injected skip count EXACTLY (docs/observability.md).
 # Like the comm pass it hard-fails rather than silently skipping.
 #
+# A FLIGHT stage drives the crash-forensics path end to end
+# (docs/observability.md): the resilient example runs under a
+# persistent chaos NaN burst until the skip budget exhausts
+# max_rollbacks (a RuntimeError by contract), and the stage asserts a
+# flight dump exists, tools/flight_view.py parses it, and the dump's
+# recorded skip/rollback counts EXACTLY match the JSONL goodput line.
+#
 # A fourth stage is the static-analysis gate (docs/analysis.md):
 # tools/repo_lint.py greps apex_tpu/ for banned source patterns in
 # jitted paths, and tools/graph_lint.py builds the resilient example's
@@ -29,7 +36,7 @@
 # dropped donation, f64, collective mismatch) hard-fails.
 #
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + lint
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -37,6 +44,7 @@
 #   T1_TIMEOUT  seconds         (default 870)
 #   T1_SKIP_COMM=1              skip the dedicated comm pass
 #   T1_SKIP_OBS=1               skip the observability pass
+#   T1_SKIP_FLIGHT=1            skip the flight-recorder pass
 #   T1_SKIP_LINT=1              skip the static-analysis pass
 
 set -o pipefail
@@ -125,6 +133,74 @@ PYEOF
     fi
 fi
 
+flight_rc=0
+if [ "${T1_SKIP_FLIGHT:-0}" != "1" ]; then
+    FL_OUT="$(mktemp /tmp/_t1_flight.XXXXXX.jsonl)"
+    FL_DIR="$(mktemp -d /tmp/_t1_flight_ckpt.XXXXXX)"
+    # 5 consecutive NaN steps x (1 + max_rollbacks=3 replays) -> the
+    # skip budget (rollback_after=5) exhausts and run_resilient raises;
+    # the example must STILL leave a parseable black box.  Expected
+    # ledger: skipped=20, rollbacks=3, in BOTH artifacts.
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        APEX_TPU_CHAOS="grads:nan@10,11,12,13,14" \
+        python examples/simple/resilient/train_resilient.py \
+        --steps 30 --save-every 5 --dir "$FL_DIR" \
+        --metrics-out "$FL_OUT" 2>&1 | tail -n 3 | tee -a "$LOG"
+    example_rc=${PIPESTATUS[0]}
+    if [ "$example_rc" -eq 0 ]; then
+        echo "TIER1-FLIGHT: example was expected to DIE (skip budget)" \
+            | tee -a "$LOG"
+        flight_rc=1
+    else
+        DUMP=$(ls "$FL_DIR"/flight/flight_*.json 2>/dev/null | tail -n 1)
+        if [ -z "$DUMP" ]; then
+            echo "TIER1-FLIGHT: no flight dump under $FL_DIR/flight" \
+                | tee -a "$LOG"
+            flight_rc=1
+        else
+            python tools/flight_view.py "$DUMP" --json 2>&1 | tee -a "$LOG"
+            flight_rc=${PIPESTATUS[0]}
+        fi
+    fi
+    if [ "$flight_rc" -eq 0 ]; then
+        python - "$DUMP" "$FL_OUT" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+dump = json.load(open(sys.argv[1]))
+recs = [json.loads(l) for l in open(sys.argv[2]) if l.strip()]
+final = [r for r in recs if r["metric"] == "train/goodput" and "skipped" in r]
+assert final, "no consolidated goodput line in the JSONL"
+g = final[-1]
+fg = dump.get("goodput") or {}
+assert "skip budget exhausted" in dump["reason"], dump["reason"]
+# the black box and the telemetry stream must tell ONE story
+for key in ("accepted", "skipped", "discarded", "rollbacks", "retries"):
+    assert fg.get(key) == g[key], (
+        f"flight {key}={fg.get(key)} vs goodput line {g[key]}")
+assert g["skipped"] == 20 and g["rollbacks"] == 3, g
+frames = dump["frames"]
+assert frames, "flight dump has no frames"
+tail = frames[-5:]
+assert all(f["skipped"] for f in tail), "last frames must be the fatal streak"
+fm = dump["final"]["metrics"]
+assert fm.get("guard/consecutive_skips") == 5.0, fm
+assert fm.get("guard/found_inf") == 1.0, fm
+print(f"flight dump OK: reason={dump['reason'][:40]!r}... "
+      f"skipped={fg['skipped']} rollbacks={fg['rollbacks']} "
+      f"(== JSONL goodput line)")
+PYEOF
+        flight_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$flight_rc" -eq 0 ]; then
+        rm -rf "$FL_DIR"
+        rm -f "$FL_OUT"
+        echo "TIER1-FLIGHT: PASS"
+    else
+        # keep the artifacts that failed the assertions — the evidence
+        echo "TIER1-FLIGHT: FAIL (rc=$flight_rc; metrics at $FL_OUT," \
+            "dump dir $FL_DIR)"
+    fi
+fi
+
 lint_rc=0
 if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
     # source-level lint: banned patterns in jitted paths (fast, no jax)
@@ -147,12 +223,13 @@ if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
 fi
 
 if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
-    && [ "$lint_rc" -eq 0 ]; then
+    && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, lint rc=$lint_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$obs_rc" -ne 0 ] && exit "$obs_rc"
+[ "$flight_rc" -ne 0 ] && exit "$flight_rc"
 exit "$lint_rc"
