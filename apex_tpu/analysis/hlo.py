@@ -23,6 +23,13 @@ Contents:
   actually committed to (the donation lint's ground truth).
 - :func:`host_transfer_ops` — infeed/outfeed/host send-recv/callback
   custom-calls (the transfer lint's HLO-level ground truth).
+- :func:`parse_computations` / :func:`instruction_flops` /
+  :func:`instruction_bytes` — the per-instruction reader + cost
+  primitives behind step-time attribution
+  (:mod:`apex_tpu.observability.attribution`): every instruction as a
+  structured record, and the FLOP/byte estimate of one instruction
+  from its printed shapes (XLA prints operand shapes inline at every
+  use site, so no cross-reference pass is needed).
 """
 
 from __future__ import annotations
@@ -41,6 +48,11 @@ __all__ = [
     "overlap_collect",
     "input_output_aliases",
     "host_transfer_ops",
+    "parse_computations",
+    "shape_dims",
+    "shape_elements",
+    "instruction_flops",
+    "instruction_bytes",
 ]
 
 DTYPE_BYTES = {
@@ -358,3 +370,221 @@ def host_transfer_ops(hlo_text: str) -> List[Tuple[str, str]]:
             if tgt and any(t in tgt.group(1) for t in _CALLBACK_TARGETS):
                 out.append((name, f"callback custom-call ({tgt.group(1)})"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# per-instruction reader + cost primitives (step-time attribution)
+# ---------------------------------------------------------------------------
+
+#: computation header: ``%name (params) -> shape {`` / ``ENTRY %name ...``
+_COMP_HEADER_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.-]+)\s+\(.*\)\s*->\s*\S.*\{\s*$"
+)
+
+_INSTR_HEAD_RE = re.compile(
+    r"^(?:ROOT\s+)?%?([\w.-]+)\s*=\s*"
+    r"(\((?:[^()]|\([^()]*\))*\)|[^\s]+)\s+"
+    r"([\w-]+)\("
+)
+
+_SHAPE_IN_TEXT_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+
+#: attrs that reference other computations, per container opcode
+_CALLED_COMP_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|true_computation|"
+    r"false_computation)=%?([\w.-]+)"
+)
+
+
+def shape_dims(shape: str) -> List[int]:
+    """Dims of the FIRST array in an HLO shape string (``'f32[8,128]
+    {1,0}'`` → ``[8, 128]``; scalars → ``[]``; tuples → first element)."""
+    m = _SHAPE_IN_TEXT_RE.search(shape)
+    if not m:
+        return []
+    return [int(d) for d in m.group(2).split(",") if d]
+
+
+def shape_elements(shape: str) -> int:
+    """Element count of the first array in a shape string."""
+    n = 1
+    for d in shape_dims(shape):
+        n *= d
+    return n
+
+
+def _balanced_span(text: str, start: int) -> int:
+    """Index just past the ')' matching the '(' at ``start``."""
+    depth = 0
+    for i in range(start, len(text)):
+        ch = text[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def parse_computations(hlo_text: str):
+    """``(computations, entry_name)`` — every instruction as a record.
+
+    ``computations`` maps computation name → list of instruction dicts
+    in program order; each record carries ``name``, ``shape`` (result
+    shape string), ``opcode``, ``operands`` (list of operand shape
+    strings, as printed inline at the use site), ``op_name`` (the jax
+    source path from metadata — named scopes land here), ``called``
+    (referenced computation names for fusion/call/while/conditional),
+    and ``attrs`` (the raw text after the operand list, for
+    opcode-specific parsing like ``lhs_contracting_dims``).
+    """
+    comps: Dict[str, List[dict]] = {}
+    entry = None
+    current: Optional[List[dict]] = None
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        hm = _COMP_HEADER_RE.match(line)
+        if hm and " = " not in line.split("{", 1)[0]:
+            name = hm.group(2)
+            current = comps.setdefault(name, [])
+            if hm.group(1):
+                entry = name
+            continue
+        if line == "}":
+            current = None
+            continue
+        im = _INSTR_HEAD_RE.match(line)
+        if im is None or current is None:
+            continue
+        name, shape, opcode = im.group(1), im.group(2), im.group(3)
+        open_paren = im.end() - 1
+        close = _balanced_span(line, open_paren)
+        operand_text = line[open_paren + 1:close - 1]
+        attrs = line[close:]
+        onm = _OP_NAME_RE.search(attrs)
+        current.append({
+            "name": name,
+            "shape": shape,
+            "opcode": opcode,
+            "operands": [
+                f"{dt}[{dims}]"
+                for dt, dims in _SHAPE_IN_TEXT_RE.findall(operand_text)
+            ],
+            "op_name": onm.group(1) if onm else "",
+            "called": _CALLED_COMP_RE.findall(attrs),
+            "attrs": attrs,
+        })
+    if entry is None and comps:
+        # un-ENTRY'd fragments (tests, hand-written snippets): the last
+        # computation is the outermost by HLO printing convention
+        entry = next(reversed(comps))
+    return comps, entry
+
+
+#: 1-FLOP-per-element transcendentals/arithmetic (coarse on purpose —
+#: attribution consumes relative shares, not absolute cycle counts)
+_ELEMENTWISE_OPS = frozenset((
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "power", "remainder", "atan2", "and", "or", "xor", "not",
+    "negate", "abs", "sign", "compare", "select", "clamp", "convert",
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "tanh", "logistic", "sqrt", "rsqrt", "cbrt", "sine", "cosine",
+    "floor", "ceil", "round-nearest-afz", "round-nearest-even",
+    "is-finite", "shift-left", "shift-right-arithmetic",
+    "shift-right-logical", "popcnt", "count-leading-zeros",
+    "stochastic-convert", "erf",
+))
+
+#: pure data movement / bookkeeping: 0 FLOPs, bytes still count
+_ZERO_FLOP_OPS = frozenset((
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "bitcast-convert", "reshape", "transpose", "broadcast", "copy",
+    "copy-start", "copy-done", "slice", "dynamic-slice",
+    "dynamic-update-slice", "concatenate", "gather", "iota", "pad",
+    "reverse", "rng", "rng-bit-generator", "after-all", "domain",
+    "partition-id", "replica-id", "opt-barrier", "send", "recv",
+    "send-done", "recv-done", "infeed", "outfeed", "custom-call",
+))
+
+_CONTRACTING_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DIM_LABELS_RE = re.compile(r"dim_labels=(\w+)_(\w+)->(\w+)")
+
+
+def instruction_flops(instr: dict) -> float:
+    """Estimated FLOPs of ONE leaf instruction from its printed shapes.
+
+    - ``dot``: ``2 * result_elements * contracted_elements`` (the lhs
+      contracting dims, parsed from the attrs; batch dims are already
+      inside the result product).
+    - ``convolution``: ``2 * result_elements * kernel_elements /
+      out_features`` (out-feature index from ``dim_labels``).
+    - elementwise/transcendental: one FLOP per result element.
+    - ``reduce``/``reduce-window``: one FLOP per INPUT element.
+    - data movement, parameters, collectives, custom-calls: 0 (a
+      custom-call's interior is invisible in HLO text; its measured
+      time still lands in the right bucket via the trace source).
+
+    Container ops (fusion/call/while/conditional) are costed by the
+    caller over their ``called`` computations — see
+    :mod:`apex_tpu.observability.attribution`.
+    """
+    opcode = instr["opcode"]
+    if opcode in _ZERO_FLOP_OPS or opcode.startswith(
+        ("all-", "reduce-scatter", "collective-")
+    ):
+        return 0.0
+    result_elems = shape_elements(instr["shape"])
+    if opcode == "dot":
+        contracted = 1
+        m = _CONTRACTING_RE.search(instr["attrs"])
+        if m and instr["operands"]:
+            lhs_dims = shape_dims(instr["operands"][0])
+            for idx in m.group(1).split(","):
+                if idx and int(idx) < len(lhs_dims):
+                    contracted *= lhs_dims[int(idx)]
+        return 2.0 * result_elems * contracted
+    if opcode == "convolution":
+        if len(instr["operands"]) > 1:
+            kernel = instr["operands"][1]
+            k_elems = shape_elements(kernel)
+            out_features = 1
+            m = _DIM_LABELS_RE.search(instr["attrs"])
+            if m:
+                o_idx = m.group(2).find("o")
+                kd = shape_dims(kernel)
+                if 0 <= o_idx < len(kd):
+                    out_features = kd[o_idx]
+            elif shape_dims(instr["shape"]):
+                out_features = shape_dims(instr["shape"])[-1]
+            return 2.0 * result_elems * k_elems / max(1, out_features)
+        return 0.0
+    if opcode in ("reduce", "reduce-window", "scatter", "sort",
+                  "select-and-scatter"):
+        src = instr["operands"][0] if instr["operands"] else instr["shape"]
+        return float(shape_elements(src))
+    if opcode in _ELEMENTWISE_OPS:
+        return float(result_elems)
+    if opcode in ("map", "fusion", "call", "while", "conditional"):
+        return 0.0  # containers: costed over their called computations
+    return float(result_elems)  # unknown op: one FLOP/element floor
+
+
+def instruction_bytes(instr: dict) -> int:
+    """HBM-traffic estimate of one instruction: result + operand bytes
+    as printed (for a fusion this is exactly the boundary traffic — its
+    interior never touches HBM, which is the point of fusing).
+    Pointer-shuffling ops (tuple plumbing, bitcasts) move nothing."""
+    if instr["opcode"] in (
+        "parameter", "constant", "tuple", "get-tuple-element",
+        "bitcast", "after-all", "opt-barrier",
+    ):
+        return 0
+    total = shape_bytes(instr["shape"])
+    for op_shape in instr["operands"]:
+        total += shape_bytes(op_shape)
+    return total

@@ -40,10 +40,18 @@ session over bench logs:
 - :mod:`apex_tpu.observability.health` —
   :class:`~apex_tpu.observability.health.Watchdog`: declarative
   rules (straggler z-score, MFU/goodput floors, loss spike, NaN
-  rate, stale fetch, hung step) emitting structured
+  rate, stale fetch, hung step, comm/host-stall fraction floors)
+  emitting structured
   :class:`~apex_tpu.observability.health.HealthEvent` s to the
   sinks/flight recorder, with ``on_unhealthy`` escalation (e.g.
   arm a trace window — alert→profile in one run).
+- :mod:`apex_tpu.observability.attribution` — step-time attribution
+  and roofline analysis: the compiled cost model (per-op FLOPs/bytes
+  bucketed matmul/attention/norm-elementwise/collective/other via
+  ``analysis/hlo.py``) cross-checked against measured profiler trace
+  windows, reduced to compute/collective/host-stall fractions and a
+  per-bucket roofline (``tools/step_profile.py``,
+  ``tools/bench_diff.py`` ride it).
 
 See ``docs/observability.md`` for the full tour.
 """
@@ -57,9 +65,21 @@ from apex_tpu.observability.flight import (  # noqa: F401
     parse_flight_spec,
 )
 from apex_tpu.observability.health import (  # noqa: F401
+    CollectiveFractionRule,
     HealthEvent,
+    HostStallRule,
     Watchdog,
     default_rules,
+)
+from apex_tpu.observability.attribution import (  # noqa: F401
+    CostAttribution,
+    TraceAttribution,
+    attribute_cost_model,
+    attribute_trace,
+    attribute_trace_dir,
+    hlo_bucket_map,
+    publish_attribution,
+    roofline_report,
 )
 from apex_tpu.observability.export import (  # noqa: F401
     CSVSink,
@@ -69,9 +89,13 @@ from apex_tpu.observability.export import (  # noqa: F401
     bench_record,
 )
 from apex_tpu.observability.meter import (  # noqa: F401
+    BUCKETS,
     GoodputAccountant,
     StepMeter,
+    categorize_op,
     chip_peak_flops,
+    peak_flops_for,
+    peak_hbm_bandwidth_for,
     total_peak_flops,
     transformer_train_flops,
 )
@@ -104,11 +128,25 @@ __all__ = [
     "Watchdog",
     "HealthEvent",
     "default_rules",
+    "CollectiveFractionRule",
+    "HostStallRule",
     "StepMeter",
     "GoodputAccountant",
+    "BUCKETS",
+    "categorize_op",
     "chip_peak_flops",
+    "peak_flops_for",
+    "peak_hbm_bandwidth_for",
     "total_peak_flops",
     "transformer_train_flops",
+    "CostAttribution",
+    "TraceAttribution",
+    "attribute_cost_model",
+    "attribute_trace",
+    "attribute_trace_dir",
+    "hlo_bucket_map",
+    "publish_attribution",
+    "roofline_report",
     "Reporter",
     "JSONLSink",
     "CSVSink",

@@ -25,7 +25,19 @@ rule                      fires when
                           (a hung/slow collective that eventually
                           completed); :meth:`Watchdog.poll` covers the
                           still-hung case from an external thread
+:class:`CollectiveFractionRule` the step-time attribution's collective
+                          share exceeds a floor (comm-bound: the next
+                          lever is wire format/overlap, not kernels)
+:class:`HostStallRule`    the attribution's host-stall share exceeds a
+                          floor (the chip is starving, not slow)
 ========================  =================================================
+
+The two fraction rules read the step-time attribution published by
+:func:`~apex_tpu.observability.attribution.publish_attribution` —
+either an object handed to ``Watchdog(attribution=...)`` or the board
+keys ``attribution/collective_fraction`` /
+``attribution/host_stall_fraction`` (how ``tools/step_profile.py`` and
+the resilient example feed them).
 
 Every firing emits a structured :class:`HealthEvent` to: the watchdog's
 ``events`` ledger, the observability board (``health/<rule>``), the
@@ -54,6 +66,8 @@ __all__ = [
     "NaNRateRule",
     "StaleFetchRule",
     "HungStepRule",
+    "CollectiveFractionRule",
+    "HostStallRule",
     "default_rules",
     "Watchdog",
 ]
@@ -363,6 +377,70 @@ class HungStepRule(Rule):
         return []
 
 
+class _AttributionFractionRule(Rule):
+    """Base for rules over the step-time attribution fractions
+    (:mod:`apex_tpu.observability.attribution`).  The fraction comes
+    from ``Watchdog(attribution=...)`` — an object with
+    ``fractions()`` or a plain mapping — or, failing that, the board
+    key ``attribution/<key>_fraction`` that
+    :func:`~apex_tpu.observability.attribution.publish_attribution`
+    sets.  No attribution anywhere → the rule is silent (it cannot
+    invent a decomposition)."""
+
+    key = "collective"
+
+    def __init__(self, max_fraction: float, cooldown: int = 64):
+        super().__init__(cooldown)
+        self.max_fraction = max_fraction
+
+    def _fraction(self, wd) -> Optional[float]:
+        src = getattr(wd, "attribution", None)
+        if src is not None:
+            fr = src.fractions() if hasattr(src, "fractions") else src
+            val = fr.get(self.key)
+            return float(val) if val is not None else None
+        from apex_tpu.observability.metrics import board
+
+        val = board.get(f"attribution/{self.key}_fraction")
+        return float(val) if val is not None else None
+
+    def evaluate(self, wd, step):
+        frac = self._fraction(wd)
+        if frac is not None and frac > self.max_fraction:
+            return self._event(
+                step, frac, self.max_fraction,
+                f"{self.key} fraction {frac:.3f} of step time exceeds "
+                f"{self.max_fraction:.3f} ({self.diagnosis})",
+            )
+        return []
+
+
+class CollectiveFractionRule(_AttributionFractionRule):
+    """Comm share of the step over a floor — the step is comm-bound:
+    tune wire formats / chunked overlap (docs/comm.md) before
+    kernels."""
+
+    name = "collective_fraction"
+    key = "collective"
+    diagnosis = "comm-bound: next lever is wire format/overlap"
+
+    def __init__(self, max_fraction: float = 0.35, cooldown: int = 64):
+        super().__init__(max_fraction, cooldown)
+
+
+class HostStallRule(_AttributionFractionRule):
+    """Host-stall share of the step over a floor — the chip is
+    starving (dispatch latency, blocked fetches, input waits), not
+    slow; faster kernels cannot help."""
+
+    name = "host_stall"
+    key = "host_stall"
+    diagnosis = "chip starving: dispatch/input path, not kernels"
+
+    def __init__(self, max_fraction: float = 0.15, cooldown: int = 64):
+        super().__init__(max_fraction, cooldown)
+
+
 def default_rules(**overrides) -> List[Rule]:
     """The standard rule set; keyword args override a rule's kwargs by
     name, e.g. ``default_rules(straggler={"zmax": 2.5})``."""
@@ -374,6 +452,8 @@ def default_rules(**overrides) -> List[Rule]:
         "nan_rate": NaNRateRule,
         "stale_fetch": StaleFetchRule,
         "hung_step": HungStepRule,
+        "collective_fraction": CollectiveFractionRule,
+        "host_stall": HostStallRule,
     }
     unknown = set(overrides) - set(specs)
     if unknown:
@@ -406,6 +486,7 @@ class Watchdog:
         fleet=None,
         reporter=None,
         flight=None,
+        attribution=None,
         on_unhealthy: Optional[Callable[[HealthEvent], Any]] = None,
         check_every: int = 8,
         window: int = 64,
@@ -418,6 +499,10 @@ class Watchdog:
         self.meter = meter
         self.goodput = goodput
         self.fleet = fleet
+        #: step-time attribution source for the fraction rules: an
+        #: object with ``fractions()`` (Cost/TraceAttribution) or a
+        #: plain mapping; None → rules fall back to the board keys
+        self.attribution = attribution
         self.reporter = reporter
         self.flight = flight
         self.on_unhealthy = on_unhealthy

@@ -29,6 +29,13 @@ from typing import Any, Callable, Dict, Optional
 
 __all__ = [
     "PEAK_BF16_FLOPS",
+    "PEAK_HBM_GBPS",
+    "PEAK_ICI_GBPS",
+    "BUCKETS",
+    "peak_flops_for",
+    "peak_hbm_bandwidth_for",
+    "peak_ici_bandwidth_for",
+    "categorize_op",
     "chip_peak_flops",
     "total_peak_flops",
     "transformer_train_flops",
@@ -37,7 +44,8 @@ __all__ = [
 ]
 
 #: Per-chip dense bf16 peak FLOP/s by device kind (public specs) — the
-#: single source bench.py's MFU headline and live telemetry share.
+#: single source bench.py's MFU headline, live telemetry, and the
+#: roofline (``observability.attribution``) share.
 PEAK_BF16_FLOPS = {
     "TPU v5 lite": 197e12,  # v5e
     "TPU v5e": 197e12,
@@ -47,18 +55,135 @@ PEAK_BF16_FLOPS = {
     "TPU v6 lite": 918e12,  # v6e (Trillium)
 }
 
+#: Per-chip HBM bandwidth (bytes/s, public specs) — the roofline's
+#: bandwidth ceiling and the ridge-point denominator.
+PEAK_HBM_GBPS = {
+    "TPU v5 lite": 819e9,  # v5e
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v5": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,  # v6e (Trillium)
+}
+
+#: Per-chip ICI bandwidth (bytes/s per link direction, public specs) —
+#: the cost model's collective-time denominator.
+PEAK_ICI_GBPS = {
+    "TPU v5 lite": 200e9,  # v5e: 4x 100 GB/s links bidir, ~200 usable
+    "TPU v5e": 200e9,
+    "TPU v5p": 600e9,
+    "TPU v5": 600e9,
+    "TPU v4": 300e9,
+    "TPU v6 lite": 400e9,
+}
+
 #: Unknown device kinds (CPU, new chips) fall back conservatively.
 DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_HBM_GBPS = 819e9
+DEFAULT_ICI_GBPS = 100e9
+
+
+def _lookup(table: Dict[str, float], device_kind: str, default: float) -> float:
+    for key, val in table.items():
+        if device_kind.startswith(key):
+            return val
+    return default
+
+
+def peak_flops_for(device_kind: str) -> float:
+    """Dense bf16 peak FLOP/s for a device-kind STRING — the one
+    denominator StepMeter MFU, bench.py headlines, and the roofline
+    share (conservative default for unknown kinds: an MFU from it is a
+    floor, not a lie)."""
+    return _lookup(PEAK_BF16_FLOPS, device_kind, DEFAULT_PEAK_FLOPS)
+
+
+def peak_hbm_bandwidth_for(device_kind: str) -> float:
+    """HBM bytes/s for a device-kind string (roofline ceiling)."""
+    return _lookup(PEAK_HBM_GBPS, device_kind, DEFAULT_HBM_GBPS)
+
+
+def peak_ici_bandwidth_for(device_kind: str) -> float:
+    """Interconnect bytes/s for a device-kind string (cost-model
+    collective-time denominator)."""
+    return _lookup(PEAK_ICI_GBPS, device_kind, DEFAULT_ICI_GBPS)
 
 
 def chip_peak_flops(device) -> float:
-    """Dense bf16 peak FLOP/s of one device (conservative default for
-    unknown kinds — an MFU from it is a floor, not a lie)."""
-    kind = getattr(device, "device_kind", "")
-    for key, val in PEAK_BF16_FLOPS.items():
-        if kind.startswith(key):
-            return val
-    return DEFAULT_PEAK_FLOPS
+    """Dense bf16 peak FLOP/s of one device object (delegates to
+    :func:`peak_flops_for` on its ``device_kind``)."""
+    return peak_flops_for(getattr(device, "device_kind", ""))
+
+
+# ---------------------------------------------------------------------------
+# the bucket model: one op-category vocabulary for attribution/roofline
+# ---------------------------------------------------------------------------
+
+#: The op-category buckets step-time attribution decomposes into — the
+#: shared vocabulary of the cost model, the trace parser, the roofline
+#: table, and the watchdog's fraction rules.
+BUCKETS = ("matmul", "attention", "norm_elementwise", "collective", "other")
+
+_ATTENTION_HINTS = (
+    "attention", "attn", "flash", "mha", "multihead", "softmax_xent",
+)
+#: "conv_general"/"convolution" (jax's conv_general_dilated), never a
+#: bare "conv": dtype casts print as convert/convert_element_type and
+#: must fall through to the elementwise branch, not inflate matmul
+_MATMUL_HINTS = (
+    "dot_general", "einsum", "conv_general", "convolution", "conv2d",
+    "matmul", "dense", "gemm", "dot",
+)
+_NORM_ELEMENTWISE_HINTS = (
+    "norm", "softmax", "gelu", "relu", "tanh", "sigmoid", "logistic",
+    "dropout", "bias", "residual", "add", "mul", "rope", "rotary",
+    "scale", "mean", "var", "rsqrt", "exp", "erf",
+)
+_ELEMENTWISE_OPCODES = frozenset((
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "power", "negate", "abs", "compare", "select", "clamp", "convert",
+    "exponential", "log", "tanh", "logistic", "sqrt", "rsqrt", "sine",
+    "cosine", "erf", "reduce", "reduce-window", "map", "broadcast",
+    "iota", "floor", "ceil", "sign", "and", "or", "xor", "not",
+))
+
+
+def categorize_op(opcode: str, op_name: str = "") -> str:
+    """Bucket one op into :data:`BUCKETS` from its HLO opcode and
+    ``op_name`` metadata (the jax source path — named scopes land
+    there, so a ``dot`` inside ``named_scope("flash_attention")``
+    buckets as attention, which is what a roofline wants: the
+    attention bucket owns its matmuls).
+
+    Priority: collective > attention > matmul > norm-elementwise >
+    other.  Works on trace-event names too: pass the event name as
+    ``op_name`` with its leading token as ``opcode`` (fused kernels
+    print like ``add_multiply_fusion.78``, carrying their content in
+    the name).
+    """
+    opcode = (opcode or "").lower()
+    name = (op_name or "").lower()
+    if opcode.startswith(
+        ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+         "collective-permute", "collective-broadcast")
+    ) or any(
+        k in name
+        for k in ("all-reduce", "all_reduce", "all-gather", "all_gather",
+                  "reduce-scatter", "reduce_scatter", "all-to-all",
+                  "all_to_all", "collective-permute", "psum")
+    ):
+        return "collective"
+    if any(k in name for k in _ATTENTION_HINTS):
+        return "attention"
+    if opcode in ("dot", "convolution") or any(
+        k in name for k in _MATMUL_HINTS
+    ):
+        return "matmul"
+    if opcode in _ELEMENTWISE_OPCODES or any(
+        k in name for k in _NORM_ELEMENTWISE_HINTS
+    ):
+        return "norm_elementwise"
+    return "other"
 
 
 def total_peak_flops(devices=None) -> float:

@@ -161,6 +161,11 @@ _METRICS_SINK = None
 # flaky tunnel mid-config (docs/observability.md).
 _FLIGHT = None
 
+# Every emitted record, in-memory — what --gate hands tools/bench_diff.py
+# after the configs finish (degenerate rows ride along; the gate excludes
+# them itself, so the exclusion rule lives in ONE place).
+_GATE_RECORDS = []
+
 
 def _emit(metric, value, unit, vs_baseline, degenerate=False):
     """``degenerate=True`` marks a multi-device config that ran with only
@@ -175,6 +180,7 @@ def _emit(metric, value, unit, vs_baseline, degenerate=False):
     if degenerate:
         rec["degenerate"] = True
     print(json.dumps(rec), flush=True)
+    _GATE_RECORDS.append(rec)
     if _METRICS_SINK is not None:
         _METRICS_SINK.write(rec)
     if _FLIGHT is not None:
@@ -890,6 +896,101 @@ def bench_long_attn(trace_dir=None, batch=1, heads=8, seq=16384,
     )
 
 
+# ---------------------------------------------------------------------------
+# CI smoke config (seconds on CPU — the verify_tier1.sh PERF pass)
+# ---------------------------------------------------------------------------
+
+
+def bench_smoke(trace_dir=None, dim=128, batch=64, chunk=4, trials=2):
+    """Tiny MLP train step, single-device AND under a dp shard_map over
+    every visible device — NOT a performance claim, a schema driver:
+    it exercises the real ``_time_chunks``/``_emit`` path (including
+    the degenerate-marking contract on the dp row) in seconds on CPU,
+    so ``tools/bench_diff.py --check-schema`` can gate contract drift
+    in CI without a TPU (``tools/bench_golden_cpu.jsonl`` is the
+    committed golden line)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    key = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(key, (dim, dim), jnp.float32) * 0.1
+    w2 = jax.random.normal(key, (dim, dim), jnp.float32) * 0.1
+    x = jax.random.normal(key, (batch, dim), jnp.float32)
+    y = jnp.ones((batch, dim), jnp.float32)
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    def body(carry, _):
+        params = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 1e-2 * g, params, grads
+        )
+        return params, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_chunk(params):
+        params, losses = jax.lax.scan(body, params, None, length=chunk)
+        return (params,), losses[-1]
+
+    # each arm gets its own copy: the chunks donate their carry, and
+    # the dp arm below needs live source buffers
+    params = {"w1": jnp.copy(w1), "w2": jnp.copy(w2)}
+    t, _, loss = _time_chunks(
+        lambda p: train_chunk(p), (params,), chunk, trials
+    )
+    _emit(
+        "smoke_mlp_step_ms",
+        round(t * 1e3, 3),
+        "ms/step (dim=%d, batch=%d, loss=%.4f, single device; CI "
+        "schema smoke, not a perf claim)" % (dim, batch, loss),
+        None,
+    )
+
+    devices = jax.devices()
+    dp = len(devices)
+    mesh = Mesh(devices, ("dp",))
+
+    def dp_body(carry, _):
+        params = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "dp"), grads
+        )
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 1e-2 * g, params, grads
+        )
+        return params, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def dp_chunk(params):
+        def sharded(params):
+            params, losses = jax.lax.scan(
+                dp_body, params, None, length=chunk
+            )
+            return params, losses[-1]
+
+        params, loss = jax.shard_map(
+            sharded, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
+            check_vma=False,
+        )(params)
+        return (params,), loss
+
+    params = {"w1": jnp.copy(w1), "w2": jnp.copy(w2)}
+    t_dp, _, loss = _time_chunks(
+        lambda p: dp_chunk(p), (params,), chunk, trials
+    )
+    _emit(
+        "smoke_dp_mlp_step_ms",
+        round(t_dp * 1e3, 3),
+        "ms/step (dp=%d, dim=%d, batch=%d, loss=%.4f, psum grad sync; "
+        "CI schema smoke, not a perf claim)" % (dp, dim, batch, loss),
+        None,
+        degenerate=dp == 1,
+    )
+
+
 _CONFIGS = {
     "resnet50": bench_resnet50,
     "ddp_syncbn": bench_ddp_syncbn,
@@ -898,6 +999,7 @@ _CONFIGS = {
     "tp_gpt": bench_tp_gpt,
     "zero": bench_zero,
     "long_attn": bench_long_attn,
+    "smoke": bench_smoke,
 }
 
 
@@ -924,10 +1026,65 @@ def main(config="bert_lamb", trace_dir=None):
         armed.set()
     if config == "all":
         for name, fn in _CONFIGS.items():
+            if name == "smoke":
+                continue  # CI schema driver, not a measurement row
             # one trace (the headline config) per invocation
             fn(trace_dir if name == "bert_lamb" else None)
         return
     _CONFIGS[config](trace_dir)
+
+
+def _run_gate(baseline_path=None):
+    """bench.py --gate: judge THIS invocation's emitted lines against
+    the last committed round with tools/bench_diff.py (regression gate
+    on every measured metric + the flatline gate on the flash-attention
+    line when it was measured).  Returns the number of failures; emits
+    a ``bench_gate_failures`` metric line so the gate verdict rides the
+    same artifact stream it judges."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(root, "tools", "bench_diff.py")
+    )
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+
+    baseline_path = baseline_path or bd.default_baseline(root)
+    if baseline_path is None:
+        print("bench gate: no baseline round found — nothing to gate",
+              file=sys.stderr)
+        return 0
+    current = bd.collapse(list(_GATE_RECORDS))
+    baseline = bd.collapse(bd.load_records(baseline_path))
+    # judge only what this invocation measured: --config bert_lamb must
+    # not "fail" for not re-running the other rows
+    baseline = {m: s for m, s in baseline.items() if m in current}
+    rows = bd.compare(current, baseline)
+    print(f"bench gate vs {os.path.basename(baseline_path)}:",
+          file=sys.stderr)
+    print(bd.render(rows), file=sys.stderr)
+    failures = [
+        f"regression: {r['metric']} {r['baseline']} -> {r['current']}"
+        for r in rows if r["status"] == "regressed"
+    ]
+    flash = next(
+        (r for r in rows if r["metric"] == bd.FLAT_DEFAULT), None
+    )
+    if flash is not None and flash["status"] == "flat":
+        failures.append(
+            f"flatline: {bd.FLAT_DEFAULT} stuck at {flash['current']}"
+        )
+    for f_ in failures:
+        print(f"bench gate FAIL {f_}", file=sys.stderr)
+    _emit(
+        "bench_gate_failures",
+        float(len(failures)),
+        "regressions+flatlines vs %s (tools/bench_diff.py; "
+        "docs/observability.md)" % os.path.basename(baseline_path),
+        None,
+    )
+    return len(failures)
 
 
 if __name__ == "__main__":
@@ -977,6 +1134,22 @@ if __name__ == "__main__":
         "scan via jaxpr) and emit a graph_lint_errors metric line "
         "(docs/analysis.md).  Equivalent to APEX_TPU_BENCH_LINT=1.",
     )
+    ap.add_argument(
+        "--gate",
+        action="store_true",
+        help="after the configs run, judge this invocation's metric "
+        "lines against the last committed BENCH round with "
+        "tools/bench_diff.py (regression + flash-attention flatline "
+        "gates); exit 4 on failure so the trajectory cannot go flat "
+        "silently again (ROADMAP item 2)",
+    )
+    ap.add_argument(
+        "--gate-baseline",
+        metavar="FILE",
+        default=None,
+        help="baseline round for --gate (default: the newest "
+        "BENCH_all_r*.json at the repo root)",
+    )
     args = ap.parse_args()
     if args.hlo_out:
         os.environ["APEX_TPU_BENCH_HLO_OUT"] = args.hlo_out
@@ -996,8 +1169,10 @@ if __name__ == "__main__":
     )
     try:
         main(config=args.config, trace_dir=args.trace)
+        if args.gate and _run_gate(args.gate_baseline):
+            sys.exit(4)
     except BaseException as e:
-        if _FLIGHT is not None:
+        if _FLIGHT is not None and not isinstance(e, SystemExit):
             from apex_tpu.resilience.runner import _safe_dump
 
             # guarded: a failing dump (full disk, bad dir) must not

@@ -330,6 +330,25 @@ def main():
         # an armed trace window and land its final telemetry — those
         # are exactly the artifacts needed to debug the failure
         tracer.stop()
+        # a captured window gets attributed on the way out: the
+        # compute/collective/host-stall split lands on the board (the
+        # watchdog fraction rules' source) and — with --metrics-out —
+        # in the JSONL (docs/observability.md "Attribution & roofline")
+        if tracer.log_dir and os.path.isdir(tracer.log_dir):
+            try:
+                from apex_tpu.observability import attribution as attr
+
+                meas = attr.attribute_trace_dir(tracer.log_dir)
+                fr = attr.publish_attribution(meas, reporter=reporter)
+                print(
+                    "trace attribution (steps %s..%s): compute=%.3f "
+                    "collective=%.3f host_stall=%.3f "
+                    "(tools/step_profile.py adds the roofline)"
+                    % (tracer.start, tracer.end, fr["compute"],
+                       fr["collective"], fr["host_stall"])
+                )
+            except Exception as e:  # the postmortem must not eat the run
+                print(f"trace attribution failed: {e}", file=sys.stderr)
         if reporter is not None:
             registry.fetch()  # drain the async buffers for the report
             final_step = (

@@ -433,7 +433,8 @@ def test_default_rules_overrides_and_unknown():
     rules = default_rules(straggler={"zmax": 2.5})
     names = [r.name for r in rules]
     assert names == ["straggler", "mfu_floor", "goodput_floor",
-                     "loss_spike", "nan_rate", "stale_fetch", "hung_step"]
+                     "loss_spike", "nan_rate", "stale_fetch", "hung_step",
+                     "collective_fraction", "host_stall"]
     assert rules[0].zmax == 2.5
     with pytest.raises(ValueError, match="unknown health rules"):
         default_rules(typo={})

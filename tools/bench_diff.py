@@ -1,0 +1,399 @@
+"""Noise-aware bench regression gate — diff two bench JSONL rounds.
+
+The trajectory went flat for three rounds (BENCH r03→r05: flash
+attention pinned at 43 TFLOP/s) and nothing failed.  This tool makes
+that impossible to miss again: it compares the current bench output
+against a baseline round, per metric, with median-of-trials collapsing
+and a per-metric noise tolerance, and exits non-zero on demand when a
+metric *regresses* — or when a metric that is supposed to be moving is
+*flat*.
+
+Usage::
+
+    # regression gate vs the newest committed BENCH_all round
+    python tools/bench_diff.py current.jsonl --fail-on-regression
+
+    # the flatline catch (r03 vs r05 reproduces the miss):
+    python tools/bench_diff.py BENCH_all_r05.json \
+        --baseline BENCH_all_r03.json \
+        --fail-on-flat long_context_flash_attn_tflops
+
+    # CI schema gate (verify_tier1.sh PERF pass)
+    python tools/bench_diff.py smoke.jsonl \
+        --baseline tools/bench_golden_cpu.jsonl \
+        --check-schema --require-same-metrics
+
+Inputs are bench-line JSONL (``{"metric", "value", "unit",
+"vs_baseline", ...}`` — bench.py stdout, ``--metrics-out`` files,
+``BENCH_all_r*.json`` artifacts) or a driver wrapper object with a
+``"parsed"`` record (``BENCH_r*.json``).  Rules of honesty:
+
+- multiple lines per metric = trials → the MEDIAN is compared;
+- ``degenerate: true`` rows (a multi-device config that ran dp=1/tp=1)
+  are EXCLUDED from gating — a single-device proxy can neither regress
+  nor prove a scale win;
+- ``value: null`` rows (explicit non-measurements) are excluded but
+  reported;
+- direction is per metric: ``*_ms`` metrics and ``ms/...`` units are
+  lower-is-better, everything else higher-is-better;
+- ``--check-schema`` hard-fails drift: contract key order, and the
+  degenerate flag must match the dp=/tp= world printed in the unit
+  string (a ``dp=1`` row without the flag is a silent proxy; a
+  ``dp=8`` row WITH it is hiding a real measurement).
+
+Exit codes: 0 clean, 1 gate failure (regression/flat/schema), 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline")
+
+#: the metric the roadmap's flatline lesson is about — the default
+#: --fail-on-flat target
+FLAT_DEFAULT = "long_context_flash_attn_tflops"
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def load_records(path: str) -> List[dict]:
+    """Bench-schema records from JSONL, a JSON array, or a BENCH_r*
+    driver wrapper ({"parsed": {...}})."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.strip()
+    if not stripped:
+        return []
+    try:
+        obj = json.loads(stripped)
+    except ValueError:
+        obj = None
+    if isinstance(obj, list):
+        return [r for r in obj if isinstance(r, dict)]
+    if isinstance(obj, dict):
+        if "metric" in obj:
+            return [obj]
+        parsed = obj.get("parsed")
+        return [parsed] if isinstance(parsed, dict) else []
+    records = []
+    for line in stripped.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # logs interleaved with metric lines
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    return records
+
+
+def default_baseline(root: str = REPO) -> Optional[str]:
+    """The newest committed round by the number in its name:
+    BENCH_all_r*.json preferred (full batches), BENCH_r*.json
+    fallback."""
+    def _round(p):
+        m = re.search(r"_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    for pattern in ("BENCH_all_r*.json", "BENCH_r*.json"):
+        paths = sorted(glob.glob(os.path.join(root, pattern)), key=_round)
+        if paths:
+            return paths[-1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# collapsing + comparison
+# ---------------------------------------------------------------------------
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def collapse(records: List[dict]) -> Dict[str, dict]:
+    """metric → {value (median of trials), trials, unit, degenerate,
+    measured}.  The LAST line's unit/degenerate wins (a re-run appended
+    to the same JSONL supersedes)."""
+    out: Dict[str, dict] = {}
+    for rec in records:
+        m = rec.get("metric")
+        if not isinstance(m, str):
+            continue
+        slot = out.setdefault(
+            m, {"values": [], "unit": "", "degenerate": False}
+        )
+        v = rec.get("value")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            slot["values"].append(float(v))
+        slot["unit"] = rec.get("unit", "") or slot["unit"]
+        slot["degenerate"] = bool(rec.get("degenerate", False))
+    for m, slot in out.items():
+        vals = slot.pop("values")
+        slot["trials"] = len(vals)
+        slot["measured"] = bool(vals)
+        slot["value"] = _median(vals) if vals else None
+    return out
+
+
+def direction(metric: str, unit: str = "") -> str:
+    """"lower" for time-like metrics, else "higher"."""
+    if metric.endswith("_ms") or metric.endswith("_s"):
+        return "lower"
+    if (unit or "").strip().startswith("ms"):
+        return "lower"
+    return "higher"
+
+
+def compare(
+    current: Dict[str, dict],
+    baseline: Dict[str, dict],
+    *,
+    tolerance: float = 0.05,
+    tolerances: Optional[Dict[str, float]] = None,
+    flat_tolerance: float = 0.01,
+) -> List[dict]:
+    """Row per metric (union of both rounds) with a status:
+
+    ``regressed`` / ``improved`` / ``ok`` (within noise, moving) /
+    ``flat`` (within ``flat_tolerance`` — indistinguishable from the
+    baseline) / ``degenerate`` (excluded) / ``not-measured`` (null
+    value) / ``new`` / ``missing``.
+    """
+    tolerances = tolerances or {}
+    rows = []
+    for metric in sorted(set(current) | set(baseline)):
+        cur, base = current.get(metric), baseline.get(metric)
+        row = {"metric": metric}
+        if cur is None:
+            rows.append({**row, "status": "missing",
+                         "baseline": base["value"]})
+            continue
+        if base is None:
+            rows.append({**row, "status": "new", "current": cur["value"]})
+            continue
+        row.update(current=cur["value"], baseline=base["value"],
+                   trials=cur["trials"])
+        if cur["degenerate"] or base["degenerate"]:
+            rows.append({**row, "status": "degenerate"})
+            continue
+        if not cur["measured"] or not base["measured"]:
+            rows.append({**row, "status": "not-measured"})
+            continue
+        tol = tolerances.get(metric, tolerance)
+        denom = abs(base["value"]) or 1e-12
+        rel = (cur["value"] - base["value"]) / denom
+        row["delta"] = rel
+        if direction(metric, cur["unit"]) == "lower":
+            rel = -rel  # improvement = smaller
+        if abs(row["delta"]) <= flat_tolerance:
+            status = "flat"
+        elif rel < -tol:
+            status = "regressed"
+        elif rel > tol:
+            status = "improved"
+        else:
+            status = "ok"
+        rows.append({**row, "status": status})
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# schema check
+# ---------------------------------------------------------------------------
+
+_WORLD_RE = re.compile(r"\b(dp|tp)=(\d+)\b")
+
+
+def check_schema(records: List[dict]) -> List[str]:
+    """Contract-drift findings (empty = clean).  Checks: the four
+    contract keys lead every record in order; metric/unit types; the
+    degenerate flag is HONEST against the dp=/tp= world the unit
+    string records."""
+    problems = []
+    if not records:
+        return ["no bench records found"]
+    for i, rec in enumerate(records):
+        where = f"line {i + 1} ({rec.get('metric', '?')})"
+        if list(rec)[:4] != list(CONTRACT_KEYS):
+            problems.append(
+                f"{where}: keys {list(rec)[:4]} != contract "
+                f"{list(CONTRACT_KEYS)}"
+            )
+            continue
+        if not isinstance(rec["metric"], str) or not rec["metric"]:
+            problems.append(f"{where}: empty metric name")
+        v = rec["value"]
+        if v is not None and (isinstance(v, bool)
+                              or not isinstance(v, (int, float))):
+            problems.append(f"{where}: value {v!r} is not a number/null")
+        if not isinstance(rec["unit"], str):
+            problems.append(f"{where}: unit is not a string")
+        worlds = dict(_WORLD_RE.findall(rec.get("unit", "") or ""))
+        flagged = bool(rec.get("degenerate", False))
+        if worlds:
+            collapsed = all(int(n) == 1 for n in worlds.values())
+            if collapsed and not flagged:
+                problems.append(
+                    f"{where}: unit says {worlds} (single-device proxy) "
+                    "but the row is not marked degenerate"
+                )
+            if not collapsed and flagged:
+                problems.append(
+                    f"{where}: marked degenerate but unit says {worlds} "
+                    "(a real multi-device measurement)"
+                )
+        elif flagged:
+            problems.append(
+                f"{where}: marked degenerate but the unit string records "
+                "no dp=/tp= world to justify it"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def render(rows: List[dict]) -> str:
+    out = [f"{'metric':<38} {'baseline':>12} {'current':>12} "
+           f"{'delta':>8}  status"]
+    for r in rows:
+        base = r.get("baseline")
+        cur = r.get("current")
+        delta = r.get("delta")
+        out.append(
+            f"{r['metric']:<38} "
+            f"{base if base is not None else '-':>12} "
+            f"{cur if cur is not None else '-':>12} "
+            f"{f'{100 * delta:+.1f}%' if delta is not None else '-':>8}"
+            f"  {r['status']}"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="noise-aware bench regression/flatline gate "
+        "(docs/observability.md)"
+    )
+    ap.add_argument("current", help="bench JSONL / BENCH_*.json to judge")
+    ap.add_argument("--baseline", default=None,
+                    help="round to compare against (default: the newest "
+                    "BENCH_all_r*.json at the repo root)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative noise tolerance (default 0.05)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric tolerance override (repeatable)")
+    ap.add_argument("--flat-tolerance", type=float, default=0.01,
+                    help="|delta| at or under this is 'flat' "
+                    "(default 0.01)")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 if any gated metric regressed past "
+                    "tolerance")
+    ap.add_argument("--fail-on-flat", nargs="?", const=FLAT_DEFAULT,
+                    default=None, metavar="METRICS",
+                    help="exit 1 if these comma-separated metrics are "
+                    f"flat vs baseline (bare flag: {FLAT_DEFAULT} — "
+                    "the r03→r05 lesson); a metric missing from the "
+                    "current round also fails")
+    ap.add_argument("--check-schema", action="store_true",
+                    help="hard-fail contract drift in CURRENT (key "
+                    "order, degenerate honesty vs the unit's dp=/tp=)")
+    ap.add_argument("--require-same-metrics", action="store_true",
+                    help="fail when CURRENT's metric set differs from "
+                    "the baseline's (CI golden-line mode)")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="write the comparison rows as one JSON object")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or default_baseline()
+    if baseline_path is None:
+        ap.error("no --baseline given and no BENCH_*round artifacts found")
+    cur_records = load_records(args.current)
+    base_records = load_records(baseline_path)
+    tolerances = {}
+    for spec in args.tol:
+        if "=" not in spec:
+            ap.error(f"--tol wants METRIC=FRAC, got {spec!r}")
+        k, v = spec.split("=", 1)
+        tolerances[k] = float(v)
+
+    failures: List[str] = []
+    if args.check_schema:
+        for p in check_schema(cur_records):
+            failures.append(f"schema: {p}")
+
+    current = collapse(cur_records)
+    baseline = collapse(base_records)
+    rows = compare(
+        current, baseline, tolerance=args.tolerance,
+        tolerances=tolerances, flat_tolerance=args.flat_tolerance,
+    )
+    print(f"baseline: {baseline_path}")
+    print(render(rows))
+
+    if args.require_same_metrics and set(current) != set(baseline):
+        failures.append(
+            f"metric set drift: only-current="
+            f"{sorted(set(current) - set(baseline))} only-baseline="
+            f"{sorted(set(baseline) - set(current))}"
+        )
+    by_metric = {r["metric"]: r for r in rows}
+    if args.fail_on_regression:
+        for r in rows:
+            if r["status"] == "regressed":
+                failures.append(
+                    f"regression: {r['metric']} "
+                    f"{r['baseline']} -> {r['current']} "
+                    f"({100 * r['delta']:+.1f}%)"
+                )
+    if args.fail_on_flat:
+        for metric in args.fail_on_flat.split(","):
+            metric = metric.strip()
+            r = by_metric.get(metric)
+            if r is None or r["status"] in ("missing", "not-measured"):
+                failures.append(
+                    f"flatline gate: {metric} not measured this round"
+                )
+            elif r["status"] == "flat":
+                failures.append(
+                    f"flatline: {metric} stuck at {r['current']} "
+                    f"(baseline {r['baseline']}, "
+                    f"|delta| <= {args.flat_tolerance:.0%})"
+                )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"baseline": baseline_path, "rows": rows,
+                       "failures": failures}, f, indent=2)
+            f.write("\n")
+    for f_ in failures:
+        print(f"FAIL {f_}", file=sys.stderr)
+    if failures:
+        return 1
+    print("bench_diff: OK "
+          f"({sum(1 for r in rows if r['status'] == 'degenerate')} "
+          "degenerate rows excluded)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,15 @@ TensorBoard needed in this environment.
 scheduled-trace windows under the dir: it prints which windows overlap
 the incident's step span and summarizes the latest overlapping one —
 "was anything profiling when it died, and what did the chip do?".
+
+``--attribution`` additionally runs the step-time attribution layer
+(``apex_tpu.observability.attribution``, docs/observability.md
+"Attribution & roofline") over the chosen window: bucket fractions
+(matmul/attention/norm-elementwise/collective/other), the
+compute/collective/host-stall split, and — with ``--hlo`` — cost-model
+exact bucketing of every fused op.  ``tools/step_profile.py`` is the
+full workflow (profile + roofline + watchdog); this flag answers the
+same question for a trace that already exists.
 """
 
 from __future__ import annotations
@@ -226,11 +235,56 @@ def summarize(trace: dict, top: int, like: str | None, hlo_meta=None):
         print(f"{dur:9.2f} {n:6d} {dur / n * 1e3:8.1f}  {name[:110]}{attr[:160]}")
 
 
+def print_attribution(trace: dict, hlo_path: str | None) -> None:
+    """Bucket fractions of one loaded trace (the --attribution block)."""
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from apex_tpu.observability import attribution as A
+
+    hlo_map = None
+    cost_weights = None
+    if hlo_path and os.path.exists(hlo_path):
+        with open(hlo_path) as f:
+            text = f.read()
+        hlo_map = A.hlo_bucket_map(text)
+        cost_weights = A.attribute_cost_model(text).bucket_fractions()
+    meas = A.attribute_trace(
+        trace, hlo_map=hlo_map, cost_weights=cost_weights
+    )
+    fr = meas.fractions()
+    print(
+        "attribution (%s, %d op events): compute=%.3f collective=%.3f "
+        "host_stall=%.3f"
+        % (meas.source, meas.events, fr["compute"], fr["collective"],
+           fr["host_stall"])
+    )
+    for bucket, share in sorted(
+        meas.bucket_fractions().items(), key=lambda kv: -kv[1]
+    ):
+        if share > 0:
+            print(f"  {bucket:<18} {100 * share:5.1f}% of busy "
+                  f"({meas.bucket_ms[bucket]:.2f} ms)")
+    print(f"  span={meas.span_ms:.1f}ms busy={meas.busy_ms:.1f}ms "
+          f"stall={meas.stall_ms:.1f}ms "
+          "(tools/step_profile.py adds the roofline)\n")
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("log_dir")
     ap.add_argument("-n", type=int, default=30)
     ap.add_argument("--like", default=None, help="substring filter")
+    ap.add_argument(
+        "--attribution", action="store_true",
+        help="print step-time attribution bucket fractions for the "
+        "chosen window (docs/observability.md 'Attribution & "
+        "roofline'); --hlo upgrades the bucketing to the cost model's "
+        "exact per-op join",
+    )
     ap.add_argument(
         "--step", type=int, default=None,
         help="pick the scheduled-trace window (steps_<start>_<end>/ "
@@ -270,4 +324,7 @@ if __name__ == "__main__":
         else:
             print(f"[trace_summary] --hlo {args.hlo} not found; "
                   "printing un-attributed summary")
-    summarize(load_trace(args.log_dir), args.n, args.like, hlo_meta=meta)
+    trace = load_trace(args.log_dir)
+    if args.attribution:
+        print_attribution(trace, args.hlo)
+    summarize(trace, args.n, args.like, hlo_meta=meta)

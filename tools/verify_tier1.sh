@@ -35,8 +35,21 @@
 # jaxpr + optimized HLO — any ERROR-severity finding (host transfer,
 # dropped donation, f64, collective mismatch) hard-fails.
 #
+# A PERF stage guards the perf-observability contract
+# (docs/observability.md "Attribution & roofline"):
+#   1. the committed r03→r05 flash-attention flatline MUST be caught by
+#      tools/bench_diff.py --fail-on-flat (and the same rounds must
+#      pass the plain regression gate — no false positive);
+#   2. a short CPU bench config (bench.py --config smoke) runs end to
+#      end and its lines pass the schema gate against the committed
+#      golden (key order, degenerate honesty vs the unit's dp=/tp=);
+#   3. tools/step_profile.py --target resilient emits
+#      compute/collective/host-stall fractions summing to 1 +- 0.02
+#      with roofline-vs-StepMeter MFU agreement within 5% (the ISSUE 6
+#      acceptance line).
+#
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -46,6 +59,7 @@
 #   T1_SKIP_OBS=1               skip the observability pass
 #   T1_SKIP_FLIGHT=1            skip the flight-recorder pass
 #   T1_SKIP_LINT=1              skip the static-analysis pass
+#   T1_SKIP_PERF=1              skip the perf-gate pass
 
 set -o pipefail
 
@@ -222,14 +236,91 @@ if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
     fi
 fi
 
+perf_rc=0
+if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
+    # 1a. the flatline catch: r03 vs r05 sat at 43 TFLOP/s — the gate
+    #     MUST exit non-zero on these committed artifacts
+    if python tools/bench_diff.py BENCH_all_r05.json \
+        --baseline BENCH_all_r03.json --fail-on-flat \
+        >/dev/null 2>>"$LOG"; then
+        echo "TIER1-PERF: bench_diff failed to catch the committed" \
+            "r03->r05 flash flatline" | tee -a "$LOG"
+        perf_rc=1
+    fi
+    # 1b. ...and no false positive from the plain regression gate
+    if [ "$perf_rc" -eq 0 ]; then
+        python tools/bench_diff.py BENCH_all_r05.json \
+            --baseline BENCH_all_r03.json --fail-on-regression \
+            2>&1 | tail -n 2 | tee -a "$LOG"
+        perf_rc=${PIPESTATUS[0]}
+    fi
+    # 2. short CPU bench config + schema gate vs the committed golden
+    if [ "$perf_rc" -eq 0 ]; then
+        PERF_OUT="$(mktemp /tmp/_t1_perf.XXXXXX.jsonl)"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+            APEX_TPU_BENCH_WATCHDOG_S=0 \
+            python bench.py --config smoke --metrics-out "$PERF_OUT" \
+            2>&1 | tail -n 2 | tee -a "$LOG"
+        perf_rc=${PIPESTATUS[0]}
+        if [ "$perf_rc" -eq 0 ]; then
+            python tools/bench_diff.py "$PERF_OUT" \
+                --baseline tools/bench_golden_cpu.jsonl \
+                --check-schema --require-same-metrics \
+                2>&1 | tail -n 2 | tee -a "$LOG"
+            perf_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$perf_rc" -eq 0 ]; then
+            rm -f "$PERF_OUT"
+        else
+            echo "TIER1-PERF: smoke/schema gate failed (lines kept at" \
+                "$PERF_OUT)" | tee -a "$LOG"
+        fi
+    fi
+    # 3. the ISSUE 6 acceptance line: attribution fractions + MFU
+    if [ "$perf_rc" -eq 0 ]; then
+        SP_JSON="$(mktemp /tmp/_t1_stepprof.XXXXXX.json)"
+        timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+            python tools/step_profile.py --target resilient --steps 5 \
+            --json "$SP_JSON" 2>&1 | tail -n 4 | tee -a "$LOG"
+        perf_rc=${PIPESTATUS[0]}
+        if [ "$perf_rc" -eq 0 ]; then
+            python - "$SP_JSON" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+p = json.load(open(sys.argv[1]))
+assert abs(p["fraction_sum"] - 1.0) <= 0.02, p["fraction_sum"]
+assert set(p["fractions"]) == {"compute", "collective", "host_stall"}
+assert p["mfu"]["agreement"] <= 0.05, p["mfu"]
+assert p["roofline"][-1]["bucket"] == "total"
+print(f"step_profile OK: fractions sum={p['fraction_sum']:.3f} "
+      f"(source={p['source']}), mfu agreement="
+      f"{p['mfu']['agreement']:.4f}")
+PYEOF
+            perf_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$perf_rc" -eq 0 ]; then
+            rm -f "$SP_JSON"
+        else
+            echo "TIER1-PERF: step_profile acceptance failed (json at" \
+                "$SP_JSON)" | tee -a "$LOG"
+        fi
+    fi
+    if [ "$perf_rc" -eq 0 ]; then
+        echo "TIER1-PERF: PASS"
+    else
+        echo "TIER1-PERF: FAIL (rc=$perf_rc)"
+    fi
+fi
+
 if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
-    && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ]; then
+    && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] \
+    && [ "$perf_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, perf rc=$perf_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$obs_rc" -ne 0 ] && exit "$obs_rc"
 [ "$flight_rc" -ne 0 ] && exit "$flight_rc"
-exit "$lint_rc"
+[ "$lint_rc" -ne 0 ] && exit "$lint_rc"
+exit "$perf_rc"
