@@ -68,8 +68,11 @@ from apex_tpu.observability.health import (  # noqa: F401
     CollectiveFractionRule,
     HealthEvent,
     HostStallRule,
+    QueueDepthRule,
+    TTFTRule,
     Watchdog,
     default_rules,
+    serve_rules,
 )
 from apex_tpu.observability.attribution import (  # noqa: F401
     CostAttribution,
@@ -128,8 +131,11 @@ __all__ = [
     "Watchdog",
     "HealthEvent",
     "default_rules",
+    "serve_rules",
     "CollectiveFractionRule",
     "HostStallRule",
+    "TTFTRule",
+    "QueueDepthRule",
     "StepMeter",
     "GoodputAccountant",
     "BUCKETS",

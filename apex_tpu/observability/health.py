@@ -30,7 +30,19 @@ rule                      fires when
                           lever is wire format/overlap, not kernels)
 :class:`HostStallRule`    the attribution's host-stall share exceeds a
                           floor (the chip is starving, not slow)
+:class:`TTFTRule`         serving time-to-first-token over its SLO
+                          deadline (``serve/ttft_ms`` gauge; critical
+                          past 2x) — :func:`serve_rules` only
+:class:`QueueDepthRule`   the serving admission queue backs up past a
+                          depth budget (``serve/queue_depth``) —
+                          :func:`serve_rules` only
 ========================  =================================================
+
+Training loops use :func:`default_rules`; the serving path
+(:mod:`apex_tpu.serve`) uses :func:`serve_rules` — TTFT/queue-depth
+plus the substrate rules (stale fetch, hung step) — so tail-latency
+regressions page the SAME health layer training uses
+(``docs/serving.md``).
 
 The two fraction rules read the step-time attribution published by
 :func:`~apex_tpu.observability.attribution.publish_attribution` —
@@ -68,7 +80,10 @@ __all__ = [
     "HungStepRule",
     "CollectiveFractionRule",
     "HostStallRule",
+    "TTFTRule",
+    "QueueDepthRule",
     "default_rules",
+    "serve_rules",
     "Watchdog",
 ]
 
@@ -439,6 +454,102 @@ class HostStallRule(_AttributionFractionRule):
 
     def __init__(self, max_fraction: float = 0.15, cooldown: int = 64):
         super().__init__(max_fraction, cooldown)
+
+
+class TTFTRule(Rule):
+    """Serving time-to-first-token over its deadline — tail latency is
+    regressing at the front door.  Reads the ``serve/ttft_ms`` gauge
+    the :class:`apex_tpu.serve.scheduler.ContinuousBatchingScheduler`
+    publishes on every admission; like :class:`LossSpikeRule`, only a
+    freshly fetched value is judged (stale reads between cadences
+    neither re-trigger nor mask).  Critical at ``critical_factor`` x
+    the deadline."""
+
+    name = "ttft"
+
+    def __init__(self, deadline_ms: float = 1000.0,
+                 key: str = "serve/ttft_ms",
+                 critical_factor: float = 2.0, cooldown: int = 64):
+        super().__init__(cooldown)
+        self.deadline_ms = deadline_ms
+        self.key = key
+        self.critical_factor = critical_factor
+        self._last_fetched: Optional[int] = None
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        fetched = reg.fetched_step
+        if fetched is None or fetched == self._last_fetched:
+            return []
+        value = reg.values().get(self.key)
+        if value is None:
+            return []
+        self._last_fetched = fetched
+        if value > self.deadline_ms:
+            severity = (
+                "critical"
+                if value > self.critical_factor * self.deadline_ms
+                else "warn"
+            )
+            return [
+                HealthEvent(
+                    self.name, severity, int(step), float(value),
+                    float(self.deadline_ms),
+                    f"TTFT {value:.1f}ms over deadline "
+                    f"{self.deadline_ms:.0f}ms",
+                )
+            ]
+        return []
+
+
+class QueueDepthRule(Rule):
+    """The serving admission queue backing up past a depth budget —
+    arrivals outpace capacity and TTFT is about to follow.  Reads the
+    ``serve/queue_depth`` gauge; sustained depth re-emits on the
+    cooldown heartbeat like every rule."""
+
+    name = "queue_depth"
+
+    def __init__(self, max_depth: int = 16,
+                 key: str = "serve/queue_depth", cooldown: int = 64):
+        super().__init__(cooldown)
+        self.max_depth = max_depth
+        self.key = key
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        value = reg.values().get(self.key)
+        if value is None:
+            return []
+        if value > self.max_depth:
+            return self._event(
+                step, value, self.max_depth,
+                f"admission queue depth {value:.0f} over budget "
+                f"{self.max_depth} (arrivals outpacing decode capacity)",
+            )
+        return []
+
+
+def serve_rules(**overrides) -> List[Rule]:
+    """The serving-path rule set (``docs/serving.md``): TTFT deadline,
+    queue-depth budget, plus the substrate rules that apply to any
+    long-running device loop (stale fetch, hung step).  Same override
+    convention as :func:`default_rules`, e.g.
+    ``serve_rules(ttft={"deadline_ms": 250.0})``."""
+    specs = {
+        "ttft": TTFTRule,
+        "queue_depth": QueueDepthRule,
+        "stale_fetch": StaleFetchRule,
+        "hung_step": HungStepRule,
+    }
+    unknown = set(overrides) - set(specs)
+    if unknown:
+        raise ValueError(f"unknown serve health rules: {sorted(unknown)}")
+    return [cls(**overrides.get(name, {})) for name, cls in specs.items()]
 
 
 def default_rules(**overrides) -> List[Rule]:

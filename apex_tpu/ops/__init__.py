@@ -10,6 +10,10 @@ from apex_tpu.ops.attention import (  # noqa: F401
     fmha_qkvpacked,
     mha_reference,
 )
+from apex_tpu.ops.paged_attention import (  # noqa: F401
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
 from apex_tpu.ops.layer_norm import (  # noqa: F401
     fused_layer_norm,
     fused_layer_norm_affine,

@@ -1,0 +1,122 @@
+"""Paged single-query decode attention — public API and dispatch.
+
+The serving counterpart of :mod:`apex_tpu.ops.attention`: one query row
+per sequence against a KV history living in the block-pooled paged
+cache (:mod:`apex_tpu.serve.cache`).  Two numerically-identical
+implementations behind the usual :mod:`apex_tpu.ops._dispatch` policy:
+
+- **jnp path** — gathers the live pages into a contiguous history and
+  runs masked softmax attention; XLA-fused, the correctness reference,
+  and what CPU serving uses by default (the gather is a device-side
+  ``take``, no host transfer);
+- **Pallas path** (:func:`apex_tpu.ops.pallas.decode_attention.
+  paged_decode_fwd`) — reads the pages IN PLACE through
+  scalar-prefetched page-table indexing: no gather materialization,
+  O(live tokens) HBM traffic, with the per-layer query RoPE rotation
+  and the int8-KV dequant fused into the same kernel.
+
+Both paths share the semantics: positions ``>= lengths[b]`` are masked,
+an idle slot (``lengths[b] == 0``) returns exactly zeros, and RoPE is
+applied to the query INSIDE the attention op (the cached keys were
+rotated at append time).  No backward: decode is inference-only, and
+the op is wrapped in ``stop_gradient`` to make that explicit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import _dispatch
+from apex_tpu.ops.pallas.decode_attention import paged_decode_fwd
+from apex_tpu.ops.pallas.flash_attention import MASK_VALUE
+from apex_tpu.ops.rope import rotate_half
+
+__all__ = [
+    "paged_decode_attention",
+    "paged_decode_attention_reference",
+]
+
+
+def paged_decode_attention_reference(
+    q, k_pages, v_pages, page_table, lengths, *,
+    scale: Optional[float] = None,
+    k_scale=None, v_scale=None, rope_cos=None, rope_sin=None,
+):
+    """Gather-then-attend jnp composition — the correctness reference.
+
+    Same signature and semantics as :func:`paged_decode_attention`.
+    """
+    b, h, d = q.shape
+    page = k_pages.shape[2]
+    np_ = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    if rope_cos is not None:
+        cos = rope_cos.astype(jnp.float32)[:, None, :]  # (B, 1, D)
+        sin = rope_sin.astype(jnp.float32)[:, None, :]
+        qf = qf * cos + rotate_half(qf) * sin
+    # gather: (B, NP, H, page, D) -> (B, H, NP*page, D)
+    k = jnp.take(k_pages, page_table, axis=0).astype(jnp.float32)
+    v = jnp.take(v_pages, page_table, axis=0).astype(jnp.float32)
+    if k_scale is not None:
+        k = k * jnp.take(k_scale, page_table, axis=0).astype(
+            jnp.float32
+        )[..., None]
+        v = v * jnp.take(v_scale, page_table, axis=0).astype(
+            jnp.float32
+        )[..., None]
+    k = jnp.moveaxis(k, 1, 2).reshape(b, h, np_ * page, d)
+    v = jnp.moveaxis(v, 1, 2).reshape(b, h, np_ * page, d)
+    s = jnp.einsum("bhd,bhtd->bht", qf, k) * scale
+    pos = jnp.arange(np_ * page, dtype=jnp.int32)
+    valid = pos[None, :] < lengths[:, None]  # (B, T)
+    s = jnp.where(valid[:, None, :], s, MASK_VALUE)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bht,bhtd->bhd", p / jnp.maximum(l, 1e-30), v)
+    # idle slots: softmax over an all-masked row would be a uniform
+    # average of garbage pages — the contract is zeros
+    o = jnp.where(lengths[:, None, None] > 0, o, 0.0)
+    return o.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q, k_pages, v_pages, page_table, lengths, *,
+    scale: Optional[float] = None,
+    k_scale=None, v_scale=None, rope_cos=None, rope_sin=None,
+):
+    """Single-query attention over the paged KV cache.
+
+    - ``q`` (B, H, D): the current token's query rows (PRE-RoPE when
+      ``rope_cos``/``rope_sin`` are given — the rotation fuses here);
+    - ``k_pages``/``v_pages`` (P, H, page, D): the shared page pool
+      (f32/bf16, or int8 codes with ``k_scale``/``v_scale`` (P, H,
+      page) blockwise f32 scales — the ``parallel/comm.py`` codec
+      layout at ``block = D``);
+    - ``page_table`` (B, NP) int32; ``lengths`` (B,) int32: live KV
+      positions per sequence including the current token.
+
+    Returns (B, H, D) in ``q.dtype``.  Inference-only (no VJP;
+    gradients are stopped).  Dispatch: the Pallas in-place page-walk
+    kernel on TPU (or when forced), the gather-based jnp composition
+    otherwise.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    args = (q, k_pages, v_pages, page_table, lengths)
+    kw = dict(
+        scale=scale, k_scale=k_scale, v_scale=v_scale,
+        rope_cos=rope_cos, rope_sin=rope_sin,
+    )
+    if _dispatch.use_pallas():
+        _dispatch.record_path("paged_decode_attention", "pallas")
+        out = paged_decode_fwd(*args, **kw)
+    else:
+        _dispatch.record_path("paged_decode_attention", "jnp")
+        out = paged_decode_attention_reference(*args, **kw)
+    return jax.lax.stop_gradient(out)

@@ -1,0 +1,55 @@
+"""TPU serving — AOT inference engine, paged KV cache, continuous batching.
+
+The decode half of apex_tpu (ROADMAP item 1): the training stack
+produces a ``GptModel`` parameter tree; this package serves it under
+heavy traffic with the same engineering discipline the training side
+gets — AOT-compiled step programs proven transfer-free and
+donation-aliased by :mod:`apex_tpu.analysis`, telemetry through the
+:mod:`apex_tpu.observability` spine, and the ``parallel/comm.py``
+blockwise int8 codec reused as the KV and weight wire formats.
+
+- :mod:`apex_tpu.serve.cache` — :class:`PagePool` + the paged KV
+  pool: fixed-size pages from one shared pool, so cache memory scales
+  with live tokens and freeing is O(1) with no defragmentation.
+- :mod:`apex_tpu.serve.model` — the functional prefill/decode
+  re-expression of ``models/gpt.py`` (numerics pinned against
+  ``GptModel.apply``), plus int8 weight wires.
+- :mod:`apex_tpu.serve.engine` — :class:`InferenceEngine`: one AOT
+  executable per prefill bucket + one for the decode slot array,
+  verified at build.
+- :mod:`apex_tpu.serve.scheduler` —
+  :class:`ContinuousBatchingScheduler`: page-granular admission into
+  the running decode batch, TTFT SLO deadlines, graceful shedding on
+  pool exhaustion.
+
+Fused decode attention lives with the other kernels
+(:func:`apex_tpu.ops.paged_decode_attention` /
+``ops/pallas/decode_attention.py``).  Tour: ``docs/serving.md``;
+runnable train→serve round-trip: ``examples/simple/serve/``.
+"""
+
+from apex_tpu.serve.cache import (  # noqa: F401
+    NULL_PAGE,
+    PagePool,
+    init_kv_pages,
+)
+from apex_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    ServeConfig,
+)
+from apex_tpu.serve.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    Request,
+    declare_serve_metrics,
+)
+
+__all__ = [
+    "NULL_PAGE",
+    "PagePool",
+    "init_kv_pages",
+    "InferenceEngine",
+    "ServeConfig",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "declare_serve_metrics",
+]

@@ -1,0 +1,188 @@
+"""Paged KV cache — block-pooled pages so memory scales with live tokens.
+
+A serving process admits and retires sequences continuously; a
+contiguous per-sequence KV buffer sized for the maximum context would
+waste ``max_context - live`` slots per sequence and make admission a
+memory-compaction problem.  The paged design (vLLM's PagedAttention,
+PAPERS.md "LLM Inference Acceleration via Efficient Operation Fusion"
+motivates the fused read side) splits the cache into fixed-size
+**pages** drawn from one shared pool:
+
+- **device side** — one pool per layer, stacked: ``k``/``v`` arrays of
+  shape ``(L, P, H, page, D)`` (heads OUTSIDE the page dim — the layout
+  :func:`apex_tpu.ops.paged_decode_attention` contracts with no
+  transposes).  With ``kv_wire="int8"`` the pools hold blockwise int8
+  codes plus f32 scale planes ``(L, P, H, page)`` — one scale per
+  (head, token) row at ``block = head_dim``, the exact
+  ``parallel/comm.py`` codec (:func:`~apex_tpu.parallel.comm.
+  quantize_blocks`), so the KV wire format is the same code the
+  gradient wire uses.
+- **host side** — :class:`PagePool`, a free-list allocator.  Page 0 is
+  the reserved **null page**: page-table entries beyond a sequence's
+  live count point at it, padded prefill tails scatter into it, and
+  idle decode slots append into it — it is write-only garbage that the
+  ``lengths`` masking guarantees is never read.
+
+There is no defragmentation pass and none is needed: pages are
+fixed-size and fully owned by one sequence, so freeing a sequence
+returns its pages to the free list with zero compaction — occupancy is
+exactly ``live_pages / usable_pages`` at all times.
+
+The device-side write helpers here are pure functions meant to be
+called INSIDE the engine's jitted step programs; the engine donates the
+cache arrays so the scatters update pages in place
+(``analysis.check``'s donation lint proves the aliasing at build).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.parallel import comm
+
+__all__ = [
+    "NULL_PAGE",
+    "PagePool",
+    "init_kv_pages",
+    "encode_kv",
+    "pack_prompt_pages",
+    "write_prompt_pages",
+    "append_token_kv",
+]
+
+#: page 0 — never allocated; the write-only garbage target for padded
+#: tails and idle slots
+NULL_PAGE = 0
+
+
+class PagePool:
+    """Host-side free-list allocator over ``num_pages`` device pages.
+
+    Page 0 (:data:`NULL_PAGE`) is reserved, so ``num_pages - 1`` pages
+    are usable.  ``alloc`` is all-or-nothing: a request that cannot get
+    every page it asked for gets none (no partial admissions to later
+    roll back — the scheduler's shedding logic stays trivial).
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # LIFO free list: recently freed pages are re-used first (their
+        # content is dead by construction, and re-use keeps the touched
+        # working set small)
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def usable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.usable - self.available
+
+    def occupancy(self) -> float:
+        """Live fraction of the usable pool (0..1)."""
+        return self.in_use / self.usable
+
+    def pages_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` KV positions."""
+        return -(-max(tokens, 0) // self.page_size)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None when the pool cannot cover all of them
+        (all-or-nothing; never hands out :data:`NULL_PAGE`)."""
+        if n < 0:
+            raise ValueError("cannot allocate a negative page count")
+        if n > len(self._free):
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"page {p} is not an allocatable page id")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+# ---------------------------------------------------------------------------
+# device-side pure helpers (called inside the engine's jitted steps)
+# ---------------------------------------------------------------------------
+
+
+def init_kv_pages(
+    num_layers: int,
+    num_pages: int,
+    num_heads: int,
+    page_size: int,
+    head_dim: int,
+    *,
+    dtype=jnp.bfloat16,
+    kv_wire: str = "f32",
+) -> dict:
+    """Fresh zeroed pool arrays: ``{"k", "v"}`` of ``(L, P, H, page,
+    D)``, plus ``{"k_scale", "v_scale"}`` ``(L, P, H, page)`` f32 planes
+    under ``kv_wire="int8"`` (codes then carry dtype int8)."""
+    if kv_wire not in ("f32", "int8"):
+        raise ValueError(f"kv_wire must be 'f32' or 'int8', got {kv_wire!r}")
+    shape = (num_layers, num_pages, num_heads, page_size, head_dim)
+    store = jnp.int8 if kv_wire == "int8" else dtype
+    cache = {
+        "k": jnp.zeros(shape, store),
+        "v": jnp.zeros(shape, store),
+    }
+    if kv_wire == "int8":
+        # two DISTINCT buffers: the engine donates the whole cache
+        # tree, and donating one shared buffer twice is a runtime error
+        cache["k_scale"] = jnp.ones(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.ones(shape[:-1], jnp.float32)
+    return cache
+
+
+def encode_kv(x):
+    """Blockwise int8 codes + scales for KV rows ``(..., D)`` — the
+    ``parallel/comm.py`` codec at ``block = D`` (one f32 scale per
+    (head, token) row; an all-zero row gets scale 1.0, so the null page
+    stays NaN-free)."""
+    d = x.shape[-1]
+    codes, scale = comm.quantize_blocks(x.astype(jnp.float32), block=d)
+    return codes, scale[..., 0]
+
+
+def pack_prompt_pages(kv, page_size: int):
+    """``(S, H, D)`` per-position rows -> ``(NP, H, page, D)`` page
+    blocks (``S`` must be a page multiple — prefill buckets are)."""
+    s, h, d = kv.shape
+    if s % page_size:
+        raise ValueError(f"prompt length {s} is not a page multiple")
+    return jnp.transpose(
+        kv.reshape(s // page_size, page_size, h, d), (0, 2, 1, 3)
+    )
+
+
+def write_prompt_pages(pages, new, page_ids):
+    """Scatter layer-stacked page blocks ``new`` ``(L, NP, H, page,
+    D[, ...])`` into the pool ``pages`` ``(L, P, H, page, D[, ...])`` at
+    ``page_ids`` ``(NP,)``.  Entries pointing at the null page dump the
+    padded tail there (never read back)."""
+    return pages.at[:, page_ids].set(new.astype(pages.dtype))
+
+
+def append_token_kv(pages, rows, page_ids, slots):
+    """Scatter one token's rows ``(B, H, D[, ...])`` into ``pages``
+    ``(P, H, page, D[, ...])`` at ``(page_ids[b], slots[b])`` per
+    sequence — the per-layer decode append (idle slots target the null
+    page)."""
+    return pages.at[page_ids, :, slots].set(rows.astype(pages.dtype))

@@ -1,0 +1,359 @@
+"""AOT-compiled inference engine — prefill/decode executables + cache.
+
+The engine owns the three device-side pieces of the serving stack and
+the proofs about them:
+
+- **step programs** — one prefill executable per bucket shape and ONE
+  decode executable for the full slot array, compiled ahead of time
+  (``jit(...).lower(...).compile()``) at :meth:`InferenceEngine.build`.
+  Steady-state serving calls compiled executables only: a retrace is
+  impossible by construction, and :attr:`compile_counts` +
+  a :class:`~apex_tpu.analysis.RetraceSentinel` per program pin it
+  observably (``tests/test_serve.py``).
+- **verification** — with ``verify=True`` (the default), the
+  :mod:`apex_tpu.analysis` passes run over every step program at
+  build (``lint_hlo`` on the one AOT-compiled module + ``lint_jaxpr``
+  on a re-trace — the split-entry API exists exactly so the lint does
+  not pay a second compile): transfer-free (no host round-trip inside
+  a latency-critical step), donation-aliased (the KV pool updates in
+  place — a dropped donation would double cache memory per step), plus
+  the standard f64 screens.  Any ERROR finding fails the build;
+  reports stay on :attr:`reports` and publish to the observability
+  board.  ``engine.lint()`` / ``tools/graph_lint.py --target serve``
+  re-prove the same through the full :func:`analysis.check` path.
+- **cache + wires** — the paged KV pool (:mod:`apex_tpu.serve.cache`),
+  optionally on the blockwise int8 KV wire, and optionally int8-packed
+  weights (:func:`apex_tpu.serve.model.quantize_params`) dequantized
+  inside the compiled step.
+
+Bucketed padding: a prompt compiles against the smallest bucket that
+holds it (buckets are page multiples, powers-of-two by default), so the
+number of distinct compiled shapes is ``len(prefill_buckets) + 1`` for
+the life of the process.
+
+The engine is deliberately scheduler-agnostic: it moves tokens and
+pages, :class:`apex_tpu.serve.scheduler.ContinuousBatchingScheduler`
+owns admission/shedding/SLOs, and both feed the same
+:class:`~apex_tpu.observability.metrics.MetricRegistry`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.gpt import GptConfig
+from apex_tpu.observability.metrics import board
+from apex_tpu.serve import cache as cache_lib
+from apex_tpu.serve import model as model_lib
+
+__all__ = ["ServeConfig", "InferenceEngine"]
+
+
+def _default_buckets(page_size: int, max_len: int) -> Tuple[int, ...]:
+    """Power-of-two page-multiple buckets covering [page, max_len]."""
+    buckets = []
+    b = page_size
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine shape/wire knobs (model shape lives in ``GptConfig``)."""
+
+    page_size: int = 16
+    #: pool size INCLUDING the reserved null page
+    num_pages: int = 128
+    #: decode slot count — the continuous batch's capacity
+    max_batch: int = 4
+    #: page-table width: the longest context is ``max_pages_per_seq *
+    #: page_size`` tokens
+    max_pages_per_seq: int = 8
+    #: prefill bucket lengths (page multiples); () = powers of two up
+    #: to the max context
+    prefill_buckets: Tuple[int, ...] = ()
+    #: "f32" keeps KV in the cache dtype; "int8" stores blockwise codes
+    kv_wire: str = "f32"
+    #: "f32" keeps weights dense; "int8" packs large leaves on the
+    #: comm codec and dequantizes inside the compiled step
+    weight_wire: str = "f32"
+    #: run analysis.check over every step program at build (ERROR
+    #: findings raise)
+    verify: bool = True
+
+    def __post_init__(self):
+        if self.kv_wire not in ("f32", "int8"):
+            raise ValueError(f"kv_wire must be f32|int8, got {self.kv_wire!r}")
+        if self.weight_wire not in ("f32", "int8"):
+            raise ValueError(
+                f"weight_wire must be f32|int8, got {self.weight_wire!r}"
+            )
+        usable = self.num_pages - 1
+        if usable < self.max_pages_per_seq:
+            raise ValueError(
+                f"pool of {usable} usable pages cannot hold even one "
+                f"max-length sequence ({self.max_pages_per_seq} pages)"
+            )
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def buckets(self) -> Tuple[int, ...]:
+        if self.prefill_buckets:
+            for b in self.prefill_buckets:
+                if b % self.page_size or b > self.max_context:
+                    raise ValueError(
+                        f"bucket {b} must be a page multiple within "
+                        f"max context {self.max_context}"
+                    )
+            return tuple(sorted(self.prefill_buckets))
+        return _default_buckets(self.page_size, self.max_context)
+
+
+class InferenceEngine:
+    """AOT prefill/decode over the paged cache for a GPT param tree.
+
+    >>> eng = InferenceEngine(cfg, params, ServeConfig(max_batch=4))
+    >>> eng.build()                      # compile + verify (analysis)
+    >>> logits, tok = eng.prefill(prompt_ids, page_ids)
+    >>> toks = eng.decode(tokens, lengths, page_tables)
+
+    The engine holds the cache arrays and rebinds them after every
+    donated call; callers pass page ids / tables / lengths (the
+    scheduler's bookkeeping) and get tokens back.
+    """
+
+    def __init__(
+        self,
+        cfg: GptConfig,
+        params,
+        serve: Optional[ServeConfig] = None,
+        *,
+        registry=None,
+    ):
+        self.cfg = model_lib.validate_config(cfg)
+        self.serve = serve or ServeConfig()
+        if self.serve.max_context > cfg.max_seq_len:
+            raise ValueError(
+                f"max context {self.serve.max_context} exceeds the "
+                f"model's max_seq_len {cfg.max_seq_len}"
+            )
+        if cfg.hidden_size % cfg.num_heads:
+            raise ValueError("num_heads must divide hidden_size")
+        self.registry = registry
+        self.params = params
+        if self.serve.weight_wire == "int8":
+            self.params = model_lib.quantize_params(params)
+        self.pool = cache_lib.PagePool(
+            self.serve.num_pages, self.serve.page_size
+        )
+        self.cache = cache_lib.init_kv_pages(
+            cfg.num_layers,
+            self.serve.num_pages,
+            cfg.num_heads,
+            self.serve.page_size,
+            cfg.hidden_size // cfg.num_heads,
+            dtype=cfg.dtype,
+            kv_wire=self.serve.kv_wire,
+        )
+        self._prefill: Dict[int, object] = {}
+        self._decode = None
+        #: per-program AOT compile counter — the observable
+        #: retrace-freedom pin (steady state never increments it)
+        self.compile_counts: Dict[str, int] = {}
+        self.reports: Dict[str, object] = {}
+        self._sentinels: Dict[str, object] = {}
+        self._publish_build_gauges()
+
+    # -- build ------------------------------------------------------------
+    def _publish_build_gauges(self) -> None:
+        s = self.serve
+        board.set("serve/page_size", s.page_size)
+        board.set("serve/num_pages", s.num_pages - 1)
+        board.set("serve/max_batch", s.max_batch)
+        board.set("serve/max_context", s.max_context)
+        board.set("serve/kv_wire", s.kv_wire)
+        board.set("serve/weight_wire", s.weight_wire)
+
+    def _prefill_fn(self, bucket: int):
+        np_ = bucket // self.serve.page_size
+
+        def fn(params, kv_pages, tokens, length, page_ids):
+            return model_lib.prefill_body(
+                self.cfg, params, kv_pages, tokens, length, page_ids,
+                page_size=self.serve.page_size,
+                kv_wire=self.serve.kv_wire,
+            )
+
+        fn.__name__ = f"serve_prefill_{bucket}"
+        args = (
+            self.params,
+            self.cache,
+            jnp.zeros((bucket, 1), jnp.int32),
+            jnp.asarray(1, jnp.int32),
+            jnp.zeros((np_,), jnp.int32),
+        )
+        return fn, args
+
+    def _decode_fn(self):
+        s = self.serve
+
+        def fn(params, kv_pages, tokens, lengths, page_tables):
+            return model_lib.decode_body(
+                self.cfg, params, kv_pages, tokens, lengths, page_tables,
+                page_size=s.page_size, kv_wire=s.kv_wire,
+            )
+
+        fn.__name__ = "serve_decode"
+        args = (
+            self.params,
+            self.cache,
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch, s.max_pages_per_seq), jnp.int32),
+        )
+        return fn, args
+
+    def _compile(self, name: str, fn, args):
+        from apex_tpu import analysis
+
+        compiled = jax.jit(fn, donate_argnums=(1,)).lower(*args).compile()
+        if self.serve.verify:
+            # lint the executable we just paid for (lint_hlo/lint_jaxpr
+            # instead of analysis.check, which would trace+compile the
+            # identical program a second time): HLO-level transfer +
+            # donation-aliasing over the compiled text, jaxpr-level
+            # transfer/promotion over a cheap re-trace
+            report = analysis.lint_hlo(
+                compiled.as_text(),
+                donated=len(jax.tree_util.tree_leaves(args[1])),
+                name=f"serve/{name}",
+            )
+            report.extend(
+                analysis.lint_jaxpr(
+                    jax.make_jaxpr(fn)(*args), name=f"serve/{name}"
+                ).findings
+            )
+            analysis.publish_report(report)
+            self.reports[name] = report
+            errors = report.errors()
+            if errors:
+                raise RuntimeError(
+                    f"serve step {name} failed graph lint with "
+                    f"{len(errors)} ERROR finding(s):\n{report.render()}"
+                )
+        self.compile_counts[name] = self.compile_counts.get(name, 0) + 1
+        self._sentinels[name] = analysis.RetraceSentinel(name=name)
+        return compiled
+
+    def build(self, buckets: Optional[Tuple[int, ...]] = None):
+        """Compile (and verify) the decode step and every prefill
+        bucket eagerly.  Lazy compilation still happens on first use of
+        a bucket that was skipped here."""
+        for b in buckets if buckets is not None else self.serve.buckets():
+            self._get_prefill(b)
+        self._get_decode()
+        return self
+
+    def _get_prefill(self, bucket: int):
+        if bucket not in self._prefill:
+            fn, args = self._prefill_fn(bucket)
+            self._prefill[bucket] = self._compile(
+                f"prefill_{bucket}", fn, args
+            )
+        return self._prefill[bucket]
+
+    def _get_decode(self):
+        if self._decode is None:
+            fn, args = self._decode_fn()
+            self._decode = self._compile("decode", fn, args)
+        return self._decode
+
+    @property
+    def retraces(self) -> int:
+        return sum(s.retraces for s in self._sentinels.values())
+
+    def lint(self, bucket: Optional[int] = None):
+        """One merged :class:`apex_tpu.analysis.Report` over the
+        prefill (smallest bucket by default) and decode step programs —
+        the ``tools/graph_lint.py --target serve`` surface.  Unlike the
+        build-time ``verify``, this never raises: findings come back
+        for rendering."""
+        from apex_tpu import analysis
+
+        bucket = bucket or self.serve.buckets()[0]
+        fn, args = self._prefill_fn(bucket)
+        report = analysis.check(
+            jax.jit(fn, donate_argnums=(1,)), *args,
+            donate_argnums=(1,), name=f"serve/prefill_{bucket}",
+        )
+        fn, args = self._decode_fn()
+        dec = analysis.check(
+            jax.jit(fn, donate_argnums=(1,)), *args,
+            donate_argnums=(1,), name="serve/decode",
+        )
+        report.extend(dec.findings)
+        report.target = "serve"
+        return report
+
+    # -- serving calls ----------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.serve.buckets():
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the max context "
+            f"{self.serve.max_context}"
+        )
+
+    def prefill(self, prompt_ids, page_ids) -> Tuple[np.ndarray, int]:
+        """Run the prompt through the bucketed prefill: writes its K/V
+        into ``page_ids`` (null-padded to the bucket's page count) and
+        returns ``(last_logits (V,), first_token)``."""
+        n = len(prompt_ids)
+        bucket = self.bucket_for(n)
+        np_b = bucket // self.serve.page_size
+        tokens = np.zeros((bucket, 1), np.int32)
+        tokens[:n, 0] = np.asarray(prompt_ids, np.int32)
+        ids = np.full((np_b,), cache_lib.NULL_PAGE, np.int32)
+        ids[: len(page_ids)] = np.asarray(page_ids, np.int32)
+        compiled = self._get_prefill(bucket)
+        name = f"prefill_{bucket}"
+        args = (
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(n, jnp.int32), jnp.asarray(ids),
+        )
+        self._sentinels[name].observe(*args)
+        logits, next_token, self.cache = compiled(*args)
+        # logits stay ON DEVICE (lazy jax.Array): only the sampled
+        # token crosses to the host — the logits matrix is (V,)/(B, V)
+        # and most callers never read it
+        return logits, int(next_token)
+
+    def decode(self, tokens, lengths, page_tables):
+        """One decode iteration over the full slot array.  ``lengths``
+        counts each slot's context INCLUDING the token being fed (0 =
+        idle slot).  Returns ``(logits (B, V), next_tokens (B,))`` —
+        ``next_tokens`` on host (the scheduler needs them), ``logits``
+        left as a lazy on-device array so the hot serving loop never
+        pays the (B, V) device→host copy it does not read."""
+        compiled = self._get_decode()
+        args = (
+            self.params,
+            self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(page_tables, jnp.int32),
+        )
+        self._sentinels["decode"].observe(*args)
+        logits, next_tokens, self.cache = compiled(*args)
+        return logits, np.asarray(next_tokens)

@@ -1,0 +1,406 @@
+"""Functional GPT forward for serving — prefill and decode bodies.
+
+The training stack's :class:`apex_tpu.models.gpt.GptModel` is a flax
+module built for ``value_and_grad`` over a full sequence; serving needs
+the same weights driven through two different dataflows — a one-shot
+**prefill** that also emits every position's K/V for the cache, and a
+single-token **decode** that appends to and reads from the paged cache.
+This module is the functional re-expression of ``GptBlock`` /
+``GptModel`` over the ``GptModel.init`` parameter tree (the scanned
+stack's leaves carry a leading ``num_layers`` axis, which maps directly
+onto ``lax.scan`` here), kept numerically in lockstep with the training
+forward:
+
+- same compute-dtype discipline as ``ColumnParallelLinear`` /
+  ``RowParallelLinear`` at tp=1 (matmul in ``cfg.dtype`` with
+  ``preferred_element_type=f32``, cast back, bias in compute dtype);
+- same fused LayerNorm, same f32 RoPE rotation
+  (``ops.rope._apply``'s math), same causal flash attention for
+  prefill, same tied-embedding f32 logits as ``gpt._tied_vocab_logits``
+  — ``tests/test_serve.py`` pins prefill/decode logits against
+  ``GptModel.apply`` itself.
+
+Serving scope: dense blocks, single model shard (no SP/CP/MoE — the
+engine validates).  **Weight wires**: :func:`quantize_params` /
+:func:`dequantize_params` put the large parameter leaves on the
+blockwise int8 code of ``parallel/comm.py`` (small leaves — biases, LN
+affines — stay exact, mirroring ``sync_gradients``'s ``min_size``
+rule); the engine dequantizes inside the compiled step, so the param
+HBM footprint is the wire footprint.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GptConfig, _rope_cos_sin
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.ops.paged_attention import paged_decode_attention
+from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached, rotate_half
+from apex_tpu.parallel import comm
+from apex_tpu.serve import cache as cache_lib
+
+__all__ = [
+    "validate_config",
+    "rope_tables",
+    "PackedWeight",
+    "quantize_params",
+    "dequantize_params",
+    "prefill_body",
+    "decode_body",
+]
+
+#: leaves smaller than this stay f32 under weight_wire="int8" (biases,
+#: LN affines — the same noise-sensitivity rule as comm.sync_gradients)
+WEIGHT_WIRE_MIN_SIZE = 1024
+
+
+def validate_config(cfg: GptConfig) -> GptConfig:
+    """Serving supports the dense single-shard GPT stack."""
+    if cfg.sequence_parallel or cfg.context_parallel:
+        raise ValueError(
+            "serving requires sequence_parallel=False and "
+            "context_parallel=None (the engine owns the whole sequence)"
+        )
+    if cfg.num_experts:
+        raise ValueError("MoE serving is not supported yet")
+    return cfg
+
+
+def rope_tables(cfg: GptConfig):
+    """Cached f32 cos/sin ``(max_seq_len, head_dim)`` in the model's
+    rotate_half layout (None for non-rotary configs)."""
+    if not cfg.rotary:
+        return None, None
+    head_dim = cfg.hidden_size // cfg.num_heads
+    return _rope_cos_sin(cfg.max_seq_len, head_dim)
+
+
+# ---------------------------------------------------------------------------
+# parameter access + weight wires
+# ---------------------------------------------------------------------------
+
+
+def _tree(params):
+    return params["params"]
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedWeight:
+    """A parameter leaf on the blockwise int8 wire: the codes and f32
+    scales are the traced arrays; shape/size/block/dtype ride the
+    treedef as static metadata (so a jitted step sees them as
+    structure, not operands)."""
+
+    def __init__(self, codes, scale, shape, n, block, dtype):
+        self.codes = codes
+        self.scale = scale
+        self.shape = tuple(shape)
+        self.n = int(n)
+        self.block = int(block)
+        self.dtype = dtype
+
+    def unpack(self):
+        flat = comm.dequantize_blocks(
+            self.codes, self.scale, self.block, self.n
+        )
+        return flat.reshape(self.shape).astype(self.dtype)
+
+    def tree_flatten(self):
+        return (self.codes, self.scale), (
+            self.shape, self.n, self.block, self.dtype,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def _is_packed(leaf) -> bool:
+    return isinstance(leaf, PackedWeight)
+
+
+def quantize_params(params, *, block: int = comm.DEFAULT_BLOCK,
+                    min_size: int = WEIGHT_WIRE_MIN_SIZE):
+    """Pack every parameter leaf of >= ``min_size`` elements onto the
+    blockwise int8 wire (flattened, ``comm.quantize_blocks``); smaller
+    leaves pass through exact.  Inverse: :func:`dequantize_params`."""
+
+    def pack(leaf):
+        if leaf.size < min_size:
+            return leaf
+        flat = jnp.ravel(leaf).astype(jnp.float32)
+        codes, scale = comm.quantize_blocks(flat, block=block)
+        return PackedWeight(
+            codes, scale, leaf.shape, flat.shape[0], block, leaf.dtype
+        )
+
+    return jax.tree_util.tree_map(pack, params)
+
+
+def dequantize_params(params):
+    """Unpack a :func:`quantize_params` tree back to dense leaves —
+    called INSIDE the compiled step, so the resident format stays
+    int8."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.unpack() if _is_packed(leaf) else leaf,
+        params, is_leaf=_is_packed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# functional layers (numerics of the flax stack at tp=1)
+# ---------------------------------------------------------------------------
+
+
+def _layer_norm(x, p, eps):
+    return fused_layer_norm_affine(
+        x, p["scale"], p["bias"], (x.shape[-1],), eps=eps
+    )
+
+
+def _linear(x, p, dtype):
+    """tp=1 Column/RowParallelLinear numerics: compute-dtype matmul
+    with f32 accumulation, cast back, bias in compute dtype."""
+    y = jnp.matmul(
+        x.astype(dtype), p["weight"].astype(dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(dtype)
+    return y + p["bias"].astype(dtype)
+
+
+def _embed(p, ids, dtype):
+    return jnp.take(p["weight"], ids, axis=0).astype(dtype)
+
+
+def _logits(tree, h, dtype):
+    """Tied-embedding vocab logits (``gpt._tied_vocab_logits`` at
+    tp=1): f32 output."""
+    embed = tree["word_embeddings"]["weight"]
+    return jnp.matmul(
+        h.astype(dtype), jnp.transpose(embed).astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _rope_rows(x, cos, sin):
+    """f32 rotate_half rotation with PER-SEQUENCE cos/sin rows
+    ``(B, D)`` broadcast over heads — ``ops.rope._apply``'s math for
+    the decode step, where every sequence sits at its own position."""
+    with jax.named_scope("rope_f32"):
+        xf = x.astype(jnp.float32)
+    out = xf * cos[:, None, :] + rotate_half(xf) * sin[:, None, :]
+    return out.astype(x.dtype)
+
+
+def _mlp(x, bp, cfg):
+    y = _layer_norm(x, bp["ln_mlp"], cfg.layer_norm_eps)
+    y = _linear(y, bp["fc1"], cfg.dtype)
+    y = jax.nn.gelu(y, approximate=True)
+    y = _linear(y, bp["fc2"], cfg.dtype)
+    return x + y
+
+
+# ---------------------------------------------------------------------------
+# prefill: full-sequence forward that also yields per-position K/V
+# ---------------------------------------------------------------------------
+
+
+def _prefill_block(cfg: GptConfig, bp, x, cos, sin):
+    """One decoder block over ``x`` (S, B, hidden); returns the new
+    hidden and this layer's rotated K + V as ``(B, H, S, D)``."""
+    heads = cfg.num_heads
+    head_dim = cfg.hidden_size // heads
+    y = _layer_norm(x, bp["ln_attn"], cfg.layer_norm_eps)
+    qkv = _linear(y, bp["qkv"], cfg.dtype)
+    s, b = qkv.shape[0], qkv.shape[1]
+    qkv = qkv.reshape(s, b, heads, 3, head_dim)
+    q, k, v = (
+        jnp.transpose(qkv[:, :, :, i], (1, 2, 0, 3)) for i in range(3)
+    )
+    if cfg.rotary:
+        q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
+        k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
+    ctx = flash_attention(q, k, v, causal=True, scale=head_dim**-0.5)
+    ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, heads * head_dim)
+    attn = _linear(ctx, bp["out"], cfg.dtype)
+    x = x + attn
+    return _mlp(x, bp, cfg), (k, v)
+
+
+def prefill_body(
+    cfg: GptConfig,
+    params,
+    kv_pages: dict,
+    tokens,          # (S, 1) int32 — one sequence, bucket-padded
+    length,          # ()    int32 — live prompt positions
+    page_ids,        # (S/page,) int32 — null-page entries pad the tail
+    *,
+    page_size: int,
+    kv_wire: str = "f32",
+):
+    """Full prefill: forward the (padded) prompt, write every layer's
+    K/V into the assigned pages, and return the last live position's
+    logits.  Causality makes the padding free: a live query row never
+    attends a padded (later) key, so the padded tail needs no mask —
+    its garbage K/V land in pages the decode ``lengths`` never reads
+    (or in the null page).
+
+    Returns ``(logits (V,) f32, next_token () int32, kv_pages)``.
+    """
+    params = dequantize_params(params)
+    tree = _tree(params)
+    x = _embed(tree["word_embeddings"], tokens, cfg.dtype)  # (S, 1, h)
+    s = tokens.shape[0]
+    head_dim = cfg.hidden_size // cfg.num_heads
+    cos = sin = None
+    if cfg.rotary:
+        cos, sin = _rope_cos_sin(s, head_dim)
+    else:
+        pos = tree["position_embeddings"][:s]
+        x = x + pos[:, None, :].astype(cfg.dtype)
+
+    bp = tree["layers"]["block"]
+
+    def layer(carry, xs):
+        x, new = _prefill_block(cfg, xs, carry, cos, sin)
+        return x, new
+
+    x, (k_all, v_all) = jax.lax.scan(layer, x, bp)
+    # (L, 1, H, S, D) -> per-position rows (L, S, H, D) -> page blocks
+    k_all = jnp.transpose(k_all[:, 0], (0, 2, 1, 3))
+    v_all = jnp.transpose(v_all[:, 0], (0, 2, 1, 3))
+    k_blocks = jax.vmap(
+        lambda t: cache_lib.pack_prompt_pages(t, page_size)
+    )(k_all)
+    v_blocks = jax.vmap(
+        lambda t: cache_lib.pack_prompt_pages(t, page_size)
+    )(v_all)
+    if kv_wire == "int8":
+        k_codes, k_scale = cache_lib.encode_kv(k_blocks)
+        v_codes, v_scale = cache_lib.encode_kv(v_blocks)
+        kv_pages = dict(
+            kv_pages,
+            k=cache_lib.write_prompt_pages(kv_pages["k"], k_codes, page_ids),
+            v=cache_lib.write_prompt_pages(kv_pages["v"], v_codes, page_ids),
+            k_scale=cache_lib.write_prompt_pages(
+                kv_pages["k_scale"], k_scale, page_ids
+            ),
+            v_scale=cache_lib.write_prompt_pages(
+                kv_pages["v_scale"], v_scale, page_ids
+            ),
+        )
+    else:
+        kv_pages = dict(
+            kv_pages,
+            k=cache_lib.write_prompt_pages(kv_pages["k"], k_blocks, page_ids),
+            v=cache_lib.write_prompt_pages(kv_pages["v"], v_blocks, page_ids),
+        )
+
+    h_last = jax.lax.dynamic_slice_in_dim(
+        x[:, 0], jnp.maximum(length - 1, 0), 1, 0
+    )  # (1, hidden)
+    h_last = _layer_norm(h_last, tree["ln_f"], cfg.layer_norm_eps)
+    logits = _logits(tree, h_last, cfg.dtype)[0]  # (V,) f32
+    next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return logits, next_token, kv_pages
+
+
+# ---------------------------------------------------------------------------
+# decode: one token per running sequence through the paged cache
+# ---------------------------------------------------------------------------
+
+
+def decode_body(
+    cfg: GptConfig,
+    params,
+    kv_pages: dict,
+    tokens,       # (B,) int32 — current token per slot
+    lengths,      # (B,) int32 — context length AFTER this token; 0 = idle
+    page_tables,  # (B, NP) int32
+    *,
+    page_size: int,
+    kv_wire: str = "f32",
+):
+    """One continuous-batching decode iteration over the full slot
+    array.  Per layer: project the token, rotate K, append K/V to this
+    position's page slot, and run the fused single-query paged
+    attention (query RoPE + int8 dequant fused in the kernel).  Idle
+    slots (``lengths == 0``) write into the null page and read zeros.
+
+    Returns ``(logits (B, V) f32, next_tokens (B,) int32, kv_pages)``.
+    """
+    params = dequantize_params(params)
+    tree = _tree(params)
+    b = tokens.shape[0]
+    heads = cfg.num_heads
+    head_dim = cfg.hidden_size // heads
+    x = _embed(tree["word_embeddings"], tokens, cfg.dtype)  # (B, hidden)
+
+    pos = jnp.maximum(lengths - 1, 0)  # this token's position; idle -> 0
+    page_ids = page_tables[jnp.arange(b), pos // page_size]  # (B,)
+    slots = pos % page_size
+    cos_rows = sin_rows = None
+    if cfg.rotary:
+        cos_t, sin_t = _rope_cos_sin(cfg.max_seq_len, head_dim)
+        cos_rows = jnp.take(cos_t, pos, axis=0)  # (B, D)
+        sin_rows = jnp.take(sin_t, pos, axis=0)
+    else:
+        rows = jnp.take(tree["position_embeddings"], pos, axis=0)
+        x = x + rows.astype(cfg.dtype)
+
+    bp = tree["layers"]["block"]
+    int8 = kv_wire == "int8"
+    xs = (bp, kv_pages["k"], kv_pages["v"]) + (
+        (kv_pages["k_scale"], kv_pages["v_scale"]) if int8 else ()
+    )
+
+    def layer(x, xs):
+        if int8:
+            lp, k_l, v_l, ks_l, vs_l = xs
+        else:
+            lp, k_l, v_l = xs
+            ks_l = vs_l = None
+        y = _layer_norm(x, lp["ln_attn"], cfg.layer_norm_eps)
+        qkv = _linear(y, lp["qkv"], cfg.dtype).reshape(
+            b, heads, 3, head_dim
+        )
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, H, D)
+        if cfg.rotary:
+            k = _rope_rows(k, cos_rows, sin_rows)
+        if int8:
+            k_codes, k_sc = cache_lib.encode_kv(k)
+            v_codes, v_sc = cache_lib.encode_kv(v)
+            k_l = cache_lib.append_token_kv(k_l, k_codes, page_ids, slots)
+            v_l = cache_lib.append_token_kv(v_l, v_codes, page_ids, slots)
+            ks_l = cache_lib.append_token_kv(ks_l, k_sc, page_ids, slots)
+            vs_l = cache_lib.append_token_kv(vs_l, v_sc, page_ids, slots)
+        else:
+            k_l = cache_lib.append_token_kv(k_l, k, page_ids, slots)
+            v_l = cache_lib.append_token_kv(v_l, v, page_ids, slots)
+        ctx = paged_decode_attention(
+            q, k_l, v_l, page_tables, lengths,
+            scale=head_dim**-0.5,
+            k_scale=ks_l, v_scale=vs_l,
+            rope_cos=cos_rows if cfg.rotary else None,
+            rope_sin=sin_rows if cfg.rotary else None,
+        )
+        ctx = ctx.astype(cfg.dtype).reshape(b, heads * head_dim)
+        x = x + _linear(ctx, lp["out"], cfg.dtype)
+        x = _mlp(x, lp, cfg)
+        return x, (k_l, v_l, ks_l, vs_l) if int8 else (k_l, v_l)
+
+    x, new = jax.lax.scan(layer, x, xs)
+    if int8:
+        kv_pages = dict(
+            kv_pages, k=new[0], v=new[1], k_scale=new[2], v_scale=new[3]
+        )
+    else:
+        kv_pages = dict(kv_pages, k=new[0], v=new[1])
+
+    h = _layer_norm(x, tree["ln_f"], cfg.layer_norm_eps)
+    logits = _logits(tree, h, cfg.dtype)  # (B, V) f32
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return logits, next_tokens, kv_pages

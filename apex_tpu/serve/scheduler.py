@@ -1,0 +1,337 @@
+"""Continuous batching — admission, decode slots, SLOs, shedding.
+
+The throughput lever of a serving system is keeping the decode batch
+full: a decode iteration costs nearly the same whether 1 or
+``max_batch`` sequences ride it (the weights are read either way), so
+every empty slot is wasted HBM bandwidth.
+:class:`ContinuousBatchingScheduler` admits new sequences INTO the
+running batch at page granularity — a prefill is slotted between decode
+iterations (bucketed padding keeps the compiled-shape count finite),
+the new sequence joins the very next decode, and finished sequences
+free their pages to the pool immediately.
+
+Admission control and degradation are explicit:
+
+- a request is admitted when a decode slot is free AND the page pool
+  covers its prompt (``PagePool.alloc`` is all-or-nothing);
+- a queued request whose **TTFT SLO deadline** has already passed while
+  the pool stays exhausted is **shed** (rejected loudly — the client
+  can retry elsewhere) instead of silently blowing its latency budget;
+- when a RUNNING sequence needs a growth page and the pool is empty,
+  the youngest running request is shed to keep the older ones making
+  progress (LIFO victim: it has the least sunk prefill cost).
+
+Every iteration publishes the serving gauges through the shared
+:class:`~apex_tpu.observability.metrics.MetricRegistry` — queue depth,
+batch fill, page-pool occupancy, tokens/s, TTFT — the same spine
+training telemetry rides, so :class:`~apex_tpu.observability.health.
+TTFTRule` / :class:`~apex_tpu.observability.health.QueueDepthRule`
+watchdogs page the same health layer (``docs/serving.md``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from apex_tpu.serve.cache import NULL_PAGE
+
+__all__ = ["Request", "ContinuousBatchingScheduler", "declare_serve_metrics"]
+
+_ids = itertools.count()
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+SHED = "shed"
+
+#: default for ``ContinuousBatchingScheduler(registry=...)``: inherit
+#: the engine's registry.  Pass ``registry=None`` to run with NO
+#: telemetry (e.g. a baseline probe that must not pollute the engine
+#: registry's observation stream).
+ENGINE_REGISTRY = object()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle ledger."""
+
+    prompt: List[int]
+    max_new_tokens: int = 16
+    #: TTFT SLO in milliseconds; None = best-effort (never shed by
+    #: deadline, only as a growth-page victim)
+    slo_ttft_ms: Optional[float] = None
+    eos_token: Optional[int] = None
+    rid: int = dataclasses.field(default_factory=lambda: next(_ids))
+
+    # -- runtime ledger (scheduler-owned) --------------------------------
+    status: str = QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    #: KV positions written (prompt + generated-and-fed tokens)
+    ctx_len: int = 0
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    done_at: Optional[float] = None
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.submitted_at is None or self.first_token_at is None:
+            return None
+        return 1e3 * (self.first_token_at - self.submitted_at)
+
+
+def declare_serve_metrics(registry) -> None:
+    """Declare the serving metric set on a registry (idempotent)."""
+    for g in ("serve/queue_depth", "serve/batch_fill",
+              "serve/page_occupancy", "serve/tokens_per_s",
+              "serve/ttft_ms"):
+        registry.gauge(g)
+    for c in ("serve/admitted", "serve/completed", "serve/shed",
+              "serve/tokens_out", "serve/prefills", "serve/decode_steps"):
+        registry.counter(c)
+
+
+class ContinuousBatchingScheduler:
+    """Drive an :class:`~apex_tpu.serve.engine.InferenceEngine` with
+    continuous batching.
+
+    >>> sched = ContinuousBatchingScheduler(engine)
+    >>> sched.submit(Request(prompt=[...], max_new_tokens=32))
+    >>> while sched.pending:
+    ...     sched.step()
+    """
+
+    def __init__(self, engine, *, registry=ENGINE_REGISTRY,
+                 clock=time.monotonic, window: int = 32):
+        self.engine = engine
+        self.pool = engine.pool
+        self.serve = engine.serve
+        self.clock = clock
+        self.queue: Deque[Request] = collections.deque()
+        self.slots: List[Optional[Request]] = [None] * self.serve.max_batch
+        self.completed: List[Request] = []
+        self.shed: List[Request] = []
+        self._step = 0
+        # tokens/s over a sliding window of (time, cumulative tokens)
+        self._tokens_out = 0
+        self._window: Deque = collections.deque(maxlen=window)
+        self.registry = (
+            engine.registry if registry is ENGINE_REGISTRY else registry
+        )
+        self._mstate = None
+        if self.registry is not None:
+            declare_serve_metrics(self.registry)
+            self._mstate = self.registry.init()
+
+    # -- bookkeeping ------------------------------------------------------
+    @property
+    def running(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    @property
+    def pending(self) -> bool:
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
+    def batch_fill(self) -> float:
+        return len(self.running) / len(self.slots)
+
+    def submit(self, req: Request) -> Request:
+        req.status = QUEUED
+        req.submitted_at = self.clock()
+        self.queue.append(req)
+        return req
+
+    def _page_table_row(self, req: Request) -> np.ndarray:
+        row = np.full((self.serve.max_pages_per_seq,), NULL_PAGE, np.int32)
+        row[: len(req.pages)] = req.pages
+        return row
+
+    def _retire(self, req: Request, status: str) -> None:
+        if req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        req.status = status
+        req.done_at = self.clock()
+        (self.completed if status == DONE else self.shed).append(req)
+
+    def _shed_request(self, req: Request) -> None:
+        self._retire(req, SHED)
+        self._count("serve/shed")
+
+    # -- admission --------------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit_one(self) -> bool:
+        """Try to move the queue head into a free slot (prefill now).
+        Returns True when a request was admitted or shed (progress)."""
+        if not self.queue:
+            return False
+        slot = self._free_slot()
+        if slot is None:
+            return False
+        req = self.queue[0]
+        if len(req.prompt) > self.serve.max_context:
+            self.queue.popleft()
+            self._shed_request(req)
+            return True
+        need = self.pool.pages_for(len(req.prompt))
+        pages = self.pool.alloc(need)
+        if pages is None:
+            # pool exhausted: shed only once the TTFT budget is already
+            # blown — before that the request just waits its turn
+            if (
+                req.slo_ttft_ms is not None
+                and 1e3 * (self.clock() - req.submitted_at) > req.slo_ttft_ms
+            ):
+                self.queue.popleft()
+                self._shed_request(req)
+                return True
+            return False
+        self.queue.popleft()
+        req.pages = pages
+        _, first = self.engine.prefill(req.prompt, pages)
+        req.ctx_len = len(req.prompt)
+        req.tokens.append(first)
+        req.first_token_at = self.clock()
+        req.status = RUNNING
+        self.slots[slot] = req
+        self._tokens_out += 1
+        self._count("serve/admitted")
+        self._count("serve/prefills")
+        self._count("serve/tokens_out")
+        self._gauge("serve/ttft_ms", req.ttft_ms)
+        if self._finished(req):
+            self.slots[slot] = None
+            self._retire(req, DONE)
+            self._count("serve/completed")
+        return True
+
+    def _finished(self, req: Request) -> bool:
+        if len(req.tokens) >= req.max_new_tokens:
+            return True
+        if req.eos_token is not None and req.tokens and (
+            req.tokens[-1] == req.eos_token
+        ):
+            return True
+        # context capacity: the NEXT fed token would not fit
+        return req.ctx_len + 1 > self.serve.max_context
+
+    # -- decode -----------------------------------------------------------
+    def _ensure_growth_page(self, req: Request) -> bool:
+        """The next append lands at position ``ctx_len``; allocate its
+        page if the sequence is about to cross a page boundary."""
+        if req.ctx_len // self.serve.page_size < len(req.pages):
+            return True
+        got = self.pool.alloc(1)
+        if got is None:
+            return False
+        req.pages.extend(got)
+        return True
+
+    def _decode_once(self) -> None:
+        b = len(self.slots)
+        tokens = np.zeros((b,), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        tables = np.full(
+            (b, self.serve.max_pages_per_seq), NULL_PAGE, np.int32
+        )
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if not self._ensure_growth_page(req):
+                # pool exhausted mid-decode: shed the youngest running
+                # request (least sunk cost) and retry this one
+                victims = sorted(
+                    self.running, key=lambda r: r.submitted_at or 0.0
+                )
+                victim = victims[-1]
+                v_slot = self.slots.index(victim)
+                self.slots[v_slot] = None
+                self._shed_request(victim)
+                # the victim's row may already be staged for this
+                # iteration — clear it so the decode never touches its
+                # (now freed) pages
+                tokens[v_slot] = 0
+                lengths[v_slot] = 0
+                tables[v_slot] = NULL_PAGE
+                if victim is req or not self._ensure_growth_page(req):
+                    if self.slots[i] is req:
+                        self.slots[i] = None
+                        self._shed_request(req)
+                    continue
+            tokens[i] = req.tokens[-1]
+            lengths[i] = req.ctx_len + 1  # context incl. the fed token
+            tables[i] = self._page_table_row(req)
+        if not any(s is not None for s in self.slots):
+            return
+        _, next_tokens = self.engine.decode(tokens, lengths, tables)
+        self._count("serve/decode_steps")
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            req.ctx_len += 1
+            req.tokens.append(int(next_tokens[i]))
+            self._tokens_out += 1
+            self._count("serve/tokens_out")
+            if self._finished(req):
+                self.slots[i] = None
+                self._retire(req, DONE)
+                self._count("serve/completed")
+
+    # -- metrics ----------------------------------------------------------
+    def _count(self, name: str, n: float = 1.0) -> None:
+        if self._mstate is not None:
+            self._mstate = self.registry.update(self._mstate, {name: n})
+
+    def _gauge(self, name: str, value) -> None:
+        if self._mstate is not None and value is not None:
+            self._mstate = self.registry.update(
+                self._mstate, {name: float(value)}
+            )
+
+    def _publish(self) -> None:
+        now = self.clock()
+        self._window.append((now, self._tokens_out))
+        tps = 0.0
+        if len(self._window) >= 2:
+            (t0, n0), (t1, n1) = self._window[0], self._window[-1]
+            if t1 > t0:
+                tps = (n1 - n0) / (t1 - t0)
+        self._gauge("serve/queue_depth", len(self.queue))
+        self._gauge("serve/batch_fill", self.batch_fill())
+        self._gauge("serve/page_occupancy", self.pool.occupancy())
+        self._gauge("serve/tokens_per_s", tps)
+        if self._mstate is not None:
+            self.registry.observe(self._step, self._mstate)
+
+    # -- the iteration ----------------------------------------------------
+    def step(self) -> None:
+        """One continuous-batching iteration: admit (prefill) into free
+        slots, then one decode pass over the running batch."""
+        # admit until slots or pages run out — each prefill slots in
+        # between decode iterations by construction
+        while self._admit_one():
+            pass
+        self._decode_once()
+        self._step += 1
+        self._publish()
+
+    def run(self, max_steps: int = 10_000) -> None:
+        """Drain: step until every submitted request completed or shed."""
+        for _ in range(max_steps):
+            if not self.pending:
+                return
+            self.step()
+        raise RuntimeError(
+            f"scheduler did not drain within {max_steps} iterations"
+        )

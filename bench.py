@@ -56,6 +56,7 @@ _METRIC_NAMES = {
     "tp_gpt": "tp_gpt_block_step_ms",
     "long_attn": "long_context_flash_attn_tflops",
     "zero": "zero_lamb_int8_wire_speedup",
+    "serve": "serve_decode_tokens_per_s",
     "all": "bert_large_lamb_mfu",  # the headline stands in for the batch
 }
 
@@ -897,6 +898,133 @@ def bench_long_attn(trace_dir=None, batch=1, heads=8, seq=16384,
 
 
 # ---------------------------------------------------------------------------
+# Serving smoke config (seconds on CPU — the verify_tier1.sh PERF pass;
+# docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def bench_serve(trace_dir=None, prompt_len=48, decode_steps=24, trials=3):
+    """Paged-inference smoke rows: prefill tokens/s, continuous-batch
+    decode tokens/s, and TTFT through the real scheduler path — a tiny
+    GPT so the rows land in seconds on CPU.  Like ``bench_smoke``
+    these are SCHEMA/PRESENCE rows, not performance claims: they pin
+    the serving metric names into the golden/gate stream
+    (``tools/bench_golden_cpu.jsonl``) so serving perf can never go
+    flat silently; real serving load curves come from
+    ``tools/serve_bench.py``."""
+    import numpy as np
+
+    from apex_tpu.models.gpt import GptConfig, GptModel
+    from apex_tpu.serve import (
+        ContinuousBatchingScheduler,
+        InferenceEngine,
+        Request,
+        ServeConfig,
+    )
+
+    cfg = GptConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_seq_len=256, dtype=jnp.float32,
+    )
+    serve_cfg = ServeConfig(
+        page_size=16, num_pages=64, max_batch=4, max_pages_per_seq=8,
+        verify=False,
+    )
+    model = GptModel(cfg)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (prompt_len, 1), 0, cfg.vocab_size
+    )
+    params = model.init(jax.random.PRNGKey(1), ids)
+    engine = InferenceEngine(cfg, params, serve_cfg)
+    rs = np.random.RandomState(0)
+
+    def prompt(n):
+        return list(rs.randint(0, cfg.vocab_size, size=n))
+
+    # -- prefill tokens/s (direct engine path, batch-of-1 buckets) ------
+    pages = engine.pool.alloc(engine.pool.pages_for(prompt_len))
+    engine.prefill(prompt(prompt_len), pages)  # warmup/compile
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        engine.prefill(prompt(prompt_len), pages)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    t_prefill = times[len(times) // 2]
+    _emit(
+        "serve_prefill_tokens_per_s",
+        round(prompt_len / t_prefill, 1),
+        "tokens/s (prompt=%d, bucket=%d, page=%d, h=%d L=%d; CI "
+        "serving smoke on CPU, not a perf claim)"
+        % (prompt_len, engine.bucket_for(prompt_len),
+           serve_cfg.page_size, cfg.hidden_size, cfg.num_layers),
+        None,
+    )
+    engine.pool.free(pages)
+
+    # -- decode tokens/s at a full continuous batch ---------------------
+    b = serve_cfg.max_batch
+    reqs = []
+    tables = np.zeros((b, serve_cfg.max_pages_per_seq), np.int32)
+    for i in range(b):
+        p = engine.pool.alloc(engine.pool.pages_for(prompt_len))
+        _, tok = engine.prefill(prompt(prompt_len), p)
+        reqs.append({"pages": p, "tok": tok, "ctx": prompt_len})
+    lengths = np.zeros((b,), np.int32)
+    tokens = np.zeros((b,), np.int32)
+
+    def decode_once():
+        for i, r in enumerate(reqs):
+            if r["ctx"] // serve_cfg.page_size >= len(r["pages"]):
+                got = engine.pool.alloc(1)
+                if got is None:
+                    raise RuntimeError(
+                        "bench serve: page pool exhausted — raise "
+                        "num_pages or lower decode_steps/prompt_len"
+                    )
+                r["pages"] += got
+            tables[i, : len(r["pages"])] = r["pages"]
+            tokens[i] = r["tok"]
+            lengths[i] = r["ctx"] + 1
+        _, nxt = engine.decode(tokens, lengths, tables)
+        for i, r in enumerate(reqs):
+            r["ctx"] += 1
+            r["tok"] = int(nxt[i])
+
+    decode_once()  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(decode_steps):
+        decode_once()
+    t_decode = (time.perf_counter() - t0) / decode_steps
+    _emit(
+        "serve_decode_tokens_per_s",
+        round(b / t_decode, 1),
+        "tokens/s (batch=%d, ctx~%d, page=%d, paged KV; CI serving "
+        "smoke on CPU, not a perf claim)"
+        % (b, prompt_len + decode_steps, serve_cfg.page_size),
+        None,
+    )
+    for r in reqs:
+        engine.pool.free(r["pages"])
+
+    # -- TTFT through the scheduler (queue -> admit -> prefill) ---------
+    ttfts = []
+    for _ in range(trials):
+        sched = ContinuousBatchingScheduler(engine)
+        sched.submit(Request(prompt=prompt(prompt_len), max_new_tokens=2))
+        sched.run()
+        ttfts.append(sched.completed[-1].ttft_ms)
+    ttfts.sort()
+    _emit(
+        "serve_ttft_ms",
+        round(ttfts[len(ttfts) // 2], 3),
+        "ms (prompt=%d via ContinuousBatchingScheduler, queue->first "
+        "token; CI serving smoke on CPU, not a perf claim)" % prompt_len,
+        None,
+    )
+
+
+# ---------------------------------------------------------------------------
 # CI smoke config (seconds on CPU — the verify_tier1.sh PERF pass)
 # ---------------------------------------------------------------------------
 
@@ -1000,6 +1128,7 @@ _CONFIGS = {
     "zero": bench_zero,
     "long_attn": bench_long_attn,
     "smoke": bench_smoke,
+    "serve": bench_serve,
 }
 
 
@@ -1026,8 +1155,8 @@ def main(config="bert_lamb", trace_dir=None):
         armed.set()
     if config == "all":
         for name, fn in _CONFIGS.items():
-            if name == "smoke":
-                continue  # CI schema driver, not a measurement row
+            if name in ("smoke", "serve"):
+                continue  # CI schema drivers, not measurement rows
             # one trace (the headline config) per invocation
             fn(trace_dir if name == "bert_lamb" else None)
         return

@@ -695,3 +695,119 @@ class TestTunedTileTable:
             )
         finally:
             fa.flash_fwd.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# Paged single-query decode attention (the serving kernel,
+# ops/pallas/decode_attention.py — docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedDecodeAttention:
+    """The decode kernel must agree with its gather-based jnp reference
+    AND with plain full-context attention on the equivalent contiguous
+    history — paging and online softmax are layout, not math."""
+
+    def _paged_case(self, key, b=3, h=4, d=32, page=8, pool=12, np_=3,
+                    lengths=(17, 9, 0)):
+        import numpy as np_mod
+
+        rs = np_mod.random.RandomState(int(key))
+        k_pages = jnp.asarray(rs.randn(pool, h, page, d), jnp.float32)
+        v_pages = jnp.asarray(rs.randn(pool, h, page, d), jnp.float32)
+        q = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+        # distinct non-null pages per live sequence
+        table = jnp.asarray(
+            rs.permutation(pool - 1)[: b * np_].reshape(b, np_) + 1,
+            jnp.int32,
+        )
+        return q, k_pages, v_pages, table, jnp.asarray(lengths, jnp.int32)
+
+    def test_kernel_matches_reference(self, force_pallas):
+        from apex_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            paged_decode_attention_reference,
+        )
+
+        q, kp, vp, table, lengths = self._paged_case(0)
+        out = paged_decode_attention(q, kp, vp, table, lengths)
+        ref = paged_decode_attention_reference(q, kp, vp, table, lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+    def test_matches_contiguous_attention(self, force_pallas):
+        """Sequence 0's paged output == mha_reference over the pages
+        gathered back into a contiguous (1, H, S, D) history."""
+        from apex_tpu.ops.paged_attention import paged_decode_attention
+
+        q, kp, vp, table, lengths = self._paged_case(1)
+        out = paged_decode_attention(q, kp, vp, table, lengths)
+        s0 = int(lengths[0])
+        page = kp.shape[2]
+        kc = jnp.moveaxis(kp[table[0]], 0, 1).reshape(
+            kp.shape[1], -1, kp.shape[3]
+        )[None, :, :s0]
+        vc = jnp.moveaxis(vp[table[0]], 0, 1).reshape(
+            vp.shape[1], -1, vp.shape[3]
+        )[None, :, :s0]
+        ref = mha_reference(
+            q[0][None, :, None, :], kc, vc, scale=q.shape[-1] ** -0.5
+        )
+        np.testing.assert_allclose(
+            out[0], ref[0, :, 0], atol=2e-6, rtol=2e-6
+        )
+        del page
+
+    def test_fused_rope_matches_pre_rotated_query(self, force_pallas):
+        """In-kernel q RoPE == rotating q first and attending plain."""
+        from apex_tpu.ops.paged_attention import paged_decode_attention
+        from apex_tpu.ops.rope import rotate_half
+
+        q, kp, vp, table, lengths = self._paged_case(2)
+        rs = np.random.RandomState(9)
+        cos = jnp.asarray(rs.randn(q.shape[0], q.shape[2]), jnp.float32)
+        sin = jnp.asarray(rs.randn(q.shape[0], q.shape[2]), jnp.float32)
+        fused = paged_decode_attention(
+            q, kp, vp, table, lengths, rope_cos=cos, rope_sin=sin
+        )
+        q_rot = q * cos[:, None, :] + rotate_half(q) * sin[:, None, :]
+        plain = paged_decode_attention(q_rot, kp, vp, table, lengths)
+        np.testing.assert_allclose(fused, plain, atol=2e-6, rtol=2e-6)
+
+    def test_int8_kv_dequant_matches_reference(self, force_pallas):
+        """In-kernel int8 dequant == the reference's gather+dequant,
+        and both sit near the f32 cache (codec quantization noise
+        only)."""
+        from apex_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            paged_decode_attention_reference,
+        )
+        from apex_tpu.serve.cache import encode_kv
+
+        q, kp, vp, table, lengths = self._paged_case(3)
+        kq, ks = encode_kv(kp)
+        vq, vs = encode_kv(vp)
+        out = paged_decode_attention(
+            q, kq, vq, table, lengths, k_scale=ks, v_scale=vs
+        )
+        ref = paged_decode_attention_reference(
+            q, kq, vq, table, lengths, k_scale=ks, v_scale=vs
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+        f32 = paged_decode_attention(q, kp, vp, table, lengths)
+        assert float(jnp.abs(out - f32).max()) < 5e-2
+
+    def test_idle_slot_returns_zeros(self, force_pallas):
+        from apex_tpu.ops.paged_attention import paged_decode_attention
+
+        q, kp, vp, table, lengths = self._paged_case(4)
+        out = paged_decode_attention(q, kp, vp, table, lengths)
+        assert float(jnp.abs(out[2]).max()) == 0.0  # lengths[2] == 0
+
+    def test_jnp_dispatch_default_off_tpu(self):
+        """Auto mode off-TPU routes to the gather-based jnp path (the
+        kernel runs interpret-mode only when forced or on real TPU)."""
+        from apex_tpu.ops import paged_attention as pa
+
+        q, kp, vp, table, lengths = self._paged_case(5)
+        pa.paged_decode_attention(q, kp, vp, table, lengths)
+        assert _dispatch.last_paths()["paged_decode_attention"] == "jnp"

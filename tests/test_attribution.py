@@ -499,8 +499,12 @@ class TestBenchDiff:
             os.path.join(REPO, "tools", "bench_golden_cpu.jsonl")
         )
         assert bd.check_schema(golden) == []
+        # smoke rows + the serving rows (bench.py --config serve) — the
+        # verify_tier1.sh PERF pass runs BOTH configs against this file
         assert {r["metric"] for r in golden} == {
-            "smoke_mlp_step_ms", "smoke_dp_mlp_step_ms"
+            "smoke_mlp_step_ms", "smoke_dp_mlp_step_ms",
+            "serve_prefill_tokens_per_s", "serve_decode_tokens_per_s",
+            "serve_ttft_ms",
         }
 
 

@@ -238,3 +238,27 @@ def test_bert_pretrain_checkpoint_resume(tmp_path):
         out_resumed[-600:],
         out_full[-600:],
     )
+
+
+def test_serve_gpt_smoke(tmp_path):
+    """Train -> checkpoint -> restore (bit-exact assert inside the
+    example) -> serve through the AOT engine + paged cache + scheduler;
+    the JSONL must carry the serving TTFT/throughput gauges."""
+    import json
+
+    d = str(tmp_path / "serve_demo")
+    out = _run_example(
+        "examples/simple/serve/serve_gpt.py",
+        ["--dir", d, "--train-steps", "6", "--requests", "3",
+         "--metrics-out", os.path.join(d, "serve.jsonl")],
+        n_devices=1,
+    )
+    assert "round-trips: restored == trained" in out, out[-800:]
+    assert "served 3 requests (0 shed)" in out, out[-800:]
+    recs = [
+        json.loads(l)
+        for l in open(os.path.join(d, "serve.jsonl"))
+        if l.strip()
+    ]
+    metrics = {r["metric"] for r in recs}
+    assert {"serve/ttft_ms", "serve/tokens_per_s"} <= metrics, metrics

@@ -1,0 +1,525 @@
+"""Serving subsystem — paged cache, AOT engine, continuous batching.
+
+Covers the ISSUE 7 acceptance surface: page-pool alloc/free/exhaustion
++ shedding, continuous-batching admission order and mid-stream
+admission (batch fill above the single-request baseline), prefill and
+decode numerics against the UNPAGED ``GptModel.apply`` reference at f32
+and int8-KV, the ``analysis.check`` zero-ERROR pin on both AOT step
+programs, and the serving watchdog rules.  The decode-attention kernel
+parity tests live beside the flash-attention tests
+(``tests/test_attention.py::TestPagedDecodeAttention``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt import GptConfig, GptModel, _tied_vocab_logits
+from apex_tpu.serve import (
+    ContinuousBatchingScheduler,
+    InferenceEngine,
+    NULL_PAGE,
+    PagePool,
+    Request,
+    ServeConfig,
+)
+from apex_tpu.serve import cache as cache_lib
+from apex_tpu.serve import model as serve_model
+
+#: pinned serving-numerics envelopes on last-position logits vs the
+#: unpaged f32 reference (tools/serve_bench.py pins the same numbers)
+TOL_F32 = 2e-4
+TOL_INT8_KV = 5e-2
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_seq_len=128, dtype=jnp.float32,
+    )
+    base.update(kw)
+    return GptConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = tiny_cfg()
+    model = GptModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((8, 1), jnp.int32)
+    )
+    return cfg, model, params
+
+
+def make_engine(gpt, **serve_kw):
+    cfg, _, params = gpt
+    kw = dict(
+        page_size=8, num_pages=32, max_batch=2, max_pages_per_seq=8,
+        verify=False,
+    )
+    kw.update(serve_kw)
+    return InferenceEngine(cfg, params, ServeConfig(**kw))
+
+
+def ref_logits(model, params, token_ids):
+    """Unpaged reference: full forward, all positions' logits."""
+    ids = jnp.asarray(np.asarray(token_ids, np.int32)[:, None])
+    h = model.apply(params, ids)
+    return np.asarray(
+        _tied_vocab_logits(params, model, h, sp_gathered=False)[:, 0]
+    )
+
+
+# ---------------------------------------------------------------------------
+# page pool
+# ---------------------------------------------------------------------------
+
+
+class TestPagePool:
+    def test_alloc_free_roundtrip(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        assert pool.usable == 7 and pool.available == 7
+        got = pool.alloc(3)
+        assert len(got) == 3 and NULL_PAGE not in got
+        assert pool.in_use == 3
+        pool.free(got)
+        assert pool.available == 7 and pool.occupancy() == 0.0
+
+    def test_alloc_is_all_or_nothing(self):
+        pool = PagePool(num_pages=4, page_size=4)
+        assert pool.alloc(5) is None
+        # the failed alloc must not leak pages
+        assert pool.available == 3
+        assert len(pool.alloc(3)) == 3
+        assert pool.alloc(1) is None
+
+    def test_double_free_and_bad_ids_raise(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        got = pool.alloc(2)
+        pool.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            pool.free([got[0]])
+        with pytest.raises(ValueError):
+            pool.free([NULL_PAGE])
+
+    def test_pages_for(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        assert pool.pages_for(0) == 0
+        assert pool.pages_for(1) == 1
+        assert pool.pages_for(4) == 1
+        assert pool.pages_for(5) == 2
+
+
+# ---------------------------------------------------------------------------
+# cache device helpers
+# ---------------------------------------------------------------------------
+
+
+class TestCacheWrites:
+    def test_prompt_pages_roundtrip(self):
+        rs = np.random.RandomState(0)
+        s, h, d, page = 16, 2, 8, 4
+        kv = jnp.asarray(rs.randn(1, s, h, d), jnp.float32)  # one layer
+        pages = jnp.zeros((1, 10, h, page, d), jnp.float32)
+        ids = jnp.asarray([3, 5, 2, 7], jnp.int32)
+        blocks = jax.vmap(
+            lambda t: cache_lib.pack_prompt_pages(t, page)
+        )(kv)
+        out = cache_lib.write_prompt_pages(pages, blocks, ids)
+        # gather back in table order and compare to the original rows
+        got = jnp.moveaxis(out[0][ids], 1, 0).reshape(h, s, d)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(jnp.transpose(kv[0], (1, 0, 2)))
+        )
+
+    def test_append_token_roundtrip(self):
+        rs = np.random.RandomState(1)
+        h, d, page = 2, 8, 4
+        pages = jnp.zeros((6, h, page, d), jnp.float32)
+        rows = jnp.asarray(rs.randn(3, h, d), jnp.float32)
+        pids = jnp.asarray([1, 4, 2], jnp.int32)
+        slots = jnp.asarray([0, 3, 1], jnp.int32)
+        out = cache_lib.append_token_kv(pages, rows, pids, slots)
+        for b in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(out[pids[b], :, slots[b]]),
+                np.asarray(rows[b]),
+            )
+
+    def test_int8_encode_roundtrip(self):
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.randn(4, 2, 8) * 3.0, jnp.float32)
+        codes, scale = cache_lib.encode_kv(x)
+        assert codes.dtype == jnp.int8 and scale.shape == (4, 2)
+        back = codes.astype(jnp.float32) * scale[..., None]
+        assert float(jnp.abs(back - x).max()) <= float(
+            jnp.abs(x).max()
+        ) / 127.0 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# engine numerics vs the unpaged reference
+# ---------------------------------------------------------------------------
+
+
+class TestEngineNumerics:
+    def test_prefill_matches_unpaged_reference(self, gpt):
+        cfg, model, params = gpt
+        eng = make_engine(gpt)
+        rs = np.random.RandomState(3)
+        prompt = [int(t) for t in rs.randint(0, cfg.vocab_size, size=21)]
+        pages = eng.pool.alloc(eng.pool.pages_for(len(prompt)))
+        logits, tok = eng.prefill(prompt, pages)
+        ref = ref_logits(model, params, prompt)[-1]
+        assert np.abs(logits - ref).max() <= 1e-5
+        assert tok == int(np.argmax(ref))
+
+    @pytest.mark.parametrize("kv_wire,tol", [
+        ("f32", TOL_F32), ("int8", TOL_INT8_KV),
+    ])
+    def test_decode_matches_unpaged_reference(self, gpt, kv_wire, tol):
+        """Greedy continuation through the paged decode step stays
+        within the pinned envelope of the growing full forward — and
+        at f32 the generated TOKENS are identical."""
+        cfg, model, params = gpt
+        eng = make_engine(gpt, kv_wire=kv_wire)
+        rs = np.random.RandomState(4)
+        prompt = [int(t) for t in rs.randint(0, cfg.vocab_size, size=13)]
+        pages = eng.pool.alloc(eng.pool.pages_for(len(prompt)))
+        _, tok = eng.prefill(prompt, pages)
+        cur = list(prompt)
+        ctx = len(prompt)
+        table = np.zeros((2, 8), np.int32)
+        for _ in range(5):
+            if ctx // 8 >= len(pages):
+                pages += eng.pool.alloc(1)
+            table[0, : len(pages)] = pages
+            logits, nxt = eng.decode(
+                np.array([tok, 0]), np.array([ctx + 1, 0]), table
+            )
+            cur.append(tok)
+            ref = ref_logits(model, params, cur)[-1]
+            assert np.abs(logits[0] - ref).max() <= tol, kv_wire
+            if kv_wire == "f32":
+                assert int(nxt[0]) == int(np.argmax(ref))
+            ctx += 1
+            tok = int(nxt[0])
+
+    def test_weight_wire_int8_stays_close(self, gpt):
+        cfg, model, params = gpt
+        eng = make_engine(gpt, weight_wire="int8")
+        rs = np.random.RandomState(5)
+        prompt = [int(t) for t in rs.randint(0, cfg.vocab_size, size=9)]
+        pages = eng.pool.alloc(eng.pool.pages_for(len(prompt)))
+        logits, _ = eng.prefill(prompt, pages)
+        ref = ref_logits(model, params, prompt)[-1]
+        # int8 weights: codec noise only, scaled by logit magnitude
+        assert np.abs(logits - ref).max() <= 0.15 * max(
+            1.0, np.abs(ref).max()
+        )
+
+    def test_packed_weight_roundtrip(self, gpt):
+        _, _, params = gpt
+        q = serve_model.quantize_params(params)
+        back = serve_model.dequantize_params(q)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(back),
+        ):
+            assert a.shape == b.shape and a.dtype == b.dtype
+            scale = max(1e-6, float(jnp.abs(a).max()))
+            # blockwise int8: worst-case error is one quantization step
+            assert float(jnp.abs(a - b).max()) <= scale / 127.0 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# AOT + analysis pins
+# ---------------------------------------------------------------------------
+
+
+class TestEngineBuild:
+    def test_analysis_zero_errors_on_both_steps(self, gpt):
+        """The ISSUE 7 acceptance pin: analysis.check runs over the
+        AOT prefill AND decode programs at build and reports zero
+        ERRORs (transfer-free + donation-aliased), for both KV
+        wires."""
+        for wire in ("f32", "int8"):
+            eng = make_engine(gpt, kv_wire=wire, verify=True)
+            eng.build(buckets=(16,))
+            assert set(eng.reports) == {"prefill_16", "decode"}
+            for name, report in eng.reports.items():
+                assert report.errors() == [], (wire, name, report.render())
+                assert "transfer" in report.rules_run
+                assert "donation" in report.rules_run
+
+    def test_lint_surface_is_clean(self, gpt):
+        report = make_engine(gpt).lint()
+        assert report.errors() == [], report.render()
+        assert report.target == "serve"
+
+    def test_aot_compiles_once_no_retrace(self, gpt):
+        """Steady-state serving never recompiles: many prefill/decode
+        calls leave exactly one compile per program and zero sentinel
+        retraces."""
+        eng = make_engine(gpt)
+        rs = np.random.RandomState(6)
+        table = np.zeros((2, 8), np.int32)
+        for i in range(4):
+            prompt = [int(t) for t in rs.randint(0, 64, size=5 + i)]
+            pages = eng.pool.alloc(1)
+            _, tok = eng.prefill(prompt, pages)
+            table[0, :1] = pages
+            eng.decode(
+                np.array([tok, 0]),
+                np.array([len(prompt) + 1, 0]), table,
+            )
+            eng.pool.free(pages)
+        assert eng.compile_counts == {"prefill_8": 1, "decode": 1}
+        assert eng.retraces == 0
+
+    def test_config_validation(self, gpt):
+        cfg, _, params = gpt
+        with pytest.raises(ValueError, match="max_seq_len"):
+            InferenceEngine(
+                cfg, params,
+                ServeConfig(page_size=8, num_pages=128,
+                            max_pages_per_seq=64),
+            )
+        with pytest.raises(ValueError, match="cannot hold even one"):
+            ServeConfig(page_size=8, num_pages=4, max_pages_per_seq=8)
+        with pytest.raises(ValueError, match="sequence_parallel"):
+            serve_model.validate_config(
+                tiny_cfg(sequence_parallel=True)
+            )
+        with pytest.raises(ValueError, match="kv_wire"):
+            ServeConfig(kv_wire="fp8")
+
+    def test_prompt_over_max_context_rejected(self, gpt):
+        eng = make_engine(gpt, max_pages_per_seq=2)
+        with pytest.raises(ValueError, match="exceeds the max context"):
+            eng.bucket_for(17)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching scheduler
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1e-4  # every read advances a hair (monotonic)
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestScheduler:
+    def _prompt(self, rs, n):
+        return [int(t) for t in rs.randint(0, 64, size=n)]
+
+    def test_fifo_admission_and_drain(self, gpt):
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(7)
+        reqs = [
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=3))
+            for _ in range(4)
+        ]
+        sched.run()
+        assert [r.rid for r in sched.completed] == [r.rid for r in reqs]
+        assert all(len(r.tokens) == 3 for r in sched.completed)
+        assert all(r.ttft_ms is not None for r in sched.completed)
+        assert eng.pool.in_use == 0  # every page returned
+
+    def test_mid_stream_admission_raises_batch_fill(self, gpt):
+        """A request submitted while another is mid-decode joins the
+        RUNNING batch (continuous batching), pushing batch fill above
+        the single-request baseline."""
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(8)
+        first = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                     max_new_tokens=12))
+        fills = []
+        sched.step()  # admit + first decode of request 1, alone
+        baseline = sched.batch_fill()
+        assert baseline == 0.5  # 1 of 2 slots
+        second = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                      max_new_tokens=4))
+        while sched.pending:
+            sched.step()
+            fills.append(sched.batch_fill())
+        assert max(fills) == 1.0  # both ran TOGETHER mid-stream
+        assert second.status == "done" and first.status == "done"
+        # the short request finished while the long one kept running
+        assert second.done_at < first.done_at
+
+    def test_pool_exhaustion_sheds_past_deadline(self, gpt):
+        """Admission backpressure: with the pool pinned by a running
+        request, a queued request waits — and is shed (not silently
+        starved) once its TTFT SLO deadline passes."""
+        eng = make_engine(gpt, num_pages=3, max_pages_per_seq=2)
+        clock = FakeClock()
+        sched = ContinuousBatchingScheduler(eng, clock=clock)
+        rs = np.random.RandomState(9)
+        # the hog still holds the whole pool when the starved request's
+        # deadline is judged (it keeps decoding past step 1)
+        hog = sched.submit(Request(prompt=self._prompt(rs, 14),
+                                   max_new_tokens=4))
+        starved = sched.submit(Request(prompt=self._prompt(rs, 14),
+                                       max_new_tokens=2,
+                                       slo_ttft_ms=500.0))
+        sched.step()  # hog admitted (2 pages = whole pool), starved waits
+        assert hog.status in ("running", "done")
+        assert starved.status == "queued"
+        clock.advance(1.0)  # blow the 500ms deadline
+        sched.run()
+        assert starved.status == "shed"
+        assert hog.status == "done"
+        assert eng.pool.in_use == 0
+
+    def test_growth_page_exhaustion_sheds_youngest(self, gpt):
+        """Mid-decode pool exhaustion sheds the YOUNGEST running
+        request so older ones keep making progress."""
+        eng = make_engine(gpt, num_pages=4, max_pages_per_seq=3)
+        clock = FakeClock()
+        sched = ContinuousBatchingScheduler(eng, clock=clock)
+        rs = np.random.RandomState(10)
+        # both need a growth page mid-generation: 8-token prompts fill
+        # one page exactly, decode crosses into a second page
+        old = sched.submit(Request(prompt=self._prompt(rs, 8),
+                                   max_new_tokens=10))
+        young = sched.submit(Request(prompt=self._prompt(rs, 8),
+                                     max_new_tokens=10))
+        # a third hogs the remaining page so growth must fail
+        hog = sched.submit(Request(prompt=self._prompt(rs, 8),
+                                   max_new_tokens=1))
+        sched.run()
+        assert old.status == "done" and len(old.tokens) == 10
+        assert young.status == "shed"
+        assert hog.status == "done"
+        assert eng.pool.in_use == 0
+
+    def test_oversize_prompt_is_shed(self, gpt):
+        eng = make_engine(gpt, max_pages_per_seq=2)  # 16-token context
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(11)
+        too_big = sched.submit(Request(prompt=self._prompt(rs, 20)))
+        ok = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                  max_new_tokens=2))
+        sched.run()
+        assert too_big.status == "shed"
+        assert ok.status == "done"
+
+    def test_metrics_flow_through_registry(self, gpt):
+        from apex_tpu.observability import MetricRegistry
+
+        eng = make_engine(gpt)
+        reg = MetricRegistry(fetch_every=1)
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(12)
+        for _ in range(3):
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=2))
+        sched.run()
+        reg.fetch()
+        vals = reg.values()
+        assert vals["serve/completed"] == 3.0
+        assert vals["serve/admitted"] == 3.0
+        assert vals["serve/shed"] == 0.0
+        assert vals["serve/tokens_out"] == 6.0
+        assert vals["serve/ttft_ms"] > 0.0
+        assert vals["serve/tokens_per_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# serving watchdog rules
+# ---------------------------------------------------------------------------
+
+
+class TestServeHealthRules:
+    def _registry(self, **values):
+        from apex_tpu.observability import MetricRegistry
+        from apex_tpu.serve import declare_serve_metrics
+
+        reg = MetricRegistry(fetch_every=1)
+        declare_serve_metrics(reg)
+        state = reg.update(reg.init(), values)
+        reg.observe(0, state)
+        reg.observe(1, state)
+        reg.fetch()
+        return reg
+
+    def test_ttft_rule_fires_and_escalates(self):
+        from apex_tpu.observability import TTFTRule, Watchdog, serve_rules
+
+        reg = self._registry(**{"serve/ttft_ms": 2500.0})
+        wd = Watchdog(
+            serve_rules(ttft={"deadline_ms": 1000.0}),
+            registry=reg, check_every=1,
+        )
+        wd.on_step(1)
+        events = [e for e in wd.events if e.rule == "ttft"]
+        assert len(events) == 1
+        assert events[0].severity == "critical"  # > 2x deadline
+        # under the deadline: silent
+        rule = TTFTRule(deadline_ms=5000.0)
+        reg2 = self._registry(**{"serve/ttft_ms": 100.0})
+        wd2 = Watchdog([rule], registry=reg2, check_every=1)
+        wd2.on_step(1)
+        assert wd2.events == []
+
+    def test_queue_depth_rule(self):
+        from apex_tpu.observability import Watchdog, serve_rules
+
+        reg = self._registry(**{"serve/queue_depth": 40.0})
+        wd = Watchdog(
+            serve_rules(queue_depth={"max_depth": 16}),
+            registry=reg, check_every=1,
+        )
+        wd.on_step(1)
+        events = [e for e in wd.events if e.rule == "queue_depth"]
+        assert len(events) == 1 and events[0].severity == "warn"
+
+    def test_serve_rules_rejects_unknown(self):
+        from apex_tpu.observability import serve_rules
+
+        with pytest.raises(ValueError, match="unknown serve health"):
+            serve_rules(mfu_floor={})
+
+
+class TestBf16Serving:
+    def test_bf16_engine_runs_and_is_sane(self):
+        """The default training dtype (bf16) serves: greedy decode
+        runs, logits stay finite, and the argmax token agrees with the
+        bf16 reference forward most of the time (exact-match is not
+        guaranteed at bf16 — the paged path rounds at different
+        points)."""
+        cfg = tiny_cfg(dtype=jnp.bfloat16)
+        model = GptModel(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((8, 1), jnp.int32)
+        )
+        eng = InferenceEngine(
+            cfg, params,
+            ServeConfig(page_size=8, num_pages=16, max_batch=2,
+                        max_pages_per_seq=4, verify=False),
+        )
+        rs = np.random.RandomState(13)
+        prompt = [int(t) for t in rs.randint(0, cfg.vocab_size, size=9)]
+        pages = eng.pool.alloc(2)
+        logits, tok = eng.prefill(prompt, pages)
+        assert np.isfinite(logits).all()
+        table = np.zeros((2, 4), np.int32)
+        table[0, :2] = pages
+        lg, nxt = eng.decode(
+            np.array([tok, 0]), np.array([len(prompt) + 1, 0]), table
+        )
+        assert np.isfinite(lg[0]).all()
+        assert 0 <= int(nxt[0]) < cfg.vocab_size

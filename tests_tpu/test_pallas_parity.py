@@ -522,3 +522,50 @@ def test_flash_bwd_independent_dq_tiles_on_chip():
         )
         for a, b in zip(alt[1:], base[1:]):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# paged single-query decode attention (serving kernel, docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_int8", [False, True])
+def test_paged_decode_attention_on_chip(kv_int8):
+    """Compiled page-walk kernel (scalar-prefetched page-table index
+    maps + fused q-RoPE + optional in-kernel int8 dequant) vs the jnp
+    gather reference, on the real chip.  Shapes chosen tile-aligned:
+    page=128 rows x D=128 lanes, H=8 heads."""
+    from apex_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        paged_decode_attention_reference,
+    )
+    from apex_tpu.serve.cache import encode_kv
+
+    b, h, d, page, pool, np_ = 2, 8, 128, 128, 8, 2
+    rs = np.random.RandomState(0)
+    k_pages = jnp.asarray(rs.randn(pool, h, page, d), jnp.float32)
+    v_pages = jnp.asarray(rs.randn(pool, h, page, d), jnp.float32)
+    q = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    cos = jnp.asarray(rs.randn(b, d), jnp.float32)
+    sin = jnp.asarray(rs.randn(b, d), jnp.float32)
+    table = jnp.asarray([[1, 3], [5, 2]], jnp.int32)
+    lengths = jnp.asarray([200, 37], jnp.int32)
+    kw = dict(rope_cos=cos, rope_sin=sin)
+    if kv_int8:
+        k_pages, ks = encode_kv(k_pages)
+        v_pages, vs = encode_kv(v_pages)
+        kw.update(k_scale=ks, v_scale=vs)
+
+    _dispatch.set_use_pallas(True)
+    try:
+        got = paged_decode_attention(
+            q, k_pages, v_pages, table, lengths, **kw
+        )
+    finally:
+        _dispatch.set_use_pallas(None)
+    want = paged_decode_attention_reference(
+        q, k_pages, v_pages, table, lengths, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )

@@ -11,6 +11,15 @@ Targets:
                        This is the tools/verify_tier1.sh gate: any
                        ERROR finding fails CI.
 
+  --target serve       Build the serve example's ACTUAL engine
+                       (examples/simple/serve/serve_gpt.py::
+                       build_serving) and lint its AOT step programs —
+                       the smallest prefill bucket and the decode step
+                       (transfer-free + donation-aliased: the paged KV
+                       pool must update in place).  The
+                       verify_tier1.sh SERVE gate.  --wire selects the
+                       KV wire format here.
+
   --hlo FILE           Lint an optimized-HLO text dump (e.g. bench.py
                        --hlo-out) with the HLO-level passes only.
 
@@ -92,6 +101,41 @@ def lint_resilient(args):
     return report
 
 
+def _load_serve_module():
+    import importlib.util
+
+    path = os.path.join(
+        REPO, "examples", "simple", "serve", "serve_gpt.py"
+    )
+    spec = importlib.util.spec_from_file_location("serve_gpt", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def lint_serve(args):
+    """Check the serve example's AOT prefill + decode step programs.
+
+    ``build_serving`` is the example's own engine constructor, so the
+    compiled programs under lint are the ones the example dispatches;
+    ``engine.lint()`` runs ``analysis.check`` (with the cache donation
+    declared) over the smallest prefill bucket and the decode step
+    without the build-time raise, so findings render instead of
+    aborting."""
+    import jax
+
+    from apex_tpu.models.gpt import GptModel
+
+    mod = _load_serve_module()
+    cfg = mod.model_config()
+    params = GptModel(cfg).init(
+        jax.random.PRNGKey(0), jax.numpy.zeros((8, 1), jax.numpy.int32)
+    )
+    kv_wire = "int8" if args.wire == "int8" else "f32"
+    engine = mod.build_serving(params, kv_wire=kv_wire, verify=False)
+    return engine.lint()
+
+
 def lint_hlo_file(args):
     from apex_tpu import analysis
 
@@ -110,7 +154,8 @@ def main():
         description="static graph lint over step programs "
         "(rule catalog: docs/analysis.md)"
     )
-    ap.add_argument("--target", choices=["resilient"], default=None)
+    ap.add_argument("--target", choices=["resilient", "serve"],
+                    default=None)
     ap.add_argument("--hlo", metavar="FILE", default=None,
                     help="lint an optimized-HLO text dump instead of "
                     "building a target")
@@ -130,7 +175,12 @@ def main():
     if bool(args.target) == bool(args.hlo):
         ap.error("exactly one of --target / --hlo is required")
 
-    report = lint_hlo_file(args) if args.hlo else lint_resilient(args)
+    if args.hlo:
+        report = lint_hlo_file(args)
+    elif args.target == "serve":
+        report = lint_serve(args)
+    else:
+        report = lint_resilient(args)
 
     # ride the observability board like every other subsystem, so a
     # host process embedding this as a library sees the same gauges

@@ -1,0 +1,399 @@
+"""Closed-loop serving load generator — latency distribution, goodput,
+and the serving acceptance artifact.
+
+Drives a real :class:`apex_tpu.serve.InferenceEngine` +
+:class:`ContinuousBatchingScheduler` with **Poisson arrivals** and a
+configurable prompt/output length mix, then reports what a production
+operator would page on:
+
+- the TTFT and per-output-token latency distributions (p50/p95/p99,
+  rendered as a text histogram);
+- goodput under shedding: completed / offered requests and tokens, with
+  the shed count broken out (graceful degradation is only graceful if
+  it is measured);
+- the continuous-batching proof: mean/peak batch-fill gauge vs the
+  single-request baseline (a scheduler that never admits mid-stream
+  would sit at the baseline);
+- the numerics proof: paged **int8-KV** decode logits vs the unpaged
+  f32 reference forward (``GptModel.apply``) within the pinned
+  tolerance, same check at f32;
+- the static proof: ``analysis.check`` ERROR counts on the AOT prefill
+  and decode step programs (zero required).
+
+``--json FILE`` writes everything as one artifact — the ISSUE 7
+acceptance surface, consumed by CI.
+
+Usage::
+
+    python tools/serve_bench.py                  # small CPU run
+    python tools/serve_bench.py --requests 32 --rate 50 --json out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: pinned acceptance tolerances on last-position logits vs the unpaged
+#: f32 reference (tests/test_serve.py pins the same numbers)
+TOL_F32 = 2e-4
+TOL_INT8_KV = 5e-2
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _histogram(vals, width=40, bins=10):
+    if not vals:
+        return "  (no samples)"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    counts = [0] * bins
+    for v in vals:
+        counts[min(bins - 1, int((v - lo) / span * bins))] += 1
+    peak = max(counts)
+    lines = []
+    for i, c in enumerate(counts):
+        b0 = lo + span * i / bins
+        b1 = lo + span * (i + 1) / bins
+        bar = "#" * int(width * c / peak)
+        lines.append(f"  {b0:9.2f}-{b1:9.2f} ms |{bar:<{width}}| {c}")
+    return "\n".join(lines)
+
+
+def build_engine(args):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GptConfig, GptModel
+    from apex_tpu.serve import InferenceEngine, ServeConfig
+    from apex_tpu.observability import MetricRegistry
+
+    cfg = GptConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=args.heads,
+        intermediate_size=2 * args.hidden, max_seq_len=1024,
+        dtype=jnp.float32,
+    )
+    serve_cfg = ServeConfig(
+        page_size=args.page_size, num_pages=args.pages,
+        max_batch=args.batch, max_pages_per_seq=args.pages_per_seq,
+        kv_wire=args.kv_wire, weight_wire=args.weight_wire,
+        verify=True,
+    )
+    model = GptModel(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (32, 1), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    registry = MetricRegistry(fetch_every=1)
+    # build() compiles AND analysis-verifies every bucket + the decode
+    # step up front, so engine.reports is the acceptance evidence
+    engine = InferenceEngine(
+        cfg, params, serve_cfg, registry=registry
+    ).build()
+    return cfg, model, params, engine, registry
+
+
+def numerics_check(cfg, model, params, args):
+    """Paged decode logits (f32 cache AND int8-KV cache) vs the unpaged
+    f32 reference forward, on one greedy continuation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models.gpt import _tied_vocab_logits
+    from apex_tpu.serve import InferenceEngine, ServeConfig
+
+    rs = np.random.RandomState(7)
+    prompt = list(rs.randint(0, cfg.vocab_size, size=24))
+    steps = 6
+    out = {}
+    for wire, tol in (("f32", TOL_F32), ("int8", TOL_INT8_KV)):
+        eng = InferenceEngine(
+            cfg, params,
+            ServeConfig(
+                page_size=args.page_size, num_pages=args.pages,
+                max_batch=2, max_pages_per_seq=args.pages_per_seq,
+                kv_wire=wire, verify=False,
+            ),
+        )
+        pages = eng.pool.alloc(eng.pool.pages_for(len(prompt)))
+        _, tok = eng.prefill(prompt, pages)
+        cur = list(prompt)
+        ctx = len(prompt)
+        worst = 0.0
+        table = np.zeros((2, args.pages_per_seq), np.int32)
+        for _ in range(steps):
+            if ctx // args.page_size >= len(pages):
+                got = eng.pool.alloc(1)
+                if got is None:
+                    raise RuntimeError(
+                        "numerics check: page pool exhausted — raise "
+                        "--pages"
+                    )
+                pages += got
+            table[0, : len(pages)] = pages
+            logits, nxt = eng.decode(
+                np.array([tok, 0]), np.array([ctx + 1, 0]), table
+            )
+            cur.append(tok)
+            ref_ids = jnp.asarray(np.array(cur)[:, None], jnp.int32)
+            h = model.apply(params, ref_ids)
+            ref = _tied_vocab_logits(params, model, h, sp_gathered=False)
+            worst = max(
+                worst,
+                float(np.abs(logits[0] - np.asarray(ref[-1, 0])).max()),
+            )
+            ctx += 1
+            tok = int(nxt[0])
+        out[wire] = {
+            "max_abs_logit_diff": worst,
+            "tolerance": tol,
+            "ok": worst <= tol,
+        }
+    return out
+
+
+def run_load(engine, registry, args):
+    import numpy as np
+
+    from apex_tpu.serve import ContinuousBatchingScheduler, Request
+
+    rs = np.random.RandomState(args.seed)
+    sched = ContinuousBatchingScheduler(engine, registry=registry)
+
+    # Poisson arrivals: exponential inter-arrival gaps at --rate req/s,
+    # pre-drawn so the run is deterministic under --seed
+    gaps = rs.exponential(1.0 / args.rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    prompt_lens = rs.choice(args.prompt_mix, size=args.requests)
+    out_lens = rs.choice(args.output_mix, size=args.requests)
+
+    t0 = time.monotonic()
+    submitted = 0
+    fills = []
+    occupancy = []
+    while submitted < args.requests or sched.pending:
+        now = time.monotonic() - t0
+        while submitted < args.requests and arrivals[submitted] <= now:
+            sched.submit(Request(
+                prompt=list(rs.randint(0, args.vocab,
+                                       size=prompt_lens[submitted])),
+                max_new_tokens=int(out_lens[submitted]),
+                slo_ttft_ms=args.slo_ttft_ms,
+            ))
+            submitted += 1
+        if sched.pending:
+            sched.step()
+            fills.append(sched.batch_fill())
+            occupancy.append(sched.pool.occupancy())
+        elif submitted < args.requests:
+            time.sleep(min(0.002, arrivals[submitted] - now))
+    wall = time.monotonic() - t0
+
+    done = sched.completed
+    shed = sched.shed
+    ttfts = sorted(r.ttft_ms for r in done if r.ttft_ms is not None)
+    per_tok = []
+    for r in done:
+        n_decode = len(r.tokens) - 1
+        if n_decode > 0 and r.done_at and r.first_token_at:
+            per_tok.append(
+                1e3 * (r.done_at - r.first_token_at) / n_decode
+            )
+    per_tok.sort()
+    tokens_done = sum(len(r.tokens) for r in done)
+    # offered output tokens across ALL submitted requests (shed
+    # included): the token-level goodput denominator
+    tokens_offered = int(sum(int(n) for n in out_lens[:submitted]))
+    offered = len(done) + len(shed)
+    return {
+        "requests": {
+            "offered": offered,
+            "completed": len(done),
+            "shed": len(shed),
+            "goodput": len(done) / offered if offered else 0.0,
+        },
+        "tokens": {
+            "completed": tokens_done,
+            "offered": tokens_offered,
+            "goodput": (
+                tokens_done / tokens_offered if tokens_offered else 0.0
+            ),
+            "throughput_per_s": tokens_done / wall if wall > 0 else 0.0,
+        },
+        "ttft_ms": {
+            "p50": _percentile(ttfts, 0.50),
+            "p95": _percentile(ttfts, 0.95),
+            "p99": _percentile(ttfts, 0.99),
+            "samples": len(ttfts),
+        },
+        "per_token_ms": {
+            "p50": _percentile(per_tok, 0.50),
+            "p95": _percentile(per_tok, 0.95),
+            "p99": _percentile(per_tok, 0.99),
+            "samples": len(per_tok),
+        },
+        "batch_fill": {
+            "mean": sum(fills) / len(fills) if fills else 0.0,
+            "peak": max(fills) if fills else 0.0,
+        },
+        "page_occupancy_peak": max(occupancy) if occupancy else 0.0,
+        "wall_s": wall,
+        "_ttft_samples": ttfts,
+        "_per_tok_samples": per_tok,
+    }
+
+
+def single_request_baseline(engine, args):
+    """Batch-fill a lone request sustains — the bar the continuous
+    batcher must beat (one request on max_batch slots)."""
+    import numpy as np
+
+    from apex_tpu.serve import ContinuousBatchingScheduler, Request
+
+    rs = np.random.RandomState(1)
+    sched = ContinuousBatchingScheduler(engine, registry=None)
+    sched.submit(Request(
+        prompt=list(rs.randint(0, args.vocab, size=int(args.prompt_mix[0]))),
+        max_new_tokens=int(args.output_mix[0]),
+    ))
+    fills = []
+    while sched.pending:
+        sched.step()
+        fills.append(sched.batch_fill())
+    return sum(fills) / len(fills) if fills else 0.0
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="closed-loop serving load generator (docs/serving.md)"
+    )
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="Poisson arrival rate, requests/s")
+    ap.add_argument("--prompt-mix", type=int, nargs="+",
+                    default=[16, 32, 48], dest="prompt_mix")
+    ap.add_argument("--output-mix", type=int, nargs="+",
+                    default=[4, 8, 16], dest="output_mix")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    help="per-request TTFT SLO (None = best effort)")
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--pages", type=int, default=96)
+    ap.add_argument("--pages-per-seq", type=int, default=8)
+    ap.add_argument("--kv-wire", default="f32", choices=["f32", "int8"])
+    ap.add_argument("--weight-wire", default="f32", choices=["f32", "int8"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", metavar="FILE", default=None)
+    args = ap.parse_args()
+
+    cfg, model, params, engine, registry = build_engine(args)
+    lint_errors = {
+        name: len(rep.errors()) for name, rep in engine.reports.items()
+    }
+
+    baseline_fill = single_request_baseline(engine, args)
+    load = run_load(engine, registry, args)
+    numerics = numerics_check(cfg, model, params, args)
+
+    ttft_samples = load.pop("_ttft_samples")
+    per_tok_samples = load.pop("_per_tok_samples")
+    registry.fetch()
+
+    print(f"== serve_bench: {args.requests} requests, Poisson "
+          f"{args.rate}/s, kv_wire={args.kv_wire}, "
+          f"weight_wire={args.weight_wire} ==")
+    r = load["requests"]
+    tk = load["tokens"]
+    print(f"goodput: {r['completed']}/{r['offered']} requests "
+          f"({100 * r['goodput']:.1f}%), {r['shed']} shed; "
+          f"{tk['completed']}/{tk['offered']} tokens "
+          f"({100 * tk['goodput']:.1f}%)")
+    print(f"throughput: {load['tokens']['throughput_per_s']:.1f} tokens/s "
+          f"({load['tokens']['completed']} tokens in "
+          f"{load['wall_s']:.2f}s)")
+    t = load["ttft_ms"]
+    print(f"TTFT ms: p50={t['p50']:.2f} p95={t['p95']:.2f} "
+          f"p99={t['p99']:.2f} (n={t['samples']})")
+    print(_histogram(ttft_samples))
+    p = load["per_token_ms"]
+    print(f"per-token ms: p50={p['p50']:.2f} p95={p['p95']:.2f} "
+          f"p99={p['p99']:.2f} (n={p['samples']})")
+    print(_histogram(per_tok_samples))
+    bf = load["batch_fill"]
+    print(f"batch fill: mean={bf['mean']:.3f} peak={bf['peak']:.3f} "
+          f"(single-request baseline {baseline_fill:.3f}); page "
+          f"occupancy peak {load['page_occupancy_peak']:.3f}")
+    for wire, rec in numerics.items():
+        print(f"numerics [{wire} KV vs unpaged f32]: max|dlogit|="
+              f"{rec['max_abs_logit_diff']:.2e} tol={rec['tolerance']} "
+              f"{'OK' if rec['ok'] else 'FAIL'}")
+    print(f"graph lint ERRORs: {lint_errors}")
+
+    failures = []
+    if bf["mean"] <= baseline_fill:
+        failures.append(
+            f"continuous batching not engaged: mean fill {bf['mean']:.3f} "
+            f"<= single-request baseline {baseline_fill:.3f}"
+        )
+    for wire, rec in numerics.items():
+        if not rec["ok"]:
+            failures.append(
+                f"{wire}-KV decode drifted {rec['max_abs_logit_diff']:.3e} "
+                f"> {rec['tolerance']} from the unpaged f32 reference"
+            )
+    if "decode" not in lint_errors or not any(
+        k.startswith("prefill") for k in lint_errors
+    ):
+        failures.append(
+            f"analysis.check did not cover both steps: {sorted(lint_errors)}"
+        )
+    if any(lint_errors.values()):
+        failures.append(f"graph lint ERRORs on serve steps: {lint_errors}")
+
+    if args.json:
+        artifact = {
+            "config": {
+                k: getattr(args, k) for k in (
+                    "requests", "rate", "prompt_mix", "output_mix",
+                    "slo_ttft_ms", "batch", "page_size", "pages",
+                    "pages_per_seq", "kv_wire", "weight_wire", "seed",
+                )
+            },
+            "load": load,
+            "batch_fill_single_request_baseline": baseline_fill,
+            "numerics_vs_unpaged_f32": numerics,
+            "graph_lint_errors": lint_errors,
+            "registry": {
+                k: v for k, v in registry.values().items()
+                if k.startswith("serve/")
+            },
+            "failures": failures,
+        }
+        with open(args.json, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"[serve_bench] wrote {args.json}")
+
+    for msg in failures:
+        print(f"FAIL {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,8 +48,17 @@
 #      with roofline-vs-StepMeter MFU agreement within 5% (the ISSUE 6
 #      acceptance line).
 #
+# A SERVE stage drives the inference path end to end
+# (docs/serving.md): the serve example trains a tiny GPT with the
+# resilient runner, restores the checkpoint from disk (asserting the
+# restored tree is bit-exact — the train->serve handoff), and serves it
+# through the AOT engine + paged KV cache + continuous-batching
+# scheduler.  The stage asserts the emitted JSONL carries TTFT and
+# tokens-per-s serving metrics, and that tools/graph_lint.py --target
+# serve reports ZERO ERRORs on the compiled prefill/decode steps.
+#
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf + serve
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -60,6 +69,7 @@
 #   T1_SKIP_FLIGHT=1            skip the flight-recorder pass
 #   T1_SKIP_LINT=1              skip the static-analysis pass
 #   T1_SKIP_PERF=1              skip the perf-gate pass
+#   T1_SKIP_SERVE=1             skip the serving pass
 
 set -o pipefail
 
@@ -254,7 +264,9 @@ if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
             2>&1 | tail -n 2 | tee -a "$LOG"
         perf_rc=${PIPESTATUS[0]}
     fi
-    # 2. short CPU bench config + schema gate vs the committed golden
+    # 2. short CPU bench configs + schema gate vs the committed golden
+    #    (smoke + serve append into ONE file: the golden carries both
+    #    metric sets, so --require-same-metrics needs both runs)
     if [ "$perf_rc" -eq 0 ]; then
         PERF_OUT="$(mktemp /tmp/_t1_perf.XXXXXX.jsonl)"
         timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
@@ -262,6 +274,13 @@ if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
             python bench.py --config smoke --metrics-out "$PERF_OUT" \
             2>&1 | tail -n 2 | tee -a "$LOG"
         perf_rc=${PIPESTATUS[0]}
+        if [ "$perf_rc" -eq 0 ]; then
+            timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+                APEX_TPU_BENCH_WATCHDOG_S=0 \
+                python bench.py --config serve --metrics-out "$PERF_OUT" \
+                2>&1 | tail -n 2 | tee -a "$LOG"
+            perf_rc=${PIPESTATUS[0]}
+        fi
         if [ "$perf_rc" -eq 0 ]; then
             python tools/bench_diff.py "$PERF_OUT" \
                 --baseline tools/bench_golden_cpu.jsonl \
@@ -311,16 +330,67 @@ PYEOF
     fi
 fi
 
+serve_rc=0
+if [ "${T1_SKIP_SERVE:-0}" != "1" ]; then
+    SV_OUT="$(mktemp /tmp/_t1_serve.XXXXXX.jsonl)"
+    SV_DIR="$(mktemp -d /tmp/_t1_serve_demo.XXXXXX)"
+    # train -> checkpoint -> restore (bit-exact assert inside) -> serve
+    timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+        python examples/simple/serve/serve_gpt.py \
+        --dir "$SV_DIR" --train-steps 8 --requests 5 \
+        --metrics-out "$SV_OUT" 2>&1 | tail -n 5 | tee -a "$LOG"
+    serve_rc=${PIPESTATUS[0]}
+    if [ "$serve_rc" -eq 0 ]; then
+        python - "$SV_OUT" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert recs, "serving metrics JSONL is empty"
+metrics = {r["metric"] for r in recs}
+for need in ("serve/ttft_ms", "serve/tokens_per_s", "serve/queue_depth",
+             "serve/batch_fill", "serve/page_occupancy"):
+    assert need in metrics, f"missing metric {need}; have {sorted(metrics)}"
+def last(name):
+    return [r for r in recs if r["metric"] == name][-1]["value"]
+ttft = last("serve/ttft_ms")
+tps = last("serve/tokens_per_s")
+assert isinstance(ttft, (int, float)) and ttft > 0, f"ttft={ttft!r}"
+assert isinstance(tps, (int, float)) and tps > 0, f"tokens/s={tps!r}"
+assert last("serve/completed") == 5, last("serve/completed")
+print(f"serving JSONL OK: {len(recs)} records, ttft={ttft:.2f}ms "
+      f"tokens/s={tps:.1f}, 5/5 completed")
+PYEOF
+        serve_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$serve_rc" -eq 0 ]; then
+        # the decode/prefill AOT programs must lint clean (exit 1 on
+        # any ERROR — the ISSUE 7 acceptance gate)
+        SERVE_LINT_JSON="${T1_SERVE_LINT_JSON:-/tmp/_t1_serve_lint.json}"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            python tools/graph_lint.py --target serve \
+            --json "$SERVE_LINT_JSON" 2>&1 | tail -n 2 | tee -a "$LOG"
+        serve_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$serve_rc" -eq 0 ]; then
+        rm -rf "$SV_DIR"
+        rm -f "$SV_OUT"
+        echo "TIER1-SERVE: PASS"
+    else
+        echo "TIER1-SERVE: FAIL (rc=$serve_rc; metrics at $SV_OUT," \
+            "demo dir $SV_DIR)"
+    fi
+fi
+
 if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
     && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] \
-    && [ "$perf_rc" -eq 0 ]; then
+    && [ "$perf_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, perf rc=$perf_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, perf rc=$perf_rc, serve rc=$serve_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$obs_rc" -ne 0 ] && exit "$obs_rc"
 [ "$flight_rc" -ne 0 ] && exit "$flight_rc"
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
-exit "$perf_rc"
+[ "$perf_rc" -ne 0 ] && exit "$perf_rc"
+exit "$serve_rc"
