@@ -25,6 +25,16 @@ session over bench logs:
   :class:`~apex_tpu.observability.trace.TraceScheduler`: "profile
   steps N..N+K to this dir" via ``APEX_TPU_TRACE_STEPS``, no script
   edits.
+- :mod:`apex_tpu.observability.spans` —
+  :class:`~apex_tpu.observability.spans.SpanRecorder`: ring-buffered
+  structured spans with monotonic timestamps anchored once to wall
+  clock — per-request serve lifecycles (``queued → admitted →
+  prefill → decode[i] → done|shed(reason)``) with engine-iteration
+  correlation ids, per-step train spans from the ``run_resilient``
+  observer protocol, health events and profiler-window markers —
+  merged into one Perfetto timeline by
+  :class:`~apex_tpu.observability.export.TimelineSink` /
+  ``tools/timeline.py``.
 - :mod:`apex_tpu.observability.flight` —
   :class:`~apex_tpu.observability.flight.FlightRecorder`: a ring
   buffer of the last N steps' telemetry + event log, dumped
@@ -69,10 +79,16 @@ from apex_tpu.observability.health import (  # noqa: F401
     HealthEvent,
     HostStallRule,
     QueueDepthRule,
+    QueueWaitFractionRule,
     TTFTRule,
     Watchdog,
     default_rules,
     serve_rules,
+)
+from apex_tpu.observability.spans import (  # noqa: F401
+    SpanRecorder,
+    monotonic_to_epoch,
+    wall_clock_anchor,
 )
 from apex_tpu.observability.attribution import (  # noqa: F401
     CostAttribution,
@@ -89,6 +105,7 @@ from apex_tpu.observability.export import (  # noqa: F401
     JSONLSink,
     Reporter,
     TensorBoardSink,
+    TimelineSink,
     bench_record,
 )
 from apex_tpu.observability.meter import (  # noqa: F401
@@ -136,6 +153,10 @@ __all__ = [
     "HostStallRule",
     "TTFTRule",
     "QueueDepthRule",
+    "QueueWaitFractionRule",
+    "SpanRecorder",
+    "wall_clock_anchor",
+    "monotonic_to_epoch",
     "StepMeter",
     "GoodputAccountant",
     "BUCKETS",
@@ -157,6 +178,7 @@ __all__ = [
     "JSONLSink",
     "CSVSink",
     "TensorBoardSink",
+    "TimelineSink",
     "bench_record",
     "TraceScheduler",
     "annotate",

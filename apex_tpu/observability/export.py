@@ -40,6 +40,9 @@ __all__ = [
     "JSONLSink",
     "CSVSink",
     "TensorBoardSink",
+    "TimelineSink",
+    "flight_entries",
+    "flight_counters",
     "Reporter",
 ]
 
@@ -254,6 +257,263 @@ class TensorBoardSink:
 
     def close(self) -> None:
         self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- Chrome-trace-event timeline (Perfetto-viewable) ------------------------
+
+
+def _is_nonfinite_sample(v) -> bool:
+    """A frame-metric value the counter track cannot render: the
+    ``json_safe`` string encodings, or a live non-finite float."""
+    if isinstance(v, str):
+        return v in ("NaN", "Infinity", "-Infinity")
+    if isinstance(v, float):
+        return v != v or v in (float("inf"), float("-inf"))
+    return False
+
+
+def flight_entries(dump: Mapping[str, Any]) -> list:
+    """SpanRecorder-format entries from a flight-recorder dump —
+    frames become ``train/step`` spans, the event log becomes instants
+    (timestamps are already epoch seconds: ``FlightRecorder`` clocks
+    ``time.time``).  Non-finite frame metrics — the crash evidence the
+    dump's ``json_safe`` encoding deliberately preserves — become
+    marker instants, since a counter track cannot render them and
+    silently ending the track one frame early would hide exactly the
+    value the flight recorder kept.  ``tools/timeline.py`` and
+    ``tools/flight_view.py --timeline`` feed the result to
+    :meth:`TimelineSink.add_spans`."""
+    entries = []
+    prev_t = None
+    for fr in dump.get("frames", []):
+        t = fr.get("t")
+        if isinstance(prev_t, (int, float)) and isinstance(t, (int, float)):
+            args = {"step": fr.get("step"),
+                    "skipped": bool(fr.get("skipped"))}
+            if fr.get("replay"):
+                args["replay"] = True
+            entries.append({
+                "name": "train/step", "track": "train",
+                "t0": prev_t, "t1": t, "args": args,
+            })
+        prev_t = t
+        if isinstance(t, (int, float)):
+            for name, v in (fr.get("metrics") or {}).items():
+                if _is_nonfinite_sample(v):
+                    entries.append({
+                        "name": f"{name} = {v}", "track": "health",
+                        "t": t,
+                        "args": {"metric": name, "value": str(v),
+                                 "step": fr.get("step")},
+                    })
+    for ev in dump.get("events", []):
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        kind = ev.get("kind", "event")
+        name = (
+            f"health/{ev.get('rule', '?')}" if kind == "health"
+            else f"train/{kind}"
+        )
+        track = "health" if kind == "health" else "train"
+        args = {k: v for k, v in ev.items()
+                if k not in ("seq", "t", "kind") and v is not None}
+        entries.append({
+            "name": name, "track": track, "t": t, "args": args,
+        })
+    return entries
+
+
+def flight_counters(dump: Mapping[str, Any]) -> list:
+    """``(name, t_epoch_s, value)`` counter samples from a flight
+    dump's per-frame metrics — one Perfetto counter track per metric."""
+    out = []
+    for fr in dump.get("frames", []):
+        t = fr.get("t")
+        metrics = fr.get("metrics") or {}
+        if not isinstance(t, (int, float)):
+            continue
+        for name, v in metrics.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out.append((name, t, float(v)))
+    return out
+
+
+class TimelineSink:
+    """Chrome-trace-event JSON (the format ``ui.perfetto.dev`` and
+    ``chrome://tracing`` open) — the merged-timeline sink beside
+    JSONL/CSV/TensorBoard.
+
+    Two input surfaces:
+
+    - :meth:`add_spans` takes :class:`~apex_tpu.observability.spans.
+      SpanRecorder` entries (spans → ``"X"`` complete events, instants
+      → ``"i"``) together with the recorder dump's **wall-clock
+      anchor**, converting monotonic timestamps to epoch microseconds —
+      which is what lets artifacts from different processes/hosts merge
+      onto one timeline.  Each ``track`` becomes its own named thread
+      row; a span's ``lane`` (e.g. a request id) becomes a sub-row.
+    - :meth:`write` takes a bench-schema record (the
+      :class:`Reporter` sink protocol) and emits a ``"C"`` counter
+      event, so live metric lines render as counter tracks under the
+      spans.
+
+    Events buffer in memory and the JSON object is written at
+    :meth:`close` (the trace format is one document, not a line
+    stream).  ``tools/timeline.py`` and ``tools/flight_view.py
+    --timeline`` are the CLI surfaces.
+    """
+
+    def __init__(self, target: Union[str, os.PathLike, IO], *,
+                 pid: int = 1, process_name: Optional[str] = None,
+                 other_data: Optional[Mapping[str, Any]] = None):
+        if hasattr(target, "write"):
+            self._f, self._owns = target, False
+        else:
+            path = os.fspath(target)
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._f, self._owns = open(path, "w"), True
+        self.pid = int(pid)
+        self._events: list = []
+        self._tids: Dict[Any, int] = {}
+        self._procs: set = set()
+        self._other: Dict[str, Any] = dict(other_data or {})
+        self._closed = False
+        if process_name is not None:
+            self._name_process(self.pid, process_name)
+
+    def _name_process(self, pid: int, name: str) -> None:
+        if pid not in self._procs:
+            self._procs.add(pid)
+            self._events.append({
+                "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "args": {"name": name},
+            })
+
+    def _tid(self, pid: int, track: str, lane=None) -> int:
+        key = (pid, track, lane)
+        tid = self._tids.get(key)
+        if tid is None:
+            tid = len(self._tids) + 1
+            self._tids[key] = tid
+            label = track if lane is None else f"{track} [{lane}]"
+            self._events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": label},
+            })
+            # keep tracks grouped by name, lanes in creation order
+            self._events.append({
+                "ph": "M", "name": "thread_sort_index", "pid": pid,
+                "tid": tid, "args": {"sort_index": tid},
+            })
+        return tid
+
+    @staticmethod
+    def _to_epoch_us(t: float, anchor: Optional[Mapping[str, Any]]) -> float:
+        """Epoch microseconds for timestamp ``t`` — monotonic seconds
+        when ``anchor`` carries the process's monotonic→epoch offset,
+        already-epoch seconds when ``anchor`` is None (flight frames)."""
+        if anchor:
+            t = float(t) - float(anchor["monotonic"]) + float(
+                anchor["epoch"]
+            )
+        return float(t) * 1e6
+
+    def add_spans(
+        self,
+        entries: Iterable[Mapping[str, Any]],
+        *,
+        anchor: Optional[Mapping[str, Any]] = None,
+        pid: Optional[int] = None,
+        process_name: Optional[str] = None,
+    ) -> int:
+        """Append SpanRecorder-format entries; returns the event count
+        added.  Pass each source file's own ``anchor`` (and a distinct
+        ``pid``/``process_name`` per host) when merging."""
+        pid = self.pid if pid is None else int(pid)
+        if process_name is not None:
+            self._name_process(pid, process_name)
+        n = 0
+        for e in entries:
+            track = e.get("track", "events")
+            tid = self._tid(pid, track, e.get("lane"))
+            args = dict(e.get("args") or {})
+            if "t0" in e:
+                ts = self._to_epoch_us(e["t0"], anchor)
+                dur = max(
+                    0.0,
+                    self._to_epoch_us(e["t1"], anchor) - ts,
+                )
+                self._events.append({
+                    "name": e.get("name", "?"), "cat": track, "ph": "X",
+                    "ts": ts, "dur": dur, "pid": pid, "tid": tid,
+                    "args": args,
+                })
+            else:
+                self._events.append({
+                    "name": e.get("name", "?"), "cat": track, "ph": "i",
+                    "ts": self._to_epoch_us(e.get("t", 0.0), anchor),
+                    "s": "t", "pid": pid, "tid": tid, "args": args,
+                })
+            n += 1
+        return n
+
+    def counter(self, name: str, t_epoch_s: float, value: float,
+                *, pid: Optional[int] = None) -> None:
+        """One counter sample (epoch seconds) — renders as a counter
+        track."""
+        self._events.append({
+            "name": name, "ph": "C",
+            "ts": float(t_epoch_s) * 1e6,
+            "pid": self.pid if pid is None else int(pid),
+            "tid": 0, "args": {"value": float(value)},
+        })
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        """Reporter sink protocol: numeric bench-schema records become
+        counter samples stamped with the wall clock at write time."""
+        value = record.get("value")
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        v = float(value)
+        if v != v or v in (float("inf"), float("-inf")):
+            return  # counter tracks are numeric; non-finite has no bar
+        self.counter(record["metric"], time.time(), v)
+
+    def flush(self) -> None:
+        pass  # events buffer until close — the trace is one document
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._events.sort(
+            key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0))
+        )
+        # span args may carry forensic non-finites (a NaN health value)
+        # — encode them the flight-dump way, strict JSON throughout
+        from apex_tpu.observability.flight import json_safe
+
+        json.dump(
+            json_safe({
+                "traceEvents": self._events,
+                "displayTimeUnit": "ms",
+                "otherData": self._other,
+            }),
+            self._f,
+            allow_nan=False,
+        )
+        self._f.write("\n")
+        self._f.flush()
+        if self._owns:
+            self._f.close()
 
     def __enter__(self):
         return self

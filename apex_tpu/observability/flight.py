@@ -52,6 +52,7 @@ __all__ = [
     "DEFAULT_FLIGHT_DIR",
     "DEFAULT_CAPACITY",
     "parse_flight_spec",
+    "json_safe",
     "FlightRecorder",
 ]
 
@@ -82,11 +83,15 @@ def parse_flight_spec(spec: str) -> Tuple[int, Optional[str]]:
     return capacity, dir_override
 
 
-def _json_safe(value):
+def json_safe(value):
     """Make ``value`` JSON-serializable without destroying forensics:
     non-finite floats become the strings ``"NaN"`` / ``"Infinity"`` /
     ``"-Infinity"`` (a NaN loss IS the evidence — ``null`` would erase
-    it, a bare NaN token is invalid JSON)."""
+    it, a bare NaN token is invalid JSON).  The ONE non-finite encoding
+    shared by every observability artifact: flight dumps, span dumps
+    (:mod:`~apex_tpu.observability.spans`), Perfetto timelines
+    (:class:`~apex_tpu.observability.export.TimelineSink`), and the
+    ``tools/serve_bench.py`` acceptance JSON."""
     if isinstance(value, float):
         if math.isnan(value):
             return "NaN"
@@ -94,13 +99,13 @@ def _json_safe(value):
             return "Infinity" if value > 0 else "-Infinity"
         return value
     if isinstance(value, dict):
-        return {str(k): _json_safe(v) for k, v in value.items()}
+        return {str(k): json_safe(v) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
-        return [_json_safe(v) for v in value]
+        return [json_safe(v) for v in value]
     if isinstance(value, (str, int, bool)) or value is None:
         return value
     try:
-        return _json_safe(float(value))
+        return json_safe(float(value))
     except Exception:
         return repr(value)
 
@@ -283,10 +288,20 @@ class FlightRecorder:
             host = {"id": multihost.host_id(), "count": multihost.host_count()}
         except Exception:
             pass
+        # the per-process monotonic→epoch anchor (captured once in
+        # observability.spans): lets tools/timeline.py line this dump
+        # up against span records from the same or other processes
+        try:
+            from apex_tpu.observability.spans import wall_clock_anchor
+
+            anchor = wall_clock_anchor()
+        except Exception:
+            anchor = None
         payload: Dict[str, Any] = {
             "version": 1,
             "reason": str(reason),
             "wall_time": self._clock(),
+            "anchor": anchor,
             "host": host,
             "capacity": self.capacity,
             "run": self.run,
@@ -308,7 +323,7 @@ class FlightRecorder:
         )
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(_json_safe(payload), f, indent=1, allow_nan=False)
+            json.dump(json_safe(payload), f, indent=1, allow_nan=False)
             f.write("\n")
         os.replace(tmp, path)
         self.dumps.append(path)

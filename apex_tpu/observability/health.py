@@ -36,6 +36,10 @@ rule                      fires when
 :class:`QueueDepthRule`   the serving admission queue backs up past a
                           depth budget (``serve/queue_depth``) —
                           :func:`serve_rules` only
+:class:`QueueWaitFractionRule` the TTFT attribution's queue-wait share
+                          exceeds a budget (admission starved —
+                          ``serve/ttft_queue_wait_fraction``) —
+                          :func:`serve_rules` only
 ========================  =================================================
 
 Training loops use :func:`default_rules`; the serving path
@@ -54,7 +58,9 @@ the resilient example feed them).
 Every firing emits a structured :class:`HealthEvent` to: the watchdog's
 ``events`` ledger, the observability board (``health/<rule>``), the
 Reporter sinks (bench-schema lines with ``severity``/``message``/
-``host`` extras), the flight recorder's event log, and the
+``host`` extras), the flight recorder's event log, the span
+recorder's health track (``Watchdog(spans=...)`` — the alert lands on
+the merged timeline next to the spans that explain it), and the
 ``on_unhealthy`` callback — which is the escalation hook: pass a
 callback that arms a :class:`~apex_tpu.observability.trace.
 TraceScheduler` window and an alert turns into an on-chip profile in
@@ -82,6 +88,7 @@ __all__ = [
     "HostStallRule",
     "TTFTRule",
     "QueueDepthRule",
+    "QueueWaitFractionRule",
     "default_rules",
     "serve_rules",
     "Watchdog",
@@ -534,15 +541,58 @@ class QueueDepthRule(Rule):
         return []
 
 
+class QueueWaitFractionRule(Rule):
+    """TTFT dominated by **queue wait** — admission is starved (slots
+    or pages), not the prefill program: adding compute to the decode
+    path cannot help; the levers are pool size, batch slots, and
+    shedding policy.  Reads the ``serve/ttft_queue_wait_fraction``
+    gauge the scheduler's TTFT attribution publishes over its recent
+    completion window (``docs/observability.md`` "Request tracing &
+    timeline"); like :class:`TTFTRule`, only a freshly fetched value is
+    judged."""
+
+    name = "queue_wait_fraction"
+
+    def __init__(self, max_fraction: float = 0.5,
+                 key: str = "serve/ttft_queue_wait_fraction",
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.max_fraction = max_fraction
+        self.key = key
+        self._last_fetched: Optional[int] = None
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        fetched = reg.fetched_step
+        if fetched is None or fetched == self._last_fetched:
+            return []
+        value = reg.values().get(self.key)
+        if value is None:
+            return []
+        self._last_fetched = fetched
+        if value > self.max_fraction:
+            return self._event(
+                step, value, self.max_fraction,
+                f"queue wait is {value:.0%} of TTFT (budget "
+                f"{self.max_fraction:.0%}) — admission starved: grow "
+                "the page pool / decode slots or shed earlier",
+            )
+        return []
+
+
 def serve_rules(**overrides) -> List[Rule]:
     """The serving-path rule set (``docs/serving.md``): TTFT deadline,
-    queue-depth budget, plus the substrate rules that apply to any
-    long-running device loop (stale fetch, hung step).  Same override
-    convention as :func:`default_rules`, e.g.
+    queue-depth budget, queue-wait-fraction attribution, plus the
+    substrate rules that apply to any long-running device loop (stale
+    fetch, hung step).  Same override convention as
+    :func:`default_rules`, e.g.
     ``serve_rules(ttft={"deadline_ms": 250.0})``."""
     specs = {
         "ttft": TTFTRule,
         "queue_depth": QueueDepthRule,
+        "queue_wait_fraction": QueueWaitFractionRule,
         "stale_fetch": StaleFetchRule,
         "hung_step": HungStepRule,
     }
@@ -597,6 +647,7 @@ class Watchdog:
         fleet=None,
         reporter=None,
         flight=None,
+        spans=None,
         attribution=None,
         on_unhealthy: Optional[Callable[[HealthEvent], Any]] = None,
         check_every: int = 8,
@@ -616,6 +667,10 @@ class Watchdog:
         self.attribution = attribution
         self.reporter = reporter
         self.flight = flight
+        #: optional :class:`~apex_tpu.observability.spans.SpanRecorder`
+        #: — every firing lands on its health track, so the merged
+        #: timeline shows the alert next to the spans that explain it
+        self.spans = spans
         self.on_unhealthy = on_unhealthy
         self.check_every = check_every
         self.events: List[HealthEvent] = []
@@ -732,6 +787,8 @@ class Watchdog:
                 sink.write(rec)
         if self.flight is not None:
             self.flight.note_health(event)
+        if self.spans is not None:
+            self.spans.note_health(event)
         if self.on_unhealthy is not None:
             try:
                 self.on_unhealthy(event)

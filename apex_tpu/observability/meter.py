@@ -41,7 +41,21 @@ __all__ = [
     "transformer_train_flops",
     "StepMeter",
     "GoodputAccountant",
+    "percentile",
 ]
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence — the
+    ONE implementation behind the serving TTFT/latency statistics on
+    every surface (scheduler gauges, ``tools/serve_bench.py``
+    artifacts), so the two can never disagree on the same data.
+    Returns NaN on an empty sequence ("no measurement", the bench
+    schema's null)."""
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
 
 #: Per-chip dense bf16 peak FLOP/s by device kind (public specs) — the
 #: single source bench.py's MFU headline, live telemetry, and the

@@ -169,9 +169,16 @@ class TraceScheduler:
         spec: Optional[str] = None,
         base_dir: Optional[str] = None,
         *,
+        spans=None,
         _start_fn=None,
         _stop_fn=None,
     ):
+        #: optional :class:`~apex_tpu.observability.spans.SpanRecorder`
+        #: — each captured window records a ``trace/window`` span, so
+        #: on-chip profile artifacts locate themselves on the merged
+        #: timeline (``tools/timeline.py``)
+        self.spans = spans
+        self._capture_t0 = None
         spec = spec if spec is not None else os.environ.get(ENV_TRACE_STEPS)
         self.start = self.end = None
         dir_override = None
@@ -215,8 +222,7 @@ class TraceScheduler:
         ):
             return
         if self._tracing:
-            self._stop_fn()
-            self._tracing = False
+            self._abort("rearm")
         self.start, self.end = int(start), int(start) + length - 1
         if base_dir is not None:
             self.base_dir = base_dir
@@ -237,8 +243,7 @@ class TraceScheduler:
             if rewound:
                 # rollback replay mid-window: abort and re-arm — the
                 # replay pass recaptures the window cleanly
-                self._stop_fn()
-                self._tracing = False
+                self._abort("rollback")
             elif step > self.end:
                 self._finish()
         # only ever start at exactly `start`: beginning mid-window (a
@@ -248,11 +253,33 @@ class TraceScheduler:
             os.makedirs(self.log_dir, exist_ok=True)
             self._start_fn(self.log_dir)
             self._tracing = True
+            if self.spans is not None:
+                self._capture_t0 = self.spans.now()
+
+    def _abort(self, reason: str) -> None:
+        """Close an in-flight capture WITHOUT marking the window done
+        (it re-arms).  The partial artifacts exist on disk, so the
+        window span is still recorded — marked ``aborted`` so the
+        timeline says how far they cover."""
+        self._stop_fn()
+        self._tracing = False
+        if self.spans is not None and self._capture_t0 is not None:
+            self.spans.trace_window(
+                self.start, self.end, self._capture_t0,
+                self.spans.now(), log_dir=self.log_dir, aborted=reason,
+            )
+            self._capture_t0 = None
 
     def _finish(self) -> None:
         self._stop_fn()
         self._tracing = False
         self._done = True
+        if self.spans is not None and self._capture_t0 is not None:
+            self.spans.trace_window(
+                self.start, self.end, self._capture_t0,
+                self.spans.now(), log_dir=self.log_dir,
+            )
+            self._capture_t0 = None
 
     def stop(self) -> None:
         """Close an in-flight window (end of training / teardown)."""

@@ -28,7 +28,8 @@ indexed by step so replay after rollback/resume feeds the same data.
 The loop narrates itself to an optional ``observer`` (duck-typed; every
 method optional): ``on_step(step, skipped, info)`` per executed step,
 ``on_rollback(step, anchor, skips, discarded)``, ``on_resume(step)``,
-``on_preempt(step)``, and ``on_retry(what, attempt, error)`` for
+``on_preempt(step)``, ``on_checkpoint(step)`` when a save is enqueued,
+and ``on_retry(what, attempt, error)`` for
 checkpoint-I/O retries (bridged from
 :mod:`apex_tpu.resilience.retry` for the duration of the run).
 ``discarded`` is the EXACT count of accepted-but-unsaved steps the
@@ -222,6 +223,9 @@ class ObserverFanout:
     def on_preempt(self, *args) -> None:
         self._fan("on_preempt", *args)
 
+    def on_checkpoint(self, *args) -> None:
+        self._fan("on_checkpoint", *args)
+
     def on_retry(self, *args, **kwargs) -> None:
         for o in self.observers:
             fn = getattr(o, "on_retry", None)
@@ -229,16 +233,16 @@ class ObserverFanout:
                 fn(*args, **kwargs)
 
 
-def _safe_dump(flight, reason: str) -> None:
-    """Write the flight dump without masking the failure being dumped."""
+def _safe_dump(recorder, reason: str, label: str = "flight") -> None:
+    """Write a recorder dump without masking the failure being dumped."""
     try:
-        path = flight.dump(reason)
-        print(f"[flight] black box written: {path}", flush=True)
+        path = recorder.dump(reason)
+        print(f"[{label}] black box written: {path}", flush=True)
     except Exception as e:
         import warnings
 
         warnings.warn(
-            f"flight dump failed ({type(e).__name__}: {e}) — "
+            f"{label} dump failed ({type(e).__name__}: {e}) — "
             "continuing with the original failure",
             RuntimeWarning,
         )
@@ -281,6 +285,7 @@ def run_resilient(
     signals=(signal.SIGTERM,),
     observer: Any = None,
     flight: Any = None,
+    spans: Any = None,
 ) -> RunResult:
     """Drive ``step_fn`` for ``num_steps`` with auto-resume, preemption
     handling, checkpoint retries, and skip-budget rollback.
@@ -304,13 +309,30 @@ def run_resilient(
     When ``flight`` is None, ``APEX_TPU_FLIGHT=N[:DIR]`` arms one from
     the environment with no code changes (no sources attached: frames
     then carry steps/skips/events only).
+
+    ``spans`` arms a :class:`apex_tpu.observability.spans.SpanRecorder`
+    the same way: it joins the observer fan-out (one ``train/step``
+    span per step, rollback/resume/retry/checkpoint/preempt instants)
+    and its record is dumped beside the flight black box on any
+    unhandled exception.  When ``spans`` is None,
+    ``APEX_TPU_SPANS=N[:DIR]`` arms one from the environment — an
+    env-armed recorder additionally dumps at normal completion (a
+    timeline of a *good* run is the baseline a postmortem compares
+    against); an explicitly passed recorder stays with its caller,
+    who decides when to export.
     """
+    spans_env_armed = False
+    if spans is None:
+        from apex_tpu.observability.spans import SpanRecorder
+
+        spans = SpanRecorder.from_env()
+        spans_env_armed = spans is not None
     if flight is None:
         from apex_tpu.observability.flight import FlightRecorder
 
         flight = FlightRecorder.from_env()
-    if flight is not None:
-        observer = ObserverFanout([observer, flight])
+    if flight is not None or spans is not None:
+        observer = ObserverFanout([observer, flight, spans])
     on_retry = getattr(observer, "on_retry", None)
     if on_retry is not None:
         _retry.add_retry_listener(on_retry)
@@ -327,12 +349,25 @@ def run_resilient(
         # exactly the deaths a black box exists for
         if flight is not None:
             _safe_dump(flight, f"{type(e).__name__}: {e}")
+        if spans is not None:
+            _safe_dump(spans, f"{type(e).__name__}: {e}", label="spans")
         raise
     finally:
         if on_retry is not None:
             _retry.remove_retry_listener(on_retry)
-    if flight is not None and result.preempted:
-        _safe_dump(flight, f"preemption (SIGTERM) at step {result.last_step}")
+    if result.preempted:
+        if flight is not None:
+            _safe_dump(
+                flight, f"preemption (SIGTERM) at step {result.last_step}"
+            )
+        if spans is not None:
+            _safe_dump(
+                spans,
+                f"preemption (SIGTERM) at step {result.last_step}",
+                label="spans",
+            )
+    elif spans_env_armed:
+        _safe_dump(spans, "completed", label="spans")
     return result
 
 
@@ -439,6 +474,9 @@ def _run_resilient_inner(
                         s for s in unsaved_accepted if s > prev_save_step
                     ]
                     prev_save_step = step
+                    # checkpoint ENQUEUED (orbax saves are async): the
+                    # event a timeline wants next to rollback anchors
+                    _notify(observer, "on_checkpoint", step)
             step += 1
 
         if preempt.requested:

@@ -40,7 +40,10 @@ from apex_tpu.serve.engine import (  # noqa: F401
 from apex_tpu.serve.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     Request,
+    SHED_REASONS,
+    TTFT_COMPONENTS,
     declare_serve_metrics,
+    ttft_attribution,
 )
 
 __all__ = [
@@ -51,5 +54,8 @@ __all__ = [
     "ServeConfig",
     "ContinuousBatchingScheduler",
     "Request",
+    "SHED_REASONS",
+    "TTFT_COMPONENTS",
     "declare_serve_metrics",
+    "ttft_attribution",
 ]

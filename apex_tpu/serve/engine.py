@@ -167,6 +167,16 @@ class InferenceEngine:
         )
         self._prefill: Dict[int, object] = {}
         self._decode = None
+        #: optional :class:`~apex_tpu.observability.spans.SpanRecorder`
+        #: — when set, every prefill/decode call records an
+        #: ``engine/prefill`` / ``engine/decode`` span (the scheduler
+        #: attaches its recorder here automatically)
+        self.spans = None
+        #: monotonically increasing call counters — the correlation
+        #: ids linking a request's span chain to the engine batch
+        #: iterations it rode (always counted, spans or not)
+        self.decode_iters = 0
+        self.prefill_calls = 0
         #: per-program AOT compile counter — the observable
         #: retrace-freedom pin (steady state never increments it)
         self.compile_counts: Dict[str, int] = {}
@@ -333,11 +343,24 @@ class InferenceEngine:
             jnp.asarray(n, jnp.int32), jnp.asarray(ids),
         )
         self._sentinels[name].observe(*args)
+        self.prefill_calls += 1
+        rec = self.spans
+        t0 = rec.now() if rec is not None else None
         logits, next_token, self.cache = compiled(*args)
         # logits stay ON DEVICE (lazy jax.Array): only the sampled
         # token crosses to the host — the logits matrix is (V,)/(B, V)
         # and most callers never read it
-        return logits, int(next_token)
+        first = int(next_token)
+        if rec is not None:
+            # int(next_token) above synced, so the span covers the real
+            # device time, not just the async dispatch
+            from apex_tpu.observability.spans import TRACK_ENGINE
+
+            rec.span(
+                "engine/prefill", t0, rec.now(), track=TRACK_ENGINE,
+                bucket=bucket, tokens=n, call=self.prefill_calls,
+            )
+        return logits, first
 
     def decode(self, tokens, lengths, page_tables):
         """One decode iteration over the full slot array.  ``lengths``
@@ -355,5 +378,18 @@ class InferenceEngine:
             jnp.asarray(page_tables, jnp.int32),
         )
         self._sentinels["decode"].observe(*args)
+        self.decode_iters += 1
+        rec = self.spans
+        t0 = rec.now() if rec is not None else None
         logits, next_tokens, self.cache = compiled(*args)
-        return logits, np.asarray(next_tokens)
+        out = np.asarray(next_tokens)
+        if rec is not None:
+            # np.asarray(next_tokens) above synced — real device time
+            from apex_tpu.observability.spans import TRACK_ENGINE
+
+            rec.span(
+                "engine/decode", t0, rec.now(), track=TRACK_ENGINE,
+                iter=self.decode_iters,
+                batch=int((np.asarray(lengths) > 0).sum()),
+            )
+        return logits, out

@@ -21,12 +21,40 @@ Admission control and degradation are explicit:
   the youngest running request is shed to keep the older ones making
   progress (LIFO victim: it has the least sunk prefill cost).
 
+Every shed carries a **reason** (:data:`SHED_REASONS`): the single
+``serve/shed`` counter is split into per-reason counters so "we shed
+3%" becomes "we shed 3%, all of it deadline-in-queue — admission is
+starved, not the decode batch".
+
 Every iteration publishes the serving gauges through the shared
 :class:`~apex_tpu.observability.metrics.MetricRegistry` — queue depth,
 batch fill, page-pool occupancy, tokens/s, TTFT — the same spine
 training telemetry rides, so :class:`~apex_tpu.observability.health.
 TTFTRule` / :class:`~apex_tpu.observability.health.QueueDepthRule`
 watchdogs page the same health layer (``docs/serving.md``).
+
+**TTFT attribution** (``docs/observability.md``): each completed
+request's TTFT decomposes into three components that sum to the
+measured TTFT *by construction* (the same remainder discipline
+:mod:`~apex_tpu.observability.attribution` applies to step time):
+
+- ``queue_wait`` — time the request sat in the queue while admission
+  was **resource-blocked** (no free decode slot, or the page pool
+  could not cover the queue head);
+- ``prefill``    — admission to first token (the prefill program);
+- ``contention`` — the remainder of the pre-admission wait: the
+  request was admissible but the scheduler was busy running decode
+  iterations for the requests already in the batch.
+
+Per-component p50/p95/p99 gauges and the queue-wait fraction publish
+through the registry on the observation cadence;
+:class:`~apex_tpu.observability.health.QueueWaitFractionRule` alerts
+when TTFT is dominated by starved admission.  With a
+:class:`~apex_tpu.observability.spans.SpanRecorder` attached
+(``spans=``), every request additionally records its full span chain
+``queued → admitted → prefill → decode[i] → done|shed(reason)`` with
+engine decode-iteration correlation ids — the per-request causal
+record ``tools/timeline.py`` merges into one Perfetto timeline.
 """
 
 from __future__ import annotations
@@ -35,13 +63,21 @@ import collections
 import dataclasses
 import itertools
 import time
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from apex_tpu.observability.meter import percentile as _percentile
 from apex_tpu.serve.cache import NULL_PAGE
 
-__all__ = ["Request", "ContinuousBatchingScheduler", "declare_serve_metrics"]
+__all__ = [
+    "Request",
+    "ContinuousBatchingScheduler",
+    "declare_serve_metrics",
+    "ttft_attribution",
+    "SHED_REASONS",
+    "TTFT_COMPONENTS",
+]
 
 _ids = itertools.count()
 
@@ -49,6 +85,47 @@ QUEUED = "queued"
 RUNNING = "running"
 DONE = "done"
 SHED = "shed"
+
+#: shed reasons, each with its own ``serve/shed_<reason>`` counter:
+#: ``deadline`` (queued past its TTFT SLO while the pool stayed
+#: exhausted), ``growth_victim`` (youngest running request shed to free
+#: a growth page), ``pool_exhausted`` (a running request could not grow
+#: even after a victim shed), ``oversize`` (prompt exceeds the max
+#: context).
+SHED_DEADLINE = "deadline"
+SHED_GROWTH_VICTIM = "growth_victim"
+SHED_POOL_EXHAUSTED = "pool_exhausted"
+SHED_OVERSIZE = "oversize"
+SHED_REASONS = (
+    SHED_DEADLINE, SHED_GROWTH_VICTIM, SHED_POOL_EXHAUSTED, SHED_OVERSIZE,
+)
+
+#: TTFT attribution components (ms); they sum to the measured TTFT by
+#: construction — see the module docstring
+TTFT_COMPONENTS = ("queue_wait", "prefill", "contention")
+
+def ttft_attribution(comps) -> Dict[str, object]:
+    """Aggregate per-request TTFT components
+    (:meth:`Request.ttft_components` dicts) into per-component
+    p50/p95/p99 + the queue-wait fraction — the ONE aggregation behind
+    both the scheduler's ``serve/ttft_*`` registry gauges and the
+    ``tools/serve_bench.py`` artifact, so the two surfaces
+    ``verify_tier1.sh`` cross-checks can never drift apart."""
+    out: Dict[str, object] = {}
+    for comp in TTFT_COMPONENTS:
+        vals = sorted(c[f"{comp}_ms"] for c in comps)
+        out[f"{comp}_ms"] = {
+            tag: _percentile(vals, q)
+            for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99"))
+        }
+    total_ttft = sum(c["ttft_ms"] for c in comps)
+    out["queue_wait_fraction"] = (
+        sum(c["queue_wait_ms"] for c in comps) / total_ttft
+        if total_ttft > 0 else 0.0
+    )
+    out["samples"] = len(comps)
+    return out
+
 
 #: default for ``ContinuousBatchingScheduler(registry=...)``: inherit
 #: the engine's registry.  Pass ``registry=None`` to run with NO
@@ -76,14 +153,49 @@ class Request:
     #: KV positions written (prompt + generated-and-fed tokens)
     ctx_len: int = 0
     submitted_at: Optional[float] = None
+    #: popped from the queue with pages granted (prefill dispatch)
+    admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     done_at: Optional[float] = None
+    #: why this request was shed (one of :data:`SHED_REASONS`), else None
+    shed_reason: Optional[str] = None
+    #: accumulated seconds the request sat in the queue while admission
+    #: was resource-blocked (the ``queue_wait`` TTFT component)
+    queue_blocked_s: float = 0.0
+    #: start of the current resource-blocked interval (scheduler-owned)
+    blocked_since: Optional[float] = None
+    #: engine decode iterations this request rode (correlation ids
+    #: into the ``serve/engine`` span track)
+    first_decode_iter: Optional[int] = None
+    last_decode_iter: Optional[int] = None
 
     @property
     def ttft_ms(self) -> Optional[float]:
         if self.submitted_at is None or self.first_token_at is None:
             return None
         return 1e3 * (self.first_token_at - self.submitted_at)
+
+    def ttft_components(self) -> Optional[Dict[str, float]]:
+        """``{ttft_ms, queue_wait_ms, prefill_ms, contention_ms}`` —
+        the three components sum to ``ttft_ms`` by construction
+        (contention is the remainder of the pre-admission wait)."""
+        if (
+            self.submitted_at is None
+            or self.admitted_at is None
+            or self.first_token_at is None
+        ):
+            return None
+        queue_wait = 1e3 * self.queue_blocked_s
+        prefill = 1e3 * (self.first_token_at - self.admitted_at)
+        contention = (
+            1e3 * (self.admitted_at - self.submitted_at) - queue_wait
+        )
+        return {
+            "ttft_ms": self.ttft_ms,
+            "queue_wait_ms": queue_wait,
+            "prefill_ms": prefill,
+            "contention_ms": contention,
+        }
 
 
 def declare_serve_metrics(registry) -> None:
@@ -95,6 +207,15 @@ def declare_serve_metrics(registry) -> None:
     for c in ("serve/admitted", "serve/completed", "serve/shed",
               "serve/tokens_out", "serve/prefills", "serve/decode_steps"):
         registry.counter(c)
+    # per-reason shed breakdown (sums to serve/shed)
+    for reason in SHED_REASONS:
+        registry.counter(f"serve/shed_{reason}")
+    # TTFT attribution: per-component percentiles over the recent
+    # completion window, plus the fraction the watchdog judges
+    for comp in TTFT_COMPONENTS:
+        for tag in ("p50", "p95", "p99"):
+            registry.gauge(f"serve/ttft_{comp}_ms_{tag}", "ms")
+    registry.gauge("serve/ttft_queue_wait_fraction")
 
 
 class ContinuousBatchingScheduler:
@@ -105,10 +226,18 @@ class ContinuousBatchingScheduler:
     >>> sched.submit(Request(prompt=[...], max_new_tokens=32))
     >>> while sched.pending:
     ...     sched.step()
+
+    ``spans`` attaches a :class:`~apex_tpu.observability.spans.
+    SpanRecorder`: the scheduler records each request's lifecycle span
+    chain and hands the same recorder to the engine for its
+    prefill/decode-iteration spans (taking over from any previous
+    scheduler's recorder, and sharing a non-default ``clock`` with the
+    recorder so the whole record stays on one time basis).
     """
 
     def __init__(self, engine, *, registry=ENGINE_REGISTRY,
-                 clock=time.monotonic, window: int = 32):
+                 clock=time.monotonic, window: int = 32,
+                 spans=None, attribution_window: int = 128):
         self.engine = engine
         self.pool = engine.pool
         self.serve = engine.serve
@@ -124,6 +253,26 @@ class ContinuousBatchingScheduler:
         self.registry = (
             engine.registry if registry is ENGINE_REGISTRY else registry
         )
+        self.spans = spans
+        # this scheduler owns the engine's recorder for its lifetime —
+        # a later scheduler on the same engine takes over cleanly
+        # (spans=None DETACHES a retired scheduler's recorder) instead
+        # of feeding a dead recorder events uncorrelated to any chain
+        engine.spans = spans
+        if spans is not None:
+            if clock is not time.monotonic:
+                # ONE time basis per recorder: the request ledger uses
+                # this clock, so the engine spans (rec.now()) must too
+                # — a mixed-clock record would merge into a timeline
+                # that silently misplaces half its tracks.  Export
+                # alignment via the wall-clock anchor assumes the
+                # default monotonic clock.
+                spans.clock = clock
+        # recent completions' TTFT components — the percentile window
+        self._comps: Deque[Dict[str, float]] = collections.deque(
+            maxlen=attribution_window
+        )
+        self._published_done = 0
         self._mstate = None
         if self.registry is not None:
             declare_serve_metrics(self.registry)
@@ -145,6 +294,12 @@ class ContinuousBatchingScheduler:
         req.status = QUEUED
         req.submitted_at = self.clock()
         self.queue.append(req)
+        if self.spans is not None:
+            self.spans.request_event(
+                req.rid, QUEUED, req.submitted_at,
+                prompt_tokens=len(req.prompt),
+                slo_ttft_ms=req.slo_ttft_ms,
+            )
         return req
 
     def _page_table_row(self, req: Request) -> np.ndarray:
@@ -152,17 +307,57 @@ class ContinuousBatchingScheduler:
         row[: len(req.pages)] = req.pages
         return row
 
-    def _retire(self, req: Request, status: str) -> None:
+    def _close_blocked(self, req: Request, now: float) -> None:
+        if req.blocked_since is not None:
+            req.queue_blocked_s += now - req.blocked_since
+            req.blocked_since = None
+
+    def _span_terminal(self, req: Request, status: str,
+                       reason: Optional[str]) -> None:
+        rec = self.spans
+        if rec is None:
+            return
+        args: Dict[str, object] = {}
+        if status == DONE:
+            args["tokens"] = len(req.tokens)
+        else:
+            args["reason"] = reason
+            if req.submitted_at is not None and req.done_at is not None:
+                args["waited_ms"] = 1e3 * (req.done_at - req.submitted_at)
+        if req.first_decode_iter is not None:
+            args["first_iter"] = req.first_decode_iter
+            args["last_iter"] = req.last_decode_iter
+        # a request retired straight out of prefill (finished or shed
+        # at its first token) still owns its TTFT attribution — attach
+        # it here so the req/prefill span carries the components
+        if rec.open_requests.get(req.rid) == "prefill":
+            comps = req.ttft_components()
+            if comps:
+                args.update(comps)
+        rec.request_event(req.rid, status, req.done_at, **args)
+
+    def _retire(self, req: Request, status: str,
+                reason: Optional[str] = None) -> None:
         if req.pages:
             self.pool.free(req.pages)
             req.pages = []
         req.status = status
+        req.shed_reason = reason if status == SHED else None
         req.done_at = self.clock()
-        (self.completed if status == DONE else self.shed).append(req)
+        self._close_blocked(req, req.done_at)
+        self._span_terminal(req, status, reason)
+        if status == DONE:
+            self.completed.append(req)
+            comps = req.ttft_components()
+            if comps is not None:
+                self._comps.append(comps)
+        else:
+            self.shed.append(req)
 
-    def _shed_request(self, req: Request) -> None:
-        self._retire(req, SHED)
+    def _shed_request(self, req: Request, reason: str) -> None:
+        self._retire(req, SHED, reason)
         self._count("serve/shed")
+        self._count(f"serve/shed_{reason}")
 
     # -- admission --------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
@@ -182,7 +377,7 @@ class ContinuousBatchingScheduler:
         req = self.queue[0]
         if len(req.prompt) > self.serve.max_context:
             self.queue.popleft()
-            self._shed_request(req)
+            self._shed_request(req, SHED_OVERSIZE)
             return True
         need = self.pool.pages_for(len(req.prompt))
         pages = self.pool.alloc(need)
@@ -194,11 +389,20 @@ class ContinuousBatchingScheduler:
                 and 1e3 * (self.clock() - req.submitted_at) > req.slo_ttft_ms
             ):
                 self.queue.popleft()
-                self._shed_request(req)
+                self._shed_request(req, SHED_DEADLINE)
                 return True
             return False
         self.queue.popleft()
+        now = self.clock()
+        self._close_blocked(req, now)
+        req.admitted_at = now
         req.pages = pages
+        if self.spans is not None:
+            self.spans.request_event(
+                req.rid, "prefill", now,
+                bucket=self.engine.bucket_for(len(req.prompt)),
+                prompt_tokens=len(req.prompt), pages=len(pages),
+            )
         _, first = self.engine.prefill(req.prompt, pages)
         req.ctx_len = len(req.prompt)
         req.tokens.append(first)
@@ -214,6 +418,13 @@ class ContinuousBatchingScheduler:
             self.slots[slot] = None
             self._retire(req, DONE)
             self._count("serve/completed")
+        elif self.spans is not None:
+            # entering the decode phase: the closing event carries the
+            # full TTFT attribution onto the req/prefill span
+            self.spans.request_event(
+                req.rid, "decode", req.first_token_at,
+                **(req.ttft_components() or {}),
+            )
         return True
 
     def _finished(self, req: Request) -> bool:
@@ -257,7 +468,7 @@ class ContinuousBatchingScheduler:
                 victim = victims[-1]
                 v_slot = self.slots.index(victim)
                 self.slots[v_slot] = None
-                self._shed_request(victim)
+                self._shed_request(victim, SHED_GROWTH_VICTIM)
                 # the victim's row may already be staged for this
                 # iteration — clear it so the decode never touches its
                 # (now freed) pages
@@ -267,7 +478,7 @@ class ContinuousBatchingScheduler:
                 if victim is req or not self._ensure_growth_page(req):
                     if self.slots[i] is req:
                         self.slots[i] = None
-                        self._shed_request(req)
+                        self._shed_request(req, SHED_POOL_EXHAUSTED)
                     continue
             tokens[i] = req.tokens[-1]
             lengths[i] = req.ctx_len + 1  # context incl. the fed token
@@ -276,9 +487,16 @@ class ContinuousBatchingScheduler:
             return
         _, next_tokens = self.engine.decode(tokens, lengths, tables)
         self._count("serve/decode_steps")
+        # engine-numbered iteration id: the correlation key linking a
+        # request's decode span to the engine batch iterations it rode
+        it = getattr(self.engine, "decode_iters", None)
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
+            if it is not None:
+                if req.first_decode_iter is None:
+                    req.first_decode_iter = it
+                req.last_decode_iter = it
             req.ctx_len += 1
             req.tokens.append(int(next_tokens[i]))
             self._tokens_out += 1
@@ -299,6 +517,27 @@ class ContinuousBatchingScheduler:
                 self._mstate, {name: float(value)}
             )
 
+    def _publish_attribution(self) -> None:
+        """Percentile gauges over the recent completion window — one
+        batched registry update, recomputed only when new completions
+        arrived since the last publish."""
+        if (
+            self._mstate is None
+            or not self._comps
+            or len(self.completed) == self._published_done
+        ):
+            return
+        self._published_done = len(self.completed)
+        attr = ttft_attribution(self._comps)
+        updates: Dict[str, float] = {}
+        for comp in TTFT_COMPONENTS:
+            for tag, value in attr[f"{comp}_ms"].items():
+                updates[f"serve/ttft_{comp}_ms_{tag}"] = value
+        updates["serve/ttft_queue_wait_fraction"] = attr[
+            "queue_wait_fraction"
+        ]
+        self._mstate = self.registry.update(self._mstate, updates)
+
     def _publish(self) -> None:
         now = self.clock()
         self._window.append((now, self._tokens_out))
@@ -311,6 +550,7 @@ class ContinuousBatchingScheduler:
         self._gauge("serve/batch_fill", self.batch_fill())
         self._gauge("serve/page_occupancy", self.pool.occupancy())
         self._gauge("serve/tokens_per_s", tps)
+        self._publish_attribution()
         if self._mstate is not None:
             self.registry.observe(self._step, self._mstate)
 
@@ -322,6 +562,15 @@ class ContinuousBatchingScheduler:
         # between decode iterations by construction
         while self._admit_one():
             pass
+        if self.queue:
+            # admission gave up with requests still queued: they are
+            # resource-blocked (no slot / pool cannot cover the head)
+            # from here until the next admission attempt — the
+            # queue_wait TTFT component
+            now = self.clock()
+            for r in self.queue:
+                if r.blocked_since is None:
+                    r.blocked_since = now
         self._decode_once()
         self._step += 1
         self._publish()

@@ -1008,18 +1008,28 @@ def bench_serve(trace_dir=None, prompt_len=48, decode_steps=24, trials=3):
         engine.pool.free(r["pages"])
 
     # -- TTFT through the scheduler (queue -> admit -> prefill) ---------
+    # spans ON: this row doubles as the span-recording overhead gate —
+    # the golden tolerance on serve_ttft_ms binds the scheduler path
+    # WITH per-request span chains being recorded
+    from apex_tpu.observability.spans import SpanRecorder
+
     ttfts = []
     for _ in range(trials):
-        sched = ContinuousBatchingScheduler(engine)
+        # each scheduler takes the engine over with its own recorder
+        sched = ContinuousBatchingScheduler(
+            engine, spans=SpanRecorder(capacity=1024)
+        )
         sched.submit(Request(prompt=prompt(prompt_len), max_new_tokens=2))
         sched.run()
         ttfts.append(sched.completed[-1].ttft_ms)
     ttfts.sort()
+    engine.spans = None
     _emit(
         "serve_ttft_ms",
         round(ttfts[len(ttfts) // 2], 3),
         "ms (prompt=%d via ContinuousBatchingScheduler, queue->first "
-        "token; CI serving smoke on CPU, not a perf claim)" % prompt_len,
+        "token, span recording ON; CI serving smoke on CPU, not a perf "
+        "claim)" % prompt_len,
         None,
     )
 

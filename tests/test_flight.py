@@ -20,7 +20,7 @@ from apex_tpu.observability import (
     MetricRegistry,
     parse_flight_spec,
 )
-from apex_tpu.observability.flight import ENV_FLIGHT, _json_safe
+from apex_tpu.observability.flight import ENV_FLIGHT, json_safe
 from apex_tpu.resilience import ObserverFanout, chaos, run_resilient
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -72,7 +72,7 @@ def test_ring_keeps_last_capacity_frames_and_marks_replay():
 
 
 def test_json_safe_preserves_nonfinite_as_strings():
-    enc = _json_safe(
+    enc = json_safe(
         {"a": float("nan"), "b": float("inf"), "c": -float("inf"),
          "d": 1.5, "e": [float("nan")], "f": jnp.float32(2.0)}
     )

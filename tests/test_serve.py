@@ -381,6 +381,7 @@ class TestScheduler:
         clock.advance(1.0)  # blow the 500ms deadline
         sched.run()
         assert starved.status == "shed"
+        assert starved.shed_reason == "deadline"  # the split-counter pin
         assert hog.status == "done"
         assert eng.pool.in_use == 0
 
@@ -403,6 +404,7 @@ class TestScheduler:
         sched.run()
         assert old.status == "done" and len(old.tokens) == 10
         assert young.status == "shed"
+        assert young.shed_reason == "growth_victim"
         assert hog.status == "done"
         assert eng.pool.in_use == 0
 
@@ -415,6 +417,7 @@ class TestScheduler:
                                   max_new_tokens=2))
         sched.run()
         assert too_big.status == "shed"
+        assert too_big.shed_reason == "oversize"
         assert ok.status == "done"
 
     def test_metrics_flow_through_registry(self, gpt):
@@ -436,6 +439,23 @@ class TestScheduler:
         assert vals["serve/tokens_out"] == 6.0
         assert vals["serve/ttft_ms"] > 0.0
         assert vals["serve/tokens_per_s"] >= 0.0
+        # the shed breakdown sums to the total (here: all zero)
+        from apex_tpu.serve import SHED_REASONS, TTFT_COMPONENTS
+
+        assert vals["serve/shed"] == sum(
+            vals[f"serve/shed_{r}"] for r in SHED_REASONS
+        )
+        # TTFT attribution percentiles ride the same registry, and the
+        # components sum to the TTFT gauge on every completed request
+        for comp in TTFT_COMPONENTS:
+            for tag in ("p50", "p95", "p99"):
+                assert f"serve/ttft_{comp}_ms_{tag}" in vals
+        assert vals["serve/ttft_prefill_ms_p50"] > 0.0
+        for r in sched.completed:
+            c = r.ttft_components()
+            assert (
+                c["queue_wait_ms"] + c["prefill_ms"] + c["contention_ms"]
+            ) == pytest.approx(c["ttft_ms"], abs=1e-6)
 
 
 # ---------------------------------------------------------------------------

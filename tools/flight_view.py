@@ -18,15 +18,23 @@ review:
 
 ``--json`` prints a one-line machine summary instead (reason + frame/
 event/skip/rollback counts) — what ``tools/verify_tier1.sh``'s FLIGHT
-pass consumes.  Exit status: 0 on a parseable dump, 2 otherwise.
+pass consumes.  ``--timeline OUT`` emits the dump as Chrome-trace-event
+JSON (frames as ``train/step`` spans, events as instants, frame metrics
+as counter tracks) so a crash postmortem opens in the SAME Perfetto
+viewer as live span traces (``tools/timeline.py``,
+``docs/observability.md``).  Exit status: 0 on a parseable dump, 2
+otherwise.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _num(value):
@@ -188,6 +196,9 @@ def main(argv=None) -> int:
                     help="timeline entries to show (default 16)")
     ap.add_argument("--json", action="store_true",
                     help="print a one-line machine summary instead")
+    ap.add_argument("--timeline", metavar="OUT", default=None,
+                    help="write the dump as Chrome-trace-event JSON "
+                    "(Perfetto-viewable, same format as tools/timeline.py)")
     args = ap.parse_args(argv)
     try:
         data = load_dump(args.dump)
@@ -195,6 +206,26 @@ def main(argv=None) -> int:
         print(f"flight_view: cannot read {args.dump}: {e}",
               file=sys.stderr)
         return 2
+    if args.timeline:
+        from apex_tpu.observability.export import (
+            TimelineSink,
+            flight_counters,
+            flight_entries,
+        )
+
+        host = (data.get("host") or {}).get("id", 0)
+        with TimelineSink(
+            args.timeline,
+            process_name=f"host{host} flight ({args.dump})",
+            other_data={"reason": data.get("reason"),
+                        "anchor": data.get("anchor")},
+        ) as sink:
+            n = sink.add_spans(flight_entries(data), anchor=None)
+            for name, t, v in flight_counters(data):
+                sink.counter(name, t, v)
+                n += 1
+        print(f"[flight_view] wrote {args.timeline} ({n} events)",
+              file=sys.stderr)
     if args.json:
         print(json.dumps(summarize(data)))
     else:

@@ -21,12 +21,20 @@ operator would page on:
   and decode step programs (zero required).
 
 ``--json FILE`` writes everything as one artifact — the ISSUE 7
-acceptance surface, consumed by CI.
+acceptance surface, consumed by CI — including the per-reason shed
+breakdown, the TTFT queue-wait/prefill/contention attribution
+percentiles, and the process wall-clock anchor.  ``--spans FILE``
+additionally records every request's span chain
+(``queued → admitted → prefill → decode[i] → done|shed``) through a
+:class:`~apex_tpu.observability.spans.SpanRecorder`; feed the dump to
+``tools/timeline.py`` for the Perfetto timeline and the
+span-accounting CI gate (``docs/observability.md``).
 
 Usage::
 
     python tools/serve_bench.py                  # small CPU run
     python tools/serve_bench.py --requests 32 --rate 50 --json out.json
+    python tools/serve_bench.py --spans spans.json --json out.json
 """
 
 from __future__ import annotations
@@ -47,11 +55,8 @@ TOL_F32 = 2e-4
 TOL_INT8_KV = 5e-2
 
 
-def _percentile(sorted_vals, q):
-    if not sorted_vals:
-        return float("nan")
-    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[i]
+# the ONE nearest-rank implementation the scheduler gauges use too
+from apex_tpu.observability.meter import percentile as _percentile  # noqa: E402
 
 
 def _histogram(vals, width=40, bins=10):
@@ -164,13 +169,15 @@ def numerics_check(cfg, model, params, args):
     return out
 
 
-def run_load(engine, registry, args):
+def run_load(engine, registry, args, spans=None):
     import numpy as np
 
     from apex_tpu.serve import ContinuousBatchingScheduler, Request
 
     rs = np.random.RandomState(args.seed)
-    sched = ContinuousBatchingScheduler(engine, registry=registry)
+    sched = ContinuousBatchingScheduler(
+        engine, registry=registry, spans=spans
+    )
 
     # Poisson arrivals: exponential inter-arrival gaps at --rate req/s,
     # pre-drawn so the run is deterministic under --seed
@@ -217,13 +224,32 @@ def run_load(engine, registry, args):
     # included): the token-level goodput denominator
     tokens_offered = int(sum(int(n) for n in out_lens[:submitted]))
     offered = len(done) + len(shed)
+
+    # per-reason shed breakdown (the split serve/shed counters carry
+    # the same numbers through the registry)
+    shed_reasons = {}
+    for r in shed:
+        key = r.shed_reason or "?"
+        shed_reasons[key] = shed_reasons.get(key, 0) + 1
+    # TTFT attribution: per-component percentiles over every completed
+    # request — the same queue-wait/prefill/contention decomposition
+    # the scheduler publishes as serve/ttft_*_ms_p* gauges
+    from apex_tpu.serve import ttft_attribution
+
+    comps = [c for c in (r.ttft_components() for r in done)
+             if c is not None]
+    # the scheduler's own aggregation: the artifact and the
+    # serve/ttft_* registry gauges come from ONE implementation
+    ttft_attr = ttft_attribution(comps)
     return {
         "requests": {
             "offered": offered,
             "completed": len(done),
             "shed": len(shed),
+            "shed_reasons": shed_reasons,
             "goodput": len(done) / offered if offered else 0.0,
         },
+        "ttft_attribution": ttft_attr,
         "tokens": {
             "completed": tokens_done,
             "offered": tokens_offered,
@@ -300,6 +326,10 @@ def main():
     ap.add_argument("--weight-wire", default="f32", choices=["f32", "int8"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", metavar="FILE", default=None)
+    ap.add_argument("--spans", metavar="FILE", default=None,
+                    help="record per-request span chains and dump them "
+                    "here (feed to tools/timeline.py)")
+    ap.add_argument("--span-capacity", type=int, default=65536)
     args = ap.parse_args()
 
     cfg, model, params, engine, registry = build_engine(args)
@@ -307,9 +337,21 @@ def main():
         name: len(rep.errors()) for name, rep in engine.reports.items()
     }
 
+    recorder = None
+    if args.spans:
+        from apex_tpu.observability.spans import SpanRecorder
+
+        recorder = SpanRecorder(capacity=args.span_capacity)
+
     baseline_fill = single_request_baseline(engine, args)
-    load = run_load(engine, registry, args)
+    load = run_load(engine, registry, args, spans=recorder)
     numerics = numerics_check(cfg, model, params, args)
+
+    if recorder is not None:
+        spans_path = recorder.dump(reason="serve_bench", path=args.spans)
+        print(f"[serve_bench] wrote {spans_path} "
+              f"({len(recorder.snapshot())} span entries, "
+              f"{recorder.dropped} dropped)")
 
     ttft_samples = load.pop("_ttft_samples")
     per_tok_samples = load.pop("_per_tok_samples")
@@ -320,8 +362,13 @@ def main():
           f"weight_wire={args.weight_wire} ==")
     r = load["requests"]
     tk = load["tokens"]
+    shed_desc = (
+        " (" + ", ".join(
+            f"{k}={v}" for k, v in sorted(r["shed_reasons"].items())
+        ) + ")" if r["shed_reasons"] else ""
+    )
     print(f"goodput: {r['completed']}/{r['offered']} requests "
-          f"({100 * r['goodput']:.1f}%), {r['shed']} shed; "
+          f"({100 * r['goodput']:.1f}%), {r['shed']} shed{shed_desc}; "
           f"{tk['completed']}/{tk['offered']} tokens "
           f"({100 * tk['goodput']:.1f}%)")
     print(f"throughput: {load['tokens']['throughput_per_s']:.1f} tokens/s "
@@ -330,6 +377,14 @@ def main():
     t = load["ttft_ms"]
     print(f"TTFT ms: p50={t['p50']:.2f} p95={t['p95']:.2f} "
           f"p99={t['p99']:.2f} (n={t['samples']})")
+    from apex_tpu.serve import TTFT_COMPONENTS
+
+    ta = load["ttft_attribution"]
+    print("TTFT attribution (p50/p95/p99 ms): " + "  ".join(
+        f"{comp}={ta[f'{comp}_ms']['p50']:.2f}/"
+        f"{ta[f'{comp}_ms']['p95']:.2f}/{ta[f'{comp}_ms']['p99']:.2f}"
+        for comp in TTFT_COMPONENTS
+    ) + f"  queue-wait fraction={ta['queue_wait_fraction']:.3f}")
     print(_histogram(ttft_samples))
     p = load["per_token_ms"]
     print(f"per-token ms: p50={p['p50']:.2f} p95={p['p95']:.2f} "
@@ -367,7 +422,13 @@ def main():
         failures.append(f"graph lint ERRORs on serve steps: {lint_errors}")
 
     if args.json:
+        from apex_tpu.observability.spans import wall_clock_anchor
+
         artifact = {
+            # the per-process monotonic→epoch anchor: lets this
+            # artifact line up against span/flight records from the
+            # same run when merged by tools/timeline.py
+            "anchor": wall_clock_anchor(),
             "config": {
                 k: getattr(args, k) for k in (
                     "requests", "rate", "prompt_mix", "output_mix",
@@ -383,10 +444,16 @@ def main():
                 k: v for k, v in registry.values().items()
                 if k.startswith("serve/")
             },
+            "spans_file": args.spans,
             "failures": failures,
         }
+        # strict JSON: an all-shed run yields NaN percentiles ("no
+        # measurement"); encode them the flight-dump way instead of
+        # emitting bare NaN tokens jq/JS parsers reject
+        from apex_tpu.observability.flight import json_safe
+
         with open(args.json, "w") as f:
-            json.dump(artifact, f, indent=2)
+            json.dump(json_safe(artifact), f, indent=2, allow_nan=False)
             f.write("\n")
         print(f"[serve_bench] wrote {args.json}")
 

@@ -1,0 +1,346 @@
+"""Merge span records, flight dumps, and profiler windows into one
+Perfetto timeline — and gate CI on span accounting.
+
+Usage::
+
+    python tools/timeline.py --spans spans.json [--spans more.json ...]
+        [--flight flight_<ts>.json ...] [--trace-dir DIR]
+        [--out trace.json] [--json] [--ttft-tol-ms 1.0]
+
+Inputs:
+
+- ``--spans``: :class:`apex_tpu.observability.spans.SpanRecorder`
+  dumps (``tools/serve_bench.py --spans``, ``APEX_TPU_SPANS`` runs).
+  Each file carries its own **wall-clock anchor** (monotonic→epoch
+  offset captured once per process), so records from different
+  hosts/processes land on one epoch-aligned timeline.
+- ``--flight``: :class:`~apex_tpu.observability.flight.FlightRecorder`
+  dumps — frames become ``train/step`` spans, the event log becomes
+  instants, per-frame metrics become counter tracks.  Crash
+  postmortems and live traces open in the same viewer.
+- ``--trace-dir``: a :class:`~apex_tpu.observability.trace.
+  TraceScheduler` base dir — each ``steps_<a>_<b>/`` profiler window
+  becomes a marker locating the on-chip profile on the timeline.
+
+``--out FILE`` writes Chrome-trace-event JSON (open at
+``ui.perfetto.dev`` or ``chrome://tracing``), one track per source,
+one process group per input host.
+
+``--json`` prints the **span-accounting summary** the
+``verify_tier1.sh`` SERVE gate consumes, and makes the exit status
+enforce the invariants: every admitted request's span chain must be
+complete (``queued → prefill → [decode] → exactly one terminal``),
+every attributed TTFT must equal the sum of its
+queue-wait/prefill/contention components within ``--ttft-tol-ms``, and
+a record carrying request chains must not have dropped ring entries (a
+truncated record cannot prove completeness; a wrapped train-only
+record claims nothing about chains and stays clean).  Exit status: 0
+clean (always, for a plain ``--out`` merge — violations are printed
+but only ``--json`` gates on them), 1 accounting violated under
+``--json``, 2 unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TERMINALS = ("req/done", "req/shed")
+
+
+def load_spans_dump(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("kind") != "apex_tpu_spans" or "spans" not in data:
+        raise ValueError(f"not a span dump (kind/spans keys): {path}")
+    return data
+
+
+def load_flight_dump(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    for key in ("version", "reason", "frames", "events"):
+        if key not in data:
+            raise ValueError(f"not a flight dump: missing {key!r}: {path}")
+    return data
+
+
+def trace_window_entries(trace_dir: str):
+    """Marker instants for each discovered profiler window dir, stamped
+    with the newest artifact mtime inside (epoch seconds)."""
+    entries = []
+    for d in sorted(glob.glob(os.path.join(trace_dir, "steps_*_*"))):
+        if not os.path.isdir(d):
+            continue
+        mtimes = [
+            os.path.getmtime(os.path.join(root, fn))
+            for root, _, files in os.walk(d) for fn in files
+        ]
+        if not mtimes:
+            continue
+        entries.append({
+            "name": "trace/window", "track": "trace", "t": max(mtimes),
+            "args": {"log_dir": d},
+        })
+    return entries
+
+
+def account_requests(spans, dropped, ttft_tol_ms: float) -> dict:
+    """The span-accounting invariants over the serve/requests track.
+
+    Chains key on ``(_src, lane)``: request ids restart at 0 per
+    process, so a multi-dump merge must scope each dump's rids to its
+    source (``main`` tags entries with ``_src`` per input file) — two
+    hosts' rid-0 chains are two requests, not one corrupt one.
+
+    ``dropped`` is per-source too (``{src: count}``, or an int for a
+    single source): only a source whose OWN ring wrapped *and* whose
+    record carries request chains is unaccountable — a wrapped
+    train-only dump merged beside a complete serve dump must not fail
+    the serve dump's accounting.
+    """
+    dropped_by_src = (
+        dict(dropped) if isinstance(dropped, dict)
+        else {0: int(dropped or 0)}
+    )
+    by_rid: dict = {}
+    for e in spans:
+        if e.get("track") != "serve/requests":
+            continue
+        rid = (e.get("_src", 0), e.get("lane"))
+        rec = by_rid.setdefault(
+            rid, {"spans": [], "instants": [], "terminals": []}
+        )
+        if "t0" in e:
+            rec["spans"].append(e)
+        else:
+            rec["instants"].append(e)
+            if e.get("name") in TERMINALS:
+                rec["terminals"].append(e)
+
+    total = len(by_rid)
+    admitted = complete = 0
+    shed_reasons: dict = {}
+    violations = []
+    ttft_checked = 0
+    ttft_max_err = 0.0
+    for (src, lane), rec in sorted(by_rid.items(), key=lambda kv: str(kv[0])):
+        rid = f"{lane}" if src == 0 else f"{lane} (dump {src})"
+        names = [s["name"] for s in rec["spans"]]
+        n_term = len(rec["terminals"])
+        was_admitted = "req/prefill" in names
+        if was_admitted:
+            admitted += 1
+        if n_term != 1:
+            violations.append(
+                f"rid={rid}: {n_term} terminal events (want exactly 1)"
+            )
+            continue
+        term = rec["terminals"][0]
+        if term["name"] == "req/shed":
+            reason = (term.get("args") or {}).get("reason", "?")
+            shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
+        if "req/queued" not in names:
+            violations.append(f"rid={rid}: no req/queued span")
+            continue
+        if was_admitted and term["name"] == "req/done":
+            # every completed request's prefill span must carry its
+            # TTFT attribution, and the components must sum to the
+            # measured TTFT
+            args = {}
+            for s in rec["spans"]:
+                if s["name"] == "req/prefill":
+                    args = s.get("args") or {}
+            comps = [args.get(k) for k in (
+                "ttft_ms", "queue_wait_ms", "prefill_ms", "contention_ms",
+            )]
+            if any(not isinstance(c, (int, float)) for c in comps):
+                violations.append(
+                    f"rid={rid}: req/prefill span missing TTFT "
+                    f"attribution args (have {sorted(args)})"
+                )
+                continue
+            ttft, qw, pf, ct = comps
+            err = abs(ttft - (qw + pf + ct))
+            ttft_checked += 1
+            ttft_max_err = max(ttft_max_err, err)
+            if err > ttft_tol_ms:
+                violations.append(
+                    f"rid={rid}: TTFT components sum off by {err:.3f}ms "
+                    f"(ttft={ttft:.3f}, qw={qw:.3f}, pf={pf:.3f}, "
+                    f"ct={ct:.3f}; tol {ttft_tol_ms}ms)"
+                )
+                continue
+        complete += 1
+    # a wrapped ring cannot prove REQUEST-CHAIN completeness (a whole
+    # chain may have been evicted) — the violation fires for any
+    # source that wrapped AND shows serve activity on ANY serve/*
+    # track: surviving engine spans with zero chains means the chains
+    # themselves were evicted, which is exactly the truncation the
+    # gate exists to catch.  A wrapped train-only record (the
+    # recorder's designed steady state over a long run) claims nothing
+    # about chains, so it stays clean.
+    serve_srcs = {
+        e.get("_src", 0) for e in spans
+        if str(e.get("track", "")).startswith("serve/")
+    }
+    for src in sorted(serve_srcs):
+        n = dropped_by_src.get(src, 0)
+        if n:
+            violations.append(
+                f"dump {src}: ring dropped {n} entries — its request "
+                "record cannot prove chain completeness (raise the "
+                "recorder capacity)"
+            )
+    total_dropped = sum(dropped_by_src.values())
+    return {
+        "requests": {
+            "total": total,
+            "admitted": admitted,
+            "complete": complete,
+        },
+        "shed_reasons": shed_reasons,
+        "ttft_accounting": {
+            "checked": ttft_checked,
+            "max_error_ms": ttft_max_err,
+            "tol_ms": ttft_tol_ms,
+        },
+        "dropped": total_dropped,
+        "violations": violations,
+        "ok": not violations,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge spans/flight/profiler artifacts into one "
+        "Perfetto timeline (docs/observability.md)"
+    )
+    ap.add_argument("--spans", action="extend", nargs="+", default=[],
+                    metavar="FILE",
+                    help="SpanRecorder dump(s) — repeatable, and each "
+                    "flag takes several files (shell globs work)")
+    ap.add_argument("--flight", action="extend", nargs="+", default=[],
+                    metavar="FILE",
+                    help="FlightRecorder dump(s) — repeatable/globbable")
+    ap.add_argument("--trace-dir", default=None,
+                    help="TraceScheduler base dir (profiler windows)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write Chrome-trace-event JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the span-accounting summary (the CI "
+                    "artifact); exit 1 on violations")
+    ap.add_argument("--ttft-tol-ms", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    if not args.spans and not args.flight and not args.trace_dir:
+        ap.error("nothing to merge: give --spans, --flight or --trace-dir")
+
+    span_dumps = []
+    flight_dumps = []
+    try:
+        for path in args.spans:
+            span_dumps.append((path, load_spans_dump(path)))
+        for path in args.flight:
+            flight_dumps.append((path, load_flight_dump(path)))
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"timeline: cannot read input: {e}", file=sys.stderr)
+        return 2
+
+    all_spans = []
+    dropped_by_src = {}
+    for i, (_, dump) in enumerate(span_dumps):
+        # tag each entry with its source file: request ids restart at 0
+        # per process (and ring wrap is per recorder), so accounting
+        # scopes both chains and dropped counts to the dump
+        all_spans.extend(
+            dict(e, _src=i) for e in dump.get("spans", [])
+        )
+        dropped_by_src[i] = int(dump.get("dropped", 0) or 0)
+
+    if args.out:
+        from apex_tpu.observability.export import (
+            TimelineSink,
+            flight_counters,
+            flight_entries,
+        )
+
+        with TimelineSink(
+            args.out,
+            other_data={
+                "sources": {
+                    "spans": [p for p, _ in span_dumps],
+                    "flight": [p for p, _ in flight_dumps],
+                    "trace_dir": args.trace_dir,
+                },
+            },
+        ) as sink:
+            n = 0
+            for i, (path, dump) in enumerate(span_dumps):
+                host = (dump.get("host") or {}).get("id", 0)
+                pid = 1 + i
+                n += sink.add_spans(
+                    dump.get("spans", []),
+                    anchor=dump.get("anchor"),
+                    pid=pid,
+                    process_name=(
+                        f"host{host} spans ({os.path.basename(path)})"
+                    ),
+                )
+            for j, (path, dump) in enumerate(flight_dumps):
+                host = (dump.get("host") or {}).get("id", 0)
+                pid = 101 + j
+                n += sink.add_spans(
+                    flight_entries(dump),
+                    anchor=None,  # flight timestamps are epoch already
+                    pid=pid,
+                    process_name=(
+                        f"host{host} flight ({os.path.basename(path)})"
+                    ),
+                )
+                for name, t, v in flight_counters(dump):
+                    sink.counter(name, t, v, pid=pid)
+                    n += 1
+            if args.trace_dir:
+                n += sink.add_spans(
+                    trace_window_entries(args.trace_dir),
+                    anchor=None, pid=201, process_name="profiler windows",
+                )
+        print(f"[timeline] wrote {args.out} ({n} events)", file=sys.stderr)
+
+    summary = account_requests(
+        all_spans, dropped_by_src, args.ttft_tol_ms
+    )
+    summary["sources"] = {
+        "spans": len(span_dumps),
+        "flight": len(flight_dumps),
+        "span_entries": len(all_spans),
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        req = summary["requests"]
+        print(
+            f"span accounting: {req['complete']}/{req['total']} request "
+            f"chains complete ({req['admitted']} admitted), "
+            f"TTFT checked on {summary['ttft_accounting']['checked']} "
+            f"(max err "
+            f"{summary['ttft_accounting']['max_error_ms']:.4f}ms), "
+            f"shed by reason: {summary['shed_reasons'] or '{}'}"
+        )
+        for v in summary["violations"]:
+            print(f"  VIOLATION: {v}")
+    # the exit status is the CI gate, and the gate is --json mode: a
+    # plain merge (--out) succeeds as long as the trace was written,
+    # violations or not — they are printed either way
+    if args.json and not summary["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,13 @@
 # scheduler.  The stage asserts the emitted JSONL carries TTFT and
 # tokens-per-s serving metrics, and that tools/graph_lint.py --target
 # serve reports ZERO ERRORs on the compiled prefill/decode steps.
+# A span-accounting gate (ISSUE 8) then runs tools/serve_bench.py with
+# --spans and feeds the dump through tools/timeline.py --json: every
+# admitted request must have a complete span chain with exactly one
+# terminal event, per-request TTFT components must sum to the measured
+# TTFT within 1ms, the per-reason shed counters must sum to the total
+# on both the artifact and the registry, and the merged Perfetto trace
+# must carry real events.
 #
 # Usage:
 #   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf + serve
@@ -369,6 +376,68 @@ PYEOF
             python tools/graph_lint.py --target serve \
             --json "$SERVE_LINT_JSON" 2>&1 | tail -n 2 | tee -a "$LOG"
         serve_rc=${PIPESTATUS[0]}
+    fi
+    # span-accounting gate (ISSUE 8): a closed-loop serve_bench run
+    # records every request's span chain; tools/timeline.py must prove
+    # the record complete (one terminal per admitted request, TTFT
+    # components summing to the measured TTFT within 1ms, zero ring
+    # drops) and emit a Perfetto-loadable trace.
+    if [ "$serve_rc" -eq 0 ]; then
+        SB_JSON="$(mktemp /tmp/_t1_servebench.XXXXXX.json)"
+        SB_SPANS="$(mktemp /tmp/_t1_spans.XXXXXX.json)"
+        SB_TRACE="$(mktemp /tmp/_t1_trace.XXXXXX.json)"
+        timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+            python tools/serve_bench.py --requests 8 \
+            --json "$SB_JSON" --spans "$SB_SPANS" \
+            2>&1 | tail -n 4 | tee -a "$LOG"
+        serve_rc=${PIPESTATUS[0]}
+        if [ "$serve_rc" -eq 0 ]; then
+            timeout -k 10 120 env JAX_PLATFORMS=cpu \
+                python tools/timeline.py --spans "$SB_SPANS" \
+                --out "$SB_TRACE" --json 2>&1 | tee -a "$LOG"
+            serve_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$serve_rc" -eq 0 ]; then
+            python - "$SB_JSON" "$SB_SPANS" "$SB_TRACE" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+art = json.load(open(sys.argv[1]))
+spans = json.load(open(sys.argv[2]))
+trace = json.load(open(sys.argv[3]))
+# the wall-clock anchor satellite: every artifact from the process
+# carries the same monotonic->epoch offset
+for name, d in (("serve_bench", art), ("spans", spans)):
+    a = d.get("anchor") or {}
+    assert {"monotonic", "epoch"} <= set(a), f"{name} missing anchor: {a}"
+assert art["anchor"]["epoch"] == spans["anchor"]["epoch"], "anchor drift"
+# TTFT attribution p95s appear BOTH in the artifact and on the registry
+ta = art["load"]["ttft_attribution"]
+for comp in ("queue_wait", "prefill", "contention"):
+    assert "p95" in ta[f"{comp}_ms"], ta
+    key = f"serve/ttft_{comp}_ms_p95"
+    assert key in art["registry"], f"missing {key} on the registry board"
+# per-reason shed breakdown sums to the shed total, both surfaces
+req = art["load"]["requests"]
+assert sum(req["shed_reasons"].values()) == req["shed"], req
+reg = art["registry"]
+assert sum(
+    v for k, v in reg.items()
+    if k.startswith("serve/shed_")
+) == reg["serve/shed"], reg
+# the merged trace is Chrome-trace-event JSON with real events
+assert trace["traceEvents"], "empty Perfetto trace"
+assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+print(f"span gate OK: {req['completed']}/{req['offered']} requests, "
+      f"{len(trace['traceEvents'])} trace events, queue-wait p95="
+      f"{ta['queue_wait_ms']['p95']:.2f}ms")
+PYEOF
+            serve_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$serve_rc" -eq 0 ]; then
+            rm -f "$SB_JSON" "$SB_SPANS" "$SB_TRACE"
+        else
+            echo "TIER1-SERVE: span-accounting gate failed (artifacts" \
+                "at $SB_JSON $SB_SPANS $SB_TRACE)" | tee -a "$LOG"
+        fi
     fi
     if [ "$serve_rc" -eq 0 ]; then
         rm -rf "$SV_DIR"
