@@ -18,6 +18,17 @@ proves it statically, before a single step runs:
   matches the comm engine's promise (count / bytes / wire dtype),
   on the shared HLO parser that ``apex_tpu.parallel.comm`` and
   ``tools/comm_structure.py`` also read through.
+- **sharding conformance** — every large param/optimizer leaf carries
+  its declared PartitionSpec in the compiled module (silent full
+  replication = ERROR), from regex→PartitionSpec rule tables
+  (:mod:`apex_tpu.analysis.sharding`).
+- **resharding** — no collective in the step body the declared
+  per-mesh-axis plan (kind / axis / bytes / wire dtype) doesn't
+  predict — the "verify the TP wire plan" pass.
+- **memory budget** — a static per-buffer live-range peak-HBM
+  estimate with top-K attribution and a budget gate
+  (:mod:`apex_tpu.analysis.memory`): OOM is a lint ERROR before the
+  first step runs.
 
 Surfaces::
 
@@ -61,12 +72,18 @@ from apex_tpu.analysis.passes import (  # noqa: F401
     iter_eqns,
 )
 from apex_tpu.analysis import hlo  # noqa: F401
+from apex_tpu.analysis import memory  # noqa: F401
+from apex_tpu.analysis import sharding  # noqa: F401
+from apex_tpu.analysis.sharding import (  # noqa: F401
+    match_partition_rules,
+)
 
 __all__ = [
     "check",
     "lint_jaxpr",
     "lint_hlo",
     "publish_report",
+    "attach_shard_sections",
     "Finding",
     "Report",
     "RULES",
@@ -80,6 +97,9 @@ __all__ = [
     "PASSES",
     "iter_eqns",
     "hlo",
+    "memory",
+    "sharding",
+    "match_partition_rules",
 ]
 
 
@@ -101,6 +121,8 @@ def _select(rules) -> tuple:
 
 
 def _run(graph: StepGraph, rules, target: str) -> Report:
+    import time as _time
+
     selected = _select(rules)
     if graph.jaxpr is None:
         # a jaxpr-only pass that cannot run must not be REPORTED as run
@@ -108,7 +130,9 @@ def _run(graph: StepGraph, rules, target: str) -> Report:
         selected = tuple(r for r in selected if r not in _JAXPR_ONLY)
     report = Report(target=target, rules_run=selected)
     for name in selected:
+        t0 = _time.perf_counter()
         report.extend(PASSES[name](graph))
+        report.pass_timings[name] = (_time.perf_counter() - t0) * 1e3
     return report
 
 
@@ -120,6 +144,9 @@ def check(
     donate_argnums=None,
     static_argnums=None,
     expect_collectives=None,
+    expect_sharding=None,
+    expect_plan=None,
+    hbm_budget=None,
     publish: bool = False,
     name: Optional[str] = None,
     **kwargs,
@@ -136,7 +163,12 @@ def check(
     ``Properties``, or a bare dtype) arms the promotion-widen rule;
     ``expect_collectives`` arms the collective-consistency rule
     (see :func:`apex_tpu.analysis.passes.collective_pass` for the
-    expectation schema).  Compilation happens once, AOT — nothing is
+    expectation schema); ``expect_sharding`` (mesh + regex→
+    PartitionSpec rules) arms spec conformance, ``expect_plan`` (the
+    per-mesh-axis collective plan) arms the resharding rule, and
+    ``hbm_budget`` (bytes) arms the static peak-HBM gate — schemas in
+    :mod:`apex_tpu.analysis.sharding` and :mod:`apex_tpu.analysis
+    .memory`.  Compilation happens once, AOT — nothing is
     executed and no buffer is consumed (donation only affects the
     compiled program's aliasing, not tracing).
 
@@ -182,8 +214,12 @@ def check(
         donated_argnums=tuple(donate_argnums or ()),
         compile_warnings=tuple(str(w.message) for w in caught),
         expect_collectives=expect_collectives,
+        expect_sharding=expect_sharding,
+        expect_plan=expect_plan,
+        hbm_budget=hbm_budget,
     )
     report = _run(graph, rules, target)
+    report.hlo_text = hlo_text
     if publish:
         publish_report(report)
     return report
@@ -203,36 +239,117 @@ def lint_hlo(
     *,
     donated: Optional[int] = None,
     expect_collectives=None,
+    expect_sharding=None,
+    expect_plan=None,
+    hbm_budget=None,
     rules=None,
     name: str = "",
 ) -> Report:
     """Run the HLO-level passes (host transfers, donation aliasing,
-    collective consistency) over compiled-module text — for callers
-    that already paid the compile (``bench.py --lint`` reuses the
-    ``--hlo-out`` executable's text instead of compiling twice)."""
+    collective consistency, sharding conformance, resharding, memory
+    budget) over compiled-module text — for callers that already paid
+    the compile (``bench.py --lint`` reuses the ``--hlo-out``
+    executable's text instead of compiling twice; the serve engine
+    lints the executable it just built)."""
     graph = StepGraph(
         hlo_text=hlo_text,
         donated=donated,
         expect_collectives=expect_collectives,
+        expect_sharding=expect_sharding,
+        expect_plan=expect_plan,
+        hbm_budget=hbm_budget,
     )
     wanted = rules if rules is not None else (
-        "transfer", "donation", "collective"
+        "transfer", "donation", "collective",
+        "sharding", "reshard", "memory",
     )
-    return _run(graph, wanted, name or "hlo")
+    report = _run(graph, wanted, name or "hlo")
+    report.hlo_text = hlo_text
+    return report
+
+
+def attach_shard_sections(
+    report: Report,
+    programs,
+    expect_sharding: Optional[dict] = None,
+    publish: bool = True,
+) -> Report:
+    """Fill the report's artifact ``sections`` with the sharding/memory
+    intelligence of one or more compiled programs: ``peak_hbm_bytes``
+    (max over the programs — they execute sequentially and hand
+    buffers over), per-program and per-category breakdowns, and the
+    ``shard_plan`` parameter table.  ``programs`` is ``[(name,
+    hlo_text), ...]`` — pass each sub-report's ``.hlo_text`` so no
+    second compile is paid.  ``publish=True`` gauges the peak onto the
+    observability board (``analysis/peak_hbm_bytes``), the source the
+    :class:`~apex_tpu.observability.health.MemoryBudgetRule` watchdog
+    judges.  Used by ``tools/graph_lint.py``, ``tools/shard_report.py``
+    and the serve engine's ``lint()``.
+    """
+    peaks, cats, rows = {}, {}, []
+    programs = [(n, t) for n, t in programs]
+    #: kept for renderers (tools/shard_report.py) that want the raw
+    #: per-program HLO back without a second compile
+    report.programs = programs
+    for prog_name, text in programs:
+        if not text:
+            continue
+        est = memory.estimate_peak(text)
+        peaks[prog_name] = est["peak_bytes"]
+        if est["peak_bytes"] == max(peaks.values()):
+            cats = est["by_category"]
+        for row in sharding.plan_table(text, expect_sharding or {}):
+            rows.append({"program": prog_name, **row})
+    peak = max(peaks.values()) if peaks else 0
+    report.sections["peak_hbm_bytes"] = peak
+    report.sections["peak_hbm_by_program"] = peaks
+    report.sections["peak_hbm_by_category"] = cats
+    report.sections["shard_plan"] = rows
+    if publish:
+        memory.publish_peak({"peak_bytes": peak, "by_category": cats})
+        try:
+            from apex_tpu.observability.metrics import board
+        except ImportError:  # pragma: no cover - partial install
+            return report
+        verdicts: dict = {}
+        for row in rows:
+            verdicts[row["verdict"]] = verdicts.get(row["verdict"], 0) + 1
+        board.set("analysis/shard_plan/rows", len(rows))
+        for verdict, count in verdicts.items():
+            board.set(f"analysis/shard_plan/{verdict}", count)
+    return report
 
 
 def publish_report(report: Report, prefix: str = "analysis") -> None:
     """Gauge a report's finding counts onto the observability board
-    (``{prefix}/errors``, ``{prefix}/warnings``, and per-rule
-    ``{prefix}/rule/<id>``), so lint results ride the same JSONL
-    telemetry stream as MFU/goodput — mirror of
-    ``comm.publish_collective_summary``."""
+    (``{prefix}/errors``, ``{prefix}/warnings``, per-rule
+    ``{prefix}/rule/<id>``, and per-pass ``{prefix}/pass_ms/<name>``
+    timings), so lint results ride the same JSONL telemetry stream as
+    MFU/goodput — mirror of ``comm.publish_collective_summary``.
+
+    Counts are deduplicated by (rule, location): when two passes (or
+    the jaxpr and HLO substrates of one check) report the same defect
+    at the same site, the board counts one defect, not one per pass —
+    the raw per-pass findings stay on the report itself.
+    """
     try:
         from apex_tpu.observability.metrics import board
     except ImportError:  # pragma: no cover - partial install
         return
+    unique = report.deduped()
     board.set(f"{prefix}/target", report.target)
-    board.set(f"{prefix}/errors", len(report.errors()))
-    board.set(f"{prefix}/warnings", len(report.warnings()))
-    for rule, count in report.counts().items():
+    board.set(
+        f"{prefix}/errors",
+        sum(1 for f in unique if f.severity == ERROR),
+    )
+    board.set(
+        f"{prefix}/warnings",
+        sum(1 for f in unique if f.severity == WARNING),
+    )
+    counts = {}
+    for f in unique:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    for rule, count in counts.items():
         board.set(f"{prefix}/rule/{rule}", count)
+    for name, ms in report.pass_timings.items():
+        board.set(f"{prefix}/pass_ms/{name}", round(ms, 3))

@@ -111,6 +111,82 @@ RULES: Dict[str, Tuple[str, str, str]] = {
         "ensure encode happens before the collective; a stray cast "
         "upstream re-widens the payload",
     ),
+    "sharding-replicated": (
+        ERROR,
+        "a large param/optimizer-state leaf the plan shards compiled "
+        "FULLY REPLICATED — GSPMD silently replicates anything "
+        "propagation can't decide, and every device pays the whole "
+        "tensor",
+        "pass the leaf's NamedSharding via in_shardings (build the "
+        "tree with analysis.sharding.match_partition_rules) and make "
+        "sure no with_sharding_constraint downstream contradicts it",
+    ),
+    "sharding-mismatch": (
+        ERROR,
+        "a leaf's compiled tiling disagrees with its declared "
+        "PartitionSpec — the plan did not survive compilation (wrong "
+        "axis, transposed factors, or a constraint overrode it)",
+        "align the rule table with the in_shardings actually passed; "
+        "check with_sharding_constraint calls inside the step for "
+        "conflicting specs",
+    ),
+    "sharding-unverified": (
+        WARNING,
+        "the plan names a multi-device mesh but the module compiled "
+        "single-partition — conformance cannot be proven on this "
+        "compile (a clean verdict here would be a lie)",
+        "compile on the real mesh (or mock it: "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N) before "
+        "trusting the plan",
+    ),
+    "reshard-unplanned": (
+        ERROR,
+        "the step body contains a collective the declared plan does "
+        "not predict — the signature of a weight all-gather or "
+        "reshard XLA inserted because a spec didn't survive "
+        "propagation (a 'sharded' run secretly paying replicated "
+        "wire traffic every step)",
+        "trace the named op back to its source; either fix the "
+        "sharding so the gather disappears, or declare it in the "
+        "plan if the reshard is intentional",
+    ),
+    "reshard-plan": (
+        ERROR,
+        "a planned collective's compiled count/bytes/wire dtype "
+        "drifted from the declaration (e.g. a K-chunk int8 sync that "
+        "compiled to f32 payloads, or twice the promised bytes)",
+        "compare against the engine's declared plan "
+        "(DistributedDataParallel.collective_plan / the ZeRO "
+        "optimizers'); check wire/chunks knobs against docs/comm.md",
+    ),
+    "memory-budget": (
+        ERROR,
+        "the static peak-HBM estimate of the compiled step exceeds "
+        "the configured budget — the program OOMs before the first "
+        "step produces a number",
+        "shard the top-attributed buffers (the finding names them), "
+        "donate the update buffers, lower the batch/context, or "
+        "raise the budget if the device really has the headroom",
+    ),
+    "sharding-implicit-replication": (
+        WARNING,
+        "a pjit/jit call site passes in_shardings=None — every array "
+        "arrives fully replicated and GSPMD must re-derive (or "
+        "silently skip) the partitioning the caller intended",
+        "pass explicit in_shardings (build the spec tree with "
+        "analysis.sharding.match_partition_rules) so the plan is "
+        "declared, and lintable, at the call site",
+    ),
+    "sharding-missing-constraint": (
+        WARNING,
+        "a pjit/shard_map region with large contractions never pins "
+        "an intermediate with with_sharding_constraint — GSPMD must "
+        "guess activation layouts, and a wrong guess inserts "
+        "resharding collectives mid-step",
+        "pin the big intermediates (post-attention, post-MLP) with "
+        "jax.lax.with_sharding_constraint; verify with "
+        "tools/shard_report.py",
+    ),
 }
 
 
@@ -168,9 +244,47 @@ class Report:
         self.findings: List[Finding] = list(findings or [])
         self.target = target
         self.rules_run = tuple(rules_run)
+        #: pass name -> milliseconds spent, filled by the check runner
+        #: (one entry per rules_run pass, pinned in tests)
+        self.pass_timings: Dict[str, float] = {}
+        #: extra top-level artifact sections (peak_hbm_bytes,
+        #: shard_plan, ...) merged into :meth:`to_json` — see
+        #: ``analysis.attach_shard_sections``
+        self.sections: Dict[str, object] = {}
+        #: the optimized-HLO text the HLO-level passes read (set by
+        #: check()/lint_hlo; None for pure-jaxpr reports) — kept so
+        #: artifact builders don't pay a second compile
+        self.hlo_text: Optional[str] = None
 
     def extend(self, findings) -> None:
         self.findings.extend(findings)
+
+    def merge(self, other: "Report") -> "Report":
+        """Fold another report's findings AND bookkeeping (pass
+        timings summed per pass, rules_run unioned) into this one —
+        what multi-program surfaces (``tools/graph_lint.py``,
+        ``engine.lint()``) use instead of a bare ``extend`` that
+        would drop the second report's timing/pass record."""
+        self.findings.extend(other.findings)
+        for name in other.rules_run:
+            if name not in self.rules_run:
+                self.rules_run = self.rules_run + (name,)
+        for name, ms in other.pass_timings.items():
+            self.pass_timings[name] = self.pass_timings.get(name, 0.0) + ms
+        return self
+
+    def deduped(self) -> List[Finding]:
+        """Findings unique by (rule, location) — two passes (or two
+        substrates of one pass) reporting the same defect at the same
+        site count once.  Order preserved; first occurrence wins."""
+        seen, out = set(), []
+        for f in self.findings:
+            key = (f.rule, f.path)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(f)
+        return out
 
     def errors(self) -> List[Finding]:
         return [f for f in self.findings if f.severity == ERROR]
@@ -198,13 +312,17 @@ class Report:
         return out
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "target": self.target,
             "rules_run": list(self.rules_run),
             "errors": len(self.errors()),
             "warnings": len(self.warnings()),
+            "pass_timings": dict(self.pass_timings),
             "findings": [f.to_json() for f in self.findings],
         }
+        for key, value in self.sections.items():
+            out.setdefault(key, value)
+        return out
 
     def to_json_line(self) -> str:
         return json.dumps(self.to_json())

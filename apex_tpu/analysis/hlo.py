@@ -30,6 +30,15 @@ Contents:
   structured record, and the FLOP/byte estimate of one instruction
   from its printed shapes (XLA prints operand shapes inline at every
   use site, so no cross-reference pass is needed).
+- :func:`parameter_shardings` / :func:`parse_sharding` /
+  :func:`num_partitions` — the GSPMD sharding each ENTRY parameter
+  actually compiled with (the sharding-conformance pass's ground
+  truth: a ``sharding={replicated}`` on a tensor the plan shards is
+  the silent-replication defect).
+- :func:`collective_instructions` / :func:`replica_group_size` —
+  every collective as a structured record (kind, payload bytes,
+  dtypes, replica groups, jax op path), for the per-mesh-axis
+  resharding pass.
 """
 
 from __future__ import annotations
@@ -53,6 +62,11 @@ __all__ = [
     "shape_elements",
     "instruction_flops",
     "instruction_bytes",
+    "num_partitions",
+    "parameter_shardings",
+    "parse_sharding",
+    "collective_instructions",
+    "replica_group_size",
 ]
 
 DTYPE_BYTES = {
@@ -435,10 +449,12 @@ def parse_computations(hlo_text: str):
     ``computations`` maps computation name → list of instruction dicts
     in program order; each record carries ``name``, ``shape`` (result
     shape string), ``opcode``, ``operands`` (list of operand shape
-    strings, as printed inline at the use site), ``op_name`` (the jax
-    source path from metadata — named scopes land here), ``called``
-    (referenced computation names for fusion/call/while/conditional),
-    and ``attrs`` (the raw text after the operand list, for
+    strings, as printed inline at the use site), ``operand_names``
+    (the ``%name`` tokens of the operand list — the def-use edges the
+    memory live-range walk follows), ``op_name`` (the jax source path
+    from metadata — named scopes land here), ``called`` (referenced
+    computation names for fusion/call/while/conditional), and
+    ``attrs`` (the raw text after the operand list, for
     opcode-specific parsing like ``lhs_contracting_dims``).
     """
     comps: Dict[str, List[dict]] = {}
@@ -475,9 +491,11 @@ def parse_computations(hlo_text: str):
                 f"{dt}[{dims}]"
                 for dt, dims in _SHAPE_IN_TEXT_RE.findall(operand_text)
             ],
+            "operand_names": re.findall(r"%([\w.-]+)", operand_text),
             "op_name": onm.group(1) if onm else "",
             "called": _CALLED_COMP_RE.findall(attrs),
             "attrs": attrs,
+            "root": line.startswith("ROOT"),
         })
     if entry is None and comps:
         # un-ENTRY'd fragments (tests, hand-written snippets): the last
@@ -588,3 +606,233 @@ def instruction_bytes(instr: dict) -> int:
     for op_shape in instr["operands"]:
         total += shape_bytes(op_shape)
     return total
+
+
+# ---------------------------------------------------------------------------
+# GSPMD parameter shardings (the sharding-conformance pass's ground truth)
+# ---------------------------------------------------------------------------
+
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+_PARAM_RE = re.compile(
+    r"^(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(\S+)\s+parameter\((\d+)\)"
+)
+_SHARDING_ATTR_RE = re.compile(r"sharding=\{([^}]*(?:\{[^}]*\}[^}]*)*)\}")
+_TILE_DEVICES_RE = re.compile(r"devices=\[([0-9,]+)\]")
+
+
+def num_partitions(hlo_text: str) -> int:
+    """``num_partitions`` from the module header (1 when absent — a
+    single-device compile carries no SPMD structure to verify)."""
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    return int(m.group(1)) if m else 1
+
+
+def parse_sharding(sharding: Optional[str]) -> dict:
+    """Structure one HLO sharding attribute string.
+
+    Returns ``{"kind": "replicated" | "maximal" | "tiled" | "manual" |
+    "unknown", "dims": [shards-per-data-dim, ...]}``.  Handles the
+    GSPMD print variants::
+
+        replicated
+        maximal device=3
+        devices=[2,4]<=[8]                          # plain tiling
+        devices=[2,1,4]<=[8] last_tile_dim_replicate  # partial replication
+        devices=[1,4,2]<=[2,4]T(1,0) last_tile_dim_replicate
+        devices=[...] last_tile_dims={manual}       # shard_map interiors
+
+    Trailing subgroup dims (``last_tile_dim_replicate`` /
+    ``last_tile_dims={...}``) are dropped from ``dims`` so the result
+    is shards-per-DATA-dim — multiply a parameter's printed (local)
+    shape by ``dims`` to recover the global logical shape.
+    """
+    if not sharding:
+        return {"kind": "unknown", "dims": []}
+    s = sharding.strip()
+    if s.startswith("replicated"):
+        return {"kind": "replicated", "dims": []}
+    if s.startswith("maximal"):
+        return {"kind": "maximal", "dims": []}
+    m = _TILE_DEVICES_RE.search(s)
+    if not m:
+        return {"kind": "unknown", "dims": []}
+    dims = [int(d) for d in m.group(1).split(",") if d]
+    drop = 0
+    if "last_tile_dim_replicate" in s:
+        drop = 1
+    sub = re.search(r"last_tile_dims=\{([^}]*)\}", s)
+    if sub:
+        drop = len([t for t in sub.group(1).split(",") if t.strip()])
+        if "manual" in sub.group(1):
+            return {"kind": "manual", "dims": dims[: len(dims) - drop]}
+    if drop:
+        dims = dims[: len(dims) - drop]
+    kind = "tiled"
+    if all(d == 1 for d in dims):
+        kind = "replicated"  # tiled-in-name-only: one shard per dim
+    return {"kind": kind, "dims": dims}
+
+
+def parameter_shardings(hlo_text: str) -> List[dict]:
+    """Every ENTRY-computation parameter as ``{"param": number,
+    "name": instr name, "shape": local shard shape string, "op_name":
+    jax arg path from metadata ('' when absent), "sharding": raw
+    sharding attribute or None, "bytes": local bytes, "global_bytes":
+    logical (unsharded) bytes}``, ordered by parameter number.
+
+    The printed shape is the per-device SHARD; ``global_bytes``
+    multiplies it back up by the tile counts (replicated parameters
+    print the full shape, so local == global there).
+    """
+    # parse_computations drops the parameter NUMBER (it lives inside
+    # the operand parens), so scan entry lines directly
+    numbered: List[dict] = []
+    in_entry = False
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        hm = _COMP_HEADER_RE.match(line)
+        if hm and " = " not in line.split("{", 1)[0]:
+            in_entry = bool(hm.group(1))
+            continue
+        if line == "}":
+            in_entry = False
+            continue
+        if not in_entry:
+            continue
+        m = _PARAM_RE.match(line)
+        if not m:
+            continue
+        name, shape, number = m.group(1), m.group(2), int(m.group(3))
+        sh = _SHARDING_ATTR_RE.search(line)
+        onm = _OP_NAME_RE.search(line)
+        local = shape_bytes(shape)
+        parsed = parse_sharding(sh.group(1) if sh else None)
+        factor = 1
+        for d in parsed["dims"]:
+            factor *= d
+        numbered.append({
+            "param": number,
+            "name": name,
+            "shape": shape,
+            "op_name": onm.group(1) if onm else "",
+            "sharding": sh.group(1) if sh else None,
+            "bytes": local,
+            "global_bytes": local * max(1, factor),
+        })
+    numbered.sort(key=lambda r: r["param"])
+    return numbered
+
+
+# ---------------------------------------------------------------------------
+# per-collective records (the resharding pass's ground truth)
+# ---------------------------------------------------------------------------
+
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+
+
+def replica_group_size(line: str) -> Optional[int]:
+    """Participant count per replica group of one collective line —
+    the mesh-axis size the collective spans.  Handles the explicit
+    ``{{0,1},{2,3}}`` print and the iota ``[G,S]<=[N]`` form (group
+    count G x size S).  None when the op prints no groups (a
+    full-world collective on some backends)."""
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        return int(m.group(2))
+    m = _REPLICA_GROUPS_RE.search(line)
+    if not m:
+        return None
+    first = m.group(1).split("}", 1)[0].lstrip("{")
+    ids = [t for t in first.split(",") if t.strip()]
+    return len(ids)
+
+
+def _replica_groups(line: str) -> Optional[List[List[int]]]:
+    """Replica groups of one collective line as explicit id lists, or
+    None when the op prints none.  Handles both the explicit
+    ``{{0,1},{2,3}}`` print and XLA's compact iota/V2 form
+    ``[G,S]<=[dims](T(perm))`` — ``iota(prod(dims)).reshape(dims)
+    .transpose(perm).reshape(G, S)``, rows = groups — so axis
+    attribution stays exact (not size-based) even where two mesh axes
+    share a size and only the iota form was printed."""
+    m = _REPLICA_GROUPS_RE.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", "{" + m.group(1) + "}"):
+            ids = [int(t) for t in grp.split(",") if t.strip()]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = _IOTA_GROUPS_RE.search(line)
+    if not m:
+        return None
+    g, s = int(m.group(1)), int(m.group(2))
+    dims = [int(d) for d in m.group(3).split(",") if d]
+    total = 1
+    for d in dims:
+        total *= d
+    if total != g * s:
+        return None  # malformed print: refuse to guess
+    ids = list(range(total))
+    if m.group(4):
+        perm = [int(p) for p in m.group(4).split(",") if p]
+        if sorted(perm) != list(range(len(dims))):
+            return None
+        # index math of reshape(dims).transpose(perm).flatten()
+        strides = [0] * len(dims)
+        acc = 1
+        for i in range(len(dims) - 1, -1, -1):
+            strides[i] = acc
+            acc *= dims[i]
+        out_dims = [dims[p] for p in perm]
+        out_strides = [strides[p] for p in perm]
+        ids = []
+        idx = [0] * len(out_dims)
+        for _ in range(total):
+            ids.append(sum(i * st for i, st in zip(idx, out_strides)))
+            for ax in range(len(out_dims) - 1, -1, -1):
+                idx[ax] += 1
+                if idx[ax] < out_dims[ax]:
+                    break
+                idx[ax] = 0
+    return [ids[i * s:(i + 1) * s] for i in range(g)]
+
+
+def collective_instructions(hlo_text: str) -> List[dict]:
+    """Every collective in the module as ``{"name", "kind", "shape",
+    "bytes", "dtypes", "group_size", "groups", "op_name"}``, in
+    program order.  Async ``-start``/``-done`` pairs count once (at
+    ``-start``, with the result element of the start tuple), matching
+    :func:`collective_summary`'s counting."""
+    out = []
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        m = _DEF_RE.match(line)
+        if not m:
+            continue
+        shape, kind, variant = m.group(1), m.group(2), m.group(3)
+        if variant == "-done":
+            continue
+        if variant == "-start":
+            shape = async_start_result(shape)
+        nm = _INSTR_NAME_RE.match(line)
+        onm = _OP_NAME_RE.search(line)
+        dtypes = set()
+        for dt, _dims in re.findall(r"(\w+)\[([0-9,]*)\]", shape):
+            if dt in DTYPE_BYTES:
+                dtypes.add(dt)
+        out.append({
+            "name": nm.group(1) if nm else "<unnamed>",
+            "kind": kind,
+            "shape": shape,
+            "bytes": shape_bytes(shape),
+            "dtypes": dtypes,
+            "group_size": replica_group_size(line),
+            "groups": _replica_groups(line),
+            "op_name": onm.group(1) if onm else "",
+        })
+    return out

@@ -57,6 +57,15 @@ class StepGraph:
     donated_argnums: tuple = ()
     compile_warnings: tuple = ()         # str(w) captured at compile()
     expect_collectives: Optional[dict] = None
+    #: sharding-conformance intent: {"mesh": {axis: size}, "rules":
+    #: [(regex, PartitionSpec)], "min_bytes": int} — see
+    #: apex_tpu.analysis.sharding
+    expect_sharding: Optional[dict] = None
+    #: per-mesh-axis collective plan: {"mesh": ..., "collectives":
+    #: [{kind, axis, count?, bytes?, dtypes?}], "allow_unplanned_bytes"}
+    expect_plan: Optional[dict] = None
+    #: static peak-HBM budget in bytes (apex_tpu.analysis.memory)
+    hbm_budget: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -396,11 +405,23 @@ def collective_pass(graph: StepGraph) -> List[Finding]:
     return out
 
 
+from apex_tpu.analysis.memory import memory_pass  # noqa: E402
+from apex_tpu.analysis.sharding import (  # noqa: E402
+    reshard_pass,
+    sharding_pass,
+)
+
 #: pass name -> implementation; ``rules=`` selects by these names (the
-#: retrace rule is runtime-only — see analysis.RetraceSentinel)
+#: retrace rule is runtime-only — see analysis.RetraceSentinel).  The
+#: sharding/reshard/memory passes live in their own modules
+#: (apex_tpu/analysis/sharding.py, .../memory.py) and are quiet until
+#: their intent (expect_sharding / expect_plan / hbm_budget) is given.
 PASSES: Dict[str, Callable[[StepGraph], List[Finding]]] = {
     "transfer": transfer_pass,
     "promotion": promotion_pass,
     "donation": donation_pass,
     "collective": collective_pass,
+    "sharding": sharding_pass,
+    "reshard": reshard_pass,
+    "memory": memory_pass,
 }

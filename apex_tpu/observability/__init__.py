@@ -78,6 +78,7 @@ from apex_tpu.observability.health import (  # noqa: F401
     CollectiveFractionRule,
     HealthEvent,
     HostStallRule,
+    MemoryBudgetRule,
     QueueDepthRule,
     QueueWaitFractionRule,
     TTFTRule,
@@ -151,6 +152,7 @@ __all__ = [
     "serve_rules",
     "CollectiveFractionRule",
     "HostStallRule",
+    "MemoryBudgetRule",
     "TTFTRule",
     "QueueDepthRule",
     "QueueWaitFractionRule",

@@ -30,6 +30,9 @@ rule                      fires when
                           lever is wire format/overlap, not kernels)
 :class:`HostStallRule`    the attribution's host-stall share exceeds a
                           floor (the chip is starving, not slow)
+:class:`MemoryBudgetRule` the graph linter's static peak-HBM estimate
+                          (``analysis/peak_hbm_bytes``) crosses the
+                          deployment budget — opt-in (needs the budget)
 :class:`TTFTRule`         serving time-to-first-token over its SLO
                           deadline (``serve/ttft_ms`` gauge; critical
                           past 2x) — :func:`serve_rules` only
@@ -86,6 +89,7 @@ __all__ = [
     "HungStepRule",
     "CollectiveFractionRule",
     "HostStallRule",
+    "MemoryBudgetRule",
     "TTFTRule",
     "QueueDepthRule",
     "QueueWaitFractionRule",
@@ -579,6 +583,57 @@ class QueueWaitFractionRule(Rule):
                 f"{self.max_fraction:.0%}) — admission starved: grow "
                 "the page pool / decode slots or shed earlier",
             )
+        return []
+
+
+class MemoryBudgetRule(Rule):
+    """The static peak-HBM estimate published by the graph linter
+    (``analysis/peak_hbm_bytes`` — :func:`apex_tpu.analysis.memory
+    .publish_peak`, also republished when a program recompiles
+    mid-run) crosses the deployment's budget: critical when over it
+    (the NEXT recompile OOMs), warn when inside ``warn_fraction`` of
+    it (one batch-size bump from the cliff).  Budget-less
+    construction is an error — a watchdog cannot guess how much HBM
+    the deployment reserved, which is why this rule is opt-in rather
+    than in :func:`default_rules`."""
+
+    name = "memory_budget"
+    severity = "critical"
+
+    def __init__(self, budget_bytes: int, warn_fraction: float = 0.9,
+                 key: str = "analysis/peak_hbm_bytes",
+                 cooldown: int = 512):
+        if not budget_bytes or budget_bytes <= 0:
+            raise ValueError("MemoryBudgetRule needs a positive budget")
+        super().__init__(cooldown)
+        self.budget_bytes = int(budget_bytes)
+        self.warn_fraction = warn_fraction
+        self.key = key
+
+    def evaluate(self, wd, step):
+        from apex_tpu.observability.metrics import board
+
+        peak = board.get(self.key)
+        if peak is None:
+            return []
+        peak = float(peak)
+        mib = 1 << 20
+        if peak > self.budget_bytes:
+            return self._event(
+                step, peak, self.budget_bytes,
+                f"static peak HBM {peak / mib:.1f} MiB exceeds the "
+                f"{self.budget_bytes / mib:.1f} MiB budget — the next "
+                "(re)compile OOMs; see tools/shard_report.py for the "
+                "per-buffer attribution",
+            )
+        if peak > self.warn_fraction * self.budget_bytes:
+            ev = self._event(
+                step, peak, self.warn_fraction * self.budget_bytes,
+                f"static peak HBM {peak / mib:.1f} MiB is inside "
+                f"{1 - self.warn_fraction:.0%} of the "
+                f"{self.budget_bytes / mib:.1f} MiB budget",
+            )
+            return [ev[0]._replace(severity="warn")]
         return []
 
 

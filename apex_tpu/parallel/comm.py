@@ -77,6 +77,9 @@ __all__ = [
     "dequantize_blocks",
     "pack_int8",
     "unpack_int8",
+    "wire_payload_bytes",
+    "sync_plan",
+    "zero_plan",
     "collective_summary",
     "compiled_collectives",
     "ring_wire_bytes",
@@ -506,6 +509,186 @@ def sync_gradients(
             else:
                 out.append(jax.lax.psum(pre(l), axis_name) / post)
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# declared collective plans (the analysis reshard pass's intent)
+#
+# Each sync path above PROMISES a collective structure; these helpers
+# write that promise down as the per-mesh-axis plan schema of
+# apex_tpu.analysis.sharding.reshard_pass, mirroring the exact routing
+# decisions (bucketing, chunk bounds, wire payloads) the traced code
+# makes — so "the compiled step contains only the collectives the
+# engine planned" is machine-checkable, not a docstring.
+# ---------------------------------------------------------------------------
+
+
+def wire_payload_bytes(n: int, wire: str, block: int = DEFAULT_BLOCK) -> int:
+    """EXACT encoded payload bytes of ``n`` f32 elements under
+    ``wire`` — including the int8 path's block zero-pad and packed f32
+    scales (:func:`pack_int8`), so plan bounds match the compiled
+    payload shapes byte-for-byte."""
+    check_wire(wire)
+    if wire == "f32":
+        return n * 4
+    if wire == "bf16":
+        return n * 2
+    n_pad = _padded_len(n, block)
+    return n_pad + 4 * (n_pad // block)
+
+
+def _wire_dtypes(wire: str):
+    return {"f32": ["f32"], "bf16": ["bf16"], "int8": ["s8"]}[wire]
+
+
+def _bound(estimate: int, slack: int = 1024):
+    """[0, hi] byte bounds around an exact-model estimate: generous
+    enough for layout padding / a stray scalar riding along, tight
+    enough that a doubled sync or an un-encoded payload busts it."""
+    return [0, int(estimate + max(slack, estimate // 4))]
+
+
+def sync_plan(
+    grads: Any,
+    world: int,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    *,
+    wire: str = "f32",
+    chunks: Optional[int] = None,
+    block: int = DEFAULT_BLOCK,
+    min_size: int = 1024,
+    extra_allreduce_bytes: int = 64,
+) -> list:
+    """The collective plan :func:`sync_gradients` promises for this
+    gradient tree — a list of ``{"kind", "axis", "count", "bytes",
+    "dtypes"}`` entries (``count`` None where XLA's combiner may
+    legally merge).  ``extra_allreduce_bytes`` widens the exact-psum
+    entry for the scalar all-reduces that ride the same axis in a real
+    step (loss pmean, guard flags).
+
+    Mirrors the routing in :func:`sync_gradients` exactly: same
+    bucketing predicate, same :func:`resolve_chunks` /
+    ``_chunk_bounds`` arithmetic, same wire payload model — change one
+    without the other and the reshard pass fails, which is the point.
+    """
+    check_wire(wire)
+    leaves = jax.tree_util.tree_leaves(grads)
+    sizes = [int(getattr(l, "size", l)) for l in leaves]
+    if world <= 1:
+        return []
+    big = [s for s in sizes if s >= min_size and s > 0]
+    resolved = None
+    if big:
+        nbytes = int(sum(big) * wire_bytes_per_element(wire, block))
+        resolved = resolve_chunks(nbytes, chunks)
+    bucketed = bool(big) and (
+        wire != "f32" or (chunks_requested(chunks) and resolved > 1)
+    )
+    entries = []
+    psum_elems = sum(
+        s for s in sizes if not (bucketed and s >= min_size and s > 0)
+    )
+    if bucketed:
+        n = sum(big)
+        padded = n + (-n) % world
+        shard = padded // world
+        align = 1 if wire == "f32" else block
+        k = min(resolved, shard)
+        bounds = _chunk_bounds(shard, k, align)
+        count = len(bounds)
+        if wire == "f32":
+            # psum_scatter prints the SHARD as its result shape
+            entries.append({
+                "kind": "reduce-scatter", "axis": axis_name,
+                "count": count, "bytes": _bound(shard * 4),
+                "dtypes": _wire_dtypes(wire),
+            })
+        else:
+            # encoded (world, chunk) payloads through all_to_all
+            a2a = sum(
+                world * wire_payload_bytes(hi - lo, wire, block)
+                for lo, hi in bounds
+            )
+            entries.append({
+                "kind": "all-to-all", "axis": axis_name,
+                "count": count, "bytes": _bound(a2a),
+                "dtypes": _wire_dtypes(wire),
+            })
+        ag = sum(
+            world * wire_payload_bytes(hi - lo, wire, block)
+            for lo, hi in bounds
+        )
+        entries.append({
+            "kind": "all-gather", "axis": axis_name,
+            "count": count, "bytes": _bound(ag),
+            "dtypes": _wire_dtypes(wire),
+        })
+    if psum_elems or extra_allreduce_bytes:
+        entries.append({
+            "kind": "all-reduce", "axis": axis_name,
+            "count": None,
+            "bytes": _bound(psum_elems * 4 + extra_allreduce_bytes),
+            "dtypes": ["f32"],
+        })
+    return entries
+
+
+def zero_plan(
+    n_elements: int,
+    world: int,
+    axis_name: str = ps.DATA_PARALLEL_AXIS,
+    *,
+    wire: str = "f32",
+    param_wire: Optional[str] = None,
+    chunks: Optional[int] = None,
+    block: int = DEFAULT_BLOCK,
+    extra_allreduce_bytes: int = 256,
+) -> list:
+    """The plan a ZeRO step (:meth:`_DistributedFusedBase
+    .update_inside_shard_map`) promises for ``n_elements`` flat f32
+    params: a chunked reduce-scatter of grads at ``wire``, a chunked
+    all-gather of updated shards at ``param_wire or wire``, plus the
+    small all-reduces of the loss pmean / LAMB per-tensor norms."""
+    check_wire(wire)
+    if world <= 1:
+        return []
+    padded = n_elements + (-n_elements) % world
+    shard = padded // world
+    entries = []
+
+    def _one(w, gather: bool):
+        align = 1 if w == "f32" else block
+        # mirror reduce_scatter_flat/all_gather_flat's resolve inputs:
+        # the scatter sizes the full padded buffer, the gather its
+        # world x shard result
+        n_for_chunks = world * shard if gather else padded
+        k = min(resolve_chunks(
+            int(n_for_chunks * wire_bytes_per_element(w, block)), chunks,
+        ), shard)
+        bounds = _chunk_bounds(shard, k, align)
+        count = len(bounds)
+        if gather or w != "f32":
+            payload = sum(
+                world * wire_payload_bytes(hi - lo, w, block)
+                for lo, hi in bounds
+            )
+            kind = "all-gather" if gather else "all-to-all"
+            return {
+                "kind": kind, "axis": axis_name, "count": count,
+                "bytes": _bound(payload), "dtypes": _wire_dtypes(w),
+            }
+        return {
+            "kind": "reduce-scatter", "axis": axis_name, "count": count,
+            "bytes": _bound(shard * 4), "dtypes": _wire_dtypes(w),
+        }
+
+    entries.append(_one(wire, gather=False))
+    entries.append(_one(param_wire or wire, gather=True))
+    entries.append({
+        "kind": "all-reduce", "axis": axis_name, "count": None,
+        "bytes": _bound(extra_allreduce_bytes), "dtypes": ["f32"],
+    })
+    return entries
 
 
 # ---------------------------------------------------------------------------

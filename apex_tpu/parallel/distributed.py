@@ -150,6 +150,26 @@ class DistributedDataParallel:
         finally:
             self._no_sync = prev
 
+    def collective_plan(self, params, world: int) -> dict:
+        """The per-mesh-axis collective plan this wrapper's step
+        promises — ``{"mesh": {axis: world}, "collectives": [...]}``
+        in the schema of :func:`apex_tpu.analysis.sharding
+        .reshard_pass`, built by :func:`apex_tpu.parallel.comm
+        .sync_plan` from the same wire/chunks/min_size knobs the
+        traced sync uses.  Feed it to ``analysis.check(...,
+        expect_plan=...)`` (or ``tools/graph_lint.py`` does, for the
+        resilient target) to prove the compiled step contains ONLY
+        the declared gradient sync — an extra weight all-gather is a
+        ``reshard-unplanned`` ERROR."""
+        return {
+            "mesh": {self.axis_name: int(world)},
+            "collectives": comm.sync_plan(
+                params, world, self.axis_name,
+                wire=self.wire, chunks=self.chunks, block=self.block,
+                min_size=self.min_size,
+            ),
+        }
+
     def all_reduce_gradients(self, grads):
         """Sync a (local) gradient tree with this wrapper's engine
         config — the one comms layer shared with the ZeRO optimizers

@@ -150,6 +150,23 @@ class _DistributedFusedBase:
             raise RuntimeError("call init(params) first")
         return self._spec
 
+    def collective_plan(self) -> dict:
+        """The per-mesh-axis collective plan one sharded step promises
+        (``analysis.sharding.reshard_pass`` schema): the chunked
+        grad reduce-scatter at ``wire``, the param all-gather at
+        ``param_wire or wire``, and the small norm/loss all-reduces —
+        via :func:`apex_tpu.parallel.comm.zero_plan` on this
+        optimizer's own flat spec.  Call after :meth:`init`."""
+        spec = self.spec
+        return {
+            "mesh": {self.axis_name: spec.world},
+            "collectives": comm.zero_plan(
+                spec.flat_size, spec.world, self.axis_name,
+                wire=self.wire, param_wire=self.param_wire,
+                chunks=self.chunks, block=self.block,
+            ),
+        }
+
     # -- device-side (inside shard_map over the dp axis) ----------------
     def reduce_scatter_grads(self, grads, gradient_average: bool = True):
         """Local grads tree -> my reduced flat shard (f32), via the comm

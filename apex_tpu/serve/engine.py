@@ -88,6 +88,12 @@ class ServeConfig:
     #: run analysis.check over every step program at build (ERROR
     #: findings raise)
     verify: bool = True
+    #: static peak-HBM budget in bytes for each step program (weights
+    #: + KV page pool + activations + scratch, from the compiled
+    #: module's live ranges — apex_tpu.analysis.memory).  None skips
+    #: the gate; with ``verify=True`` an over-budget program fails the
+    #: BUILD, so a pool that never fit can't reach the first request.
+    hbm_budget_bytes: Optional[int] = None
 
     def __post_init__(self):
         if self.kv_wire not in ("f32", "int8"):
@@ -241,13 +247,23 @@ class InferenceEngine:
             # lint the executable we just paid for (lint_hlo/lint_jaxpr
             # instead of analysis.check, which would trace+compile the
             # identical program a second time): HLO-level transfer +
-            # donation-aliasing over the compiled text, jaxpr-level
-            # transfer/promotion over a cheap re-trace
+            # donation-aliasing + static peak-HBM budget over the
+            # compiled text (the KV page pool is a donated argument
+            # with a static shape, so the pool is budgeted exactly),
+            # jaxpr-level transfer/promotion over a cheap re-trace
+            hlo_text = compiled.as_text()
             report = analysis.lint_hlo(
-                compiled.as_text(),
+                hlo_text,
                 donated=len(jax.tree_util.tree_leaves(args[1])),
+                hbm_budget=self.serve.hbm_budget_bytes,
                 name=f"serve/{name}",
             )
+            est = analysis.memory.estimate_peak(hlo_text)
+            analysis.memory.publish_peak(est, prefix=f"serve/hbm/{name}")
+            board.set("serve/peak_hbm_bytes", max(
+                int(board.get("serve/peak_hbm_bytes") or 0),
+                est["peak_bytes"],
+            ))
             report.extend(
                 analysis.lint_jaxpr(
                     jax.make_jaxpr(fn)(*args), name=f"serve/{name}"
@@ -304,14 +320,22 @@ class InferenceEngine:
         fn, args = self._prefill_fn(bucket)
         report = analysis.check(
             jax.jit(fn, donate_argnums=(1,)), *args,
-            donate_argnums=(1,), name=f"serve/prefill_{bucket}",
+            donate_argnums=(1,),
+            hbm_budget=self.serve.hbm_budget_bytes,
+            name=f"serve/prefill_{bucket}",
         )
         fn, args = self._decode_fn()
         dec = analysis.check(
             jax.jit(fn, donate_argnums=(1,)), *args,
-            donate_argnums=(1,), name="serve/decode",
+            donate_argnums=(1,),
+            hbm_budget=self.serve.hbm_budget_bytes,
+            name="serve/decode",
         )
-        report.extend(dec.findings)
+        analysis.attach_shard_sections(report, [
+            (f"serve/prefill_{bucket}", report.hlo_text),
+            ("serve/decode", dec.hlo_text),
+        ])
+        report.merge(dec)
         report.target = "serve"
         return report
 

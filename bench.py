@@ -325,9 +325,10 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
         # rides the same single compile: the analysis passes read the
         # executable's text rather than paying their own.
         compiled = train_chunk.lower(params, opt_state).compile()
+        module_text = compiled.as_text()  # one render serves both uses
         if hlo_out:
             with open(hlo_out, "w") as f:
-                f.write(compiled.as_text())
+                f.write(module_text)
         timed_fn = compiled
     if _BENCH_LINT:
         from apex_tpu import analysis
@@ -335,8 +336,14 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
         donated = sum(
             len(jax.tree_util.tree_leaves(a)) for a in (params, opt_state)
         )
+        lint_hlo_text = module_text
+        # APEX_TPU_BENCH_HBM_BUDGET (bytes) arms the static peak-HBM
+        # gate on the headline step; unset leaves the memory pass
+        # reporting-only (the peak still rides the unit string below)
+        hbm_budget = os.environ.get("APEX_TPU_BENCH_HBM_BUDGET")
         report = analysis.lint_hlo(
-            compiled.as_text(), donated=donated,
+            lint_hlo_text, donated=donated,
+            hbm_budget=int(hbm_budget) if hbm_budget else None,
             name="bert_lamb/train_chunk",
         )
         report.extend(analysis.lint_jaxpr(
@@ -351,6 +358,31 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
             "ERROR findings (bert_lamb step; warnings=%d, rules=%s; "
             "docs/analysis.md)" % (
                 len(report.warnings()), ",".join(report.rule_ids()) or "-"
+            ),
+            None,
+        )
+        # the sharding/memory half of the linter (ISSUE 9): ERROR count
+        # scoped to the sharding-conformance/reshard/budget rules, plus
+        # the static peak-HBM estimate of the same compiled module —
+        # the record rides the standard bench-line schema that
+        # tools/bench_diff.py --check-schema enforces
+        _SHARD_RULES = (
+            "sharding-replicated", "sharding-mismatch",
+            "reshard-unplanned", "reshard-plan", "memory-budget",
+        )
+        shard_errors = sum(
+            1 for f in report.errors() if f.rule in _SHARD_RULES
+        )
+        est = analysis.memory.estimate_peak(lint_hlo_text)
+        analysis.memory.publish_peak(est)
+        _emit(
+            "graph_lint_shard_errors",
+            float(shard_errors),
+            "sharding/reshard/memory ERROR findings (bert_lamb step; "
+            "peak_hbm=%.1fMiB; budget %s; docs/analysis.md)" % (
+                est["peak_bytes"] / (1 << 20),
+                ("%s bytes" % hbm_budget) if hbm_budget
+                else "unarmed (APEX_TPU_BENCH_HBM_BUDGET)",
             ),
             None,
         )

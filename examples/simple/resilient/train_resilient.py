@@ -189,6 +189,26 @@ def build_training(accum=1, wire="f32", fetch_every=8):
         })
         return new_state, verdict
 
+    # -- the declared sharding & collective plan ---------------------------
+    # What tools/graph_lint.py / tools/shard_report.py PROVE about the
+    # compiled programs above (docs/analysis.md "Sharding & memory
+    # passes"): regex→PartitionSpec rules in the match_partition_rules
+    # style, matched against the compiled module's parameter paths —
+    # DDP keeps params/scaler replicated by design, the batch shards
+    # its row axis over dp — plus the comm engine's own collective
+    # plan for the boundary gradient sync.
+    shard_rules = [
+        (r"^params(/|$)", P()),         # replicated: the DDP contract
+        (r"^scaler", P()),
+        (r"^batch(/|$)", P(None, "dp")),  # (accum, rows, feat)
+    ]
+    expect_sharding = {
+        "mesh": {"dp": dp},
+        "rules": shard_rules,
+        "min_bytes": 1 << 10,
+    }
+    expect_plan = ddp.collective_plan(params, dp)
+
     return {
         "mesh": mesh, "dp": dp, "micro": micro, "rows": rows,
         "x_all": x_all, "y_all": y_all,
@@ -196,6 +216,9 @@ def build_training(accum=1, wire="f32", fetch_every=8):
         "tx": tx, "scaler": scaler, "guard": guard, "ddp": ddp,
         "compute_grads": compute_grads, "apply_update": apply_update,
         "batch_fn": batch_fn,
+        "shard_rules": shard_rules,
+        "expect_sharding": expect_sharding,
+        "expect_plan": expect_plan,
     }
 
 

@@ -60,17 +60,20 @@ def model_config():
 
 
 def build_serving(params, *, kv_wire="f32", weight_wire="f32",
-                  registry=None, verify=True):
+                  registry=None, verify=True, hbm_budget_bytes=None):
     """Engine for the example's model — importable so
     ``tools/graph_lint.py --target serve`` lints EXACTLY the compiled
     programs this example dispatches (it passes ``verify=False`` and
-    renders ``engine.lint()`` instead of tripping the build raise)."""
+    renders ``engine.lint()`` instead of tripping the build raise).
+    ``hbm_budget_bytes`` arms the build-time static peak-HBM gate
+    (docs/analysis.md "Sharding & memory passes")."""
     cfg = model_config()
     engine = InferenceEngine(
         cfg, params,
         ServeConfig(
             page_size=8, num_pages=64, max_batch=4, max_pages_per_seq=8,
             kv_wire=kv_wire, weight_wire=weight_wire, verify=verify,
+            hbm_budget_bytes=hbm_budget_bytes,
         ),
         registry=registry,
     )

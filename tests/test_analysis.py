@@ -331,6 +331,10 @@ def test_every_rule_is_cataloged_and_catalog_is_complete():
         "promotion-f64", "promotion-widen",
         "donation-dropped", "retrace",
         "collective-count", "collective-bytes", "collective-dtype",
+        "sharding-replicated", "sharding-mismatch",
+        "sharding-unverified", "reshard-unplanned", "reshard-plan",
+        "memory-budget", "sharding-implicit-replication",
+        "sharding-missing-constraint",
     }
     for rule, (sev, desc, hint) in analysis.RULES.items():
         assert sev in (analysis.ERROR, analysis.WARNING, analysis.INFO)
@@ -424,3 +428,534 @@ def test_own_ops_are_promotion_clean_under_bf16(name):
         builders[name](), policy=bf, name=f"ops/{name}"
     )
     assert report.findings == [], report.render()
+
+
+# ---------------------------------------------------------------------------
+# sharding & memory passes (ISSUE 9): rule tables, spec conformance,
+# resharding plan, static peak-HBM budget
+# ---------------------------------------------------------------------------
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding  # noqa: E402
+
+from apex_tpu.analysis import memory as memory_lib  # noqa: E402
+from apex_tpu.analysis import sharding as sharding_lib  # noqa: E402
+
+
+def _dp_tp_mesh(eight_devices):
+    return Mesh(np.array(eight_devices[:4]).reshape(2, 2), ("dp", "tp"))
+
+
+_DPTP = {"dp": 2, "tp": 2}
+
+
+class TestRuleTables:
+    def test_match_partition_rules_first_match_and_scalar_exempt(self):
+        rules = [(r"\bw$", P(None, "tp")), (r".*", P())]
+        params = {
+            "w": jnp.zeros((8, 8)),
+            "b": jnp.zeros((8,)),
+            "count": jnp.zeros(()),  # scalar: never partitioned
+        }
+        specs = analysis.match_partition_rules(rules, params)
+        assert specs["w"] == P(None, "tp")
+        assert specs["b"] == P()
+        assert specs["count"] == P()
+
+    def test_match_partition_rules_hole_raises(self):
+        with pytest.raises(ValueError, match="partition rule not found"):
+            analysis.match_partition_rules(
+                [(r"\bw$", P())], {"other": jnp.zeros((4, 4))}
+            )
+
+    def test_normalize_param_path_matches_tree_paths(self):
+        """ONE rule table serves the live pytree and the compiled
+        module: HLO op_name metadata normalizes to the same /-joined
+        path tree_paths produces."""
+        assert sharding_lib.normalize_param_path(
+            "state[\\'params\\'][\\'w\\']"
+        ) == "state/params/w"
+        assert sharding_lib.normalize_param_path("batch[0]") == "batch/0"
+        assert sharding_lib.normalize_param_path(
+            "scaler_state.loss_scale"
+        ) == "scaler_state/loss_scale"
+        paths = [p for p, _l in sharding_lib.tree_paths(
+            {"state": {"params": {"w": jnp.zeros((2,))}}}
+        )]
+        assert paths == ["state/params/w"]
+
+    def test_parse_sharding_variants(self):
+        ps_ = hlo_lib.parse_sharding
+        assert ps_("replicated")["kind"] == "replicated"
+        assert ps_("maximal device=3")["kind"] == "maximal"
+        assert ps_("devices=[2,4]<=[8]") == {
+            "kind": "tiled", "dims": [2, 4]}
+        assert ps_(
+            "devices=[1,4,2]<=[2,4]T(1,0) last_tile_dim_replicate"
+        ) == {"kind": "tiled", "dims": [1, 4]}
+        # tiled-in-name-only = replicated
+        assert ps_(
+            "devices=[1,1,8]<=[8] last_tile_dim_replicate"
+        )["kind"] == "replicated"
+        assert ps_(None)["kind"] == "unknown"
+
+    def test_mesh_axis_groups_row_major(self):
+        groups = sharding_lib.mesh_axis_groups({"dp": 2, "tp": 4})
+        assert groups["tp"] == frozenset([
+            frozenset({0, 1, 2, 3}), frozenset({4, 5, 6, 7})])
+        assert groups["dp"] == frozenset([
+            frozenset({0, 4}), frozenset({1, 5}),
+            frozenset({2, 6}), frozenset({3, 7})])
+        assert groups["all"] == frozenset([frozenset(range(8))])
+
+    def test_iota_replica_groups_disambiguate_equal_axes(self):
+        """XLA's compact iota form must still attribute axes EXACTLY
+        at dp=tp=2, where group size alone is ambiguous: the minor
+        (tp) axis prints untransposed rows, the major (dp) axis a
+        T(1,0) iota — both must resolve, never fall back to None."""
+        mesh = {"dp": 2, "tp": 2}
+        groups = sharding_lib.mesh_axis_groups(mesh)
+
+        def _coll(line):
+            recs = hlo_lib.collective_instructions(
+                "ENTRY %main {\n  " + line + "\n}"
+            )
+            assert len(recs) == 1
+            return recs[0]
+
+        tp = _coll("%ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+                   "replica_groups=[2,2]<=[4], to_apply=%add")
+        assert tp["groups"] == [[0, 1], [2, 3]]
+        assert sharding_lib.infer_collective_axis(
+            tp, groups, mesh) == "tp"
+        dp = _coll("%ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+                   "replica_groups=[2,2]<=[2,2]T(1,0), to_apply=%add")
+        assert dp["groups"] == [[0, 2], [1, 3]]
+        assert sharding_lib.infer_collective_axis(
+            dp, groups, mesh) == "dp"
+        allg = _coll("%ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+                     "replica_groups=[1,4]<=[4], to_apply=%add")
+        assert sharding_lib.infer_collective_axis(
+            allg, groups, mesh) == "all"
+
+
+class TestShardingConformance:
+    RULES = [(r"\bw$", P(None, "tp")), (r"\bb$", P()), (r"^x", P("dp", None))]
+
+    def _step(self):
+        def step(params, x):
+            return jnp.tanh(x @ params["w"] + params["b"]).sum()
+        params = {
+            "w": jnp.zeros((64, 64), jnp.float32),
+            "b": jnp.zeros((64,), jnp.float32),
+        }
+        return step, params, jnp.zeros((8, 64), jnp.float32)
+
+    def test_planted_replicated_large_param_is_caught(self, eight_devices):
+        """The headline defect: the plan shards w over tp but the call
+        site replicates it — silent full replication is an ERROR."""
+        mesh = _dp_tp_mesh(eight_devices)
+        step, params, x = self._step()
+        fn = jax.jit(step, in_shardings=(
+            NamedSharding(mesh, P()), NamedSharding(mesh, P("dp", None))))
+        report = analysis.check(
+            fn, params, x,
+            expect_sharding={
+                "mesh": _DPTP, "rules": self.RULES, "min_bytes": 1 << 10,
+            },
+            rules=("sharding",),
+        )
+        assert report.rule_ids() == ["sharding-replicated"]
+        assert not report.ok()
+        assert "params/w" in report.findings[0].path
+
+    def test_planted_wrong_axis_is_mismatch(self, eight_devices):
+        mesh = _dp_tp_mesh(eight_devices)
+        step, params, x = self._step()
+        wrong = {"w": NamedSharding(mesh, P("tp", None)),  # transposed
+                 "b": NamedSharding(mesh, P())}
+        fn = jax.jit(step, in_shardings=(
+            wrong, NamedSharding(mesh, P("dp", None))))
+        report = analysis.check(
+            fn, params, x,
+            expect_sharding={
+                "mesh": _DPTP, "rules": self.RULES, "min_bytes": 1 << 10,
+            },
+            rules=("sharding",),
+        )
+        assert report.rule_ids() == ["sharding-mismatch"]
+
+    def test_clean_conformant_step(self, eight_devices):
+        mesh = _dp_tp_mesh(eight_devices)
+        step, params, x = self._step()
+        good = {"w": NamedSharding(mesh, P(None, "tp")),
+                "b": NamedSharding(mesh, P())}
+        fn = jax.jit(step, in_shardings=(
+            good, NamedSharding(mesh, P("dp", None))))
+        report = analysis.check(
+            fn, params, x,
+            expect_sharding={
+                "mesh": _DPTP, "rules": self.RULES, "min_bytes": 1 << 10,
+            },
+            rules=("sharding",),
+        )
+        assert report.findings == [], report.render()
+
+    def test_single_device_compile_is_unverified_not_clean(self):
+        """A plan naming a real mesh checked against a 1-partition
+        compile must WARN, not pass — nobody proved anything."""
+        step, params, x = self._step()
+        report = analysis.check(
+            jax.jit(step), params, x,
+            expect_sharding={
+                "mesh": _DPTP, "rules": self.RULES, "min_bytes": 1 << 10,
+            },
+            rules=("sharding",),
+        )
+        assert report.rule_ids() == ["sharding-unverified"]
+        assert report.ok()  # warning severity: visible, not fatal
+        assert not report.ok(fail_on="warning")
+
+
+class TestReshardPlan:
+    def test_planted_unplanned_weight_all_gather(self, eight_devices):
+        """The signature of a spec that didn't survive propagation:
+        a weight all-gather the plan does not predict."""
+        mesh = _dp_tp_mesh(eight_devices)
+
+        def bad(w, x):
+            wfull = jax.lax.all_gather(w, "tp", axis=0, tiled=True)
+            y = jnp.einsum("bk,kn->bn", x, wfull)
+            return jax.lax.psum(y, "tp")
+
+        fn = jax.jit(jax.shard_map(
+            bad, mesh=mesh,
+            in_specs=(P("tp", None), P(None, None)),
+            out_specs=P(None, None), check_vma=False,
+        ))
+        plan = {"mesh": _DPTP, "collectives": [
+            {"kind": "all-reduce", "axis": "tp", "dtypes": ["f32"]},
+        ]}
+        report = analysis.check(
+            fn, jnp.zeros((64, 32)), jnp.zeros((8, 64)),
+            expect_plan=plan, rules=("reshard",),
+        )
+        assert report.rule_ids() == ["reshard-unplanned"]
+        f = report.findings[0]
+        assert "all-gather" in f.path and "tp" in f.path
+
+    def test_planted_wire_drift(self, eight_devices):
+        """A plan promising an int8 wire must fail when the compiled
+        payload is f32 — the quantization didn't apply."""
+        mesh = _dp_tp_mesh(eight_devices)
+
+        def step(w, x):
+            return jax.lax.psum(jnp.einsum("bk,kn->bn", x, w), "tp")
+
+        fn = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("tp", None), P(None, None)),
+            out_specs=P(None, None), check_vma=False,
+        ))
+        plan = {"mesh": _DPTP, "collectives": [
+            {"kind": "all-reduce", "axis": "tp", "dtypes": ["s8"]},
+        ]}
+        report = analysis.check(
+            fn, jnp.zeros((64, 32)), jnp.zeros((8, 32)),
+            expect_plan=plan, rules=("reshard",),
+        )
+        assert report.rule_ids() == ["reshard-plan"]
+
+    def test_ddp_declared_plan_matches_compiled(self, eight_devices):
+        """The engine's OWN declaration (collective_plan) verifies the
+        engine's OWN compiled sync — the live 8-device check beside
+        the existing collective one, for f32 and the int8 wire."""
+        from apex_tpu import parallel_state as ps
+        from apex_tpu.parallel import DistributedDataParallel
+
+        mesh = ps.initialize_model_parallel()
+        world = ps.get_data_parallel_world_size()
+        params = {"w": jnp.zeros((64, 64), jnp.float32),
+                  "b": jnp.zeros((8,), jnp.float32)}
+        batch = (jnp.ones((16, 64)), jnp.ones((16, 64)))
+        for wire in ("f32", "int8"):
+            ddp = DistributedDataParallel(
+                lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+                wire=wire,
+            )
+            fn = jax.jit(jax.shard_map(
+                lambda p, b: ddp.value_and_grad(p, b), mesh=mesh,
+                in_specs=(P(), P("dp")), out_specs=(P(), P()),
+            ))
+            plan = ddp.collective_plan(params, world)
+            report = analysis.check(
+                fn, params, batch, expect_plan=plan,
+                rules=("reshard",), name=f"ddp/{wire}",
+            )
+            assert report.findings == [], (wire, report.render())
+            if wire == "int8":
+                kinds = {e["kind"] for e in plan["collectives"]}
+                assert kinds == {"all-to-all", "all-gather", "all-reduce"}
+
+    def test_zero_declared_plan_matches_compiled(self, eight_devices):
+        """The ZeRO optimizer's own declaration verifies its own
+        compiled step: int8 grad reduce-scatter (all-to-all on the
+        wire), f32 param all-gather.  (A bf16 param_wire is exactly
+        what the pass is FOR on the CPU backend: XLA legally hoists
+        the decode before the gather there, doubling wire bytes —
+        reshard-plan fires — so the clean pin uses wires that hold.)"""
+        from apex_tpu import parallel_state as ps
+        from apex_tpu.parallel import DistributedFusedAdam
+
+        mesh = ps.initialize_model_parallel()
+        world = ps.get_data_parallel_world_size()
+        params = {"w": jnp.zeros((64, 64), jnp.float32),
+                  "b": jnp.zeros((8,), jnp.float32)}
+        batch = (jnp.ones((16, 64)), jnp.ones((16, 64)))
+        tx = DistributedFusedAdam(wire="int8", param_wire="f32")
+        state = tx.init(params, world)
+        step = tx.make_train_step(
+            lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), mesh
+        )
+        plan = tx.collective_plan()
+        report = analysis.check(
+            step, params, state, batch, expect_plan=plan,
+            rules=("reshard",), name="zero/int8",
+        )
+        assert report.findings == [], report.render()
+
+
+class TestMemoryBudget:
+    _HLO = """
+HloModule jit_f, is_scheduled=true
+
+ENTRY %main (p0: f32[256,64], p1: f32[64,64]) -> f32[256,64] {
+  %p0 = f32[256,64]{1,0} parameter(0), metadata={op_name="state[\\'params\\'][\\'w\\']"}
+  %p1 = f32[64,64]{1,0} parameter(1), metadata={op_name="state[\\'opt\\'].m[\\'w\\']"}
+  %dot = f32[256,64]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %exp = f32[256,64]{1,0} exponential(f32[256,64]{1,0} %dot)
+  ROOT %add = f32[256,64]{1,0} add(f32[256,64]{1,0} %exp, f32[256,64]{1,0} %p0)
+}
+"""
+
+    def test_estimate_peak_on_fixture(self):
+        """Hand-checkable live ranges: p1 dies feeding %dot, p0 lives
+        to the ROOT (its last use), %dot dies feeding %exp — the peak
+        is p0 + two activations at instruction 3/4."""
+        big = 256 * 64 * 4  # p0 / dot / exp / add are 64 KiB each
+        est = memory_lib.estimate_peak(self._HLO)
+        assert est["peak_bytes"] == 3 * big
+        cats = est["by_category"]
+        assert cats["params"] == big          # p0, alive at the peak
+        assert cats["activations"] == 2 * big
+        assert "optimizer" not in cats        # p1 died at %dot
+        names = [b["name"] for b in est["buffers"]]
+        assert "p0" in names
+        # the arg-path classifier puts optimizer state in its bucket
+        assert memory_lib.categorize_buffer(
+            "parameter", "state['opt'].m['w']"
+        ) == "optimizer"
+        assert memory_lib.categorize_buffer(
+            "parameter", "kv_pages"
+        ) == "kv_cache"
+
+    def test_planted_budget_overflow_is_caught(self):
+        report = analysis.lint_hlo(
+            self._HLO, hbm_budget=100_000, rules=("memory",)
+        )
+        assert report.rule_ids() == ["memory-budget"]
+        f = report.findings[0]
+        assert "params:p0" in f.message  # top-buffer attribution
+        clean = analysis.lint_hlo(
+            self._HLO, hbm_budget=10 << 20, rules=("memory",)
+        )
+        assert clean.findings == []
+
+    def test_live_budget_overflow_on_compiled_step(self):
+        def step(x):
+            return (x @ x.T).sum()
+
+        report = analysis.check(
+            step, jnp.zeros((128, 128), jnp.float32), hbm_budget=1024,
+            rules=("memory",),
+        )
+        assert report.rule_ids() == ["memory-budget"]
+
+    def test_memory_budget_watchdog_rule(self):
+        from apex_tpu.observability import MemoryBudgetRule
+        from apex_tpu.observability.metrics import board
+
+        board.clear()
+        rule = MemoryBudgetRule(budget_bytes=1000)
+        assert rule.evaluate(None, 0) == []  # no estimate published
+        memory_lib.publish_peak(
+            {"peak_bytes": 950, "by_category": {"params": 950}}
+        )
+        (warn,) = rule.evaluate(None, 1)
+        assert warn.severity == "warn"
+        memory_lib.publish_peak({"peak_bytes": 2000, "by_category": {}})
+        (crit,) = rule.evaluate(None, 2)
+        assert crit.severity == "critical"
+        assert board.get("analysis/peak_hbm_bytes") == 2000
+        with pytest.raises(ValueError):
+            MemoryBudgetRule(budget_bytes=0)
+        board.clear()
+
+
+class TestCleanDpTpStep:
+    def test_clean_dp_tp_step_proves_whole_plan(self, eight_devices):
+        """The acceptance fixture: a dp=2 x tp=2 step with declared
+        rule table, collective plan, and budget — every sharding/
+        memory pass runs and the clean step yields ZERO findings."""
+        mesh = _dp_tp_mesh(eight_devices)
+        B, K, N = 8, 32, 16
+        rules = [(r"\bw$", P("tp", None)), (r"\bx$", P("dp", "tp"))]
+
+        def step(w, x):
+            y = jax.lax.psum(jnp.einsum("bk,kn->bn", x, w), "tp")
+            return jax.lax.pmean(jnp.mean(y * y), ("dp", "tp"))
+
+        fn = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("tp", None), P("dp", "tp")),
+            out_specs=P(), check_vma=False,
+        ))
+        plan = {"mesh": _DPTP, "collectives": [
+            {"kind": "all-reduce", "axis": "tp", "count": 1,
+             "bytes": [0, (B // 2) * N * 4 + 64], "dtypes": ["f32"]},
+        ]}
+        report = analysis.check(
+            fn, jnp.zeros((K, N), jnp.float32),
+            jnp.zeros((B, K), jnp.float32),
+            expect_sharding={
+                "mesh": _DPTP, "rules": rules, "min_bytes": 0,
+            },
+            expect_plan=plan,
+            hbm_budget=10 << 20,
+        )
+        assert report.findings == [], report.render()
+        for name in ("sharding", "reshard", "memory"):
+            assert name in report.rules_run
+            assert name in report.pass_timings
+
+
+# ---------------------------------------------------------------------------
+# report plumbing for the new passes: dedupe, timings, merge, sections
+# ---------------------------------------------------------------------------
+
+
+def test_publish_report_dedupes_same_rule_and_location():
+    """Two passes emitting the same (rule, location) — e.g. the jaxpr
+    and HLO substrates of one defect — must gauge ONE defect onto the
+    board (the ISSUE 9 bugfix), while the report keeps both raw
+    findings for rendering."""
+    from apex_tpu.observability.metrics import board
+
+    board.clear()
+    dup1 = analysis.make_finding("retrace", path="site_a", message="m1")
+    dup2 = analysis.make_finding("retrace", path="site_a", message="m2")
+    other = analysis.make_finding("retrace", path="site_b", message="m3")
+    report = analysis.Report([dup1, dup2, other], target="dedupe")
+    report.pass_timings["retrace"] = 1.25
+    analysis.publish_report(report)
+    snap = board.snapshot()
+    assert snap["analysis/rule/retrace"] == 2  # a+b, not 3
+    assert snap["analysis/errors"] == 2
+    assert snap["analysis/pass_ms/retrace"] == 1.25
+    assert len(report.findings) == 3  # raw findings untouched
+    board.clear()
+
+
+def test_pass_timings_cover_rules_run_and_survive_to_json():
+    report = analysis.check(lambda x: x * 2.0, jnp.zeros((4,)))
+    assert set(report.pass_timings) == set(report.rules_run)
+    assert all(ms >= 0.0 for ms in report.pass_timings.values())
+    blob = json.loads(report.to_json_line())
+    assert set(blob["pass_timings"]) == set(report.rules_run)
+
+
+def test_report_merge_sums_timings_and_unions_rules():
+    a = analysis.Report(target="a", rules_run=("transfer",))
+    a.pass_timings = {"transfer": 1.0}
+    b = analysis.Report(
+        [analysis.make_finding("retrace", path="p", message="m")],
+        target="b", rules_run=("transfer", "memory"),
+    )
+    b.pass_timings = {"transfer": 2.0, "memory": 0.5}
+    a.merge(b)
+    assert a.pass_timings == {"transfer": 3.0, "memory": 0.5}
+    assert a.rules_run == ("transfer", "memory")
+    assert len(a.findings) == 1
+
+
+def test_attach_shard_sections_rides_to_json():
+    hlo = TestMemoryBudget._HLO
+    report = analysis.lint_hlo(hlo, rules=("memory",), name="fixture")
+    analysis.attach_shard_sections(
+        report, [("fixture", hlo)], publish=True
+    )
+    blob = report.to_json()
+    assert blob["peak_hbm_bytes"] > 0
+    assert blob["peak_hbm_by_program"] == {
+        "fixture": blob["peak_hbm_bytes"]}
+    assert {r["name"] for r in blob["shard_plan"]} == {
+        "state/params/w", "state/opt/m/w"}
+    from apex_tpu.observability.metrics import board
+
+    assert board.get("analysis/peak_hbm_bytes") == blob["peak_hbm_bytes"]
+    board.clear()
+
+
+# ---------------------------------------------------------------------------
+# repo_lint source rules (the satellite): in_shardings=None, missing
+# with_sharding_constraint
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lint_sharding_source_rules():
+    from tools import repo_lint
+
+    implicit = [
+        "def build(step):",
+        "    return pjit(step, in_shardings=None, out_shardings=None)",
+    ]
+    got = repo_lint._sharding_violations("x/m.py", implicit, jitted=True)
+    assert len(got) == 1 and got[0][1] == 2
+    assert "replicated" in got[0][3]
+
+    unpinned = [
+        "y = jnp.einsum('bk,kn->bn', x, w)",
+        "fn = shard_map(step, mesh=mesh, in_specs=specs)",
+    ]
+    got = repo_lint._sharding_violations("x/m.py", unpinned, jitted=True)
+    assert len(got) == 1 and "with_sharding_constraint" in got[0][4]
+
+    # pinning ANY intermediate waives the call-site rule
+    pinned = unpinned + [
+        "y = jax.lax.with_sharding_constraint(y, spec)",
+    ]
+    assert repo_lint._sharding_violations("x/m.py", pinned, True) == []
+    # host-side files are out of scope
+    assert repo_lint._sharding_violations(
+        "x/m.py", implicit + unpinned, jitted=False
+    ) == []
+    # the waiver comment works like every other repo_lint rule
+    waived = [
+        "fn = pjit(step, in_shardings=None)  # repo-lint: allow tests",
+    ]
+    assert repo_lint._sharding_violations("x/m.py", waived, True) == []
+
+
+def test_bench_shard_lint_line_passes_schema():
+    """The `graph_lint_shard_errors` line bench.py --lint emits rides
+    the standard bench-record contract tools/bench_diff.py enforces."""
+    from tools import bench_diff
+
+    rec = {
+        "metric": "graph_lint_shard_errors",
+        "value": 0.0,
+        "unit": "sharding/reshard/memory ERROR findings (bert_lamb "
+                "step; peak_hbm=123.4MiB; docs/analysis.md)",
+        "vs_baseline": None,
+    }
+    assert bench_diff.check_schema([rec]) == []

@@ -257,6 +257,34 @@ class TestEngineBuild:
         report = make_engine(gpt).lint()
         assert report.errors() == [], report.render()
         assert report.target == "serve"
+        # the ISSUE 9 artifact sections ride the serve lint too
+        blob = report.to_json()
+        assert blob["peak_hbm_bytes"] > 0
+        assert set(blob["peak_hbm_by_program"]) == {
+            "serve/prefill_8", "serve/decode"}
+
+    def test_hbm_budget_gate_fails_build(self, gpt):
+        """The ISSUE 9 serve satellite: a pool that never fit is a
+        BUILD error (memory-budget), not a step-0 OOM; a generous
+        budget builds and publishes the peak gauge."""
+        from apex_tpu.observability.metrics import board
+
+        eng = make_engine(gpt, verify=True, hbm_budget_bytes=1 << 10)
+        with pytest.raises(RuntimeError, match="memory-budget"):
+            eng.build(buckets=(16,))
+
+        board.clear()
+        ok = make_engine(gpt, verify=True, hbm_budget_bytes=64 << 20)
+        ok.build(buckets=(16,))
+        peak = board.get("serve/peak_hbm_bytes")
+        assert peak and 0 < peak <= 64 << 20
+        # the KV page pool (static shape) is part of the budgeted peak
+        pool_bytes = sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(ok.cache)
+        )
+        assert peak >= pool_bytes
+        board.clear()
 
     def test_aot_compiles_once_no_retrace(self, gpt):
         """Steady-state serving never recompiles: many prefill/decode

@@ -23,10 +23,21 @@ Targets:
   --hlo FILE           Lint an optimized-HLO text dump (e.g. bench.py
                        --hlo-out) with the HLO-level passes only.
 
+Both targets also run the sharding & memory passes by default
+(docs/analysis.md "Sharding & memory passes"): spec conformance and
+the no-unplanned-resharding check against the target's own declared
+plan, and — with --budget — the static peak-HBM gate.  The --json
+artifact carries peak_hbm_bytes / peak_hbm_by_program /
+peak_hbm_by_category and the per-parameter shard_plan table next to
+the findings (tools/shard_report.py renders the same sections
+human-readably).
+
 Options:
 
   --wire / --accum     resilient-target knobs (forwarded to
                        build_training, docs/comm.md)
+  --budget BYTES       static peak-HBM budget (memory-budget ERROR
+                       when the estimate exceeds it)
   --expect JSON        collective expectations, e.g.
                        '{"all-to-all": {"count": 2, "dtypes": ["s8",
                        "f32"]}}' (schema: analysis.passes
@@ -73,6 +84,17 @@ def lint_resilient(args):
     ``batch_fn``; ``apply_update`` on the abstract output shapes of
     ``compute_grads`` (``jax.eval_shape`` — nothing executes, the lint
     is fully static: trace + AOT compile only).
+
+    The sharding/reshard/memory passes run by default against the
+    example's OWN declared plan (``build_training`` returns its
+    regex→PartitionSpec rule table and the DDP engine's collective
+    plan): params/scaler must stay replicated, the batch must shard
+    over dp, the step body may contain only the declared gradient
+    sync, and ``--budget`` arms the static peak-HBM gate.  On a
+    single-device run the sharding pass has nothing to prove and
+    stays quiet — run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (as the
+    ``verify_tier1.sh`` gate does) to prove the real mesh.
     """
     import jax
 
@@ -81,22 +103,39 @@ def lint_resilient(args):
     mod = _load_resilient_module()
     t = mod.build_training(accum=args.accum, wire=args.wire)
     state, batch = t["state"], t["batch_fn"](0)
+    expect_sharding = t.get("expect_sharding")
+    expect_plan = t.get("expect_plan")
 
     grads_args = (state["params"], state["scaler"], batch)
     report = analysis.check(
         t["compute_grads"], *grads_args,
         expect_collectives=args.expect,
+        expect_sharding=expect_sharding,
+        expect_plan=expect_plan,
+        hbm_budget=args.budget,
         name="resilient/compute_grads",
     )
 
     loss_shape, scaled_shape = jax.eval_shape(
         t["compute_grads"], *grads_args
     )
+    # the optimizer update runs replicated (no shard_map): its plan is
+    # "no collectives at all" — anything above the latency tolerance
+    # is an unplanned reshard
     up = analysis.check(
         t["apply_update"], scaled_shape, state, loss_shape,
+        expect_plan=(
+            {"mesh": expect_plan["mesh"], "collectives": []}
+            if expect_plan else None
+        ),
+        hbm_budget=args.budget,
         name="resilient/apply_update",
     )
-    report.extend(up.findings)
+    analysis.attach_shard_sections(report, [
+        ("resilient/compute_grads", report.hlo_text),
+        ("resilient/apply_update", up.hlo_text),
+    ], expect_sharding=expect_sharding)
+    report.merge(up)
     report.target = "resilient"
     return report
 
@@ -132,7 +171,10 @@ def lint_serve(args):
         jax.random.PRNGKey(0), jax.numpy.zeros((8, 1), jax.numpy.int32)
     )
     kv_wire = "int8" if args.wire == "int8" else "f32"
-    engine = mod.build_serving(params, kv_wire=kv_wire, verify=False)
+    engine = mod.build_serving(
+        params, kv_wire=kv_wire, verify=False,
+        hbm_budget_bytes=args.budget,
+    )
     return engine.lint()
 
 
@@ -141,12 +183,17 @@ def lint_hlo_file(args):
 
     with open(args.hlo) as f:
         text = f.read()
-    return analysis.lint_hlo(
+    report = analysis.lint_hlo(
         text,
         donated=args.donated,
         expect_collectives=args.expect,
+        hbm_budget=args.budget,
         name=os.path.basename(args.hlo),
     )
+    analysis.attach_shard_sections(
+        report, [(report.target, text)]
+    )
+    return report
 
 
 def main():
@@ -166,6 +213,9 @@ def main():
                     metavar="JSON", help="collective expectations")
     ap.add_argument("--donated", type=int, default=None,
                     help="declared donated-leaf count (--hlo mode)")
+    ap.add_argument("--budget", type=int, default=None, metavar="BYTES",
+                    help="static peak-HBM budget in bytes — exceeding "
+                    "it is a memory-budget ERROR (docs/analysis.md)")
     ap.add_argument("--json", metavar="FILE", default=None,
                     help="write the report as one JSON object")
     ap.add_argument("--fail-on", choices=["error", "warning"],

@@ -16,6 +16,16 @@ catch at the source line, before anything traces:
 - bare ``jax.device_get`` outside observability/export: a forced
   device→host sync that serializes dispatch — telemetry must go
   through the MetricRegistry's async fetch instead.
+- sharding hygiene at ``pjit``/``shard_map`` call sites (the source
+  half of the graph linter's sharding passes, docs/analysis.md
+  "Sharding & memory passes"): ``in_shardings=None`` is implicit full
+  replication (rule ``sharding-implicit-replication``), and a call
+  site in a file that contracts big tensors (einsum/dot/matmul) but
+  never pins an intermediate with ``with_sharding_constraint`` leaves
+  GSPMD guessing activation layouts (rule
+  ``sharding-missing-constraint``).  Severities and fix hints come
+  from the shared ``apex_tpu.analysis.findings.RULES`` catalog — one
+  rulebook for the source scan and the graph passes.
 
 A line carrying ``repo-lint: allow`` is waived (use sparingly, with a
 reason in the adjacent comment).  Run from anywhere::
@@ -69,6 +79,75 @@ GLOBAL_RULES = (
 WAIVER = "repo-lint: allow"
 
 
+_CATALOG = None
+
+
+def _catalog_rules():
+    """The shared rule catalog, loaded STANDALONE from
+    apex_tpu/analysis/findings.py (stdlib-only module) so this linter
+    stays importable without jax — the catalog is the single source of
+    severities and fix hints for the sharding source rules."""
+    global _CATALOG
+    if _CATALOG is None:
+        import importlib.util
+
+        path = os.path.join(REPO, "apex_tpu", "analysis", "findings.py")
+        spec = importlib.util.spec_from_file_location(
+            "_repo_lint_rules", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod  # dataclasses needs it registered
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop(spec.name, None)
+        _CATALOG = mod.RULES
+    return _CATALOG
+
+
+#: pjit/shard_map CALL sites (not defs/imports)
+_SHARD_CALL_RE = re.compile(
+    r"(?<!def )\b(?:pjit|shard_map)\s*\("
+)
+_IMPLICIT_REPL_RE = re.compile(r"\bin_shardings\s*=\s*None\b")
+#: big-contraction fingerprints: a file doing these wants its
+#: activations pinned
+_CONTRACTION_RE = re.compile(
+    r"jnp\.einsum|jnp\.matmul|jnp\.dot\b|lax\.dot_general|\s@\s"
+)
+_CONSTRAINT_TOKEN = "with_sharding_constraint"
+
+
+def _sharding_violations(rel: str, lines, jitted: bool):
+    """Source-level sharding rules over one file's lines; the graph
+    passes prove the compiled result, this catches the call-site
+    defect before anything traces."""
+    catalog = _catalog_rules()
+    out = []
+    has_contraction = any(
+        _CONTRACTION_RE.search(ln) for ln in lines
+        if WAIVER not in ln and not ln.lstrip().startswith("#")
+    )
+    has_constraint = any(_CONSTRAINT_TOKEN in ln for ln in lines)
+    for lineno, line in enumerate(lines, 1):
+        if WAIVER in line or line.lstrip().startswith("#"):
+            continue
+        if jitted and _IMPLICIT_REPL_RE.search(line):
+            _sev, why, fix = catalog["sharding-implicit-replication"]
+            out.append((rel, lineno, line.strip(), why, fix))
+            continue
+        if (
+            jitted
+            and _SHARD_CALL_RE.search(line)
+            and "import" not in line
+            and has_contraction
+            and not has_constraint
+        ):
+            _sev, why, fix = catalog["sharding-missing-constraint"]
+            out.append((rel, lineno, line.strip(), why, fix))
+    return out
+
+
 def _iter_sources():
     for root, dirs, files in os.walk(PKG):
         dirs[:] = [d for d in dirs if d != "__pycache__"]
@@ -84,22 +163,24 @@ def lint() -> list:
         top = rel.split(os.sep, 1)[0]
         jitted = top in JITTED_PATHS
         with open(path, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                if WAIVER in line:
-                    continue
-                if jitted:
-                    for rx, why, fix in JITTED_RULES:
-                        if rx.search(line):
-                            violations.append(
-                                (rel, lineno, line.strip(), why, fix)
-                            )
-                for rx, why, fix, allowed in GLOBAL_RULES:
-                    if any(a in rel for a in allowed):
-                        continue
+            lines = f.read().splitlines()
+        for lineno, line in enumerate(lines, 1):
+            if WAIVER in line:
+                continue
+            if jitted:
+                for rx, why, fix in JITTED_RULES:
                     if rx.search(line):
                         violations.append(
                             (rel, lineno, line.strip(), why, fix)
                         )
+            for rx, why, fix, allowed in GLOBAL_RULES:
+                if any(a in rel for a in allowed):
+                    continue
+                if rx.search(line):
+                    violations.append(
+                        (rel, lineno, line.strip(), why, fix)
+                    )
+        violations.extend(_sharding_violations(rel, lines, jitted))
     return violations
 
 

@@ -1,0 +1,215 @@
+"""Shard report — the human-readable sharding plan + memory breakdown
+of a compiled step program.
+
+Where ``tools/graph_lint.py`` answers "is the plan violated?" with
+findings and an exit code, this renders the plan itself: one row per
+ENTRY parameter (its compiled GSPMD sharding, global bytes, declared
+PartitionSpec and conformance verdict), the per-mesh-axis collective
+schedule, and the static peak-HBM estimate with top-K per-buffer
+attribution (``apex_tpu.analysis.memory`` — the live-range model of
+docs/analysis.md "Sharding & memory passes").
+
+Targets (same build paths as graph_lint, so the report describes the
+EXACT programs the examples dispatch):
+
+  --target resilient   examples/simple/resilient train step (both
+                       jitted programs), against its own declared
+                       rule table and DDP collective plan.  Run under
+                       XLA_FLAGS=--xla_force_host_platform_device_count=8
+                       to see a real dp mesh (the verify_tier1.sh gate
+                       does).
+  --target serve       the serve example's AOT prefill/decode programs
+                       (KV page pool budgeted from its static shape).
+  --hlo FILE           any optimized-HLO text dump.
+
+Options:
+
+  --budget BYTES       peak-HBM budget: the report prints headroom and
+                       the exit code turns 1 when exceeded (the same
+                       memory-budget gate graph_lint enforces)
+  --top K              buffers to attribute at the peak (default 10)
+  --wire / --accum     forwarded to the target builders
+  --json FILE          machine artifact: the full lint report plus
+                       peak_hbm_bytes / peak_hbm_by_program /
+                       peak_hbm_by_category / shard_plan sections
+                       (the CI schema verify_tier1.sh checks)
+
+Exit code: 0 clean, 1 any ERROR finding (incl. budget overflow),
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"  # pragma: no cover - fallthrough
+
+
+def _table(rows, headers):
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows
+        else len(str(h))
+        for i, h in enumerate(headers)
+    ]
+    line = "  ".join(str(h).ljust(w) for h, w in zip(headers, widths))
+    out = [line, "-" * len(line)]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def render(report, top: int, budget=None) -> str:
+    """Text report from a lint Report whose sections were filled by
+    ``analysis.attach_shard_sections`` (plus per-program collective
+    schedules re-read from the kept HLO)."""
+    from apex_tpu.analysis import hlo as hlo_lib
+    from apex_tpu.analysis import memory as mem
+
+    sec = report.sections
+    out = [f"shard report: {report.target}"]
+
+    plan = sec.get("shard_plan") or []
+    if plan:
+        out.append("\n== parameter shard plan "
+                   "(compiled sharding vs declared spec)")
+        out.append(_table(
+            [
+                (
+                    r["program"].rsplit("/", 1)[-1], r["name"],
+                    r["shape"], _fmt_bytes(r["global_bytes"]),
+                    r["sharding"], r["intended"] or "-", r["verdict"],
+                )
+                for r in plan
+            ],
+            ("program", "param", "local shape", "global",
+             "compiled sharding", "declared", "verdict"),
+        ))
+
+    for prog_name, text in getattr(report, "programs", []):
+        if not text:
+            continue
+        colls = hlo_lib.collective_instructions(text)
+        if colls:
+            out.append(f"\n== collective schedule ({prog_name})")
+            out.append(_table(
+                [
+                    (
+                        c["kind"], c["group_size"] or "-",
+                        _fmt_bytes(c["bytes"]),
+                        "/".join(sorted(c["dtypes"])) or "-",
+                        (c["op_name"] or c["name"])[-60:],
+                    )
+                    for c in colls
+                ],
+                ("kind", "group", "bytes", "dtypes", "op"),
+            ))
+        est = mem.estimate_peak(text, top_k=top)
+        out.append(
+            f"\n== memory ({prog_name}): static peak "
+            f"{_fmt_bytes(est['peak_bytes'])} at instruction "
+            f"#{est['peak_index']}"
+        )
+        cats = ", ".join(
+            f"{k}={_fmt_bytes(v)}"
+            for k, v in sorted(
+                est["by_category"].items(), key=lambda kv: -kv[1]
+            )
+        )
+        out.append(f"   at-peak by category: {cats}")
+        out.append(_table(
+            [
+                (
+                    b["category"], b["name"], _fmt_bytes(b["bytes"]),
+                    f"[{b['defined']}, {b['freed']}]",
+                    (b["op_name"] or "")[-50:],
+                )
+                for b in est["buffers"]
+            ],
+            ("category", "buffer", "bytes", "live", "op"),
+        ))
+
+    peak = sec.get("peak_hbm_bytes", 0)
+    if budget is not None:
+        headroom = budget - peak
+        verdict = "WITHIN" if headroom >= 0 else "EXCEEDS"
+        out.append(
+            f"\nbudget: peak {_fmt_bytes(peak)} {verdict} "
+            f"{_fmt_bytes(budget)} "
+            f"(headroom {_fmt_bytes(headroom)})"
+        )
+    if report.findings:
+        out.append("\n== findings")
+        for f in report.findings:
+            out.append("  " + f.render())
+    else:
+        out.append("\nfindings: none — the declared plan holds")
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="human-readable shard plan + memory breakdown "
+        "(docs/analysis.md 'Sharding & memory passes')"
+    )
+    ap.add_argument("--target", choices=["resilient", "serve"],
+                    default=None)
+    ap.add_argument("--hlo", metavar="FILE", default=None)
+    ap.add_argument("--wire", default="f32",
+                    choices=["f32", "bf16", "int8"])
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--budget", type=int, default=None, metavar="BYTES")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--json", metavar="FILE", default=None)
+    ap.add_argument("--donated", type=int, default=None,
+                    help="declared donated-leaf count (--hlo mode)")
+    ap.add_argument("--expect", type=json.loads, default=None,
+                    metavar="JSON", help="collective expectations "
+                    "(forwarded to graph_lint's resilient target)")
+    args = ap.parse_args()
+
+    if bool(args.target) == bool(args.hlo):
+        ap.error("exactly one of --target / --hlo is required")
+
+    # reuse graph_lint's builders so THIS report and the CI gate can
+    # never describe different programs
+    try:
+        import graph_lint as gl  # python tools/shard_report.py
+    except ImportError:  # imported as tools.shard_report
+        from tools import graph_lint as gl
+
+    if args.hlo:
+        report = gl.lint_hlo_file(args)
+    elif args.target == "serve":
+        report = gl.lint_serve(args)
+    else:
+        report = gl.lint_resilient(args)
+
+    from apex_tpu import analysis
+
+    analysis.publish_report(report)
+
+    print(render(report, top=args.top, budget=args.budget))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=2)
+            f.write("\n")
+        print(f"[shard_report] wrote {args.json}", file=sys.stderr)
+    return 0 if report.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,10 +30,18 @@
 #
 # A fourth stage is the static-analysis gate (docs/analysis.md):
 # tools/repo_lint.py greps apex_tpu/ for banned source patterns in
-# jitted paths, and tools/graph_lint.py builds the resilient example's
-# ACTUAL compiled step and runs the apex_tpu.analysis passes over its
-# jaxpr + optimized HLO — any ERROR-severity finding (host transfer,
-# dropped donation, f64, collective mismatch) hard-fails.
+# jitted paths (incl. the sharding source rules: in_shardings=None,
+# unpinned shard_map contractions), and tools/graph_lint.py builds the
+# resilient example's ACTUAL compiled step and runs the
+# apex_tpu.analysis passes over its jaxpr + optimized HLO — any
+# ERROR-severity finding (host transfer, dropped donation, f64,
+# collective mismatch) hard-fails.  A sharding/memory gate (ISSUE 9)
+# then runs tools/shard_report.py against the same example on a MOCKED
+# 8-device mesh (--xla_force_host_platform_device_count=8): the
+# declared dp plan must prove out (params/scaler replicated, batch
+# sharded over dp, only the declared gradient sync compiled) with zero
+# ERRORs, and the static peak-HBM estimate must sit inside the 8 MiB
+# budget without drifting to zero — both directions of drift fail.
 #
 # A PERF stage guards the perf-observability contract
 # (docs/observability.md "Attribution & roofline"):
@@ -247,9 +255,44 @@ if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
         lint_rc=${PIPESTATUS[0]}
     fi
     if [ "$lint_rc" -eq 0 ]; then
+        # sharding & memory gate (ISSUE 9): prove the declared dp plan
+        # on a mocked 8-device mesh — zero ERRORs, budget headroom, and
+        # a non-degenerate estimate (peak 0 would mean the estimator
+        # silently stopped seeing buffers: drift in EITHER direction
+        # fails)
+        SHARD_JSON="${T1_SHARD_JSON:-/tmp/_t1_shard_report.json}"
+        SHARD_BUDGET=$((8 * 1024 * 1024))
+        timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python tools/shard_report.py --target resilient \
+            --budget "$SHARD_BUDGET" --json "$SHARD_JSON" \
+            2>&1 | tail -n 6 | tee -a "$LOG"
+        lint_rc=${PIPESTATUS[0]}
+        if [ "$lint_rc" -eq 0 ]; then
+            python - "$SHARD_JSON" "$SHARD_BUDGET" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+d = json.load(open(sys.argv[1]))
+budget = int(sys.argv[2])
+assert d["errors"] == 0, f"shard report carries {d['errors']} ERROR(s)"
+peak = d["peak_hbm_bytes"]
+assert 0 < peak <= budget, f"peak {peak} outside (0, {budget}] — estimator drift"
+rows = {(r["program"], r["name"]): r for r in d["shard_plan"]}
+w = rows[("resilient/compute_grads", "params/w")]
+assert w["verdict"] == "ok" and w["sharding"] == "replicated", w
+b0 = rows[("resilient/compute_grads", "batch/0")]
+assert b0["verdict"] == "ok" and "devices=" in b0["sharding"], b0
+for name in ("sharding", "reshard", "memory"):
+    assert name in d["pass_timings"], d["pass_timings"]
+print(f"shard report OK: peak_hbm={peak} bytes (budget {budget}), "
+      f"{len(d['shard_plan'])} plan rows, dp plan proven on the 8-device mesh")
+PYEOF
+            lint_rc=${PIPESTATUS[0]}
+        fi
+    fi
+    if [ "$lint_rc" -eq 0 ]; then
         echo "TIER1-LINT: PASS"
     else
-        echo "TIER1-LINT: FAIL (rc=$lint_rc; findings in ${LINT_JSON:-repo_lint output})"
+        echo "TIER1-LINT: FAIL (rc=$lint_rc; findings in ${LINT_JSON:-repo_lint output} / ${SHARD_JSON:-shard_report})"
     fi
 fi
 
