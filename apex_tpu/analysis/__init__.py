@@ -29,6 +29,12 @@ proves it statically, before a single step runs:
   estimate with top-K attribution and a budget gate
   (:mod:`apex_tpu.analysis.memory`): OOM is a lint ERROR before the
   first step runs.
+- **kernel passes** — the shipped Pallas kernels themselves
+  (:mod:`apex_tpu.analysis.kernels`): per-config VMEM footprint vs
+  the backend budget, tiling/MXU alignment, index-map grid
+  coverage/race, causal dead-tile waste, and a compile-free roofline
+  that ranks attention tile configs for ``tools/attn_tune.py
+  --prune``.
 
 Surfaces::
 
@@ -72,6 +78,7 @@ from apex_tpu.analysis.passes import (  # noqa: F401
     iter_eqns,
 )
 from apex_tpu.analysis import hlo  # noqa: F401
+from apex_tpu.analysis import kernels  # noqa: F401
 from apex_tpu.analysis import memory  # noqa: F401
 from apex_tpu.analysis import sharding  # noqa: F401
 from apex_tpu.analysis.sharding import (  # noqa: F401
@@ -97,6 +104,7 @@ __all__ = [
     "PASSES",
     "iter_eqns",
     "hlo",
+    "kernels",
     "memory",
     "sharding",
     "match_partition_rules",

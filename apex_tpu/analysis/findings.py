@@ -187,6 +187,67 @@ RULES: Dict[str, Tuple[str, str, str]] = {
         "jax.lax.with_sharding_constraint; verify with "
         "tools/shard_report.py",
     ),
+    "kernel-vmem-overflow": (
+        ERROR,
+        "a Pallas kernel config's static VMEM footprint "
+        "(double-buffered input/output blocks + scratch + in-kernel "
+        "intermediates at true dtype widths) exceeds the backend's "
+        "on-chip VMEM — Mosaic either fails to lower or spills, and "
+        "either way the config is dead on arrival",
+        "shrink block_q/block_k (the f32 score tile is the dominant "
+        "term: bytes ~ 4*block_q*block_k); tools/attn_tune.py --prune "
+        "drops such cells before they waste a compile",
+    ),
+    "kernel-tile-misaligned": (
+        ERROR,
+        "a kernel block shape violates the TPU tile quantum (last dim "
+        "a 128-lane multiple, second-to-last a dtype-sublane "
+        "multiple, full-axis blocks exempt), leaves a ragged tail the "
+        "kernel has no masking for, or feeds the 128x128 MXU a "
+        "non-128 contraction extent (sub-tile passes do dead work)",
+        "pick power-of-two tiles >= 128 that divide the padded "
+        "sequence; the caller-side padding contracts are "
+        "ops.attention._seq_pad / _pad_head_dim",
+    ),
+    "kernel-grid-oob": (
+        ERROR,
+        "a kernel BlockSpec index map, evaluated over the full grid, "
+        "produces a block offset outside the operand's block grid — "
+        "the DMA would read or write out of the array's bounds",
+        "fix the index map's arithmetic (or the grid extent that "
+        "drives it); the finding names the first offending grid cell",
+    ),
+    "kernel-block-race": (
+        ERROR,
+        "two grid cells that differ along a PARALLEL grid dimension "
+        "write the same output block — parallel dims carry no "
+        "ordering or accumulation semantics, so the result depends on "
+        "scheduling (revisits along 'arbitrary' dims accumulating in "
+        "scratch are the sanctioned pattern and do not flag)",
+        "make the racing grid axis 'arbitrary' in dimension_semantics "
+        "and accumulate in VMEM scratch with a final-iteration write, "
+        "or give each parallel cell a distinct output block",
+    ),
+    "kernel-dead-tiles": (
+        WARNING,
+        "a causal kernel config wastes more than the configured "
+        "fraction of its live-tile FLOPs on masked elements — tiles "
+        "straddling the causal boundary pay full matmuls for a "
+        "triangle of zeros (a whole-seq tile wastes ~50%)",
+        "smaller (or rectangular) tiles track the causal boundary "
+        "more tightly; weigh against per-tile grid overhead with "
+        "tools/attn_tune.py --prune --dry-run's predicted ranking",
+    ),
+    "kernel-hardcoded-block": (
+        WARNING,
+        "a call site passes a literal block_q=/block_k= tile size, "
+        "bypassing the tuned-tile lookup (APEX_TPU_TUNE_CACHE -> "
+        "_TUNED_TILES -> heuristic) — the number was right on one "
+        "chip/shape and silently wrong everywhere else",
+        "drop the literal so dispatch consults the tuning cache, or "
+        "commit the measured winner via tools/attn_tune.py "
+        "--cache-out / the _TUNED_TILES table",
+    ),
 }
 
 

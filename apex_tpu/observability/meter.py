@@ -32,9 +32,11 @@ __all__ = [
     "PEAK_HBM_GBPS",
     "PEAK_ICI_GBPS",
     "BUCKETS",
+    "VMEM_BYTES",
     "peak_flops_for",
     "peak_hbm_bandwidth_for",
     "peak_ici_bandwidth_for",
+    "vmem_bytes_for",
     "categorize_op",
     "chip_peak_flops",
     "total_peak_flops",
@@ -127,6 +129,31 @@ def chip_peak_flops(device) -> float:
     """Dense bf16 peak FLOP/s of one device object (delegates to
     :func:`peak_flops_for` on its ``device_kind``)."""
     return peak_flops_for(getattr(device, "device_kind", ""))
+
+
+#: Per-core VMEM bytes by device kind — the kernel static analyzer's
+#: (``apex_tpu.analysis.kernels``) overflow budget, kept in the same
+#: home as the FLOP/bandwidth peaks so every cost model shares one
+#: hardware table.  TPU generations to date all carry ~16 MiB of
+#: vector memory per core (the pallas guide's "~16 MB/core"); the
+#: conservative default means an overflow verdict on an unknown chip
+#: is a floor, not a lie.
+VMEM_BYTES = {
+    "TPU v5 lite": 16 * 1024 * 1024,  # v5e
+    "TPU v5e": 16 * 1024 * 1024,
+    "TPU v5p": 16 * 1024 * 1024,
+    "TPU v5": 16 * 1024 * 1024,
+    "TPU v4": 16 * 1024 * 1024,
+    "TPU v6 lite": 32 * 1024 * 1024,  # v6e (Trillium)
+}
+
+DEFAULT_VMEM_BYTES = 16 * 1024 * 1024
+
+
+def vmem_bytes_for(device_kind: str) -> int:
+    """Per-core VMEM budget for a device-kind string (the
+    kernel-vmem-overflow gate's denominator)."""
+    return int(_lookup(VMEM_BYTES, device_kind, DEFAULT_VMEM_BYTES))
 
 
 # ---------------------------------------------------------------------------

@@ -139,10 +139,13 @@ def _pad_head_dim(x):
     lowers an untiled trailing dim of any sublane-aligned size — so D = 64
     stays 64 (half the QK/PV FLOPs and HBM traffic of padding to 128;
     measured 2x end-to-end on the S=2048 MHA bench).  Only off-grid sizes
-    pad: to 8 below 128, to a lane multiple above.
+    pad: to 8 below 128, to a lane multiple above.  The arithmetic lives
+    in ``pallas.flash_attention.padded_head_dim`` — the pure-int form
+    the kernel analyzer and tuner share, so analysis can never assume a
+    different padding than dispatch applies.
     """
     d = x.shape[-1]
-    pad = (-d) % 8 if d <= _LANES else (-d) % _LANES
+    pad = _pallas.padded_head_dim(d) - d
     if pad:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
     return x

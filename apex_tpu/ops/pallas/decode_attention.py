@@ -53,6 +53,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import pallas_interpret
+from apex_tpu.ops.pallas import introspect
 from apex_tpu.ops.pallas.flash_attention import (
     _CompilerParams,
     _LANES,
@@ -62,7 +63,119 @@ from apex_tpu.ops.pallas.flash_attention import (
 # kernel body), so serving can never drift from the training rotation
 from apex_tpu.ops.rope import rotate_half
 
-__all__ = ["paged_decode_fwd"]
+__all__ = ["paged_decode_fwd", "kernel_specs"]
+
+
+# ---------------------------------------------------------------------------
+# Call plan — shared by dispatch and the static analyzer's
+# kernel_specs() export (see flash_attention.py's plan section).
+# ---------------------------------------------------------------------------
+
+
+def _decode_plan(
+    b, h, d, p_, page, np_, dtype, kv_dtype, *, has_scales, has_rope,
+):
+    in_specs = [
+        pl.BlockSpec((1, 1, h, d), lambda b, j, pt, ln: (b, 0, 0, 0)),
+        pl.BlockSpec(
+            (1, h, page, d), lambda b, j, pt, ln: (pt[b, j], 0, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, h, page, d), lambda b, j, pt, ln: (pt[b, j], 0, 0, 0)
+        ),
+    ]
+    in_names = ["q", "k_pages", "v_pages"]
+    in_shapes = [(b, 1, h, d), (p_, h, page, d), (p_, h, page, d)]
+    in_dtypes = [dtype, kv_dtype, kv_dtype]
+    if has_scales:
+        in_specs += [
+            pl.BlockSpec(
+                (1, h, page), lambda b, j, pt, ln: (pt[b, j], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, h, page), lambda b, j, pt, ln: (pt[b, j], 0, 0)
+            ),
+        ]
+        in_names += ["k_scale", "v_scale"]
+        in_shapes += [(p_, h, page), (p_, h, page)]
+        in_dtypes += [jnp.float32, jnp.float32]
+    if has_rope:
+        in_specs += [
+            pl.BlockSpec((1, 1, d), lambda b, j, pt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, 1, d), lambda b, j, pt, ln: (b, 0, 0)),
+        ]
+        in_names += ["rope_cos", "rope_sin"]
+        in_shapes += [(b, 1, d), (b, 1, d)]
+        in_dtypes += [dtype, dtype]
+    return dict(
+        grid=(b, np_),
+        in_specs=in_specs,
+        in_names=in_names,
+        in_shapes=in_shapes,
+        in_dtypes=in_dtypes,
+        out_specs=[pl.BlockSpec(
+            (1, 1, h, d), lambda b, j, pt, ln: (b, 0, 0, 0)
+        )],
+        out_names=["o"],
+        out_shape=[jax.ShapeDtypeStruct((b, 1, h, d), dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+        ],
+        dimension_semantics=("parallel", "arbitrary"),
+    )
+
+
+def kernel_specs(
+    b, h, d, *, pool_pages, page, pages_per_seq, dtype=jnp.bfloat16,
+    kv_wire="f32", rope=True, page_table=None,
+):
+    """Export the paged-decode kernel's :class:`introspect.KernelSpec`
+    without compiling.  The page-table indirection is resolved against
+    ``page_table`` (B, pages_per_seq) when given, else a synthetic
+    round-robin table over ``pool_pages`` — either way the index maps
+    under analysis are the REAL scalar-prefetch maps, evaluated on a
+    concrete table (the coverage pass proves every referenced page id
+    stays inside the pool)."""
+    import numpy as np
+
+    dtype = jnp.dtype(dtype)
+    kv_dtype = jnp.dtype(jnp.int8 if kv_wire == "int8" else dtype)
+    if page_table is None:
+        page_table = (
+            np.arange(b * pages_per_seq).reshape(b, pages_per_seq)
+            % max(pool_pages - 1, 1)
+        ) + 1  # skip the reserved null page 0, like live allocations
+    page_table = np.asarray(page_table)
+    lengths = np.full((b,), pages_per_seq * page, np.int32)
+    plan = _decode_plan(
+        b, h, d, pool_pages, page, pages_per_seq, dtype, kv_dtype,
+        has_scales=kv_wire == "int8", has_rope=rope,
+    )
+    # close the scalar-prefetch operands over the concrete table so the
+    # analyzer can call maps with grid indices alone
+    for key in ("in_specs", "out_specs"):
+        plan[key] = [
+            pl.BlockSpec(
+                spec.block_shape,
+                (lambda m: lambda b, j: m(b, j, page_table, lengths))(
+                    spec.index_map
+                ),
+            )
+            for spec in plan[key]
+        ]
+    spec = introspect.from_plan(
+        "paged_decode_fwd",
+        plan,
+        # head-batched q.K and p.V mat-vecs on the VPU
+        flops_per_cell=4.0 * h * page * d,
+        intermediates=(((h, page), jnp.float32), ((h, page), jnp.float32)),
+    )
+    # no matmul_dims meta: the score/PV contractions here are
+    # head-batched MAT-VECS on the VPU (module docstring) — the MXU
+    # 128-alignment lint does not apply, decode is HBM-bound by design
+    return [spec]
 
 
 def _decode_kernel(
@@ -179,31 +292,14 @@ def paged_decode_fwd(
         raise ValueError("rope_cos and rope_sin must be given together")
 
     # q as (B, 1, H, D) so its block carries an (H, D) tile per program
-    in_specs = [
-        pl.BlockSpec((1, 1, h, d), lambda b, j, pt, ln: (b, 0, 0, 0)),
-        pl.BlockSpec(
-            (1, h, page, d), lambda b, j, pt, ln: (pt[b, j], 0, 0, 0)
-        ),
-        pl.BlockSpec(
-            (1, h, page, d), lambda b, j, pt, ln: (pt[b, j], 0, 0, 0)
-        ),
-    ]
+    plan = _decode_plan(
+        b, h, d, p_, page, np_, q.dtype, k_pages.dtype,
+        has_scales=has_scales, has_rope=has_rope,
+    )
     args = [q[:, None], k_pages, v_pages]
     if has_scales:
-        in_specs += [
-            pl.BlockSpec(
-                (1, h, page), lambda b, j, pt, ln: (pt[b, j], 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, h, page), lambda b, j, pt, ln: (pt[b, j], 0, 0)
-            ),
-        ]
         args += [k_scale, v_scale]
     if has_rope:
-        in_specs += [
-            pl.BlockSpec((1, 1, d), lambda b, j, pt, ln: (b, 0, 0)),
-            pl.BlockSpec((1, 1, d), lambda b, j, pt, ln: (b, 0, 0)),
-        ]
         args += [rope_cos[:, None], rope_sin[:, None]]
 
     kernel = functools.partial(
@@ -212,23 +308,17 @@ def paged_decode_fwd(
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, np_),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, h, d), lambda b, j, pt, ln: (b, 0, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((h, d), jnp.float32),
-            pltpu.VMEM((h, _LANES), jnp.float32),
-            pltpu.VMEM((h, _LANES), jnp.float32),
-        ],
+        grid=plan["grid"],
+        in_specs=plan["in_specs"],
+        out_specs=plan["out_specs"][0],
+        scratch_shapes=plan["scratch_shapes"],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        out_shape=plan["out_shape"][0],
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=plan["dimension_semantics"],
         ),
         interpret=pallas_interpret(),
     )(

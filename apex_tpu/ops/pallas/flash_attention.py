@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import pallas_interpret
+from apex_tpu.ops.pallas import introspect, tune_cache
 
 # pinned-jax compat: the class was TPUCompilerParams before the rename
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
@@ -108,19 +109,66 @@ _TUNED_TILES: dict = {
 }
 
 
-def _tuned_tile(mode, sq, sk, d, causal):
-    """(bq, bk) from the tuned table, or (None, None) → heuristic.
+def _tuned_tile(mode, sq, sk, d, causal, dtype=None):
+    """(bq, bk) from the tuning cache or the source table, or
+    (None, None) → heuristic.
 
-    The table is keyed on the q-side shape; a tile is only returned if
-    it divides the ACTUAL axis it will tile (the kernels have no
-    partial-tile masking), so a self-attention-tuned entry can never
-    hand a non-dividing bk to a cross-attention call's sk."""
-    tq, tk = _TUNED_TILES.get((sq, d, causal), {}).get(mode) or (None, None)
+    Lookup order (docs/flash-roofline.md "tuning flow"): the on-disk
+    ``APEX_TPU_TUNE_CACHE`` artifact (``tune_cache.flash_tiles`` —
+    winners ``tools/attn_tune.py --cache-out`` persisted, keyed by
+    (shape, dtype, causal, backend)) wins over the committed
+    ``_TUNED_TILES`` source table.  Either way the table is keyed on
+    the q-side shape; a tile is only returned if it divides the ACTUAL
+    axis it will tile (the kernels have no partial-tile masking), so a
+    self-attention-tuned entry can never hand a non-dividing bk to a
+    cross-attention call's sk."""
+    pair = tune_cache.flash_tiles(mode, sq, d, causal, dtype)
+    if pair is None:
+        pair = _TUNED_TILES.get((sq, d, causal), {}).get(mode)
+    tq, tk = pair or (None, None)
     if tq and sq % tq:
         tq = None
     if tk and sk % tk:
         tk = None
     return tq, tk
+
+
+def _resolve_tiles(mode, sq, sk, d, causal, dtype, block_q, block_k):
+    """The ONE dispatch-time tile resolution — explicit override →
+    tuning cache / ``_TUNED_TILES`` → ``_auto_block`` heuristic —
+    shared by :func:`flash_fwd`, :func:`flash_bwd`, and the analyzer's
+    :func:`kernel_specs` export, so analysis can never resolve a
+    different tile than dispatch."""
+    tq, tk = _tuned_tile(mode, sq, sk, d, causal, dtype)
+    bq = min(block_q or tq, sq) if (block_q or tq) else _auto_block(sq, d)
+    bk = min(block_k or tk, sk) if (block_k or tk) else _auto_block(sk, d)
+    return bq, bk
+
+
+def _resolve_dq_tiles(
+    sq, sk, d, causal, dtype, block_q, block_k, bq, bk,
+    block_q_dq, block_k_dq,
+):
+    """The dq call's independent tiles (see :func:`flash_bwd`): an
+    explicit shared-tile choice suppresses the bwd_dq table entry so
+    tuner phase-1 sweeps measure what they pin."""
+    if block_q or block_k:
+        tq_dq = tk_dq = None
+    else:
+        tq_dq, tk_dq = _tuned_tile("bwd_dq", sq, sk, d, causal, dtype)
+    return (
+        min(block_q_dq or tq_dq or bq, sq),
+        min(block_k_dq or tk_dq or bk, sk),
+    )
+
+
+def padded_head_dim(d):
+    """Kernel-side head dim for a model-side ``d`` — the pure-int form
+    of ``ops.attention._pad_head_dim``'s padding contract (D ≤ 128
+    pads to the sublane quantum, wider pads to a lane multiple); the
+    analyzer and tuner derive kernel specs through this so they can
+    never disagree with the dispatcher's padding."""
+    return d + ((-d) % 8 if d <= _LANES else (-d) % _LANES)
 
 
 def _auto_block(seq, d):
@@ -139,13 +187,13 @@ def _auto_block(seq, d):
             return b
     return seq  # seq < 128 (callers guarantee seq % min(128, seq) == 0)
 
-def _bias_spec(bias, bh, bq, bk, order):
+def _bias_spec(bias_shape, bh, bq, bk, order):
     """BlockSpec for a (G, RS, Sk) bias (module docstring's layout).
 
     ``order`` is the grid layout: "ij" = (b, qblock, kblock) grids
     (forward, dq), "ji" = (b, kblock, qblock) (dk/dv).
     """
-    g, rs, _ = bias.shape
+    g, rs, _ = bias_shape
     if bh % g:
         raise ValueError(f"bias batch group {g} must divide BH={bh}")
     div = bh // g
@@ -206,6 +254,249 @@ def _causal_mask_block(i, j, bq, bk, offset):
     rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
     cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
     return rows + offset >= cols
+
+
+# ---------------------------------------------------------------------------
+# Call plans — the pallas_call arguments as pure functions of static
+# parameters.  flash_fwd/flash_bwd dispatch through these, and
+# kernel_specs() exports the SAME plans to the static analyzer
+# (apex_tpu.analysis.kernels), so the analyzed specs can never drift
+# from the dispatched ones.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_plan(bh, sq, sk, d, dtype, *, bq, bk, bias_shape=None,
+              has_seed=False):
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    in_names = ["q", "k", "v"]
+    in_shapes = [(bh, sq, d), (bh, sk, d), (bh, sk, d)]
+    in_dtypes = [dtype, dtype, dtype]
+    if bias_shape is not None:
+        in_specs.append(_bias_spec(bias_shape, bh, bq, bk, "ij"))
+        in_names.append("bias")
+        in_shapes.append(tuple(bias_shape))
+        in_dtypes.append(jnp.float32)
+    if has_seed:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        in_names.append("seed")
+        in_shapes.append((1,))
+        in_dtypes.append(jnp.int32)
+    return dict(
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        in_names=in_names,
+        in_shapes=in_shapes,
+        in_dtypes=in_dtypes,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_names=["o", "lse"],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+
+def _dkdv_plan(bh, sq, sk, d, dtypes, *, bq, bk, bias_shape=None,
+               has_seed=False):
+    """Grid (BH, nk, nq) — q innermost; dtypes = (q, k, v) dtypes."""
+    qd, kd, vd = dtypes
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    k_spec_j = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    row_spec_i = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0))
+    in_specs = [
+        q_spec_i, k_spec_j, k_spec_j, q_spec_i, row_spec_i, row_spec_i,
+    ]
+    in_names = ["q", "k", "v", "do", "lse", "delta"]
+    in_shapes = [
+        (bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sq, d),
+        (bh, sq, _LANES), (bh, sq, _LANES),
+    ]
+    in_dtypes = [qd, kd, vd, qd, jnp.float32, jnp.float32]
+    if bias_shape is not None:
+        in_specs.append(_bias_spec(bias_shape, bh, bq, bk, "ji"))
+        in_names.append("bias")
+        in_shapes.append(tuple(bias_shape))
+        in_dtypes.append(jnp.float32)
+    if has_seed:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        in_names.append("seed")
+        in_shapes.append((1,))
+        in_dtypes.append(jnp.int32)
+    return dict(
+        grid=(bh, nk, nq),
+        in_specs=in_specs,
+        in_names=in_names,
+        in_shapes=in_shapes,
+        in_dtypes=in_dtypes,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_names=["dk", "dv"],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), kd),
+            jax.ShapeDtypeStruct((bh, sk, d), vd),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+
+def _dq_plan(bh, sq, sk, d, dtypes, *, bq, bk, bias_shape=None,
+             has_seed=False):
+    """Grid (BH, nq, nk) — k innermost; dtypes = (q, k, v) dtypes."""
+    qd, kd, vd = dtypes
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0))
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    in_names = ["q", "k", "v", "do", "lse", "delta"]
+    in_shapes = [
+        (bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sq, d),
+        (bh, sq, _LANES), (bh, sq, _LANES),
+    ]
+    in_dtypes = [qd, kd, vd, qd, jnp.float32, jnp.float32]
+    if bias_shape is not None:
+        in_specs.append(_bias_spec(bias_shape, bh, bq, bk, "ij"))
+        in_names.append("bias")
+        in_shapes.append(tuple(bias_shape))
+        in_dtypes.append(jnp.float32)
+    if has_seed:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        in_names.append("seed")
+        in_shapes.append((1,))
+        in_dtypes.append(jnp.int32)
+    return dict(
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        in_names=in_names,
+        in_shapes=in_shapes,
+        in_dtypes=in_dtypes,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))],
+        out_names=["dq"],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), qd)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+
+_plan_spec = introspect.from_plan
+
+
+def kernel_specs(
+    bh, sq, sk, d, *, dtype=jnp.bfloat16, causal=True, block_q=None,
+    block_k=None, block_q_dq=None, block_k_dq=None, bias_shape=None,
+    dropout=False, causal_offset=None, modes=("fwd", "dkdv", "dq"),
+):
+    """Export :class:`introspect.KernelSpec` records for a flash
+    attention call — the static analyzer's view of exactly the
+    pallas_calls :func:`flash_fwd` / :func:`flash_bwd` would dispatch
+    at this configuration, without tracing or compiling anything.
+
+    Tile sizes resolve exactly like dispatch does (explicit override →
+    tuning cache → ``_TUNED_TILES`` → ``_auto_block``), so analyzing
+    the DEFAULT config analyzes what the bench actually runs.  ``d``
+    is the kernel-side head dim (callers pad via
+    ``ops.attention._pad_head_dim``); ``bias_shape`` is the kernel's
+    (G, RS, Sk) layout.  ``modes`` selects among "fwd", "dkdv", "dq".
+    """
+    dtype = jnp.dtype(dtype)
+    offset = causal_offset if causal_offset is not None else sk - sq
+    specs = []
+
+    def causal_meta(q_axis, k_axis, bq, bk, include_fully_masked):
+        if not causal:
+            return None
+        return {
+            "q_axis": q_axis, "k_axis": k_axis, "bq": bq, "bk": bk,
+            "offset": offset,
+            "include_fully_masked": include_fully_masked,
+        }
+
+    common = dict(bias_shape=bias_shape, has_seed=dropout)
+    if "fwd" in modes:
+        bq, bk = _resolve_tiles(
+            "fwd", sq, sk, d, causal, dtype, block_q, block_k
+        )
+        spec = _plan_spec(
+            "flash_fwd",
+            _fwd_plan(bh, sq, sk, d, dtype, bq=bq, bk=bk, **common),
+            flops_per_cell=4.0 * bq * bk * d,
+            # ONE (bq, bk) f32 score value at steady state: s is dead
+            # once p = exp(s - m) is formed (elementwise, buffer
+            # reusable), unlike the backward kernels where p must stay
+            # live across the dp dot.  Matches the measured fact that
+            # a (1024, 2048) fwd tile (8 MiB score) fits v5e
+            # (docs/flash-roofline.md) — 2x here would wrongly prune
+            # the ROADMAP's beyond-the-sweep-edge probe.
+            intermediates=(((bq, bk), jnp.float32),),
+            causal=causal_meta(1, 2, bq, bk, True),
+        )
+        spec.meta["matmul_dims"] = {"block_q": bq, "block_k": bk,
+                                    "head_dim": d}
+        specs.append(spec)
+    if "dkdv" in modes or "dq" in modes:
+        bq, bk = _resolve_tiles(
+            "bwd", sq, sk, d, causal, dtype, block_q, block_k
+        )
+        bq_dq, bk_dq = _resolve_dq_tiles(
+            sq, sk, d, causal, dtype, block_q, block_k, bq, bk,
+            block_q_dq, block_k_dq,
+        )
+        dtypes = (dtype, dtype, dtype)
+        if "dkdv" in modes:
+            spec = _plan_spec(
+                "flash_bwd_dkdv",
+                _dkdv_plan(bh, sq, sk, d, dtypes, bq=bq, bk=bk, **common),
+                # recompute s + (dv, dp, dk) dots = 4 MXU passes
+                flops_per_cell=8.0 * bq * bk * d,
+                # peak concurrent (bq, bk) f32 values is 2 (p stays
+                # live across the dp dot; ds reuses dp's buffer) —
+                # the measured (1024, 1024) v5e config must fit
+                intermediates=(
+                    ((bq, bk), jnp.float32), ((bq, bk), jnp.float32),
+                ),
+                causal=causal_meta(2, 1, bq, bk, True),
+            )
+            spec.meta["matmul_dims"] = {"block_q": bq, "block_k": bk,
+                                        "head_dim": d}
+            specs.append(spec)
+        if "dq" in modes:
+            spec = _plan_spec(
+                "flash_bwd_dq",
+                _dq_plan(
+                    bh, sq, sk, d, dtypes, bq=bq_dq, bk=bk_dq, **common
+                ),
+                flops_per_cell=6.0 * bq_dq * bk_dq * d,
+                intermediates=(
+                    ((bq_dq, bk_dq), jnp.float32),
+                    ((bq_dq, bk_dq), jnp.float32),
+                ),
+                causal=causal_meta(1, 2, bq_dq, bk_dq, False),
+            )
+            spec.meta["matmul_dims"] = {"block_q": bq_dq, "block_k": bk_dq,
+                                        "head_dim": d}
+            specs.append(spec)
+    return specs
 
 
 # ---------------------------------------------------------------------------
@@ -341,20 +632,19 @@ def flash_fwd(
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
-    tq, tk = _tuned_tile("fwd", sq, sk, d, causal)
-    bq = min(block_q or tq, sq) if (block_q or tq) else _auto_block(sq, d)
-    bk = min(block_k or tk, sk) if (block_k or tk) else _auto_block(sk, d)
-    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
-    grid = (bh, nq, nk)
+    bq, bk = _resolve_tiles(
+        "fwd", sq, sk, d, causal, q.dtype, block_q, block_k
+    )
+    nk = pl.cdiv(sk, bk)
     offset = causal_offset if causal_offset is not None else sk - sq
     if dropout_p > 0.0 and dropout_seed is None:
         raise ValueError("dropout_p > 0 requires dropout_seed")
 
-    in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-    ]
+    plan = _fwd_plan(
+        bh, sq, sk, d, q.dtype, bq=bq, bk=bk,
+        bias_shape=None if bias is None else bias.shape,
+        has_seed=dropout_p > 0.0,
+    )
     args = [q, k, v]
     common = dict(
         scale=scale, causal=causal, bq=bq, bk=bk, nk=nk, offset=offset,
@@ -362,34 +652,22 @@ def flash_fwd(
         has_bias=bias is not None, has_seed=dropout_p > 0.0,
     )
     if bias is not None:
-        in_specs.append(_bias_spec(bias, bh, bq, bk, "ij"))
         args.append(bias)
     # The seed operand exists ONLY on dropout runs, so the (on-chip
     # proven) no-dropout kernels keep their exact operand signature.
     if dropout_p > 0.0:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
     kernel = functools.partial(_fwd_entry, **common)
 
     return pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-        ],
+        grid=plan["grid"],
+        in_specs=plan["in_specs"],
+        out_specs=plan["out_specs"],
+        out_shape=plan["out_shape"],
+        scratch_shapes=plan["scratch_shapes"],
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=plan["dimension_semantics"],
         ),
         interpret=pallas_interpret(),
     )(*args)
@@ -625,20 +903,14 @@ def flash_bwd(
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
-    tq, tk = _tuned_tile("bwd", sq, sk, d, causal)
-    bq = min(block_q or tq, sq) if (block_q or tq) else _auto_block(sq, d)
-    bk = min(block_k or tk, sk) if (block_k or tk) else _auto_block(sk, d)
+    bq, bk = _resolve_tiles(
+        "bwd", sq, sk, d, causal, q.dtype, block_q, block_k
+    )
     nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
-    if block_q or block_k:
-        # caller pinned the shared tiles: keep the documented contract
-        # (dq tiles default to block_q/block_k) — a bwd_dq table entry
-        # must not silently override an explicit choice, or tuner
-        # phase-1 sweeps would mis-measure once an entry is committed
-        tq_dq = tk_dq = None
-    else:
-        tq_dq, tk_dq = _tuned_tile("bwd_dq", sq, sk, d, causal)
-    bq_dq = min(block_q_dq or tq_dq or bq, sq)
-    bk_dq = min(block_k_dq or tk_dq or bk, sk)
+    bq_dq, bk_dq = _resolve_dq_tiles(
+        sq, sk, d, causal, q.dtype, block_q, block_k, bq, bk,
+        block_q_dq, block_k_dq,
+    )
     nq_dq, nk_dq = pl.cdiv(sq, bq_dq), pl.cdiv(sk, bk_dq)
     offset = causal_offset if causal_offset is not None else sk - sq
     sk_total = sk
@@ -659,13 +931,9 @@ def flash_bwd(
         delta_rows = delta_rows - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta_rows[..., None], lse.shape)
 
-    q_spec_i = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
-    k_spec_j = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    row_spec_i = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0))
-    seed_specs = (
-        [pl.BlockSpec(memory_space=pltpu.SMEM)] if has_seed else []
-    )
     common = [q, k, v, do, lse, delta]
+    dtypes = (q.dtype, k.dtype, v.dtype)
+    bias_shape = None if bias is None else bias.shape
     kern_kw = dict(
         scale=scale, causal=causal, bq=bq, bk=bk,
         prec=_dot_precision(q.dtype), sk_total=sk_total,
@@ -673,62 +941,52 @@ def flash_bwd(
     )
 
     # --- dk/dv: grid (BH, nk, nq), q innermost ---
-    in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, row_spec_i, row_spec_i]
+    plan = _dkdv_plan(
+        bh, sq, sk, d, dtypes, bq=bq, bk=bk, bias_shape=bias_shape,
+        has_seed=has_seed,
+    )
     args = list(common)
     if bias is not None:
-        in_specs.append(_bias_spec(bias, bh, bq, bk, "ji"))
         args.append(bias)
-    in_specs += seed_specs
     args += seed_args
     dkdv_kernel = functools.partial(
         _dkdv_entry, nq=nq, offset=offset, **kern_kw
     )
     dk, dv = pl.pallas_call(
         dkdv_kernel,
-        grid=(bh, nk, nq),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
-        ],
+        grid=plan["grid"],
+        in_specs=plan["in_specs"],
+        out_specs=plan["out_specs"],
+        out_shape=plan["out_shape"],
+        scratch_shapes=plan["scratch_shapes"],
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=plan["dimension_semantics"],
         ),
         interpret=pallas_interpret(),
     )(*args)
 
     # --- dq: grid (BH, nq, nk), k innermost; independent tile sizes ---
     kern_kw_dq = dict(kern_kw, bq=bq_dq, bk=bk_dq)
-    q_spec = pl.BlockSpec((1, bq_dq, d), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, bk_dq, d), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, bq_dq, _LANES), lambda b, i, j: (b, i, 0))
-    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    plan = _dq_plan(
+        bh, sq, sk, d, dtypes, bq=bq_dq, bk=bk_dq, bias_shape=bias_shape,
+        has_seed=has_seed,
+    )
     args = list(common)
     if bias is not None:
-        in_specs.append(_bias_spec(bias, bh, bq_dq, bk_dq, "ij"))
         args.append(bias)
-    in_specs += seed_specs
     args += seed_args
     dq_kernel = functools.partial(
         _dq_entry, nk=nk_dq, offset=offset, **kern_kw_dq
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, nq_dq, nk_dq),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq_dq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq_dq, d), jnp.float32)],
+        grid=plan["grid"],
+        in_specs=plan["in_specs"],
+        out_specs=plan["out_specs"][0],
+        out_shape=plan["out_shape"][0],
+        scratch_shapes=plan["scratch_shapes"],
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=plan["dimension_semantics"],
         ),
         interpret=pallas_interpret(),
     )(*args)

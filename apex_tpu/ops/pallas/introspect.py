@@ -1,0 +1,206 @@
+"""Introspectable kernel specs — the static mirror of a ``pallas_call``.
+
+The Pallas kernels in this package are opaque to the graph linter: by
+the time ``apex_tpu.analysis`` sees a step, each kernel is one
+``custom-call`` whose BlockSpecs, grid, and scratch shapes are gone.
+This module is the export side of the fix (ISSUE 10): every kernel
+module builds its ``pallas_call`` arguments through a *plan* function
+of static parameters only, and wraps the same plan into a
+:class:`KernelSpec` — so the analyzer
+(:mod:`apex_tpu.analysis.kernels`) reasons about exactly the specs the
+real call dispatches, without compiling anything.
+
+A :class:`KernelSpec` carries:
+
+- the grid and per-operand :class:`BlockArg` records (full array
+  shape, block shape, the REAL index-map callable, dtype) for inputs
+  and outputs;
+- scratch and declared in-kernel intermediate buffers (shape, dtype)
+  — intermediates are the register-allocated values a pass cannot see
+  in any BlockSpec (e.g. the f32 score tile of flash attention), and
+  the dominant VMEM term at large tiles;
+- ``dimension_semantics`` (which grid axes are "parallel" vs the
+  sequential "arbitrary" axes the kernels accumulate over) — what the
+  race pass needs to tell a reduction revisit from a genuine
+  double-write;
+- ``flops_per_cell`` and optional causal-tile geometry for the
+  dead-tile and roofline passes.
+
+Everything here is plain data + stdlib dataclasses; jax is only
+touched by the kernel modules that build the specs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = ["BlockArg", "KernelSpec", "from_plan"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockArg:
+    """One blocked operand of a kernel: an input or output array.
+
+    ``block`` / ``index_map`` are ``None`` for operands that bypass the
+    block pipeline (SMEM scalars, scalar-prefetch arguments) — those
+    contribute nothing to the VMEM block model and are skipped by the
+    coverage pass.  ``index_map`` takes the grid indices (python ints
+    work) and returns the BLOCK offsets, exactly the callable handed to
+    ``pl.BlockSpec``.
+    """
+
+    name: str
+    shape: Tuple[int, ...]
+    block: Optional[Tuple[int, ...]]
+    index_map: Optional[Callable]
+    dtype: str
+    memory_space: str = "vmem"  # "vmem" | "smem"
+
+    def block_bytes(self) -> int:
+        if self.block is None:
+            return 0
+        n = 1
+        for d in self.block:
+            n *= int(d)
+        return n * dtype_width(self.dtype)
+
+
+@dataclasses.dataclass
+class KernelSpec:
+    """The analyzable shape of one ``pallas_call``.
+
+    ``scratch`` and ``intermediates`` are ``((shape, dtype), ...)``
+    tuples; ``causal`` (when set) is the tile geometry the dead-tile
+    pass feeds to ``_causal_block_live``::
+
+        {"q_axis": <grid axis of q blocks>, "k_axis": <grid axis of k
+         blocks>, "bq": int, "bk": int, "offset": int,
+         "include_fully_masked": bool}
+
+    ``meta`` carries free-form facts passes key on (today:
+    ``matmul_dims`` — the MXU contraction extents the tiling lint
+    judges against the 128x128 systolic array).
+    """
+
+    name: str
+    grid: Tuple[int, ...]
+    inputs: Tuple[BlockArg, ...]
+    outputs: Tuple[BlockArg, ...]
+    scratch: Tuple[Tuple[Tuple[int, ...], str], ...] = ()
+    dimension_semantics: Tuple[str, ...] = ()
+    flops_per_cell: float = 0.0
+    intermediates: Tuple[Tuple[Tuple[int, ...], str], ...] = ()
+    causal: Optional[dict] = None
+    meta: Dict = dataclasses.field(default_factory=dict)
+
+    def cells(self) -> int:
+        n = 1
+        for g in self.grid:
+            n *= int(g)
+        return n
+
+    def blocked(self) -> Tuple[BlockArg, ...]:
+        return tuple(
+            a for a in tuple(self.inputs) + tuple(self.outputs)
+            if a.block is not None
+        )
+
+
+_WIDTHS = {
+    # width-table KEY, not an f64 value in a traced path
+    "float64": 8, "int64": 8, "uint64": 8,  # repo-lint: allow
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "bool": 1,
+}
+
+
+def dtype_width(dtype) -> int:
+    """Bytes per element for a dtype or dtype-name string (stdlib-only
+    so the analyzer never needs a live jax for arithmetic)."""
+    name = getattr(dtype, "name", None) or str(dtype)
+    try:
+        return _WIDTHS[name]
+    except KeyError:
+        raise ValueError(f"unknown dtype {name!r} in a kernel spec")
+
+
+def buffer_bytes(shape, dtype) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype_width(dtype)
+
+
+def _dtype_name(dtype) -> str:
+    import numpy as np
+
+    return np.dtype(dtype).name
+
+
+def from_plan(
+    name: str,
+    plan: dict,
+    *,
+    flops_per_cell: float = 0.0,
+    intermediates=(),
+    causal: Optional[dict] = None,
+) -> KernelSpec:
+    """Wrap a kernel module's *call plan* into a :class:`KernelSpec`.
+
+    A plan is the dict the kernel modules build their ``pallas_call``
+    from: ``grid``, ``in_specs``/``out_specs`` (``pl.BlockSpec``
+    objects), ``in_names``/``in_shapes``/``in_dtypes``, ``out_names``,
+    ``out_shape`` (``ShapeDtypeStruct``), ``scratch_shapes``
+    (``pltpu.VMEM`` refs), and ``dimension_semantics``.  Sharing the
+    plan between dispatch and export is the whole point: the analyzer
+    sees the index maps the hardware runs.
+    """
+
+    def block_arg(arg_name, shape, spec, dtype):
+        block = getattr(spec, "block_shape", None)
+        if block is None:
+            return BlockArg(
+                name=arg_name, shape=tuple(int(x) for x in shape),
+                block=None, index_map=None, dtype=_dtype_name(dtype),
+                memory_space="smem",
+            )
+        return BlockArg(
+            name=arg_name, shape=tuple(int(x) for x in shape),
+            block=tuple(int(x) for x in block),
+            index_map=spec.index_map, dtype=_dtype_name(dtype),
+        )
+
+    inputs = tuple(
+        block_arg(n, s, spec, dt)
+        for n, s, spec, dt in zip(
+            plan["in_names"], plan["in_shapes"], plan["in_specs"],
+            plan["in_dtypes"],
+        )
+    )
+    outputs = tuple(
+        block_arg(n, sd.shape, spec, sd.dtype)
+        for n, sd, spec in zip(
+            plan["out_names"], plan["out_shape"], plan["out_specs"]
+        )
+    )
+    scratch = tuple(
+        (tuple(int(x) for x in ref.shape), _dtype_name(ref.dtype))
+        for ref in plan["scratch_shapes"]
+    )
+    return KernelSpec(
+        name=name,
+        grid=tuple(int(g) for g in plan["grid"]),
+        inputs=inputs,
+        outputs=outputs,
+        scratch=scratch,
+        dimension_semantics=tuple(plan["dimension_semantics"]),
+        flops_per_cell=float(flops_per_cell),
+        intermediates=tuple(
+            (tuple(int(x) for x in shape), _dtype_name(dt))
+            for shape, dt in intermediates
+        ),
+        causal=None if causal is None else dict(causal),
+    )

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from apex_tpu.ops._dispatch import pallas_interpret
+from apex_tpu.ops.pallas import introspect, tune_cache
 
 _VMEM_BUDGET_PER_BUF = 360_000  # bytes of f32 per row-block buffer (heuristic)
 
@@ -47,7 +48,12 @@ _TUNED_BLOCK_ROWS: dict = {
 
 
 def _block_rows(rows: int, hidden: int) -> int:
-    br = _TUNED_BLOCK_ROWS.get(hidden)
+    # same lookup order as flash_attention._tuned_tile: the on-disk
+    # APEX_TPU_TUNE_CACHE artifact wins over the committed source
+    # table, then the VMEM-budget heuristic
+    br = tune_cache.layer_norm_block_rows(hidden)
+    if br is None:
+        br = _TUNED_BLOCK_ROWS.get(hidden)
     if br is None:
         br = (_VMEM_BUDGET_PER_BUF // max(hidden, 1)) // 8 * 8
         br = max(8, min(256, br))
@@ -126,6 +132,107 @@ def _ln_bwd_kernel(
     dbp_ref[...] = jnp.concatenate([db_part[None], zeros7], axis=1)
 
 
+# ---------------------------------------------------------------------------
+# Call plans — pallas_call arguments as pure functions of static
+# parameters; dispatch and the static analyzer's kernel_specs() export
+# share them (see flash_attention.py's plan section).
+# ---------------------------------------------------------------------------
+
+
+def _fwd_plan(rows, hidden, dtypes, *, br):
+    xd, wd, bd = dtypes
+    return dict(
+        grid=(pl.cdiv(rows, br),),
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+        ],
+        in_names=["x", "w", "b"],
+        in_shapes=[(rows, hidden), (1, hidden), (1, hidden)],
+        in_dtypes=[xd, wd, bd],
+        out_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_names=["y", "mu", "rstd"],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), xd),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        scratch_shapes=[],
+        dimension_semantics=("parallel",),
+    )
+
+
+def _bwd_plan(rows, hidden, dtypes, *, br):
+    xd, wd, bd = dtypes
+    nblocks = pl.cdiv(rows, br)
+    return dict(
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+        ],
+        in_names=["x", "w", "b", "mu", "rstd", "g"],
+        in_shapes=[
+            (rows, hidden), (1, hidden), (1, hidden), (rows, 1),
+            (rows, 1), (rows, hidden),
+        ],
+        in_dtypes=[xd, wd, bd, jnp.float32, jnp.float32, xd],
+        out_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0)),
+        ],
+        out_names=["dx", "dw_partial", "db_partial"],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), xd),
+            jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
+        ],
+        scratch_shapes=[],
+        dimension_semantics=("parallel",),
+    )
+
+
+def kernel_specs(
+    rows, hidden, *, dtype=jnp.bfloat16, block_rows=None,
+    modes=("fwd", "bwd"),
+):
+    """Export :class:`introspect.KernelSpec` records for the fused
+    layer-norm kernels at this configuration — the static analyzer's
+    compile-free view (mirrors ``flash_attention.kernel_specs``).
+    The row-block resolves exactly like dispatch (override → tuning
+    cache → ``_TUNED_BLOCK_ROWS`` → VMEM heuristic)."""
+    dtype = jnp.dtype(dtype)
+    br = block_rows or _block_rows(rows, hidden)
+    dtypes = (dtype, dtype, dtype)
+    specs = []
+    if "fwd" in modes:
+        specs.append(introspect.from_plan(
+            "layer_norm_fwd",
+            _fwd_plan(rows, hidden, dtypes, br=br),
+            # VPU row reductions: ~8 passes over the (br, hidden) block
+            # (mean, var, rsqrt-normalize, scale+shift)
+            flops_per_cell=8.0 * br * hidden,
+        ))
+    if "bwd" in modes:
+        specs.append(introspect.from_plan(
+            "layer_norm_bwd",
+            _bwd_plan(rows, hidden, dtypes, br=br),
+            flops_per_cell=12.0 * br * hidden,
+            intermediates=(((br, hidden), jnp.float32),),
+        ))
+    return specs
+
+
 @functools.partial(jax.jit, static_argnames=("eps", "rms", "block_rows"))
 def layer_norm_fwd(x2d, w, b, *, eps: float, rms: bool, block_rows=None):
     """Returns (y, mu, rstd); mu/rstd are f32 of shape (rows, 1).
@@ -134,25 +241,13 @@ def layer_norm_fwd(x2d, w, b, *, eps: float, rms: bool, block_rows=None):
     tools/ln_tune.py to build ``_TUNED_BLOCK_ROWS``)."""
     rows, hidden = x2d.shape
     br = block_rows or _block_rows(rows, hidden)
-    grid = (pl.cdiv(rows, br),)
+    plan = _fwd_plan(rows, hidden, (x2d.dtype, w.dtype, b.dtype), br=br)
     return pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps, rms=rms),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-        ],
+        grid=plan["grid"],
+        in_specs=plan["in_specs"],
+        out_specs=plan["out_specs"],
+        out_shape=plan["out_shape"],
         interpret=pallas_interpret(),
     )(x2d, w.reshape(1, hidden), b.reshape(1, hidden))
 
@@ -171,7 +266,7 @@ def layer_norm_bwd(
     """
     rows, hidden = x2d.shape
     br = block_rows or _block_rows(rows, hidden)
-    nblocks = pl.cdiv(rows, br)
+    plan = _bwd_plan(rows, hidden, (x2d.dtype, w.dtype, b.dtype), br=br)
     kernel = functools.partial(
         _ln_bwd_kernel,
         rows=rows,
@@ -181,25 +276,10 @@ def layer_norm_bwd(
     )
     dx, dwp, dbp = pl.pallas_call(
         kernel,
-        grid=(nblocks,),
-        in_specs=[
-            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
-            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
-        ],
+        grid=plan["grid"],
+        in_specs=plan["in_specs"],
+        out_specs=plan["out_specs"],
+        out_shape=plan["out_shape"],
         interpret=pallas_interpret(),
     )(x2d, w.reshape(1, hidden), b.reshape(1, hidden), mu, rstd, g)
     return dx, jnp.sum(dwp[:, 0, :], axis=0), jnp.sum(dbp[:, 0, :], axis=0)

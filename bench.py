@@ -386,6 +386,27 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
             ),
             None,
         )
+        # the kernel half of the linter (ISSUE 10): the three shipped
+        # Pallas kernels at their default configs, judged compile-free
+        # (VMEM/tiling/coverage/dead-tiles — docs/analysis.md "Kernel
+        # passes"); ERROR count rides the bench_diff schema so a
+        # kernel-config regression gates like shard errors do
+        krep = analysis.kernels.analyze_default_kernels()
+        analysis.kernels.publish_kernel_report(krep)
+        kernel_waste = max(
+            [
+                (e.get("dead_tiles") or {}).get("waste_fraction", 0.0)
+                for e in krep.sections["kernels"]
+            ] or [0.0]
+        )
+        _emit(
+            "graph_lint_kernel_errors",
+            float(len(krep.errors())),
+            "kernel-pass ERROR findings (flash/layer_norm/decode "
+            "defaults; warnings=%d; causal dead-tile waste=%.3f; "
+            "docs/analysis.md)" % (len(krep.warnings()), kernel_waste),
+            None,
+        )
 
     profile = apex_tpu.utils.trace(trace_dir) if trace_dir else None
     step_time, carry, loss = _time_chunks(

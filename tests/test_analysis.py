@@ -335,6 +335,9 @@ def test_every_rule_is_cataloged_and_catalog_is_complete():
         "sharding-unverified", "reshard-unplanned", "reshard-plan",
         "memory-budget", "sharding-implicit-replication",
         "sharding-missing-constraint",
+        "kernel-vmem-overflow", "kernel-tile-misaligned",
+        "kernel-grid-oob", "kernel-block-race", "kernel-dead-tiles",
+        "kernel-hardcoded-block",
     }
     for rule, (sev, desc, hint) in analysis.RULES.items():
         assert sev in (analysis.ERROR, analysis.WARNING, analysis.INFO)

@@ -7,6 +7,22 @@ This sweeps (block_q, block_k) on the real chip for the two bench-critical
 shapes (BASELINE #4 mha and the long-context config) plus fwd-only and
 fwd+bwd, prints TFLOP/s per cell, and flags where the heuristic loses.
 
+``--prune`` runs the compile-free kernel analyzer
+(``apex_tpu.analysis.kernels``) over every cell FIRST: infeasible
+configs (VMEM overflow, tile misalignment, non-dividing blocks) and
+cells the cost model predicts ``--prune-ratio``x slower than the best
+predicted cell are dropped before paying their compile; the survivors
+are ranked by predicted TFLOP/s.  ``--prune --dry-run`` prints the
+KEEP/PRUNE table and exits without touching a device (the
+verify_tier1.sh smoke).  The model's ranking is validated against the
+recorded v5e sweeps (tests/data/attn_sweep_r05.json): every recorded
+cell within 5% of the measured best survives pruning.
+
+``--cache-out FILE`` persists each sweep's measured winner into the
+on-disk tuning cache (``apex_tpu.ops.pallas.tune_cache`` schema) —
+point ``APEX_TPU_TUNE_CACHE`` at the file and ``_tuned_tile`` consults
+it at dispatch, no source edit needed.
+
 Run (on a TPU host):  python tools/attn_tune.py [--shapes mha,long]
 """
 
@@ -107,7 +123,84 @@ def _time_scan(step, q, k, v, iters=8, trials=3):
     return times[len(times) // 2]
 
 
-def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v, floor=None):
+#: sweep flavor -> the kernel_specs modes whose predicted times the
+#: prune model sums (what each sweep actually dispatches per cell)
+_PRUNE_MODES = {
+    "fwd": ("fwd",),
+    "fwd+bwd": ("fwd", "dkdv", "dq"),
+    "bwd-only": ("dkdv", "dq"),
+    # the bwd-only PHASE-2 sweep varies the dq call's tiles alone
+    # (dkdv pinned at its winner), so its prune must price the dq
+    # kernel alone — a cell whose dkdv is slow can still hold the
+    # best dq tile (the committed mha entry is exactly that shape)
+    "dq-only": ("dq",),
+}
+
+
+def _prune_verdicts(name, sweep_mode, blocks, ratio, device_kind):
+    """Model verdict per (bq, bk) cell: ("KEEP"|"PRUNE", prediction,
+    reason).  ``sweep_mode`` keys :data:`_PRUNE_MODES` so the model
+    prices exactly the kernels that sweep flavor times (a bwd-only
+    sweep must not prune on a fwd prediction it never measures).
+    Infeasible = any ERROR finding from the kernel passes;
+    model-dominated = predicted time beyond ``ratio``x the best
+    feasible cell's."""
+    from apex_tpu.analysis import kernels as ka
+
+    b, h, sq, d, causal = SHAPES[name]
+    dk = fa.padded_head_dim(d)
+    modes = _PRUNE_MODES[sweep_mode]
+    preds = {}
+    for bq in blocks:
+        if bq > sq or sq % bq:
+            continue
+        for bk in blocks:
+            if bk > sq or sq % bk:
+                continue
+            specs = fa.kernel_specs(
+                b * h, sq, sq, dk, causal=causal, block_q=bq,
+                block_k=bk, modes=modes,
+            )
+            preds[(bq, bk)] = ka.predict_config(
+                specs, device_kind=device_kind
+            )
+    feasible = [p["time_s"] for p in preds.values() if p["feasible"]]
+    best = min(feasible) if feasible else None
+    verdicts = {}
+    for cell, p in preds.items():
+        if not p["feasible"]:
+            verdicts[cell] = (
+                "PRUNE", p,
+                "infeasible: " + ",".join(p["report"].rule_ids()),
+            )
+        elif best is not None and p["time_s"] > ratio * best:
+            verdicts[cell] = (
+                "PRUNE", p,
+                f"model-dominated ({p['time_s'] / best:.2f}x best "
+                f"predicted)",
+            )
+        else:
+            verdicts[cell] = ("KEEP", p, "")
+    return verdicts
+
+
+def _print_verdicts(name, mode, verdicts, ratio):
+    kept = sum(1 for v, _, _ in verdicts.values() if v == "KEEP")
+    print(f"\n== {name} {SHAPES[name]} {mode} — model prune "
+          f"(ratio {ratio}x): keep {kept}/{len(verdicts)} ==")
+    print(f"{'':>5} {'bq':>5} {'bk':>5} {'pred ms':>9} {'pred TF/s':>9}"
+          "  reason")
+    by_time = sorted(
+        verdicts.items(), key=lambda kv: kv[1][1]["time_s"]
+    )
+    for (bq, bk), (verdict, p, reason) in by_time:
+        print(f"{verdict:>5} {bq:5d} {bk:5d} {p['time_s'] * 1e3:9.2f} "
+              f"{p['tflops']:9.1f}  {reason}")
+
+
+def _grid_sweep(
+    name, mode, make_step, flops, sq, d, q, k, v, floor=None, keep=None
+):
     """Shared (bq, bk) grid driver: divisibility filter, timing,
     FAILED formatting, best tracking, auto-heuristic footer.
     ``make_step(bq, bk)`` returns a q-shaped-output step for
@@ -119,6 +212,10 @@ def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v, floor=None):
     under its floor is physically impossible — it means the remote
     runtime under-waited at a *plausible* sub-peak rate the absolute
     gate cannot catch — so it is flagged and excluded from winners.
+
+    ``keep`` (from :func:`_prune_verdicts`) restricts the sweep to the
+    model-approved cells — pruned cells print and skip, paying neither
+    compile nor device time.
 
     Returns ``(best, times)`` where ``times`` maps every successfully
     timed cell (flagged ones included) to its seconds, so a fwd sweep's
@@ -133,6 +230,9 @@ def _grid_sweep(name, mode, make_step, flops, sq, d, q, k, v, floor=None):
             continue
         for bk in BLOCKS:
             if bk > sq or sq % bk:
+                continue
+            if keep is not None and (bq, bk) not in keep:
+                print(f"{bq:5d} {bk:5d}   PRUNED  (model; --prune)")
                 continue
             try:
                 t = _time_scan(make_step(bq, bk), q, k, v)
@@ -176,7 +276,7 @@ def _qkv(name):
     return b, h, q, k, v, sq, d, causal, d ** -0.5
 
 
-def sweep(name, bwd, floor=None):
+def sweep(name, bwd, floor=None, keep=None):
     b, h, q, k, v, sq, d, causal, scale = _qkv(name)
     flops = _flops(b, h, sq, d, causal, bwd)
 
@@ -208,11 +308,12 @@ def sweep(name, bwd, floor=None):
 
     mode = "fwd+bwd" if bwd else "fwd"
     return _grid_sweep(
-        name, mode, make_step, flops, sq, d, q, k, v, floor=floor
+        name, mode, make_step, flops, sq, d, q, k, v, floor=floor,
+        keep=keep,
     )
 
 
-def sweep_bwd_only(name):
+def sweep_bwd_only(name, keep=None, keep_dq=None):
     """Isolate the backward kernels (dkdv + dq pallas_calls, ~2/3 of a
     train step's attention time): time ``flash_bwd`` alone against
     constant precomputed (o, lse, do).  Values are garbage after the
@@ -238,7 +339,9 @@ def sweep_bwd_only(name):
             return dq + (dk + dv) * jnp.asarray(1e-8, dq.dtype)
         return step
 
-    best, _ = _grid_sweep(name, "bwd-only", make_step, flops, sq, d, q, k, v)
+    best, _ = _grid_sweep(
+        name, "bwd-only", make_step, flops, sq, d, q, k, v, keep=keep
+    )
 
     # Explicit config dict on EVERY path so consumers can't misread
     # which pair is which: apply as flash_bwd(block_q=.., block_k=..,
@@ -263,12 +366,33 @@ def sweep_bwd_only(name):
     best_dq, _ = _grid_sweep(
         name, f"bwd-only dq-tiles (dkdv pinned {dkdv_bq},{dkdv_bk})",
         make_step_dq, flops, sq, d, q, k, v,
+        keep=keep_dq if keep_dq is not None else keep,
     )
     if best_dq[0] is None:
         # every phase-2 cell failed: the shared-tile phase-1 winner is
         # still a valid measured config — don't discard it
         return {"dkdv": best[0], "dq": best[0], "tflops": best[1]}
     return {"dkdv": best[0], "dq": best_dq[0], "tflops": best_dq[1]}
+
+
+def _persist_winner(cache_out, name, tiles):
+    """Write a sweep's measured winner(s) into the on-disk tuning
+    cache — the artifact ``_tuned_tile`` consults at dispatch."""
+    from apex_tpu.ops.pallas import tune_cache
+
+    b, h, sq, d, causal = SHAPES[name]
+    tiles = {m: p for m, p in tiles.items() if p}
+    if not tiles:
+        return
+    try:
+        backend = jax.devices()[0].device_kind
+    except Exception:
+        backend = None
+    tune_cache.update_flash(
+        cache_out, sq=sq, d=fa.padded_head_dim(d), causal=causal,
+        tiles=tiles, dtype="bfloat16", backend=backend,
+    )
+    print(f"[attn_tune] cached {name} winners {tiles} -> {cache_out}")
 
 
 if __name__ == "__main__":
@@ -284,16 +408,70 @@ if __name__ == "__main__":
     ap.add_argument("--peak-tflops", type=float, default=197.0,
                     help="chip peak bf16 TFLOP/s for the under-wait "
                          "plausibility gate (default v5e 197; v5p 459)")
+    ap.add_argument("--prune", action="store_true",
+                    help="drop infeasible/model-dominated cells via the "
+                         "compile-free kernel analyzer before sweeping")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --prune: print the KEEP/PRUNE table and "
+                         "exit without touching a device")
+    ap.add_argument("--prune-ratio", type=float, default=1.5,
+                    help="prune cells predicted this many times slower "
+                         "than the best predicted cell (default 1.5)")
+    ap.add_argument("--device-kind", default="TPU v5 lite",
+                    help="device-kind string for the prune model's "
+                         "peak/VMEM tables (default v5e; the sweep "
+                         "itself always times the local chip)")
+    ap.add_argument("--cache-out", default=None, metavar="FILE",
+                    help="persist measured winners into this tuning-"
+                         "cache JSON (APEX_TPU_TUNE_CACHE schema)")
     args = ap.parse_args()
     if args.blocks:
         BLOCKS = [int(x) for x in args.blocks.split(",")]
+    if args.dry_run and not args.prune:
+        ap.error("--dry-run requires --prune")
     _PEAK_TFLOPS_BOUND = 1.27 * args.peak_tflops
     for name in args.shapes.split(","):
-        if args.bwd_only:
-            sweep_bwd_only(name)
+        keeps = {}
+        if args.prune:
+            if args.bwd_only:
+                prune_sweeps = ["bwd-only", "dq-only"]
+            elif args.fwd_only:
+                prune_sweeps = ["fwd"]
+            else:
+                prune_sweeps = ["fwd", "fwd+bwd"]
+            for sweep_mode in prune_sweeps:
+                v = _prune_verdicts(
+                    name, sweep_mode, BLOCKS, args.prune_ratio,
+                    args.device_kind,
+                )
+                _print_verdicts(name, sweep_mode, v, args.prune_ratio)
+                keeps[sweep_mode] = {
+                    c for c, (verdict, _, _) in v.items()
+                    if verdict == "KEEP"
+                }
+        keep_fwd = keeps.get("fwd")
+        keep_bwd = keeps.get("fwd+bwd") or keeps.get("bwd-only")
+        if args.dry_run:
             continue
-        _, fwd_times = sweep(name, bwd=False)
+        if args.bwd_only:
+            result = sweep_bwd_only(
+                name, keep=keep_bwd, keep_dq=keeps.get("dq-only")
+            )
+            if args.cache_out and result.get("dkdv"):
+                _persist_winner(args.cache_out, name, {
+                    "bwd": result["dkdv"], "bwd_dq": result["dq"],
+                })
+            continue
+        best_fwd, fwd_times = sweep(name, bwd=False, keep=keep_fwd)
+        if args.cache_out and best_fwd[0]:
+            _persist_winner(args.cache_out, name, {"fwd": best_fwd[0]})
         if not args.fwd_only:
             # the fwd-only cells are the combined sweep's floor: a
             # fwd+bwd cell at most as slow as fwd alone is an under-wait
-            sweep(name, bwd=True, floor=fwd_times)
+            best_bwd, _ = sweep(
+                name, bwd=True, floor=fwd_times, keep=keep_bwd
+            )
+            if args.cache_out and best_bwd[0]:
+                _persist_winner(
+                    args.cache_out, name, {"bwd": best_bwd[0]}
+                )

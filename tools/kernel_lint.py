@@ -1,0 +1,100 @@
+"""Kernel lint CLI — run the Pallas kernel static analyzer
+(``apex_tpu.analysis.kernels``, docs/analysis.md "Kernel passes") over
+the three shipped kernels at their default configs, and emit findings
+as text + a JSON artifact.
+
+Nothing traces or compiles: the kernel modules export their call plans
+(``kernel_specs()``) and the passes judge VMEM footprint, tile
+alignment, grid coverage/races, causal dead-tile waste, and the
+compile-free roofline against one peak table
+(``observability.meter``).  This is the ``verify_tier1.sh`` LINT
+gate's kernel half: any ERROR finding exits 1, and ``--max-dead-tile``
+turns the causal flash default's wasted-FLOP fraction into a pinned
+bound (the bound that keeps a naive-causal tile choice from silently
+landing).
+
+Usage::
+
+    python tools/kernel_lint.py                      # defaults, text
+    python tools/kernel_lint.py --json out.json      # machine artifact
+    python tools/kernel_lint.py --max-dead-tile 0.15 # CI bound
+    python tools/kernel_lint.py --device-kind "TPU v5p"
+
+Exit code: 0 clean, 1 ERROR findings or dead-tile bound exceeded,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="static lint + cost model over the shipped Pallas "
+        "kernels (rule catalog: docs/analysis.md)"
+    )
+    ap.add_argument("--device-kind", default="TPU v5 lite",
+                    help="device-kind string for the peak/VMEM tables "
+                    "(default v5e)")
+    ap.add_argument("--vmem-budget", type=int, default=None,
+                    metavar="BYTES",
+                    help="override the per-core VMEM budget")
+    ap.add_argument("--max-dead-tile", type=float, default=None,
+                    metavar="FRACTION",
+                    help="fail (exit 1) if any causal kernel's wasted-"
+                    "FLOP fraction exceeds this bound")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="write the report as one JSON object")
+    ap.add_argument("--fail-on", choices=["error", "warning"],
+                    default="error")
+    args = ap.parse_args()
+
+    from apex_tpu.analysis import kernels as ka
+
+    report = ka.analyze_default_kernels(
+        device_kind=args.device_kind, vmem_budget=args.vmem_budget,
+    )
+    ka.publish_kernel_report(report)
+
+    print(f"kernel lint ({args.device_kind}):")
+    print(f"  {'config':<17} {'kernel':<17} {'grid':<14} {'VMEM MiB':>8} "
+          f"{'AI':>7} {'ceil TF/s':>9} {'pred TF/s':>9} {'bound':>7} "
+          f"{'waste':>6}")
+    worst_waste = 0.0
+    for e in report.sections["kernels"]:
+        r = e["roofline"]
+        waste = (e.get("dead_tiles") or {}).get("waste_fraction")
+        worst_waste = max(worst_waste, waste or 0.0)
+        print(f"  {e['config']:<17} {e['name']:<17} "
+              f"{'x'.join(str(g) for g in e['grid']):<14} "
+              f"{e['vmem']['total_bytes'] / (1 << 20):8.1f} "
+              f"{r['arithmetic_intensity']:7.1f} "
+              f"{r['ceiling_tflops']:9.1f} {r['predicted_tflops']:9.1f} "
+              f"{r['bound']:>7} "
+              f"{'-' if waste is None else f'{waste:.3f}':>6}")
+    print(report.render())
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=2)
+            f.write("\n")
+        print(f"[kernel_lint] wrote {args.json}", file=sys.stderr)
+
+    rc = 0 if report.ok(fail_on=args.fail_on) else 1
+    if args.max_dead_tile is not None and worst_waste > args.max_dead_tile:
+        print(f"kernel lint: dead-tile waste {worst_waste:.3f} exceeds "
+              f"the {args.max_dead_tile} bound")
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,14 @@ catch at the source line, before anything traces:
   ``sharding-missing-constraint``).  Severities and fix hints come
   from the shared ``apex_tpu.analysis.findings.RULES`` catalog — one
   rulebook for the source scan and the graph passes.
+- literal kernel tile sizes at call sites (rule
+  ``kernel-hardcoded-block``, the source half of the kernel passes in
+  docs/analysis.md "Kernel passes"): ``block_q=128`` baked into a
+  jitted-path call bypasses the tuned-tile lookup
+  (``APEX_TPU_TUNE_CACHE`` → ``_TUNED_TILES`` → heuristic), so the
+  number is right on one chip/shape and silently wrong everywhere
+  else.  The kernel entry points' ``block_q=None`` defaults and
+  variable-valued plumbing never match — only literal digits do.
 
 A line carrying ``repo-lint: allow`` is waived (use sparingly, with a
 reason in the adjacent comment).  Run from anywhere::
@@ -118,6 +126,29 @@ _CONTRACTION_RE = re.compile(
 _CONSTRAINT_TOKEN = "with_sharding_constraint"
 
 
+#: literal tile sizes at kernel call sites: block_q=128 / block_k=512 /
+#: block_q_dq=... with a DIGIT on the right-hand side (the entry
+#: points' block_q=None defaults and variable plumbing never match)
+_HARDCODED_BLOCK_RE = re.compile(r"\bblock_[qk](?:_dq)?\s*=\s*\d")
+
+
+def _kernel_violations(rel: str, lines, jitted: bool):
+    """Source-level kernel rules over one file's lines (rule
+    ``kernel-hardcoded-block``); the graph-side kernel passes judge
+    the resulting configs, this catches the bypass at the call site."""
+    if not jitted:
+        return []
+    catalog = _catalog_rules()
+    out = []
+    for lineno, line in enumerate(lines, 1):
+        if WAIVER in line or line.lstrip().startswith("#"):
+            continue
+        if _HARDCODED_BLOCK_RE.search(line):
+            _sev, why, fix = catalog["kernel-hardcoded-block"]
+            out.append((rel, lineno, line.strip(), why, fix))
+    return out
+
+
 def _sharding_violations(rel: str, lines, jitted: bool):
     """Source-level sharding rules over one file's lines; the graph
     passes prove the compiled result, this catches the call-site
@@ -181,6 +212,7 @@ def lint() -> list:
                         (rel, lineno, line.strip(), why, fix)
                     )
         violations.extend(_sharding_violations(rel, lines, jitted))
+        violations.extend(_kernel_violations(rel, lines, jitted))
     return violations
 
 

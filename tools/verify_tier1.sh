@@ -42,6 +42,12 @@
 # sharded over dp, only the declared gradient sync compiled) with zero
 # ERRORs, and the static peak-HBM estimate must sit inside the 8 MiB
 # budget without drifting to zero — both directions of drift fail.
+# A kernel gate (ISSUE 10) then runs tools/kernel_lint.py over the
+# three shipped Pallas kernels at their default configs (zero ERRORs,
+# causal dead-tile waste < 0.15) and an attn_tune --prune --dry-run
+# smoke: the compile-free cost model must keep the measured-best
+# (1024, 1024) long-shape tile while eliminating >=30% of the sweep
+# grid.
 #
 # A PERF stage guards the perf-observability contract
 # (docs/observability.md "Attribution & roofline"):
@@ -287,6 +293,51 @@ print(f"shard report OK: peak_hbm={peak} bytes (budget {budget}), "
       f"{len(d['shard_plan'])} plan rows, dp plan proven on the 8-device mesh")
 PYEOF
             lint_rc=${PIPESTATUS[0]}
+        fi
+    fi
+    if [ "$lint_rc" -eq 0 ]; then
+        # kernel gate (ISSUE 10, docs/analysis.md "Kernel passes"):
+        # the three shipped Pallas kernels at their default configs
+        # must carry zero ERROR findings (VMEM/tiling/coverage) and
+        # the causal flash default must waste <15% of its live-tile
+        # FLOPs on masked elements
+        KLINT_JSON="${T1_KLINT_JSON:-/tmp/_t1_kernel_lint.json}"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            python tools/kernel_lint.py --json "$KLINT_JSON" \
+            --max-dead-tile 0.15 2>&1 | tail -n 10 | tee -a "$LOG"
+        lint_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$lint_rc" -eq 0 ]; then
+        # attn_tune prune smoke: the compile-free cost model must keep
+        # the measured-best (1024, 1024) long-shape tile while
+        # eliminating >=30% of the default sweep grid — all without
+        # touching a device
+        PRUNE_OUT="$(mktemp /tmp/_t1_prune.XXXXXX.log)"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            python tools/attn_tune.py --prune --dry-run --shapes long \
+            > "$PRUNE_OUT" 2>&1
+        lint_rc=$?
+        if [ "$lint_rc" -eq 0 ]; then
+            python - "$PRUNE_OUT" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import re, sys
+text = open(sys.argv[1]).read()
+sweeps = [(int(k), int(t)) for k, t in re.findall(r"keep (\d+)/(\d+)", text)]
+assert sweeps, "no prune summary in attn_tune --dry-run output"
+for kept, total in sweeps:
+    assert total - kept >= 0.3 * total, (
+        f"prune eliminated only {total - kept}/{total} cells (<30%)")
+assert re.search(r"^ *KEEP +1024 +1024", text, re.M), (
+    "prune dropped the known-good (1024, 1024) long-shape config")
+print(f"attn_tune prune smoke OK: kept {sweeps} of the default grid, "
+      "(1024, 1024) survives")
+PYEOF
+            lint_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$lint_rc" -eq 0 ]; then
+            rm -f "$PRUNE_OUT"
+        else
+            echo "TIER1-LINT: attn_tune prune smoke failed (output at" \
+                "$PRUNE_OUT)" | tee -a "$LOG"
         fi
     fi
     if [ "$lint_rc" -eq 0 ]; then
