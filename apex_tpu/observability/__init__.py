@@ -55,6 +55,25 @@ session over bench logs:
   :class:`~apex_tpu.observability.health.HealthEvent` s to the
   sinks/flight recorder, with ``on_unhealthy`` escalation (e.g.
   arm a trace window — alert→profile in one run).
+- :mod:`apex_tpu.observability.ometrics` — the live ops plane: a
+  dependency-free OpenMetrics exporter over the registry/board key
+  vocabulary (validated injective name mapping), host-side
+  :class:`~apex_tpu.observability.ometrics.Histogram` s, and a stdlib
+  ``http.server`` :class:`~apex_tpu.observability.ometrics.OpsServer`
+  serving ``GET /metrics`` from cached values (never a blocking
+  fetch) — armed by ``--ops-port`` / ``APEX_TPU_OPS_PORT``.
+- :mod:`apex_tpu.observability.slo` — declarative SLOs (TTFT latency,
+  goodput, shed rate) with Google-SRE multi-window multi-burn-rate
+  alerting; a firing is a normal
+  :class:`~apex_tpu.observability.health.HealthEvent`, so an SLO page
+  lands on the same merged timeline as the request spans that blew
+  the budget.
+- :mod:`apex_tpu.observability.memstats` — live device-memory
+  watermarks (``device.memory_stats()`` behind a provider interface,
+  fake provider on CPU) cross-checked against the static analyzer's
+  peak-HBM predictions (drift names the program), with an
+  OOM-forensics hook that drains the watermark history into the
+  flight recorder on allocation failure.
 - :mod:`apex_tpu.observability.attribution` — step-time attribution
   and roofline analysis: the compiled cost model (per-op FLOPs/bytes
   bucketed matmul/attention/norm-elementwise/collective/other via
@@ -125,6 +144,28 @@ from apex_tpu.observability.metrics import (  # noqa: F401
     MetricRegistry,
     board,
 )
+from apex_tpu.observability.ometrics import (  # noqa: F401
+    Histogram,
+    OpsServer,
+    metric_name,
+    parse_exposition,
+)
+from apex_tpu.observability.slo import (  # noqa: F401
+    SLO,
+    BurnRateTracker,
+    CounterRatioSLO,
+    LatencySLO,
+    SLORule,
+    Window,
+    serve_slo_rules,
+)
+from apex_tpu.observability.memstats import (  # noqa: F401
+    DeviceMemoryProvider,
+    FakeMemoryProvider,
+    MemStatsMonitor,
+    MemStatsRule,
+    oom_forensics,
+)
 # NOTE: the trace() context manager is deliberately NOT re-exported
 # here — it would shadow the `apex_tpu.observability.trace` SUBMODULE
 # attribute on the package.  Reach it as `observability.trace.trace`
@@ -159,6 +200,22 @@ __all__ = [
     "SpanRecorder",
     "wall_clock_anchor",
     "monotonic_to_epoch",
+    "OpsServer",
+    "Histogram",
+    "metric_name",
+    "parse_exposition",
+    "SLO",
+    "CounterRatioSLO",
+    "LatencySLO",
+    "BurnRateTracker",
+    "SLORule",
+    "Window",
+    "serve_slo_rules",
+    "MemStatsMonitor",
+    "MemStatsRule",
+    "DeviceMemoryProvider",
+    "FakeMemoryProvider",
+    "oom_forensics",
     "StepMeter",
     "GoodputAccountant",
     "BUCKETS",

@@ -63,9 +63,15 @@ class MetricRegistry:
     def __init__(self, *, fetch_every: int = 32):
         if fetch_every < 1:
             raise ValueError("fetch_every must be >= 1")
+        from apex_tpu.observability.ometrics import ExportNamespace
+
         self.fetch_every = fetch_every
         self._kinds: Dict[str, str] = {}
         self._units: Dict[str, str] = {}
+        # every declared key must round-trip through the OpenMetrics
+        # name mapping without collisions — a key an --ops-port scrape
+        # cannot represent fails HERE, at declare time
+        self._export = ExportNamespace()
         self._values: Dict[str, float] = {}
         self._fetched_step: Optional[int] = None
         # double buffer: _pending is the newest observed device state,
@@ -82,6 +88,9 @@ class MetricRegistry:
             raise ValueError(
                 f"metric {name!r} already declared as {prev!r}"
             )
+        # ValueError on an exporter-illegal key or a post-mangling
+        # collision with an existing key (idempotent on re-declares)
+        self._export.declare(name, kind)
         self._kinds[name] = kind
         self._units[name] = unit
 
@@ -101,6 +110,11 @@ class MetricRegistry:
 
     def unit(self, name: str) -> str:
         return self._units.get(name, "")
+
+    def kind(self, name: str) -> str:
+        """``"counter" | "gauge" | "min" | "max"`` for a declared
+        metric (the OpenMetrics exporter's type source)."""
+        return self._kinds[name]
 
     @property
     def names(self):

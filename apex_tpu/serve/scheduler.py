@@ -68,6 +68,10 @@ from typing import Deque, Dict, List, Optional
 import numpy as np
 
 from apex_tpu.observability.meter import percentile as _percentile
+from apex_tpu.observability.ometrics import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    Histogram,
+)
 from apex_tpu.serve.cache import NULL_PAGE
 
 __all__ = [
@@ -272,6 +276,14 @@ class ContinuousBatchingScheduler:
         self._comps: Deque[Dict[str, float]] = collections.deque(
             maxlen=attribution_window
         )
+        # host-side TTFT distribution: the OpenMetrics histogram an
+        # --ops-port scrape exposes and the latency-SLO burn-rate math
+        # reads (good = observations under the deadline bucket) — one
+        # bisect per admission, registry or not
+        self.ttft_hist = Histogram(
+            "serve/ttft_hist_ms", DEFAULT_LATENCY_BUCKETS_MS, unit="ms",
+            help="TTFT distribution over admitted requests",
+        )
         self._published_done = 0
         self._mstate = None
         if self.registry is not None:
@@ -414,6 +426,7 @@ class ContinuousBatchingScheduler:
         self._count("serve/prefills")
         self._count("serve/tokens_out")
         self._gauge("serve/ttft_ms", req.ttft_ms)
+        self.ttft_hist.observe(req.ttft_ms)
         if self._finished(req):
             self.slots[slot] = None
             self._retire(req, DONE)

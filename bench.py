@@ -1086,6 +1086,47 @@ def bench_serve(trace_dir=None, prompt_len=48, decode_steps=24, trials=3):
         None,
     )
 
+    # -- live ops plane rows (docs/observability.md "Live ops plane") ---
+    # ops_scrape_ms: a REAL HTTP GET against the OpenMetrics endpoint
+    # serving the last scheduler's TTFT histogram + the board — the
+    # exporter's cost rides the bench_diff golden stream so scrape
+    # overhead can never regress silently
+    import urllib.request
+
+    from apex_tpu.observability import ometrics, slo as slo_lib
+
+    srv = ometrics.OpsServer(
+        histograms=[sched.ttft_hist], port=0
+    ).start()
+    scrape_ms = []
+    body = b""
+    for _ in range(3):
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            body = resp.read()
+        scrape_ms.append(1e3 * (time.perf_counter() - t0))
+    srv.stop()
+    scrape_ms.sort()
+    _emit(
+        "ops_scrape_ms",
+        round(scrape_ms[len(scrape_ms) // 2], 3),
+        "ms (HTTP GET /metrics, median of 3, %d bytes exposition; CI "
+        "ops smoke on CPU, not a perf claim)" % len(body),
+        None,
+    )
+    # slo_alerts_fired: the deterministic burn-rate drill (a 5x burn
+    # against a 90% objective judged by one (60s, 240s, 2x) window
+    # fires exactly once) — pins the multi-window alert math into the
+    # golden stream
+    _emit(
+        "slo_alerts_fired",
+        float(slo_lib.burn_rate_drill()),
+        "alerts (canonical burn-rate drill: 50% errors vs a 90% "
+        "objective, one 60s/240s window at factor 2 — must fire "
+        "exactly once)",
+        None,
+    )
+
 
 # ---------------------------------------------------------------------------
 # CI smoke config (seconds on CPU — the verify_tier1.sh PERF pass)

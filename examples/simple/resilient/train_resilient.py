@@ -249,7 +249,15 @@ def main():
                     help="disable the health watchdog (on by default: "
                     "goodput/MFU floors, loss spike, NaN rate, stale "
                     "fetch, hung step, straggler)")
+    ap.add_argument("--ops-port", type=int, default=None, metavar="PORT",
+                    help="serve live OpenMetrics at /metrics while the "
+                    "run trains (0 = OS-assigned; APEX_TPU_OPS_PORT is "
+                    "the default; docs/observability.md 'Live ops plane')")
     args = ap.parse_args()
+    if args.ops_port is None:
+        from apex_tpu.observability.ometrics import ops_port_from_env
+
+        args.ops_port = ops_port_from_env()
 
     t = build_training(
         accum=args.accum, wire=args.wire, fetch_every=args.fetch_every
@@ -279,6 +287,23 @@ def main():
             registry=registry, meter=meter, goodput=goodput,
         )
     tracer = obs.TraceScheduler()  # armed by APEX_TPU_TRACE_STEPS, else no-op
+
+    # live ops plane: scrape the registry + board while the run trains
+    # (the memstats collect hook publishes HBM watermarks per scrape —
+    # real memory_stats() on TPU, silently absent on the CPU backend)
+    ops = None
+    if args.ops_port is not None:
+        mem_provider = obs.memstats.default_provider()
+        monitor = (
+            obs.MemStatsMonitor(mem_provider)
+            if mem_provider is not None else None
+        )
+        ops = obs.OpsServer(
+            registries=[registry],
+            collect=monitor.sample if monitor is not None else None,
+            port=args.ops_port,
+        ).start()
+        print(f"ops: live OpenMetrics at {ops.url}")
 
     # flight recorder: env > --flight > default ring of 64.  Resolved
     # to ONE spec before from_env so APEX_TPU_FLIGHT=0 genuinely
@@ -372,6 +397,8 @@ def main():
                 )
             except Exception as e:  # the postmortem must not eat the run
                 print(f"trace attribution failed: {e}", file=sys.stderr)
+        if ops is not None:
+            ops.stop()
         if reporter is not None:
             registry.fetch()  # drain the async buffers for the report
             final_step = (

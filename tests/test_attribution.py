@@ -505,6 +505,9 @@ class TestBenchDiff:
             "smoke_mlp_step_ms", "smoke_dp_mlp_step_ms",
             "serve_prefill_tokens_per_s", "serve_decode_tokens_per_s",
             "serve_ttft_ms",
+            # the live ops plane rows (ISSUE 11): exporter scrape cost
+            # + the deterministic burn-rate drill
+            "ops_scrape_ms", "slo_alerts_fired",
         }
 
 

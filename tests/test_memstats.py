@@ -1,0 +1,259 @@
+"""Live device-memory telemetry (ISSUE 11): provider degradation on
+CPU, watermark gauge publication + bounded history, the static-vs-live
+crosscheck (drift in EITHER direction names the governing program), the
+watchdog rule, and the OOM-forensics hook draining the watermark
+history into the flight recorder."""
+
+import pytest
+
+from apex_tpu.observability.flight import FlightRecorder
+from apex_tpu.observability.health import Watchdog
+from apex_tpu.observability.memstats import (
+    DeviceMemoryProvider,
+    FakeMemoryProvider,
+    MemStatsMonitor,
+    MemStatsRule,
+    default_provider,
+    oom_forensics,
+    static_peaks_from_board,
+)
+from apex_tpu.observability.metrics import Board, board
+from apex_tpu.observability.spans import SpanRecorder
+
+MIB = 1 << 20
+
+
+@pytest.fixture(autouse=True)
+def _clean_board():
+    board.clear()
+    yield
+    board.clear()
+
+
+class TestProviders:
+    def test_cpu_backend_degrades_to_empty(self):
+        # the CPU backend reports no memory_stats: the documented
+        # degradation is an empty view, not an exception
+        assert DeviceMemoryProvider().stats() == {}
+        assert default_provider() is None
+
+    def test_fake_tracks_peak(self):
+        fake = FakeMemoryProvider(limit_bytes=1024 * MIB)
+        fake.set_usage(bytes_in_use=100 * MIB)
+        fake.set_usage(bytes_in_use=50 * MIB)
+        s = fake.stats()["device0"]
+        assert s["bytes_in_use"] == 50 * MIB
+        assert s["peak_bytes_in_use"] == 100 * MIB  # high-water holds
+        assert s["bytes_limit"] == 1024 * MIB
+
+    def test_fake_from_static_scales(self):
+        fake = FakeMemoryProvider.from_static(
+            {"decode": 10 * MIB, "prefill_16": 6 * MIB}, scale=2.0
+        )
+        assert fake.stats()["device0"]["peak_bytes_in_use"] == 20 * MIB
+        with pytest.raises(ValueError):
+            FakeMemoryProvider.from_static({})
+
+    def test_fake_multi_device(self):
+        fake = FakeMemoryProvider(devices=2, limit_bytes=MIB)
+        fake.set_usage(device=1, bytes_in_use=MIB // 2)
+        assert fake.stats()["device1"]["bytes_in_use"] == MIB // 2
+        assert fake.stats()["device0"]["bytes_in_use"] == 0.0
+
+
+class TestMonitor:
+    def test_sample_publishes_watermark_gauges(self):
+        fake = FakeMemoryProvider(limit_bytes=100 * MIB)
+        fake.set_usage(bytes_in_use=25 * MIB)
+        mon = MemStatsMonitor(fake)
+        mon.sample(step=3)
+        assert board.get("memstats/device0/bytes_in_use") == 25 * MIB
+        assert board.get("memstats/device0/peak_bytes_in_use") == 25 * MIB
+        assert board.get("memstats/device0/bytes_limit") == 100 * MIB
+        assert board.get("memstats/samples") == 1
+
+    def test_history_bounded_and_peaks_survive_trim(self):
+        fake = FakeMemoryProvider(limit_bytes=100 * MIB)
+        mon = MemStatsMonitor(fake, history=4)
+        for i in range(10):
+            fake.set_usage(bytes_in_use=(i + 1) * MIB)
+            mon.sample(i)
+        assert len(mon.watermarks()) == 4
+        # the provider's own peak is a high-water mark, so the live
+        # peak is not lost to ring eviction
+        assert mon.live_peaks()["device0"] == 10 * MIB
+
+    def test_needs_a_provider(self):
+        with pytest.raises(ValueError, match="provider"):
+            MemStatsMonitor(None)
+
+
+class TestCrosscheck:
+    def _monitor(self, live_bytes):
+        fake = FakeMemoryProvider(limit_bytes=1024 * MIB)
+        fake.set_usage(bytes_in_use=live_bytes)
+        mon = MemStatsMonitor(fake)
+        mon.sample(0)
+        return mon
+
+    def test_reconciled_within_tolerance(self):
+        mon = self._monitor(11 * MIB)
+        static = {"decode": 10 * MIB, "prefill_16": 6 * MIB}
+        assert mon.crosscheck(static, tolerance=0.25) == []
+        assert board.get("memstats/crosscheck") == 0.0
+
+    def test_static_under_prediction_names_the_program(self):
+        mon = self._monitor(25 * MIB)
+        static = {"decode": 10 * MIB, "prefill_16": 6 * MIB}
+        findings = mon.crosscheck(static, tolerance=0.25)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f["rule"] == "memstats-drift"
+        assert f["program"] == "decode"  # the governing (max) program
+        assert f["direction"] == "static-under-predicts"
+        assert f["ratio"] == pytest.approx(2.5)
+        assert "decode" in f["message"]
+        assert board.get("memstats/crosscheck") == 1.0
+
+    def test_static_over_prediction_is_also_drift(self):
+        mon = self._monitor(2 * MIB)
+        findings = mon.crosscheck({"decode": 10 * MIB}, tolerance=0.25)
+        assert len(findings) == 1
+        assert findings[0]["direction"] == "static-over-predicts"
+
+    def test_no_basis_is_distinguishable_from_clean(self):
+        mon = self._monitor(5 * MIB)
+        assert mon.crosscheck({}, tolerance=0.25) == []
+        assert board.get("memstats/crosscheck") == -1.0
+
+    def test_harvests_static_peaks_from_board(self):
+        board.set("serve/hbm/decode/peak_hbm_bytes", 10 * MIB)
+        board.set("serve/hbm/prefill_16/peak_hbm_bytes", 6 * MIB)
+        board.set("serve/hbm/decode/peak_hbm/params", 4 * MIB)  # not a peak
+        board.set("analysis/peak_hbm_bytes", 8 * MIB)
+        board.set("serve/kv_wire", "int8")  # strings never harvest
+        peaks = static_peaks_from_board()
+        assert peaks == {
+            "decode": 10 * MIB, "prefill_16": 6 * MIB,
+            "analysis": 8 * MIB,
+        }
+        # and crosscheck defaults to the harvested set
+        mon = self._monitor(10 * MIB)
+        assert mon.crosscheck(tolerance=0.25) == []
+
+    def test_board_isolation(self):
+        b = Board()
+        b.set("serve/hbm/decode/peak_hbm_bytes", 123.0)
+        assert static_peaks_from_board(b) == {"decode": 123.0}
+
+
+class TestWatchdogRule:
+    def test_drift_pages_through_the_watchdog(self):
+        fake = FakeMemoryProvider(limit_bytes=1024 * MIB)
+        fake.set_usage(bytes_in_use=30 * MIB)
+        mon = MemStatsMonitor(fake)
+        flight = FlightRecorder(capacity=8)
+        spans = SpanRecorder(capacity=64)
+        rule = MemStatsRule(mon, static_peaks={"decode": 10 * MIB},
+                            tolerance=0.25)
+        wd = Watchdog(rules=[rule], flight=flight, spans=spans,
+                      check_every=1)
+        wd.on_step(0)
+        assert len(wd.events) == 1
+        ev = wd.events[0]
+        assert ev.rule == "memstats_drift"
+        assert ev.severity == "critical"  # 3x is past 2*tolerance
+        assert "decode" in ev.message
+        assert board.get("health/memstats_drift") == pytest.approx(3.0)
+        assert any(e["kind"] == "health" for e in flight.events)
+        assert [e["name"] for e in spans.snapshot()
+                if e.get("track") == "health"] == [
+            "health/memstats_drift"
+        ]
+
+    def test_warn_inside_double_tolerance(self):
+        fake = FakeMemoryProvider(limit_bytes=1024 * MIB)
+        fake.set_usage(bytes_in_use=14 * MIB)  # 1.4x at tol 0.25
+        rule = MemStatsRule(MemStatsMonitor(fake),
+                            static_peaks={"decode": 10 * MIB},
+                            tolerance=0.25)
+        wd = Watchdog(rules=[rule], check_every=1)
+        wd.on_step(0)
+        assert [e.severity for e in wd.events] == ["warn"]
+
+    def test_sampling_continues_under_cooldown(self):
+        fake = FakeMemoryProvider(limit_bytes=1024 * MIB)
+        fake.set_usage(bytes_in_use=30 * MIB)
+        mon = MemStatsMonitor(fake)
+        rule = MemStatsRule(mon, static_peaks={"decode": 10 * MIB},
+                            cooldown=64)
+        wd = Watchdog(rules=[rule], check_every=1)
+        for step in range(5):
+            wd.on_step(step)
+        assert len(wd.events) == 1  # cooldown held the repeats
+        assert mon.samples == 5  # but the forensic record kept growing
+
+
+class TestOOMForensics:
+    def _armed(self):
+        fake = FakeMemoryProvider(limit_bytes=100 * MIB)
+        mon = MemStatsMonitor(fake)
+        for i in range(3):
+            fake.set_usage(bytes_in_use=(30 + 30 * i) * MIB)
+            mon.sample(i)
+        return fake, mon, FlightRecorder(capacity=8)
+
+    def test_resource_exhausted_drains_into_flight(self):
+        fake, mon, flight = self._armed()
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            with oom_forensics(mon, flight=flight):
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 104857600 bytes"
+                )
+        oom = [e for e in flight.events if e["kind"] == "oom"]
+        assert len(oom) == 1
+        assert "RESOURCE_EXHAUSTED" in oom[0]["error"]
+        # the watermark CLIMB is the forensic payload (3 armed samples
+        # + the final sample the hook takes at death)
+        assert len(oom[0]["watermarks"]) == 4
+        assert oom[0]["live_peaks"]["device0"] == 90 * MIB
+        assert board.get("memstats/oom") == 1.0
+
+    def test_memory_error_counts_as_oom(self):
+        _fake, mon, flight = self._armed()
+        with pytest.raises(MemoryError):
+            with oom_forensics(mon, flight=flight):
+                raise MemoryError()
+        assert any(e["kind"] == "oom" for e in flight.events)
+
+    def test_other_exceptions_pass_through_untouched(self):
+        _fake, mon, flight = self._armed()
+        with pytest.raises(ValueError):
+            with oom_forensics(mon, flight=flight):
+                raise ValueError("not an allocation failure")
+        assert flight.events == []
+        assert board.get("memstats/oom") is None
+
+    def test_spans_get_the_instant_too(self):
+        _fake, mon, _flight = self._armed()
+        spans = SpanRecorder(capacity=16)
+        with pytest.raises(MemoryError):
+            with oom_forensics(mon, spans=spans):
+                raise MemoryError()
+        names = [e["name"] for e in spans.snapshot()]
+        assert "health/oom" in names
+
+    def test_hook_survives_a_dying_provider(self):
+        class DyingProvider(FakeMemoryProvider):
+            def stats(self):
+                raise RuntimeError("device gone")
+
+        fake = DyingProvider(limit_bytes=MIB)
+        mon = MemStatsMonitor(fake)
+        flight = FlightRecorder(capacity=8)
+        with pytest.raises(MemoryError):
+            with oom_forensics(mon, flight=flight):
+                raise MemoryError()
+        # the dump still landed (with whatever history existed)
+        assert any(e["kind"] == "oom" for e in flight.events)

@@ -1,0 +1,519 @@
+"""The OpenMetrics exporter (ISSUE 11): name-mangling round-trips,
+registry-level key validation, exposition-format conformance (type/unit
+lines, escaping, counter ``_total``, histogram bucket ordering), the
+HTTP endpoint, and the scrape-under-load overhead pins (the PR 3
+<1%-on-the-compiled-cost-model bar: the exporter adds ZERO device ops
+and never blocks on a fetch)."""
+
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from apex_tpu.observability.metrics import Board, MetricRegistry, board
+from apex_tpu.observability.ometrics import (
+    CONTENT_TYPE,
+    DEFAULT_LATENCY_BUCKETS_MS,
+    ExportNamespace,
+    Histogram,
+    OpsServer,
+    metric_name,
+    parse_exposition,
+    render,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_board():
+    board.clear()
+    yield
+    board.clear()
+
+
+# ---------------------------------------------------------------------------
+# name mangling + the injectivity guard
+# ---------------------------------------------------------------------------
+
+
+class TestMetricName:
+    def test_documented_mapping(self):
+        # the exact examples the docs table carries
+        assert metric_name("serve/ttft_queue_wait_fraction") == (
+            "apex_tpu_serve_ttft_queue_wait_fraction"
+        )
+        assert metric_name("guard/skipped") == "apex_tpu_guard_skipped"
+        assert metric_name("fleet/train/step_time_ms/host0") == (
+            "apex_tpu_fleet_train_step_time_ms_host0"
+        )
+        assert metric_name("memstats/device0/bytes_in_use") == (
+            "apex_tpu_memstats_device0_bytes_in_use"
+        )
+
+    def test_separators_dashes_dots_spaces(self):
+        assert metric_name("a-b.c d:e") == "apex_tpu_a_b_c_d_e"
+
+    def test_case_folds_and_runs_collapse(self):
+        assert metric_name("Serve//TTFT__ms") == "apex_tpu_serve_ttft_ms"
+
+    def test_illegal_chars_dropped_not_kept(self):
+        assert metric_name("serve/p99!") == "apex_tpu_serve_p99"
+
+    def test_unmappable_key_raises(self):
+        with pytest.raises(ValueError, match="cannot be mapped"):
+            metric_name("///")
+        with pytest.raises(ValueError):
+            metric_name("")
+
+    def test_namespace_collision_after_mangling(self):
+        ns = ExportNamespace()
+        ns.declare("serve/ttft_ms", "gauge")
+        # same key re-declared: idempotent
+        assert ns.declare("serve/ttft_ms", "gauge") == (
+            "apex_tpu_serve_ttft_ms"
+        )
+        with pytest.raises(ValueError, match="injective"):
+            ns.declare("serve.ttft_ms", "gauge")
+
+    def test_counter_total_suffix_reserved(self):
+        # a counter `x` emits `x_total`: a gauge named x_total collides
+        ns = ExportNamespace()
+        ns.declare("serve/shed", "counter")
+        with pytest.raises(ValueError, match="collides"):
+            ns.declare("serve/shed_total", "gauge")
+        # ...and the reverse order too
+        ns2 = ExportNamespace()
+        ns2.declare("serve/shed_total", "gauge")
+        with pytest.raises(ValueError, match="collides"):
+            ns2.declare("serve/shed", "counter")
+
+    def test_registry_declare_validates(self):
+        reg = MetricRegistry()
+        reg.gauge("train/loss")
+        with pytest.raises(ValueError):
+            reg.gauge("train.loss")  # collides after mangling
+        with pytest.raises(ValueError):
+            reg.counter("///")  # unmappable
+        # legal keys still declare fine after a rejection
+        reg.counter("train/skips")
+
+    def test_shipped_vocabulary_round_trips(self):
+        """The board/registry vocabulary the stack actually publishes
+        must round-trip injectively — the ISSUE 11 audit, pinned so a
+        future key addition that can't export fails here."""
+        reg = MetricRegistry()
+        from apex_tpu.serve.scheduler import declare_serve_metrics
+
+        declare_serve_metrics(reg)  # raises on any illegal serve key
+        # the resilient example's device metric set
+        reg.counter("guard/skipped")
+        for key in ("train/loss", "guard/found_inf",
+                    "guard/spike", "guard/grad_norm", "guard/norm_ema",
+                    "guard/consecutive_skips", "guard/total_skips",
+                    "guard/budget_left", "amp/loss_scale",
+                    "amp/growth_tracker", "amp/hysteresis"):
+            reg.gauge(key)
+        # board-only families published across the stack
+        seen = set()
+        for key in (
+            "serve/peak_hbm_bytes", "serve/hbm/decode/peak_hbm_bytes",
+            "serve/hbm/prefill_16/peak_hbm_bytes",
+            "analysis/peak_hbm_bytes", "analysis/peak_hbm/params",
+            "analysis/shard_plan/rows", "analysis/pass_ms/memory",
+            "analysis/kernels/flash_fwd/vmem_bytes",
+            "attribution/collective_fraction",
+            "attribution/host_stall_fraction",
+            "health/slo_ttft", "health/memstats_drift",
+            "fleet/train/step_time_ms/host0",
+            "memstats/device0/bytes_in_use",
+            "memstats/device0/peak_bytes_in_use", "memstats/crosscheck",
+            "ops/scrape_ms", "ops/scrapes", "ops/port",
+        ):
+            name = metric_name(key)
+            assert name not in seen, f"{key} collides with another key"
+            seen.add(name)
+
+
+# ---------------------------------------------------------------------------
+# Histogram
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_observe_and_cumulative(self):
+        h = Histogram("serve/ttft_hist_ms", (1.0, 5.0, 10.0), unit="ms")
+        for v in (0.5, 1.0, 3.0, 10.0, 99.0):
+            h.observe(v)
+        # le is INCLUSIVE: the 1.0 observation lands in the le=1 bucket
+        assert h.cumulative() == [
+            (1.0, 2), (5.0, 3), (10.0, 4), (math.inf, 5),
+        ]
+        assert h.count == 5
+        assert h.sum == pytest.approx(113.5)
+
+    def test_count_le_truncates_to_lower_bound(self):
+        h = Histogram("x", (1.0, 5.0, 10.0))
+        for v in (0.5, 3.0, 7.0):
+            h.observe(v)
+        assert h.count_le(5.0) == 2       # exact bound
+        assert h.count_le(7.0) == 2       # truncates down to le=5
+        assert h.count_le(0.2) == 0       # under the first bucket
+        assert h.count_le(1e9) == 3
+
+    def test_bad_buckets_rejected(self):
+        with pytest.raises(ValueError):
+            Histogram("x", ())
+        with pytest.raises(ValueError):
+            Histogram("x", (1.0, 1.0))
+        with pytest.raises(ValueError):
+            Histogram("x", (1.0, math.inf))
+        with pytest.raises(ValueError, match="cannot be mapped"):
+            Histogram("///", (1.0,))
+
+    def test_default_latency_buckets_increase(self):
+        b = DEFAULT_LATENCY_BUCKETS_MS
+        assert all(y > x for x, y in zip(b, b[1:]))
+
+    def test_render_consistent_under_concurrent_observe(self):
+        """A scrape racing observe() must never emit an exposition
+        whose _count disagrees with the +Inf bucket — strict parsers
+        (and the CI OPS gate) reject that as invalid."""
+        h = Histogram("lat_ms", (1.0, 10.0), unit="ms")
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                h.observe(float(i % 20))
+                i += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                parse_exposition(render(histograms=[h]))  # raises on skew
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# exposition conformance
+# ---------------------------------------------------------------------------
+
+
+def _sample_registry():
+    reg = MetricRegistry(fetch_every=1)
+    reg.gauge("serve/ttft_ms", "ms")
+    reg.counter("serve/completed")
+    reg.minimum("train/loss_min")
+    st = reg.init()
+    st = reg.update(st, {"serve/ttft_ms": 12.5, "serve/completed": 3,
+                         "train/loss_min": 0.25})
+    reg.observe(0, st)
+    reg.fetch()
+    return reg
+
+
+class TestExposition:
+    def test_conformance_round_trip(self):
+        reg = _sample_registry()
+        h = Histogram("serve/ttft_hist_ms", (1.0, 10.0), unit="ms")
+        h.observe(4.0)
+        text = render([reg], [h], {"analysis/peak_hbm_bytes": 4096})
+        fams = parse_exposition(text)  # raises on any format violation
+        assert fams["apex_tpu_serve_completed"]["type"] == "counter"
+        assert fams["apex_tpu_serve_completed"]["value"] == 3
+        assert fams["apex_tpu_serve_ttft_ms"]["unit"] == "ms"
+        assert fams["apex_tpu_serve_ttft_ms"]["value"] == 12.5
+        # min/max kinds export as gauges
+        assert fams["apex_tpu_train_loss_min"]["type"] == "gauge"
+        assert fams["apex_tpu_analysis_peak_hbm_bytes"]["value"] == 4096
+        assert text.endswith("# EOF\n")
+
+    def test_counter_sample_is_name_total(self):
+        text = render([_sample_registry()])
+        assert "apex_tpu_serve_completed_total 3" in text
+        # the metadata lines carry the BARE family name
+        assert "# TYPE apex_tpu_serve_completed counter" in text
+
+    def test_help_documents_the_original_key(self):
+        text = render([_sample_registry()])
+        assert (
+            "# HELP apex_tpu_serve_ttft_ms board key 'serve/ttft_ms'"
+            in text
+        )
+
+    def test_help_escaping(self):
+        h = Histogram("x_ms", (1.0,), unit="ms",
+                      help='line1\nline2 with "quotes" and \\slash')
+        text = render(histograms=[h])
+        assert '# HELP apex_tpu_x_ms line1\\nline2' in text
+        parse_exposition(text)
+
+    def test_histogram_bucket_ordering_and_count(self):
+        h = Histogram("lat_ms", (1.0, 5.0, 25.0), unit="ms")
+        for v in (0.1, 2.0, 2.0, 100.0):
+            h.observe(v)
+        text = render(histograms=[h])
+        fams = parse_exposition(text)
+        buckets = [
+            (labels["le"], v)
+            for s, labels, v in fams["apex_tpu_lat_ms"]["samples"]
+            if s.endswith("_bucket")
+        ]
+        assert buckets == [("1", 1), ("5", 3), ("25", 3), ("+Inf", 4)]
+        assert 'apex_tpu_lat_ms_count 4' in text
+        assert 'apex_tpu_lat_ms_sum 104.1' in text
+
+    def test_unit_line_only_when_suffix_matches(self):
+        reg = MetricRegistry(fetch_every=1)
+        reg.gauge("serve/batch_fill", "fraction of max_batch slots")
+        st = reg.update(reg.init(), {"serve/batch_fill": 0.5})
+        reg.observe(0, st)
+        reg.fetch()
+        text = render([reg])
+        # a descriptive unit string is NOT a legal unit token suffix —
+        # no UNIT line, and the exposition still parses
+        assert "# UNIT" not in text
+        parse_exposition(text)
+
+    def test_board_strings_skipped(self):
+        text = render(board={"serve/kv_wire": "int8", "serve/pages": 64})
+        assert "kv_wire" not in text
+        assert "apex_tpu_serve_pages 64" in text
+
+    def test_nonfinite_values_encode(self):
+        reg = MetricRegistry(fetch_every=1)
+        reg.gauge("x")
+        reg._values["x"] = float("nan")
+        text = render([reg])
+        assert "apex_tpu_x NaN" in text
+        parse_exposition(text)
+
+    def test_registry_beats_board_echo(self):
+        # a board echo of a registry key must not duplicate the family
+        reg = _sample_registry()
+        text = render([reg], board={"serve/ttft_ms": 999.0})
+        assert text.count("# TYPE apex_tpu_serve_ttft_ms") == 1
+        assert parse_exposition(text)["apex_tpu_serve_ttft_ms"][
+            "value"
+        ] == 12.5
+
+    def test_parser_rejects_planted_defects(self):
+        with pytest.raises(ValueError, match="# EOF"):
+            parse_exposition("apex_tpu_x 1\n")
+        with pytest.raises(ValueError, match="before any matching"):
+            parse_exposition("apex_tpu_x 1\n# EOF\n")
+        with pytest.raises(ValueError, match="_total"):
+            parse_exposition(
+                "# TYPE apex_tpu_c counter\napex_tpu_c 1\n# EOF\n"
+            )
+        with pytest.raises(ValueError, match="not increasing"):
+            parse_exposition(
+                "# TYPE h histogram\n"
+                'h_bucket{le="5"} 1\nh_bucket{le="1"} 2\n'
+                'h_bucket{le="+Inf"} 2\n# EOF\n'
+            )
+        with pytest.raises(ValueError, match="decreasing"):
+            parse_exposition(
+                "# TYPE h histogram\n"
+                'h_bucket{le="1"} 3\nh_bucket{le="5"} 2\n'
+                'h_bucket{le="+Inf"} 3\n# EOF\n'
+            )
+        with pytest.raises(ValueError, match=r"\+Inf"):
+            parse_exposition(
+                "# TYPE h histogram\n"
+                'h_bucket{le="1"} 1\n# EOF\n'
+            )
+        with pytest.raises(ValueError, match="suffix"):
+            parse_exposition("# TYPE x gauge\n# UNIT x ms\nx 1\n# EOF\n")
+
+
+# ---------------------------------------------------------------------------
+# the HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestOpsServer:
+    def test_serves_metrics_over_http(self):
+        reg = _sample_registry()
+        srv = OpsServer(registries=[reg], port=0).start()
+        try:
+            assert srv.port > 0
+            with urllib.request.urlopen(srv.url, timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == CONTENT_TYPE
+                fams = parse_exposition(resp.read().decode())
+            assert fams["apex_tpu_serve_completed"]["value"] == 3
+        finally:
+            srv.stop()
+
+    def test_unknown_path_404(self):
+        srv = OpsServer(port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/bogus", timeout=5
+                )
+            assert exc.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_collect_hook_runs_per_scrape(self):
+        calls = []
+        srv = OpsServer(collect=lambda: calls.append(1))
+        srv.scrape()
+        srv.scrape()
+        assert len(calls) == 2
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv("APEX_TPU_OPS_PORT", raising=False)
+        assert OpsServer.from_env() is None
+        monkeypatch.setenv("APEX_TPU_OPS_PORT", "")
+        assert OpsServer.from_env() is None
+        monkeypatch.setenv("APEX_TPU_OPS_PORT", "0")
+        srv = OpsServer.from_env()
+        assert srv is not None and srv.port == 0
+
+    def test_scrape_publishes_self_observability(self):
+        srv = OpsServer()
+        srv.scrape()
+        assert board.get("ops/scrapes") == 1
+        assert board.get("ops/scrape_ms") is not None
+
+
+# ---------------------------------------------------------------------------
+# overhead: the PR 3 bar, applied to the scrape path
+# ---------------------------------------------------------------------------
+
+
+class TestScrapeOverhead:
+    def test_scrape_never_fetches_or_syncs(self):
+        """The <1% claim's mechanism: a scrape renders the registry's
+        CACHED values — no blocking fetch, no device contact.  100
+        scrapes must leave the fetch count at zero and the async
+        double-buffer untouched."""
+        fetches = []
+
+        class CountingRegistry(MetricRegistry):
+            def fetch(self):
+                fetches.append(1)
+                return super().fetch()
+
+            def _rotate(self):
+                fetches.append(1)  # even an async copy start counts
+                return super()._rotate()
+
+        reg = CountingRegistry(fetch_every=1000)
+        reg.gauge("x")
+        st = reg.update(reg.init(), {"x": 1.0})
+        reg.observe(1, st)  # off-cadence: stays pending
+        srv = OpsServer(registries=[reg])
+        for _ in range(100):
+            srv.scrape()
+        assert not fetches, "scrape touched the device fetch path"
+        assert reg._pending is not None  # the stash survived untouched
+
+    def test_device_cost_identical_under_scraping(self):
+        """The compiled-cost-model pin (same bar as the PR 3 registry
+        test): the step program's flops/bytes are IDENTICAL with a live
+        exporter scraping concurrently — the exporter adds zero device
+        ops, so its share of the <1% budget is exactly 0."""
+        import jax
+        import jax.numpy as jnp
+
+        reg = MetricRegistry(fetch_every=32)
+        reg.gauge("loss")
+
+        def chunk(w, m):
+            def body(carry, _):
+                w, m = carry
+                w = w @ w * 0.99
+                m = reg.update(m, {"loss": jnp.sum(w)})
+                return (w, m), ()
+
+            (w, m), _ = jax.lax.scan(body, (w, m), None, length=8)
+            return w, m
+
+        w0 = jnp.ones((64, 64), jnp.float32)
+        m0 = reg.init()
+        fn = jax.jit(chunk)
+
+        def costs():
+            c = fn.lower(w0, m0).compile().cost_analysis()
+            c = c[0] if isinstance(c, (list, tuple)) else c
+            return (float(c.get("flops", 0.0)),
+                    float(c.get("bytes accessed", 0.0)))
+
+        bare = costs()
+        srv = OpsServer(registries=[reg], port=0).start()
+        stop = threading.Event()
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    urllib.request.urlopen(srv.url, timeout=5).read()
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+        try:
+            scraped = costs()
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            srv.stop()
+        assert bare == scraped, (
+            f"exporter perturbed the compiled step: {bare} vs {scraped}"
+        )
+
+    def test_host_path_tripwire_under_scraping(self):
+        """Wall-clock tripwire (PR 3's 25% discipline, not a precision
+        claim on a shared container): the hot observe() loop with a
+        thread scraping flat-out must stay within 1.25x of the bare
+        loop on its best-of-9 ratio."""
+        reg = MetricRegistry(fetch_every=10_000)
+        reg.gauge("x")
+        st = reg.update(reg.init(), {"x": 1.0})
+
+        def observe_loop(n=2000):
+            t0 = time.perf_counter()
+            for i in range(n):
+                reg.observe(i + 1, st)
+            return time.perf_counter() - t0
+
+        observe_loop()  # warmup
+        srv = OpsServer(registries=[reg])
+        stop = threading.Event()
+
+        def scraper():
+            while not stop.is_set():
+                srv.scrape()
+
+        ratios = []
+        for _ in range(9):
+            tb = observe_loop()
+            t = threading.Thread(target=scraper, daemon=True)
+            stop.clear()
+            t.start()
+            ti = observe_loop()
+            stop.set()
+            t.join(timeout=5)
+            ratios.append(ti / tb)
+        assert min(ratios) < 1.25, (
+            f"scrape-under-load tripwire: best ratio {min(ratios):.3f} "
+            f"(all: {[round(r, 3) for r in ratios]})"
+        )
+
+
+def test_board_class_unaffected():
+    # the Board stays a plain dict surface (no validation — ad-hoc keys
+    # are skipped at render time instead)
+    b = Board()
+    b.set("weird key!!", 1)
+    text = render(board=b.snapshot())
+    parse_exposition(text)

@@ -30,11 +30,37 @@ additionally records every request's span chain
 ``tools/timeline.py`` for the Perfetto timeline and the
 span-accounting CI gate (``docs/observability.md``).
 
+The live ops plane (``docs/observability.md`` "Live ops plane"):
+
+- ``--ops-port PORT`` (or ``APEX_TPU_OPS_PORT``; 0 = OS-assigned)
+  serves OpenMetrics at ``/metrics`` while the load runs — scheduler
+  gauges/counters, the TTFT histogram, and the board.  One scrape is
+  taken over real HTTP mid-run and one after the final registry drain;
+  both land in the ``--json`` artifact (the end-of-run one parsed and
+  value-cross-checked against the registry section by the
+  ``verify_tier1.sh`` OPS gate).
+- with ``--slo-ttft-ms`` set, a health :class:`Watchdog` evaluates the
+  serving SLO set (TTFT latency, goodput, deadline-shed rate) with
+  multi-window burn-rate alerting on every scheduler iteration; fired
+  alerts land in the artifact AND — with ``--spans`` — on the span
+  timeline next to the requests that blew the budget.  The window pair
+  is scaled by ``--slo-burn-short/--slo-burn-long`` (seconds) so a CI
+  storm fires in-process; production deployments use the SRE-workbook
+  defaults in :mod:`apex_tpu.observability.slo`.
+- live device-memory watermarks are sampled every iteration
+  (``device.memory_stats()`` on TPU; a fake provider seeded from the
+  engine's OWN static peak-HBM predictions on CPU — scale it with
+  ``--memstats-fake-scale`` to plant drift) and cross-checked against
+  the static analyzer at the end: drift beyond
+  ``--memstats-tolerance`` is reported in the artifact naming the
+  program, never silently.
+
 Usage::
 
     python tools/serve_bench.py                  # small CPU run
     python tools/serve_bench.py --requests 32 --rate 50 --json out.json
     python tools/serve_bench.py --spans spans.json --json out.json
+    python tools/serve_bench.py --ops-port 9400 --slo-ttft-ms 250
 """
 
 from __future__ import annotations
@@ -169,15 +195,38 @@ def numerics_check(cfg, model, params, args):
     return out
 
 
-def run_load(engine, registry, args, spans=None):
+def http_scrape(url, timeout=5.0):
+    """One HTTP GET of the ops endpoint: ``{ok, ms, bytes, status}``
+    (+ ``text`` on success, ``error`` on failure)."""
+    import urllib.error
+    import urllib.request
+
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read().decode("utf-8")
+            return {
+                "ok": True,
+                "status": resp.status,
+                "ms": 1e3 * (time.perf_counter() - t0),
+                "bytes": len(body),
+                "content_type": resp.headers.get("Content-Type", ""),
+                "text": body,
+            }
+    except (urllib.error.URLError, OSError) as e:
+        return {
+            "ok": False,
+            "ms": 1e3 * (time.perf_counter() - t0),
+            "error": f"{type(e).__name__}: {e}",
+        }
+
+
+def run_load(sched, args, *, watchdog=None, monitor=None, ops=None):
     import numpy as np
 
-    from apex_tpu.serve import ContinuousBatchingScheduler, Request
+    from apex_tpu.serve import Request
 
     rs = np.random.RandomState(args.seed)
-    sched = ContinuousBatchingScheduler(
-        engine, registry=registry, spans=spans
-    )
 
     # Poisson arrivals: exponential inter-arrival gaps at --rate req/s,
     # pre-drawn so the run is deterministic under --seed
@@ -188,8 +237,10 @@ def run_load(engine, registry, args, spans=None):
 
     t0 = time.monotonic()
     submitted = 0
+    iteration = 0
     fills = []
     occupancy = []
+    mid_scrape = None
     while submitted < args.requests or sched.pending:
         now = time.monotonic() - t0
         while submitted < args.requests and arrivals[submitted] <= now:
@@ -202,8 +253,22 @@ def run_load(engine, registry, args, spans=None):
             submitted += 1
         if sched.pending:
             sched.step()
+            iteration += 1
             fills.append(sched.batch_fill())
             occupancy.append(sched.pool.occupancy())
+            if monitor is not None:
+                monitor.sample(iteration)
+            if watchdog is not None:
+                watchdog.on_step(iteration)
+            if (
+                ops is not None
+                and mid_scrape is None
+                and submitted * 2 >= args.requests
+            ):
+                # the scrape-under-load proof: a real HTTP GET against
+                # the endpoint WHILE the scheduler is mid-traffic
+                mid_scrape = http_scrape(ops.url)
+                mid_scrape.pop("text", None)  # the end-of-run one is kept
         elif submitted < args.requests:
             time.sleep(min(0.002, arrivals[submitted] - now))
     wall = time.monotonic() - t0
@@ -278,6 +343,7 @@ def run_load(engine, registry, args, spans=None):
         "wall_s": wall,
         "_ttft_samples": ttfts,
         "_per_tok_samples": per_tok,
+        "_mid_scrape": mid_scrape,
     }
 
 
@@ -330,12 +396,50 @@ def main():
                     help="record per-request span chains and dump them "
                     "here (feed to tools/timeline.py)")
     ap.add_argument("--span-capacity", type=int, default=65536)
+    ap.add_argument("--ops-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve OpenMetrics at /metrics during the run "
+                    "(0 = OS-assigned; APEX_TPU_OPS_PORT is the default)")
+    ap.add_argument("--slo-objective", type=float, default=0.9,
+                    help="TTFT SLO objective (fraction of requests "
+                    "under --slo-ttft-ms)")
+    ap.add_argument("--slo-burn-short", type=float, default=0.25,
+                    metavar="S",
+                    help="short burn-rate window, seconds (scaled for "
+                    "in-process runs; production uses slo.DEFAULT_WINDOWS)")
+    ap.add_argument("--slo-burn-long", type=float, default=1.0,
+                    metavar="S", help="long burn-rate window, seconds")
+    ap.add_argument("--slo-burn-factor", type=float, default=2.0,
+                    help="burn-rate page factor over BOTH windows")
+    ap.add_argument("--memstats-fake-scale", type=float, default=1.0,
+                    help="scale of the fake provider's live watermark "
+                    "vs the static peak (CPU only; 2.0 plants the "
+                    "drift the CI gate must flag)")
+    ap.add_argument("--memstats-tolerance", type=float, default=0.25,
+                    help="static-vs-live reconciliation tolerance")
     args = ap.parse_args()
+    if args.ops_port is None:
+        from apex_tpu.observability.ometrics import ops_port_from_env
+
+        args.ops_port = ops_port_from_env()
 
     cfg, model, params, engine, registry = build_engine(args)
     lint_errors = {
         name: len(rep.errors()) for name, rep in engine.reports.items()
     }
+
+    from apex_tpu.observability import memstats as memstats_lib
+
+    # the engine build (verify=True) just published its per-program
+    # static peak-HBM predictions — the reconciliation baseline
+    static_peaks = memstats_lib.static_peaks_from_board()
+    provider = memstats_lib.default_provider()
+    if provider is None:  # CPU tier: fake seeded from the static peaks
+        provider = memstats_lib.FakeMemoryProvider.from_static(
+            static_peaks or {"unverified": 0.0},
+            scale=args.memstats_fake_scale,
+        )
+    monitor = memstats_lib.MemStatsMonitor(provider)
 
     recorder = None
     if args.spans:
@@ -344,7 +448,45 @@ def main():
         recorder = SpanRecorder(capacity=args.span_capacity)
 
     baseline_fill = single_request_baseline(engine, args)
-    load = run_load(engine, registry, args, spans=recorder)
+
+    from apex_tpu.serve import ContinuousBatchingScheduler
+
+    sched = ContinuousBatchingScheduler(
+        engine, registry=registry, spans=recorder
+    )
+
+    ops = None
+    if args.ops_port is not None:
+        from apex_tpu.observability.ometrics import OpsServer
+
+        ops = OpsServer(
+            registries=[registry], histograms=[sched.ttft_hist],
+            collect=monitor.sample, port=args.ops_port,
+        ).start()
+        print(f"[serve_bench] ops endpoint live at {ops.url}")
+
+    watchdog = None
+    if args.slo_ttft_ms is not None:
+        from apex_tpu.observability import slo as slo_lib
+        from apex_tpu.observability.health import Watchdog
+
+        windows = (slo_lib.Window(
+            args.slo_burn_short, args.slo_burn_long,
+            args.slo_burn_factor, "critical",
+        ),)
+        watchdog = Watchdog(
+            rules=slo_lib.serve_slo_rules(
+                ttft_histogram=sched.ttft_hist,
+                ttft_threshold_ms=args.slo_ttft_ms,
+                ttft_objective=args.slo_objective,
+                windows=windows,
+            ),
+            registry=registry, spans=recorder, check_every=1,
+        )
+
+    load = run_load(
+        sched, args, watchdog=watchdog, monitor=monitor, ops=ops
+    )
     numerics = numerics_check(cfg, model, params, args)
 
     if recorder is not None:
@@ -355,7 +497,16 @@ def main():
 
     ttft_samples = load.pop("_ttft_samples")
     per_tok_samples = load.pop("_per_tok_samples")
+    mid_scrape = load.pop("_mid_scrape")
     registry.fetch()
+
+    # the end-of-run scrape happens AFTER the registry drain, so its
+    # gauge/counter samples must EQUAL the artifact's registry section
+    # — the OPS gate's cross-check
+    final_scrape = http_scrape(ops.url) if ops is not None else None
+    memstats_findings = monitor.crosscheck(
+        static_peaks, tolerance=args.memstats_tolerance
+    )
 
     print(f"== serve_bench: {args.requests} requests, Poisson "
           f"{args.rate}/s, kv_wire={args.kv_wire}, "
@@ -399,6 +550,28 @@ def main():
               f"{rec['max_abs_logit_diff']:.2e} tol={rec['tolerance']} "
               f"{'OK' if rec['ok'] else 'FAIL'}")
     print(f"graph lint ERRORs: {lint_errors}")
+
+    slo_events = list(watchdog.events) if watchdog is not None else []
+    if watchdog is not None:
+        print(f"SLO burn-rate alerts fired: {len(slo_events)}")
+        for ev in slo_events[:5]:
+            print(f"  [{ev.severity}] {ev.rule}: {ev.message}")
+    live_peaks = monitor.live_peaks()
+    print(
+        f"memstats [{provider.kind}]: live peak "
+        f"{max(live_peaks.values(), default=0.0) / (1 << 20):.2f} MiB "
+        f"vs static {max(static_peaks.values(), default=0.0) / (1 << 20):.2f}"
+        f" MiB over {len(static_peaks)} program(s); "
+        f"{len(memstats_findings)} drift finding(s)"
+    )
+    for f in memstats_findings:
+        print(f"  DRIFT: {f['message']}")
+    if ops is not None and final_scrape is not None:
+        print(
+            f"ops scrape: {final_scrape.get('bytes', 0)} bytes in "
+            f"{final_scrape['ms']:.2f}ms "
+            f"(mid-run: {'OK' if mid_scrape and mid_scrape.get('ok') else 'MISSED'})"
+        )
 
     failures = []
     if bf["mean"] <= baseline_fill:
@@ -444,6 +617,34 @@ def main():
                 k: v for k, v in registry.values().items()
                 if k.startswith("serve/")
             },
+            "ttft_histogram": sched.ttft_hist.snapshot(),
+            "ops": None if ops is None else {
+                "port": ops.port,
+                "url": ops.url,
+                "mid_scrape": mid_scrape,
+                "scrape": final_scrape,
+            },
+            "slo": None if watchdog is None else {
+                "alerts_fired": len(slo_events),
+                "windows": {
+                    "short_s": args.slo_burn_short,
+                    "long_s": args.slo_burn_long,
+                    "factor": args.slo_burn_factor,
+                },
+                "events": [ev._asdict() for ev in slo_events],
+            },
+            "memstats": {
+                "provider": provider.kind,
+                "fake_scale": (
+                    args.memstats_fake_scale
+                    if provider.kind == "fake" else None
+                ),
+                "tolerance": args.memstats_tolerance,
+                "live_peaks": live_peaks,
+                "static_peaks": static_peaks,
+                "watermark_samples": monitor.samples,
+                "findings": memstats_findings,
+            },
             "spans_file": args.spans,
             "failures": failures,
         }
@@ -457,6 +658,8 @@ def main():
             f.write("\n")
         print(f"[serve_bench] wrote {args.json}")
 
+    if ops is not None:
+        ops.stop()
     for msg in failures:
         print(f"FAIL {msg}", file=sys.stderr)
     return 1 if failures else 0

@@ -78,8 +78,23 @@
 # on both the artifact and the registry, and the merged Perfetto trace
 # must carry real events.
 #
+# An OPS stage drives the live ops plane end to end
+# (docs/observability.md "Live ops plane", ISSUE 11): serve_bench runs
+# a Poisson load with --ops-port 0 --spans under a PLANTED deadline
+# storm (--slo-ttft-ms 1: every admission blows the TTFT objective).
+# The gate asserts (1) the artifact's end-of-run HTTP scrape is
+# OpenMetrics-valid (ometrics.parse_exposition) and carries
+# TTFT/queue/goodput/watermark families whose values EQUAL the
+# artifact's registry section (the scrape ran after the final drain);
+# (2) the fast-burn multi-window SLO alert fired as a critical
+# HealthEvent AND landed as a health/slo_ttft instant in the span dump
+# and the merged Perfetto trace; (3) the fake-provider memstats
+# cross-check reconciles cleanly on the honest run, and a second run
+# with --memstats-fake-scale 2.0 (a planted static-vs-live drift) is
+# FLAGGED with a finding naming the governing program.
+#
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf + serve
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf + serve + ops
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -91,6 +106,7 @@
 #   T1_SKIP_LINT=1              skip the static-analysis pass
 #   T1_SKIP_PERF=1              skip the perf-gate pass
 #   T1_SKIP_SERVE=1             skip the serving pass
+#   T1_SKIP_OPS=1               skip the live-ops-plane pass
 
 set -o pipefail
 
@@ -543,12 +559,123 @@ PYEOF
     fi
 fi
 
+ops_rc=0
+if [ "${T1_SKIP_OPS:-0}" != "1" ]; then
+    OPS_JSON="$(mktemp /tmp/_t1_ops.XXXXXX.json)"
+    OPS_SPANS="$(mktemp /tmp/_t1_ops_spans.XXXXXX.json)"
+    OPS_TRACE="$(mktemp /tmp/_t1_ops_trace.XXXXXX.json)"
+    # the planted deadline storm: a 1ms TTFT objective every admission
+    # blows, judged by an in-process-scaled (0.15s, 0.6s, 2x) window
+    # pair — the fast-burn alert must fire DURING the run and land on
+    # the span timeline beside the requests that blew the budget
+    timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+        python tools/serve_bench.py --requests 16 --rate 300 \
+        --output-mix 8 16 24 \
+        --slo-ttft-ms 1 --slo-burn-short 0.15 --slo-burn-long 0.6 \
+        --ops-port 0 --spans "$OPS_SPANS" --json "$OPS_JSON" \
+        2>&1 | tail -n 6 | tee -a "$LOG"
+    ops_rc=${PIPESTATUS[0]}
+    if [ "$ops_rc" -eq 0 ]; then
+        timeout -k 10 120 env JAX_PLATFORMS=cpu \
+            python tools/timeline.py --spans "$OPS_SPANS" \
+            --out "$OPS_TRACE" 2>&1 | tee -a "$LOG"
+        ops_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$ops_rc" -eq 0 ]; then
+        python - "$OPS_JSON" "$OPS_SPANS" "$OPS_TRACE" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+sys.path.insert(0, ".")
+from apex_tpu.observability.ometrics import parse_exposition
+art = json.load(open(sys.argv[1]))
+spans = json.load(open(sys.argv[2]))
+trace = json.load(open(sys.argv[3]))
+# 1. the endpoint served OpenMetrics-valid text, live under load AND
+#    after the final registry drain
+ops = art["ops"]
+assert ops["mid_scrape"] and ops["mid_scrape"]["ok"], ops["mid_scrape"]
+assert ops["scrape"]["content_type"].startswith(
+    "application/openmetrics-text"), ops["scrape"]["content_type"]
+fams = parse_exposition(ops["scrape"]["text"])  # raises on violations
+for need in ("apex_tpu_serve_ttft_ms", "apex_tpu_serve_ttft_hist_ms",
+             "apex_tpu_serve_queue_depth", "apex_tpu_serve_completed",
+             "apex_tpu_memstats_device0_peak_bytes_in_use"):
+    assert need in fams, f"scrape missing {need}; have {len(fams)} families"
+# the scrape's values EQUAL the artifact registry section (the scrape
+# ran after the drain — zero-cadence staleness)
+reg = art["registry"]
+for key, fam in (("serve/completed", "apex_tpu_serve_completed"),
+                 ("serve/shed", "apex_tpu_serve_shed"),
+                 ("serve/queue_depth", "apex_tpu_serve_queue_depth"),
+                 ("serve/ttft_ms", "apex_tpu_serve_ttft_ms")):
+    assert fams[fam]["value"] == reg[key], (key, fams[fam]["value"], reg[key])
+# 2. the storm fired the fast-burn SLO alert, critically, and it is ON
+#    the timeline with the request spans
+slo = art["slo"]
+assert slo["alerts_fired"] >= 1, slo
+ttft_alerts = [e for e in slo["events"] if e["rule"] == "slo_ttft"]
+assert ttft_alerts and ttft_alerts[0]["severity"] == "critical", slo["events"]
+health = [e for e in spans["spans"]
+          if e.get("track") == "health" and e["name"] == "health/slo_ttft"]
+assert health, "SLO alert missing from the span dump's health track"
+assert any(e.get("name") == "health/slo_ttft"
+           for e in trace["traceEvents"]), "alert not in the merged trace"
+# 3. the honest fake-provider memstats run reconciles cleanly
+mem = art["memstats"]
+assert mem["provider"] == "fake", mem["provider"]  # CPU tier
+assert mem["findings"] == [], mem["findings"]
+assert mem["watermark_samples"] > 0
+assert len(mem["static_peaks"]) >= 2, mem["static_peaks"]
+print(f"OPS gate OK: {len(fams)} families served, "
+      f"{slo['alerts_fired']} SLO alert(s) on the timeline, memstats "
+      f"reconciled over {len(mem['static_peaks'])} static programs")
+PYEOF
+        ops_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$ops_rc" -eq 0 ]; then
+        # the planted static-vs-live drift: a fake watermark at 2x the
+        # static peak MUST come back as a finding naming the program
+        OPS_DRIFT="$(mktemp /tmp/_t1_ops_drift.XXXXXX.json)"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+            python tools/serve_bench.py --requests 3 \
+            --memstats-fake-scale 2.0 --json "$OPS_DRIFT" \
+            2>&1 | tail -n 2 | tee -a "$LOG"
+        ops_rc=${PIPESTATUS[0]}
+        if [ "$ops_rc" -eq 0 ]; then
+            python - "$OPS_DRIFT" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+mem = json.load(open(sys.argv[1]))["memstats"]
+assert mem["findings"], "planted 2x drift was NOT flagged"
+f = mem["findings"][0]
+assert f["direction"] == "static-under-predicts", f
+assert f["program"], f
+assert abs(f["ratio"] - 2.0) < 0.05, f
+print(f"planted drift flagged OK: {f['program']} at {f['ratio']:.2f}x")
+PYEOF
+            ops_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$ops_rc" -eq 0 ]; then
+            rm -f "$OPS_DRIFT"
+        else
+            echo "TIER1-OPS: planted-drift check failed (artifact at" \
+                "$OPS_DRIFT)" | tee -a "$LOG"
+        fi
+    fi
+    if [ "$ops_rc" -eq 0 ]; then
+        rm -f "$OPS_JSON" "$OPS_SPANS" "$OPS_TRACE"
+        echo "TIER1-OPS: PASS"
+    else
+        echo "TIER1-OPS: FAIL (rc=$ops_rc; artifacts at $OPS_JSON" \
+            "$OPS_SPANS $OPS_TRACE)"
+    fi
+fi
+
 if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
     && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] \
-    && [ "$perf_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ]; then
+    && [ "$perf_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ] \
+    && [ "$ops_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, perf rc=$perf_rc, serve rc=$serve_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
@@ -556,4 +683,5 @@ fi
 [ "$flight_rc" -ne 0 ] && exit "$flight_rc"
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
 [ "$perf_rc" -ne 0 ] && exit "$perf_rc"
-exit "$serve_rc"
+[ "$serve_rc" -ne 0 ] && exit "$serve_rc"
+exit "$ops_rc"
