@@ -35,6 +35,11 @@ Subpackages
 - :mod:`apex_tpu.analysis` — jaxpr/HLO graph linter: transfer /
   promotion / donation / retrace / collective-consistency passes over
   traced and compiled step programs.
+- :mod:`apex_tpu.train` — the single composable training entry point:
+  a declarative dp×tp trainer with framework-chosen (ZeRO-style)
+  update sharding, self-verified against the analysis passes at build.
+- :mod:`apex_tpu.serve` — AOT-compiled serving: paged KV cache,
+  continuous batching, TTFT SLOs.
 """
 
 __version__ = "0.1.0"
@@ -63,6 +68,8 @@ _LAZY_SUBMODULES = (
     "checkpoint",
     "resilience",
     "observability",
+    "serve",
+    "train",
 )
 
 

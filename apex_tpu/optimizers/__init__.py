@@ -30,3 +30,27 @@ from apex_tpu.optimizers.multi_tensor import (  # noqa: F401
     per_tensor_norm,
     scale_with_overflow_check,
 )
+
+#: name → optax-style factory — the registry `apex_tpu.train.TrainConfig
+#: (optimizer="adam")` resolves through.  The ZeRO-twin mapping (which of
+#: these the trainer can shard across replicas) lives with the trainer
+#: (`apex_tpu.train.trainer.ZERO_TWINS`).
+FACTORIES = {
+    "adagrad": fused_adagrad,
+    "adam": fused_adam,
+    "lamb": fused_lamb,
+    "novograd": fused_novograd,
+    "sgd": fused_sgd,
+}
+
+
+def by_name(name: str):
+    """The lowercase optimizer factory registered under ``name``; raises
+    with the available names on a miss (a typo'd optimizer must fail the
+    build loudly, not fall back)."""
+    try:
+        return FACTORIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {name!r}; have {sorted(FACTORIES)}"
+        ) from None

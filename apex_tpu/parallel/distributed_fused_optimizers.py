@@ -232,6 +232,28 @@ class _DistributedFusedBase:
         new_state = new_state._replace(master=new_p_shard)
         return self.gather_params(new_p_shard, params), new_state
 
+    def update_with_norm(self, grads, state, params,
+                         gradient_average: bool = True):
+        """:meth:`update_inside_shard_map` that also returns the global
+        L2 norm of the reduced (averaged) gradient — measured on the
+        reduce-scattered shards, so it costs one extra scalar psum and
+        nothing else.  The shards partition the flat buffer exactly, so
+        the psum of per-shard square-sums is the exact norm of the
+        gradient the update consumed (per ``axis_name`` group: with an
+        additional tp axis the flat buffer duplicates tp-replicated
+        leaves, so callers wanting a global norm there must account for
+        it — :class:`apex_tpu.train.Trainer` refuses that combination).
+        """
+        g_shard = self.reduce_scatter_grads(grads, gradient_average)
+        norm = jnp.sqrt(
+            jax.lax.psum(jnp.sum(g_shard * g_shard), self.axis_name)
+        )
+        new_p_shard, new_state = self._shard_update(
+            g_shard, state, state.master
+        )
+        new_state = new_state._replace(master=new_p_shard)
+        return self.gather_params(new_p_shard, params), new_state, norm
+
     # -- convenience ----------------------------------------------------
     def make_train_step(self, loss_fn, mesh=None):
         """jitted SPMD step: (params, state, batch) -> (params, state, loss).

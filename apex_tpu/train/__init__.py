@@ -1,0 +1,66 @@
+"""``apex_tpu.train`` — the single composable entry point for training.
+
+One import composes what the rest of the framework ships as parts:
+DDP and ZeRO (through the shared comm engine, ``docs/comm.md``),
+tensor parallelism (rule-table-placed params over a ``(dp, tp)``
+mesh), guarded-amp resilience, observability, and the static-analysis
+proofs — the TorchTitan shape (PAPERS.md), with the headline that the
+FRAMEWORK decides whether the weight update shards across data-parallel
+replicas ("Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training", PAPERS.md)::
+
+    from apex_tpu.train import TrainConfig, Trainer
+    from jax.sharding import PartitionSpec as P
+
+    cfg = TrainConfig(
+        mesh={"dp": 2, "tp": 2},
+        rules=[(r"mlp/kernel", P(None, "tp")),
+               (r"attn/out",   P("tp", None)),
+               (r".*",         P())],
+        wire="int8", optimizer="adam",
+    )
+    step = Trainer(cfg).build(loss_fn, params, example_batch)
+    state, aux = step(step.state, batch)      # compiled, donation-aliased
+    step.fit(batch_fn, 1000, directory=ckpt)  # run_resilient + goodput
+
+Builds are self-verifying: the compiled step is checked against the
+config-derived sharding rule table, collective plan, and HBM budget
+(:mod:`apex_tpu.analysis`) and a violating build raises
+:class:`TrainBuildError`.  See ``docs/training.md``.
+"""
+
+from apex_tpu.train.config import (  # noqa: F401
+    TrainConfig,
+    UPDATE_SHARDING_MODES,
+    VERIFY_LEVELS,
+)
+from apex_tpu.train.sharding import (  # noqa: F401
+    UpdateShardingDecision,
+    decide_update_sharding,
+)
+from apex_tpu.train.trainer import (  # noqa: F401
+    TrainBuildError,
+    Trainer,
+    TrainStep,
+)
+from apex_tpu.train.guarded import (  # noqa: F401
+    GuardedStep,
+    build_guarded,
+)
+from apex_tpu.train import demo  # noqa: F401
+from apex_tpu.train.demo import build_demo  # noqa: F401
+
+__all__ = [
+    "TrainConfig",
+    "Trainer",
+    "TrainStep",
+    "TrainBuildError",
+    "UpdateShardingDecision",
+    "decide_update_sharding",
+    "UPDATE_SHARDING_MODES",
+    "VERIFY_LEVELS",
+    "GuardedStep",
+    "build_guarded",
+    "build_demo",
+    "demo",
+]

@@ -1,0 +1,222 @@
+"""Guarded two-phase programs — the resilient example's step shape.
+
+The fused :meth:`Trainer.build` step is the production default, but the
+chaos-drill path (``examples/simple/resilient``, the verify_tier1 OBS /
+FLIGHT gates) needs the gradient tree to surface on the host BETWEEN
+gradient computation and the update, so the chaos ``grads`` site can
+poison it deterministically.  :func:`build_guarded` composes that
+two-program shape from the same config machinery:
+
+- ``compute_grads(params, scaler_state, batch) -> (loss, scaled)`` —
+  shard_map over the trainer's mesh; the dp gradient sync runs INSIDE
+  via the shared :class:`~apex_tpu.parallel.DistributedDataParallel`
+  engine (``wire=``/``chunks=`` from the config; ``accum=K``
+  microbatches accumulate locally with ONE boundary sync), and the
+  loss scale is applied so the tree that crosses the host boundary is
+  the scaled one the guard expects;
+- ``apply_update(scaled, state, loss) -> (state, verdict)`` — the
+  :func:`apex_tpu.resilience.guards.guarded_amp_update` step
+  (NaN/spike skip + budget) with the metric fold INSIDE the jitted
+  update when a registry is given.
+
+The returned :class:`GuardedStep` carries the same derived
+``expect_sharding`` / ``expect_plan`` the fused build verifies against,
+so ``tools/graph_lint.py --target resilient`` keeps proving the EXACT
+programs the example dispatches.  Replicated update only (the guarded
+ZeRO variant is future work): ``tp`` must be 1 and the update-sharding
+override must not demand ``shard``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.train.trainer import TrainBuildError
+
+__all__ = ["GuardedStep", "build_guarded"]
+
+
+@dataclasses.dataclass
+class GuardedStep:
+    """The two jitted programs plus everything the example / linters /
+    profilers consume."""
+
+    compute_grads: Callable
+    apply_update: Callable
+    state: Any
+    mesh: Any
+    dp: int
+    ddp: Any  # the DistributedDataParallel engine (comm knobs live here)
+    tx: Any
+    scaler: Any
+    guard: Any
+    registry: Any
+    shard_rules: list
+    expect_sharding: dict
+    expect_plan: dict
+
+
+def build_guarded(
+    trainer,
+    loss_fn: Callable[[Any, Any], Any],
+    params,
+    *,
+    tx,
+    scaler,
+    guard,
+    registry=None,
+    accum: int = 1,
+    verify: str = "off",
+    example_batch=None,
+) -> GuardedStep:
+    """Compose the guarded two-phase programs from ``trainer``'s config
+    (see module docstring).  ``loss_fn(params, microbatch) -> scalar``.
+
+    ``verify="error"|"warn"`` (requires ``example_batch``) runs
+    :func:`apex_tpu.analysis.check` over ``compute_grads`` at build with
+    the derived expectations — the fused build's self-check.  The
+    default ``"off"`` leaves that to the CI lint gate, which audits the
+    returned programs against the returned expectations anyway
+    (``tools/graph_lint.py --target resilient``) — the example starts
+    fast either way.
+    """
+    from apex_tpu import amp
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.resilience import guard_metrics, guarded_amp_update
+
+    cfg = trainer.config
+    if cfg.tp != 1:
+        raise TrainBuildError(
+            "build_guarded composes a replicated guarded-amp update; "
+            f"tp={cfg.tp} needs the fused Trainer.build path"
+        )
+    if cfg.update_sharding == "shard":
+        raise TrainBuildError(
+            "build_guarded cannot shard the update (the guard needs the "
+            "replicated tree); drop update_sharding='shard' or use "
+            "Trainer.build"
+        )
+    mesh = trainer.mesh()
+    dp = cfg.dp
+
+    ddp = DistributedDataParallel(
+        loss_fn,
+        wire=cfg.wire,
+        chunks=cfg.chunks,
+        block=cfg.block,
+        min_size=cfg.min_sync_size,
+    )
+
+    state = {
+        "params": params,
+        "opt": tx.init(params),
+        "scaler": scaler.init(),
+        "guard": guard.init(),
+    }
+    if registry is not None:
+        state["metrics"] = registry.init()
+
+    def grads_fn(params, scaler_state, batch):
+        # batch leaves: (accum, rows, ...); microbatch grads stay LOCAL
+        # inside the scan (no_sync), ONE engine sync on the boundary
+        if accum == 1:
+            loss, grads = ddp.value_and_grad(
+                params, jax.tree_util.tree_map(lambda x: x[0], batch)
+            )
+        else:
+            loss, grads = ddp.accum_value_and_grad(params, batch)
+        scaled = jax.tree_util.tree_map(
+            lambda g: scaler.scale(g, scaler_state), grads
+        )
+        return loss, scaled
+
+    compute_grads = jax.jit(
+        jax.shard_map(
+            grads_fn,
+            mesh=mesh,
+            in_specs=(P(), P(), P(None, "dp")),
+            out_specs=(P(), P()),
+        )
+    )
+
+    @jax.jit
+    def apply_update(scaled, state, loss):
+        p, o, s, g, verdict = guarded_amp_update(
+            tx, scaler, guard, scaled, state["opt"], state["params"],
+            state["scaler"], state["guard"],
+        )
+        new_state = {"params": p, "opt": o, "scaler": s, "guard": g}
+        if registry is not None:
+            # device-side metric fold, INSIDE the jitted update: no
+            # host sync — the registry fetches on its own cadence
+            new_state["metrics"] = registry.update(state["metrics"], {
+                "train/loss": loss,
+                **guard_metrics(verdict, g, guard),
+                **amp.DynamicLossScaler.metrics(s),
+            })
+        return new_state, verdict
+
+    # -- the declared sharding & collective plan -----------------------
+    # ONE resolution drives graph_lint / shard_report AND documents the
+    # intent: params/scaler replicated (the DDP contract), batch rows
+    # dp-sharded, and only the comm engine's declared gradient sync.
+    shard_rules = [
+        (r"^params(/|$)", P()),           # replicated: the DDP contract
+        (r"^scaler", P()),
+        (r"^batch(/|$)", P(None, "dp")),  # (accum, rows, feat)
+    ]
+    expect_sharding = {
+        "mesh": {"dp": dp},
+        "rules": shard_rules,
+        "min_bytes": cfg.min_shard_bytes,
+    }
+    expect_plan = ddp.collective_plan(params, dp)
+
+    step = GuardedStep(
+        compute_grads=compute_grads,
+        apply_update=apply_update,
+        state=state,
+        mesh=mesh,
+        dp=dp,
+        ddp=ddp,
+        tx=tx,
+        scaler=scaler,
+        guard=guard,
+        registry=registry,
+        shard_rules=shard_rules,
+        expect_sharding=expect_sharding,
+        expect_plan=expect_plan,
+    )
+    if verify != "off":
+        _verify_guarded(step, verify, example_batch)
+    return step
+
+
+def _verify_guarded(step: GuardedStep, level: str, example_batch) -> None:
+    import sys
+
+    from apex_tpu import analysis
+
+    if example_batch is None:
+        raise TrainBuildError(
+            "build_guarded(verify=...) needs example_batch to trace "
+            "compute_grads on"
+        )
+    report = analysis.check(
+        step.compute_grads,
+        step.state["params"], step.state["scaler"], example_batch,
+        expect_sharding=step.expect_sharding,
+        expect_plan=step.expect_plan,
+        name="guarded/compute_grads",
+    )
+    if report.errors() and level == "error":
+        raise TrainBuildError(
+            "guarded build failed its own verification:\n"
+            + report.render()
+        )
+    if report.findings:
+        print(report.render(), file=sys.stderr)

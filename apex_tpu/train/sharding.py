@@ -1,0 +1,340 @@
+"""Rule tables and the update-sharding heuristic.
+
+Two jobs, both feeding :class:`apex_tpu.train.Trainer`:
+
+1. **Rule-table resolution** — turn the config's regex→PartitionSpec
+   table into concrete spec trees for every jit ENTRY argument (params,
+   optimizer state, batch, metrics), through
+   :func:`apex_tpu.analysis.match_partition_rules` (ISSUE 9's machinery)
+   so a leaf no rule covers fails LOUDLY naming the path.  The resolved
+   specs then round-trip into an *exact* entry-anchored rule table
+   (:func:`exact_entry_rules`) handed to ``analysis.check`` as
+   ``expect_sharding`` — one resolution drives both the compiled
+   ``in_specs`` and the HLO conformance proof, so they cannot drift.
+
+2. **The update-sharding decision** — the framework (not the user)
+   decides whether the optimizer update shards across dp replicas
+   ("Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+   Training", PAPERS.md — the ZeRO structure
+   :mod:`apex_tpu.parallel.distributed_fused_optimizers` implements).
+   The documented heuristic (:func:`decide_update_sharding`):
+
+   - ``dp == 1`` → **replicate** (no replicas to shard across);
+   - a custom optimizer object → **replicate** (only the named
+     optimizers have a distributed twin);
+   - explicit ``update_sharding="shard"|"replicate"`` → that, always
+     (the override wins — recorded in the decision's ``reason``);
+   - otherwise **shard iff** the f32 param bytes reach
+     ``zero_min_bytes`` (default 4 MiB) — below it the replicated
+     optimizer state fits everywhere and restructuring the sync buys
+     nothing — AND the ZeRO wire plan
+     (:func:`apex_tpu.parallel.comm.zero_plan`) moves at most 2x the
+     bytes of the DDP sync plan (:func:`~apex_tpu.parallel.comm
+     .sync_plan`) under the configured wire, which guards
+     pathological trees (thousands of tiny leaves whose per-leaf psum
+     is cheaper than the padded flat buffer).
+
+   The decision object records the plan bytes and the memory the
+   sharded optimizer state saves (``(dp-1)/dp · 3 · param_bytes`` —
+   m, v, and the f32 master shard instead of three replicated copies),
+   so "why did the framework shard?" is a printed sentence, not a
+   code-read.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.analysis.sharding import (
+    match_partition_rules,
+    spec_dim_factors,
+    tree_paths,
+)
+from apex_tpu.parallel import comm
+
+__all__ = [
+    "ZERO_TWINS",
+    "UpdateShardingDecision",
+    "decide_update_sharding",
+    "resolve_param_specs",
+    "resolve_batch_specs",
+    "mirror_optimizer_specs",
+    "exact_entry_rules",
+    "local_shape",
+    "slice_local",
+    "plan_wire_bytes",
+]
+
+
+#: named optimizers with a distributed (ZeRO) twin — the ONE source the
+#: heuristic, the trainer, and the ``optimizers.by_name`` registry docs
+#: all point at; extending it arms the heuristic for the new name
+ZERO_TWINS = ("adam", "lamb")
+
+
+# ---------------------------------------------------------------------------
+# rule-table resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_param_specs(rules, params):
+    """PartitionSpec pytree for ``params`` from the config rule table.
+
+    Delegates to :func:`apex_tpu.analysis.match_partition_rules` — the
+    SAME resolver the conformance pass uses — so an uncovered leaf
+    raises ``ValueError("partition rule not found for param: <path>")``
+    instead of silently replicating."""
+    return match_partition_rules(list(rules), params)
+
+
+def resolve_batch_specs(batch_rules, batch):
+    """PartitionSpec pytree for the batch; default rule table shards
+    every leaf's leading axis over ``dp``."""
+    rules = list(batch_rules) if batch_rules else [(r".*", P("dp"))]
+    return match_partition_rules(rules, batch)
+
+
+def mirror_optimizer_specs(opt_state, params, param_specs):
+    """Spec tree for an optax-style optimizer state: sub-trees that
+    structurally mirror ``params`` (fused_adam/lamb/sgd moments) inherit
+    the param specs leaf-for-leaf; scalar leaves replicate; anything
+    else is a loud error — an optimizer leaf without a sharding is
+    exactly the silent replication the conformance pass hunts."""
+    params_def = jax.tree_util.tree_structure(params)
+    param_shapes = [
+        tuple(getattr(l, "shape", ()))
+        for l in jax.tree_util.tree_leaves(params)
+    ]
+
+    def assign(path, sub):
+        try:
+            if jax.tree_util.tree_structure(sub) == params_def:
+                shapes = [
+                    tuple(getattr(l, "shape", ()))
+                    for l in jax.tree_util.tree_leaves(sub)
+                ]
+                if shapes == param_shapes:
+                    return param_specs
+        except Exception:  # not a comparable subtree
+            pass
+        leaves = jax.tree_util.tree_leaves(sub)
+        if all(getattr(l, "ndim", 0) == 0 for l in leaves):
+            return jax.tree_util.tree_map(lambda _: P(), sub)
+        raise ValueError(
+            f"cannot infer a sharding for optimizer state field "
+            f"{path!r}: it neither mirrors the params tree nor is "
+            "scalar — pass explicit rules or a named optimizer"
+        )
+
+    # walk the top level of the state (NamedTuple fields / dict values)
+    if hasattr(opt_state, "_fields"):  # NamedTuple
+        return type(opt_state)(*(
+            assign(f, getattr(opt_state, f)) for f in opt_state._fields
+        ))
+    if isinstance(opt_state, dict):
+        return {k: assign(k, v) for k, v in opt_state.items()}
+    if isinstance(opt_state, (list, tuple)):
+        out = [assign(str(i), v) for i, v in enumerate(opt_state)]
+        return type(opt_state)(out)
+    return assign("<state>", opt_state)
+
+
+def _spec_leaves(specs, tree):
+    """Spec leaves aligned with ``tree_paths(tree)`` order."""
+    treedef = jax.tree_util.tree_structure(tree)
+    flat = treedef.flatten_up_to(specs)
+    return flat
+
+
+def exact_entry_rules(sections) -> List[Tuple[str, Any]]:
+    """Exact (escaped, anchored) entry rule table from resolved specs.
+
+    ``sections`` is ``[(arg_name, tree, spec_tree), ...]`` — one entry
+    per jit argument.  The result matches the ``/``-joined paths GSPMD
+    writes into parameter ``op_name`` metadata
+    (:func:`apex_tpu.analysis.sharding.normalize_param_path`), e.g.
+    ``state/params/w1`` or ``batch/0``, each mapped to the EXACT spec
+    the trainer compiled with, plus a replicated catch-all so
+    bookkeeping buffers stay covered.  Because the table is generated
+    from the same resolution that built ``in_specs``, conformance
+    drift is impossible by construction.
+    """
+    rules: List[Tuple[str, Any]] = []
+    for name, tree, specs in sections:
+        paths = [p for p, _ in tree_paths(tree)]
+        spec_flat = _spec_leaves(specs, tree)
+        for path, spec in zip(paths, spec_flat):
+            full = f"{name}/{path}" if path else name
+            rules.append((rf"^{re.escape(full)}$", spec))
+    rules.append((r".*", P()))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# local-shape arithmetic (tp-sharded leaves under manual shard_map)
+# ---------------------------------------------------------------------------
+
+
+def local_shape(shape, spec, mesh: dict) -> tuple:
+    """Per-device shape of a leaf under ``spec`` on ``mesh``."""
+    factors = spec_dim_factors(spec, mesh, len(shape))
+    out = []
+    for dim, f in zip(shape, factors):
+        if dim % f:
+            raise ValueError(
+                f"dim {dim} of shape {tuple(shape)} not divisible by "
+                f"its sharding factor {f} under {spec}"
+            )
+        out.append(dim // f)
+    return tuple(out)
+
+
+def slice_local(leaf, spec, axis: str, index: int, size: int):
+    """Host-side slice of ``leaf``'s shard along every dim ``spec``
+    assigns to ``axis`` (rank ``index`` of ``size``) — how the trainer
+    seeds per-tp-rank ZeRO master shards from global params."""
+    out = leaf
+    entries = tuple(spec) if spec is not None else ()
+    for d in range(getattr(leaf, "ndim", 0)):
+        e = entries[d] if d < len(entries) else None
+        names = e if isinstance(e, (tuple, list)) else (e,)
+        if axis in [n for n in names if n is not None]:
+            if len([n for n in names if n is not None]) > 1:
+                raise NotImplementedError(
+                    f"mixed-axis dim sharding {e!r} is not supported by "
+                    "the trainer's ZeRO path"
+                )
+            n = out.shape[d] // size
+            out = jax.lax.slice_in_dim(out, index * n, (index + 1) * n,
+                                       axis=d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the update-sharding heuristic
+# ---------------------------------------------------------------------------
+
+
+def plan_wire_bytes(entries: Sequence[dict]) -> int:
+    """Upper-bound wire bytes of a collective-plan entry list (the
+    ``bytes`` bounds :func:`comm.sync_plan`/:func:`comm.zero_plan`
+    emit) — the common currency the heuristic compares plans in."""
+    total = 0
+    for e in entries:
+        b = e.get("bytes")
+        if b is None:
+            continue
+        total += int(b[1] if isinstance(b, (list, tuple)) else b)
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateShardingDecision:
+    """What the framework decided about the weight update, and why."""
+
+    shard: bool
+    reason: str
+    param_bytes: int
+    ddp_wire_bytes: int
+    zero_wire_bytes: int
+    #: optimizer-state bytes the sharded layout saves per device
+    #: ((dp-1)/dp · 3 · param_bytes: m, v, master)
+    state_bytes_saved: int
+
+    @property
+    def mode(self) -> str:
+        return "zero" if self.shard else "ddp"
+
+    def render(self) -> str:
+        mib = 1 << 20
+        return (
+            f"update-sharding: {self.mode} ({self.reason}; params "
+            f"{self.param_bytes / mib:.1f}MiB, wire ddp≤"
+            f"{self.ddp_wire_bytes / mib:.1f}MiB zero≤"
+            f"{self.zero_wire_bytes / mib:.1f}MiB, state saved "
+            f"{self.state_bytes_saved / mib:.1f}MiB/device)"
+        )
+
+
+def decide_update_sharding(
+    params,
+    config,
+    param_specs=None,
+) -> UpdateShardingDecision:
+    """Apply the documented heuristic (module docstring) to a param
+    tree under ``config`` (a :class:`apex_tpu.train.TrainConfig`).
+
+    Sizing uses the LOCAL (tp-sharded) leaf sizes when ``param_specs``
+    is given — the dp sync moves local shards, so that is the honest
+    wire accounting.
+    """
+    mesh = config.mesh_dict()
+    dp = config.dp
+    if param_specs is not None:
+        sizes = []
+        specs = _spec_leaves(param_specs, params)
+        for leaf, spec in zip(jax.tree_util.tree_leaves(params), specs):
+            sizes.append(
+                int(np.prod(local_shape(leaf.shape, spec, mesh)) or 1)
+            )
+    else:
+        sizes = [int(l.size) for l in jax.tree_util.tree_leaves(params)]
+    n_elements = sum(sizes)
+    param_bytes = n_elements * 4  # f32 accounting: the master copy
+
+    ddp_wire = plan_wire_bytes(comm.sync_plan(
+        sizes, dp, wire=config.wire, chunks=config.chunks,
+        block=config.block, min_size=config.min_sync_size,
+    ))
+    zero_wire = plan_wire_bytes(comm.zero_plan(
+        n_elements, dp, wire=config.wire, param_wire=config.param_wire,
+        chunks=config.chunks, block=config.block,
+    ))
+    saved = (dp - 1) * 3 * param_bytes // max(dp, 1)
+
+    def make(shard, reason):
+        return UpdateShardingDecision(
+            shard=shard, reason=reason, param_bytes=param_bytes,
+            ddp_wire_bytes=ddp_wire, zero_wire_bytes=zero_wire,
+            state_bytes_saved=saved if shard else 0,
+        )
+
+    zero_capable = config.optimizer_name() in ZERO_TWINS
+    if config.update_sharding == "shard":
+        if dp <= 1:
+            raise ValueError(
+                "update_sharding='shard' needs a dp axis >= 2 — there "
+                "are no replicas to shard the update across"
+            )
+        if not zero_capable:
+            raise ValueError(
+                "update_sharding='shard' requires an optimizer with a "
+                f"distributed (ZeRO) twin (have {ZERO_TWINS})"
+            )
+        return make(True, "explicit override")
+    if config.update_sharding == "replicate":
+        return make(False, "explicit override")
+    # -- auto -----------------------------------------------------------
+    if dp <= 1:
+        return make(False, "dp=1: no replicas to shard across")
+    if not zero_capable:
+        return make(False, "optimizer has no distributed (ZeRO) twin")
+    if param_bytes < config.zero_min_bytes:
+        return make(
+            False,
+            f"params under the {config.zero_min_bytes >> 20} MiB "
+            "zero_min_bytes floor",
+        )
+    if zero_wire > 2 * max(ddp_wire, 1):
+        return make(
+            False,
+            "ZeRO wire plan exceeds 2x the ddp sync plan "
+            "(tiny-leaf-dominated tree)",
+        )
+    return make(True, "auto: param bytes over the floor at comparable wire")

@@ -4,15 +4,26 @@ Default (no args) runs BASELINE config #3, the north star: BERT-Large
 phase-1 pretraining step (seq 128) with FusedLAMB + fused LayerNorm + flash
 attention, and prints ONE JSON line {"metric", "value", "unit",
 "vs_baseline"} — the driver contract.  ``--config all`` (or a config name)
-additionally runs the other four BASELINE.md table rows:
+additionally runs the other BASELINE.md table rows:
 
   #1 resnet50     ResNet-50 synthetic-ImageNet train step, single device
                   (≙ examples/imagenet/main_amp.py)                [img/s]
-  #2 ddp_syncbn   ResNet-50 + DDP + SyncBatchNorm over a dp mesh of all
-                  available devices (≙ apex/parallel/*)            [img/s]
   #3 bert_lamb    BERT-Large + FusedLAMB (north star)          [MFU, step]
   #4 mha          fused self-attention vs unfused composition
                   (≙ apex/contrib/multihead_attn plots)          [speedup]
+     train3d      the composable trainer (apex_tpu.train) at dp=2, tp=2,
+                  and dp=2 x tp=2 — REPLACES the old degenerate
+                  ddp_syncbn (dp=1) / tp_gpt (tp=1) proxies in the
+                  multi-device slot: its rows are honest only when the
+                  mesh is real (dp/tp >= 2), and bench_diff
+                  --check-schema refuses degenerate train3d rows
+                  outright                                     [step time]
+
+The old ddp_syncbn (#2) and tp_gpt (#5) configs remain invocable by name
+for single-config comparisons against historical BENCH_all rounds:
+
+  #2 ddp_syncbn   ResNet-50 + DDP + SyncBatchNorm over a dp mesh of all
+                  available devices (≙ apex/parallel/*)            [img/s]
   #5 tp_gpt       GPT block train step over a tp mesh of all available
                   devices (≙ tensor_parallel/layers.py)       [step time]
 
@@ -54,6 +65,7 @@ _METRIC_NAMES = {
     "bert_lamb": "bert_large_lamb_mfu",
     "mha": "mha_fused_speedup",
     "tp_gpt": "tp_gpt_block_step_ms",
+    "train3d": "train3d_dp2tp2_step_ms",
     "long_attn": "long_context_flash_attn_tflops",
     "zero": "zero_lamb_int8_wire_speedup",
     "serve": "serve_decode_tokens_per_s",
@@ -1129,6 +1141,80 @@ def bench_serve(trace_dir=None, prompt_len=48, decode_steps=24, trials=3):
 
 
 # ---------------------------------------------------------------------------
+# train3d: the composable trainer at dp=2 / tp=2 / dp=2 x tp=2
+# ---------------------------------------------------------------------------
+
+
+def bench_train3d(trace_dir=None, steps=8, trials=3):
+    """The ``apex_tpu.train`` trainer's honest multi-device rows — the
+    replacement for the degenerate ddp_syncbn (dp=1) / tp_gpt (tp=1)
+    proxies (ISSUE 12).  Three arms — dp=2, tp=2, dp=2 x tp=2 — each a
+    REAL mesh when enough devices are visible (CI mocks 8 CPU devices
+    via ``--xla_force_host_platform_device_count=8``; an on-chip window
+    uses real chips).  Every arm's trainer build SELF-VERIFIES
+    (``TrainConfig(verify="error")``): the compiled step's sharding,
+    collective schedule, and memory must equal the config-derived plan
+    or the bench dies loudly — so a row here is a verified shape, not
+    just a number.  With too few devices the arm falls back to a
+    single-device build marked ``degenerate`` — and ``bench_diff
+    --check-schema`` REFUSES degenerate train3d rows, so the fallback
+    can never pass a gate.
+
+    With ``--lint`` a ``train3d_lint_errors`` line carries the total
+    ERROR findings across the three builds (0 by construction: a build
+    with errors raises).
+    """
+    from apex_tpu.train import build_demo
+
+    arms = (("dp2", 2, 1), ("tp2", 1, 2), ("dp2tp2", 2, 2))
+    navail = len(jax.devices())
+    lint_errors = 0
+    modes = []
+    for name, dp, tp in arms:
+        degenerate = navail < dp * tp
+        bdp, btp = (1, 1) if degenerate else (dp, tp)
+        step = build_demo(bdp, btp, verify="error")
+        if step.report is not None:
+            lint_errors += len(step.report.errors())
+        state, batch = step.state, step.example_batch
+        st, aux = step(state, batch)  # warmup/compile
+        float(aux["loss"])
+        times = []
+        loss = 0.0
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                st, aux = step(st, batch)
+            loss = float(aux["loss"])  # device->host: the sync point
+            times.append((time.perf_counter() - t0) / steps)
+        times.sort()
+        step_ms = times[len(times) // 2] * 1e3
+        modes.append(f"{name}:{step.mode}")
+        _emit(
+            f"train3d_{name}_step_ms",
+            round(step_ms, 3),
+            "ms/step (dp=%d, tp=%d, rows=%d, dim=%d, mode=%s, wire=%s, "
+            "loss=%.4f, %d devices, build self-verified; "
+            "apex_tpu.train demo config)"
+            % (bdp, btp, step.tokens_per_step(),
+               step.example_batch[0].shape[1], step.mode,
+               step.config.wire, loss, navail),
+            None,
+            degenerate=degenerate,
+        )
+    if _BENCH_LINT:
+        _emit(
+            "train3d_lint_errors",
+            float(lint_errors),
+            "ERROR findings across the three self-verified trainer "
+            "builds (%s; a failing build raises, so nonzero here means "
+            "a verify='warn' escape; docs/training.md)"
+            % ", ".join(modes),
+            None,
+        )
+
+
+# ---------------------------------------------------------------------------
 # CI smoke config (seconds on CPU — the verify_tier1.sh PERF pass)
 # ---------------------------------------------------------------------------
 
@@ -1229,11 +1315,17 @@ _CONFIGS = {
     "bert_lamb": bench_bert_lamb,
     "mha": bench_mha,
     "tp_gpt": bench_tp_gpt,
+    "train3d": bench_train3d,
     "zero": bench_zero,
     "long_attn": bench_long_attn,
     "smoke": bench_smoke,
     "serve": bench_serve,
 }
+
+#: configs `--config all` skips: smoke/serve are CI schema drivers, and
+#: ddp_syncbn/tp_gpt are the degenerate-prone proxies train3d REPLACES
+#: in the batch (still invocable by name for historical comparisons)
+_ALL_EXCLUDED = ("smoke", "serve", "ddp_syncbn", "tp_gpt")
 
 
 def main(config="bert_lamb", trace_dir=None):
@@ -1259,8 +1351,8 @@ def main(config="bert_lamb", trace_dir=None):
         armed.set()
     if config == "all":
         for name, fn in _CONFIGS.items():
-            if name in ("smoke", "serve"):
-                continue  # CI schema drivers, not measurement rows
+            if name in _ALL_EXCLUDED:
+                continue
             # one trace (the headline config) per invocation
             fn(trace_dir if name == "bert_lamb" else None)
         return

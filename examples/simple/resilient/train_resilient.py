@@ -59,26 +59,27 @@ sys.path.insert(
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from apex_tpu import amp
 from apex_tpu import observability as obs
-from apex_tpu import parallel_state as ps
 from apex_tpu.optimizers import fused_adam
-from apex_tpu.parallel import DistributedDataParallel
 from apex_tpu.resilience import (
     GradGuard,
     ObserverFanout,
     chaos,
-    guard_metrics,
-    guarded_amp_update,
     run_resilient,
 )
+from apex_tpu.train import TrainConfig, Trainer
 
 
 def build_training(accum=1, wire="f32", fetch_every=8):
     """Construct the example's full training program — mesh, toy data,
-    guarded/metered state, and the two jitted step functions.
+    guarded/metered state, and the two jitted step functions — on top
+    of the composable trainer (``apex_tpu.train``, docs/training.md):
+    the example proves the COMPOSED path end to end, not a bespoke one.
+    ``Trainer.build_guarded`` owns the mesh, the DDP comm engine
+    (``wire``/accum boundary sync), the guarded-amp update, the
+    in-step metric fold, and the declared sharding/collective plans.
 
     Shared by :func:`main` and ``tools/graph_lint.py --target
     resilient``: the CI lint gate audits EXACTLY the compiled programs
@@ -89,8 +90,7 @@ def build_training(accum=1, wire="f32", fetch_every=8):
     ``registry``, ``mesh``/``dp``/``rows``, and the raw
     ``tx``/``scaler``/``guard``/``ddp``/``x_all``/``y_all``.
     """
-    mesh = ps.initialize_model_parallel()  # all devices -> dp axis
-    dp = ps.get_data_parallel_world_size()
+    dp = len(jax.devices())  # all devices -> the trainer's dp axis
     micro = 64  # rows per microbatch, per replica
     rows = micro * dp * accum  # rows consumed per optimizer step
     if rows > 4096:  # the toy dataset below
@@ -109,13 +109,6 @@ def build_training(accum=1, wire="f32", fetch_every=8):
     scaler = amp.DynamicLossScaler(init_scale=2.0**10)
     guard = GradGuard(spike_factor=20.0, warmup_steps=5)
 
-    state = {
-        "params": params,
-        "opt": tx.init(params),
-        "scaler": scaler.init(),
-        "guard": guard.init(),
-    }
-
     # -- observability ------------------------------------------------------
     # The registry (and its slot in the checkpointed state) exists
     # UNCONDITIONALLY so the checkpoint tree structure never depends on
@@ -131,37 +124,23 @@ def build_training(accum=1, wire="f32", fetch_every=8):
                  "amp/loss_scale", "amp/growth_tracker",
                  "amp/hysteresis"):
         registry.gauge(name)
-    # the metric state CHECKPOINTS with the model: a rollback that
-    # replays steps also rewinds the counters, so guard/skipped in the
-    # JSONL can never drift from guard/total_skips in state
-    state["metrics"] = registry.init()
 
-    ddp = DistributedDataParallel(
-        lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+    # -- the composed trainer ----------------------------------------------
+    # A 1D dp mesh, replicated params (the DDP contract), the comm
+    # engine's wire format on the accumulation-boundary sync.  The
+    # guarded two-phase shape keeps the gradient tree on the host
+    # between the two programs — the chaos `grads` site needs it there.
+    trainer = Trainer(TrainConfig(
+        mesh={"dp": dp},
+        rules=[(r".*", jax.sharding.PartitionSpec())],
         wire=wire,
-    )
-
-    def grads_fn(params, scaler_state, batch):
-        # batch leaves: (accum, micro*dp, ...); microbatch grads stay
-        # LOCAL inside the scan (no_sync), ONE engine sync at the end
-        if accum == 1:
-            loss, grads = ddp.value_and_grad(
-                params, jax.tree_util.tree_map(lambda x: x[0], batch)
-            )
-        else:
-            loss, grads = ddp.accum_value_and_grad(params, batch)
-        scaled = jax.tree_util.tree_map(
-            lambda g: scaler.scale(g, scaler_state), grads
-        )
-        return loss, scaled
-
-    compute_grads = jax.jit(
-        jax.shard_map(
-            grads_fn,
-            mesh=mesh,
-            in_specs=(P(), P(), P(None, "dp")),
-            out_specs=(P(), P()),
-        )
+        update_sharding="replicate",  # the guard wants the full tree
+    ))
+    g = trainer.build_guarded(
+        lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+        params,
+        tx=tx, scaler=scaler, guard=guard,
+        registry=registry, accum=accum,
     )
 
     def batch_fn(step):
@@ -173,52 +152,17 @@ def build_training(accum=1, wire="f32", fetch_every=8):
             y_all[lo: lo + rows].reshape(*shape, 4),
         )
 
-    @jax.jit
-    def apply_update(scaled, state, loss):
-        p, o, s, g, verdict = guarded_amp_update(
-            tx, scaler, guard, scaled, state["opt"], state["params"],
-            state["scaler"], state["guard"],
-        )
-        new_state = {"params": p, "opt": o, "scaler": s, "guard": g}
-        # device-side metric fold, INSIDE the jitted update: no host
-        # sync — the registry fetches on its own cadence
-        new_state["metrics"] = registry.update(state["metrics"], {
-            "train/loss": loss,
-            **guard_metrics(verdict, g, guard),
-            **amp.DynamicLossScaler.metrics(s),
-        })
-        return new_state, verdict
-
-    # -- the declared sharding & collective plan ---------------------------
-    # What tools/graph_lint.py / tools/shard_report.py PROVE about the
-    # compiled programs above (docs/analysis.md "Sharding & memory
-    # passes"): regex→PartitionSpec rules in the match_partition_rules
-    # style, matched against the compiled module's parameter paths —
-    # DDP keeps params/scaler replicated by design, the batch shards
-    # its row axis over dp — plus the comm engine's own collective
-    # plan for the boundary gradient sync.
-    shard_rules = [
-        (r"^params(/|$)", P()),         # replicated: the DDP contract
-        (r"^scaler", P()),
-        (r"^batch(/|$)", P(None, "dp")),  # (accum, rows, feat)
-    ]
-    expect_sharding = {
-        "mesh": {"dp": dp},
-        "rules": shard_rules,
-        "min_bytes": 1 << 10,
-    }
-    expect_plan = ddp.collective_plan(params, dp)
-
     return {
-        "mesh": mesh, "dp": dp, "micro": micro, "rows": rows,
+        "mesh": g.mesh, "dp": dp, "micro": micro, "rows": rows,
         "x_all": x_all, "y_all": y_all,
-        "state": state, "registry": registry,
-        "tx": tx, "scaler": scaler, "guard": guard, "ddp": ddp,
-        "compute_grads": compute_grads, "apply_update": apply_update,
+        "state": g.state, "registry": registry,
+        "tx": tx, "scaler": scaler, "guard": guard, "ddp": g.ddp,
+        "trainer": trainer,
+        "compute_grads": g.compute_grads, "apply_update": g.apply_update,
         "batch_fn": batch_fn,
-        "shard_rules": shard_rules,
-        "expect_sharding": expect_sharding,
-        "expect_plan": expect_plan,
+        "shard_rules": g.shard_rules,
+        "expect_sharding": g.expect_sharding,
+        "expect_plan": g.expect_plan,
     }
 
 

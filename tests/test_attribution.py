@@ -499,8 +499,8 @@ class TestBenchDiff:
             os.path.join(REPO, "tools", "bench_golden_cpu.jsonl")
         )
         assert bd.check_schema(golden) == []
-        # smoke rows + the serving rows (bench.py --config serve) — the
-        # verify_tier1.sh PERF pass runs BOTH configs against this file
+        # smoke + serving + train3d rows — the verify_tier1.sh PERF
+        # pass runs all three configs against this file
         assert {r["metric"] for r in golden} == {
             "smoke_mlp_step_ms", "smoke_dp_mlp_step_ms",
             "serve_prefill_tokens_per_s", "serve_decode_tokens_per_s",
@@ -508,6 +508,11 @@ class TestBenchDiff:
             # the live ops plane rows (ISSUE 11): exporter scrape cost
             # + the deterministic burn-rate drill
             "ops_scrape_ms", "slo_alerts_fired",
+            # the composable trainer's honest multi-device rows
+            # (ISSUE 12): dp/tp >= 2 on the mocked 8-device mesh —
+            # check_schema refuses degenerate train3d rows
+            "train3d_dp2_step_ms", "train3d_tp2_step_ms",
+            "train3d_dp2tp2_step_ms", "train3d_lint_errors",
         }
 
 

@@ -1,0 +1,424 @@
+"""`apex_tpu.train` — the composable 3D-parallel trainer (ISSUE 12).
+
+Covers the satellite test matrix:
+
+- the update-sharding heuristic: tiny trees stay replicated, large
+  trees shard on dp, the explicit override always wins, dp=1 never
+  shards, custom optimizers never shard;
+- rule tables: a leaf no rule covers fails the build LOUDLY naming the
+  unmatched path (never silent replication);
+- the dp=2 x tp=2 live check: the compiled step's collectives equal
+  the trainer's declared plan for BOTH the f32 and int8 wires (the
+  build's own `analysis.check` run must come back with zero findings);
+- numerics: the zero (update-sharded) and ddp (replicated) modes train
+  to the same losses; tp=2 matches tp=1;
+- the guarded two-phase build keeps the resilient example's contract;
+- `fit` runs the composed loop (run_resilient + goodput) end to end.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.train import (
+    TrainBuildError,
+    TrainConfig,
+    Trainer,
+    build_demo,
+    decide_update_sharding,
+)
+from apex_tpu.train.demo import demo_batch, demo_loss, demo_params
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    base = dict(
+        mesh={"dp": 2},
+        rules=[(r".*", P())],
+        optimizer="adam",
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _params(n_elems: int):
+    return {"w": jnp.zeros((n_elems,), jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# the update-sharding heuristic (pure host logic — no mesh needed)
+# ---------------------------------------------------------------------------
+
+
+class TestUpdateShardingHeuristic:
+    def test_small_tree_stays_replicated(self):
+        d = decide_update_sharding(_params(1024), _cfg())
+        assert not d.shard and d.mode == "ddp"
+        assert "floor" in d.reason
+
+    def test_large_tree_shards_on_dp(self):
+        cfg = _cfg(zero_min_bytes=1 << 10)
+        d = decide_update_sharding(_params(1 << 16), cfg)
+        assert d.shard and d.mode == "zero"
+        assert d.state_bytes_saved > 0
+        # the decision narrates itself: bytes, both wire plans, savings
+        text = d.render()
+        assert "zero" in text and "MiB" in text
+
+    def test_dp1_never_shards(self):
+        cfg = _cfg(mesh={"dp": 1}, zero_min_bytes=0)
+        d = decide_update_sharding(_params(1 << 20), cfg)
+        assert not d.shard and "dp=1" in d.reason
+
+    def test_explicit_override_wins_both_ways(self):
+        forced_on = decide_update_sharding(
+            _params(16), _cfg(update_sharding="shard", zero_min_bytes=1 << 40)
+        )
+        assert forced_on.shard and forced_on.reason == "explicit override"
+        forced_off = decide_update_sharding(
+            _params(1 << 20),
+            _cfg(update_sharding="replicate", zero_min_bytes=0),
+        )
+        assert not forced_off.shard
+
+    def test_custom_optimizer_never_shards(self):
+        from apex_tpu.optimizers import fused_adam
+
+        cfg = _cfg(optimizer=fused_adam(1e-3), zero_min_bytes=0)
+        d = decide_update_sharding(_params(1 << 20), cfg)
+        assert not d.shard and "twin" in d.reason
+        with pytest.raises(ValueError, match="twin"):
+            decide_update_sharding(
+                _params(16),
+                _cfg(optimizer=fused_adam(1e-3), update_sharding="shard"),
+            )
+
+    def test_explicit_shard_on_dp1_is_an_error(self):
+        with pytest.raises(ValueError, match="dp axis"):
+            decide_update_sharding(
+                _params(16), _cfg(mesh={"dp": 1}, update_sharding="shard")
+            )
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_pp_is_reserved(self):
+        with pytest.raises(NotImplementedError, match="reserved"):
+            TrainConfig(mesh={"dp": 2, "pp": 2}, rules=[(r".*", P())])
+
+    def test_unknown_axis_and_bad_knobs(self):
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            TrainConfig(mesh={"xx": 2}, rules=[])
+        with pytest.raises(ValueError):
+            _cfg(wire="f16")
+        with pytest.raises(ValueError):
+            _cfg(update_sharding="maybe")
+        with pytest.raises(ValueError):
+            _cfg(verify="loudly")
+
+    def test_optimizer_registry_is_loud(self):
+        from apex_tpu import optimizers
+
+        assert optimizers.by_name("adam") is optimizers.fused_adam
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            optimizers.by_name("adamw2")
+
+
+# ---------------------------------------------------------------------------
+# rule tables: misses are loud
+# ---------------------------------------------------------------------------
+
+
+class TestRuleTables:
+    def test_uncovered_param_fails_the_build_naming_the_path(self):
+        cfg = TrainConfig(mesh={"dp": 2}, rules=[(r"^w$", P())])
+        params = {"w": jnp.zeros((64,)), "mlp": {"kernel": jnp.zeros((8,))}}
+        with pytest.raises(TrainBuildError, match=r"mlp/kernel"):
+            Trainer(cfg).build(
+                lambda p, b: jnp.sum(p["w"]), params,
+                (jnp.zeros((4, 2)),),
+            )
+
+    def test_mesh_larger_than_devices_is_loud(self, eight_devices):
+        cfg = TrainConfig(mesh={"dp": 16}, rules=[(r".*", P())])
+        with pytest.raises(TrainBuildError, match="devices"):
+            Trainer(cfg).build(
+                lambda p, b: jnp.sum(p["w"]),
+                {"w": jnp.zeros((8,))}, (jnp.zeros((4, 2)),),
+            )
+
+
+# ---------------------------------------------------------------------------
+# live builds on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+class TestLiveBuilds:
+    def test_dp2tp2_compiled_collectives_equal_declared_plan(
+        self, eight_devices
+    ):
+        """The ISSUE 12 acceptance check: for f32 AND int8 wires the
+        dp=2 x tp=2 build's self-verification (sharding conformance +
+        reshard plan + memory budget, `analysis.check`) must come back
+        with ZERO findings — i.e. the compiled step contains exactly
+        the collectives the trainer declared, at the declared wire
+        dtypes."""
+        for wire in ("f32", "int8"):
+            step = build_demo(2, 2, wire=wire, verify="error",
+                              hbm_budget=64 << 20)
+            assert step.mode == "zero"
+            assert step.report is not None
+            assert step.report.findings == [], (
+                wire, step.report.render()
+            )
+            for rule in ("sharding", "reshard", "memory"):
+                assert rule in step.report.rules_run
+            if wire == "int8":
+                kinds = {
+                    e["kind"] for e in step.expect_plan["collectives"]
+                }
+                # quantized grads ride all-to-all payloads; the tp
+                # activation reduction stays a planned f32 all-reduce
+                assert "all-to-all" in kinds and "all-reduce" in kinds
+
+    def test_zero_and_ddp_modes_train_identically(self, eight_devices):
+        """The framework's sharding choice must be a LAYOUT decision,
+        not a numerics one: forced-replicate and forced-shard builds
+        follow the same loss trajectory in f32."""
+        losses = {}
+        for mode in ("replicate", "shard"):
+            step = build_demo(2, 1, update_sharding=mode, verify="off")
+            st = step.state
+            out = []
+            for _ in range(5):
+                st, aux = step(st, step.example_batch)
+                out.append(float(aux["loss"]))
+            losses[mode] = out
+        assert losses["replicate"] == pytest.approx(
+            losses["shard"], rel=1e-5
+        )
+
+    def test_tp2_matches_tp1_numerics(self, eight_devices):
+        ref = build_demo(1, 1, verify="off")
+        tp2 = build_demo(1, 2, verify="off")
+        st_r, st_t = ref.state, tp2.state
+        for _ in range(3):
+            st_r, aux_r = ref(st_r, ref.example_batch)
+            st_t, aux_t = tp2(st_t, tp2.example_batch)
+        assert float(aux_t["loss"]) == pytest.approx(
+            float(aux_r["loss"]), rel=1e-4
+        )
+
+    def test_planted_bogus_plan_fails_the_build(self, eight_devices):
+        """A trainer whose declared plan cannot match the compiled step
+        must refuse to hand the step out (the self-verification
+        contract): planting an undeclarable collective expectation
+        raises TrainBuildError naming the reshard rule."""
+        from apex_tpu.train.demo import demo_config
+
+        cfg = demo_config(2, 1)
+        bogus = dict(
+            mesh=cfg.mesh, rules=cfg.rules, optimizer=cfg.optimizer,
+            learning_rate=cfg.learning_rate,
+            zero_min_bytes=cfg.zero_min_bytes, verify="error",
+            model_collectives=[{
+                "kind": "all-to-all", "axis": "tp", "count": 7,
+                "dtypes": ["s8"],
+            }],
+        )
+        with pytest.raises(TrainBuildError, match="reshard-plan"):
+            Trainer(TrainConfig(**bogus)).build(
+                demo_loss, demo_params(), demo_batch()
+            )
+
+    def test_metrics_fold_rides_aux_and_registry_observes(
+        self, eight_devices
+    ):
+        step = build_demo(2, 1, verify="off")
+        assert step.registry is not None
+        st, aux = step(step.state, step.example_batch)
+        assert "metrics" in aux
+        step.registry.observe(1, aux["metrics"])
+        step.registry.fetch()
+        vals = step.registry.values()
+        assert vals["train/loss"] == pytest.approx(
+            float(aux["loss"]), rel=1e-6
+        )
+
+    def test_optimizer_kwargs_survive_a_mode_flip(self, eight_devices):
+        """ONE optimizer_kwargs vocabulary must stay valid whichever
+        mode the heuristic picks: beta1/beta2 (the optax spelling) and
+        betas (the distributed spelling) both build in BOTH modes —
+        the mode is a size heuristic, so growing the model must never
+        invalidate the config (code-review regression)."""
+        import dataclasses
+
+        from apex_tpu.train.demo import (
+            demo_batch, demo_config, demo_loss, demo_params,
+        )
+
+        for kwargs in ({"beta1": 0.95, "beta2": 0.98},
+                       {"betas": (0.95, 0.98)}):
+            for mode in ("replicate", "shard"):
+                cfg = dataclasses.replace(
+                    demo_config(2, 1, update_sharding=mode,
+                                verify="off"),
+                    optimizer_kwargs=kwargs,
+                )
+                step = Trainer(cfg).build(
+                    demo_loss, demo_params(), demo_batch()
+                )
+                st, aux = step(step.state, step.example_batch)
+                assert float(aux["loss"]) > 0
+
+    def test_zero_twins_single_source(self):
+        from apex_tpu.train import sharding as tsh
+        from apex_tpu.train import trainer as ttr
+
+        assert ttr.ZERO_TWINS is tsh.ZERO_TWINS
+
+    def test_track_grad_norm_is_honest_in_zero_mode(self, eight_devices):
+        """The gauge must carry the real norm in the update-sharded
+        mode too (code-review regression: it silently read 0.0), the
+        two layouts must agree on the measured value, and the
+        unsupported zero+tp>1 combination must refuse the build instead
+        of exporting an overcounted metric."""
+        import dataclasses
+
+        from apex_tpu.train.demo import (
+            demo_batch, demo_config, demo_loss, demo_params,
+        )
+
+        norms = {}
+        for mode in ("replicate", "shard"):
+            cfg = dataclasses.replace(
+                demo_config(2, 1, update_sharding=mode, verify="off"),
+                track_grad_norm=True,
+            )
+            step = Trainer(cfg).build(demo_loss, demo_params(),
+                                      demo_batch())
+            st, aux = step(step.state, step.example_batch)
+            norms[mode] = float(aux["grad_norm"])
+            assert norms[mode] > 0, (mode, aux)
+            assert float(
+                aux["metrics"]["train/grad_norm"]
+            ) == pytest.approx(norms[mode])
+        # same averaged gradient, two layouts: one norm
+        assert norms["shard"] == pytest.approx(
+            norms["replicate"], rel=1e-5
+        )
+        cfg = dataclasses.replace(
+            demo_config(2, 2, update_sharding="shard", verify="off"),
+            track_grad_norm=True,
+        )
+        with pytest.raises(TrainBuildError, match="track_grad_norm"):
+            Trainer(cfg).build(demo_loss, demo_params(), demo_batch())
+
+    def test_collective_plan_surface(self, eight_devices):
+        step = build_demo(2, 2, verify="off")
+        plan = step.collective_plan()
+        assert plan["mesh"] == {"dp": 2, "tp": 2}
+        axes = {e.get("axis") for e in plan["collectives"]}
+        assert "dp" in axes and "tp" in axes
+
+
+# ---------------------------------------------------------------------------
+# guarded two-phase build (the resilient example's shape)
+# ---------------------------------------------------------------------------
+
+
+class TestGuarded:
+    def _build(self, dp=1, wire="f32", accum=1, verify="off", batch=None):
+        from apex_tpu import amp
+        from apex_tpu.optimizers import fused_adam
+        from apex_tpu.resilience import GradGuard
+
+        trainer = Trainer(TrainConfig(
+            mesh={"dp": dp}, rules=[(r".*", P())], wire=wire,
+            update_sharding="replicate",
+        ))
+        params = {"w": jnp.zeros((8, 4), jnp.float32)}
+        return trainer.build_guarded(
+            lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+            params,
+            tx=fused_adam(1e-2),
+            scaler=amp.DynamicLossScaler(init_scale=2.0**10),
+            guard=GradGuard(warmup_steps=2),
+            accum=accum,
+            verify=verify,
+            example_batch=batch,
+        )
+
+    def _batch(self, accum=1, rows=16):
+        x = jnp.ones((accum, rows, 8), jnp.float32)
+        return (x, jnp.ones((accum, rows, 4), jnp.float32))
+
+    def test_two_phase_step_runs_and_updates(self):
+        g = self._build()
+        batch = self._batch()
+        loss, scaled = g.compute_grads(
+            g.state["params"], g.state["scaler"], batch
+        )
+        new_state, verdict = g.apply_update(scaled, g.state, loss)
+        assert not bool(verdict.skipped)
+        assert float(jnp.sum(jnp.abs(new_state["params"]["w"]))) > 0
+
+    def test_guarded_declares_the_example_contract(self):
+        g = self._build()
+        assert g.expect_sharding["mesh"] == {"dp": 1}
+        assert any("params" in r for r, _ in g.shard_rules)
+        assert "collectives" in g.expect_plan
+
+    def test_guarded_verify_checks_compute_grads(self, eight_devices):
+        g = self._build(dp=8, verify="error", batch=self._batch())
+        assert g.dp == 8  # built AND passed its own analysis.check
+
+    def test_guarded_rejects_tp_and_forced_sharding(self):
+        trainer = Trainer(TrainConfig(
+            mesh={"dp": 2, "tp": 2}, rules=[(r".*", P())],
+        ))
+        with pytest.raises(TrainBuildError, match="tp"):
+            trainer.build_guarded(
+                lambda p, b: 0.0, {}, tx=None, scaler=None, guard=None
+            )
+
+
+# ---------------------------------------------------------------------------
+# the composed fit loop
+# ---------------------------------------------------------------------------
+
+
+class TestFit:
+    def test_fit_runs_resilient_loop_with_goodput(
+        self, eight_devices, tmp_path
+    ):
+        step = build_demo(2, 1, verify="off")
+        batch = step.example_batch
+
+        result = step.fit(
+            lambda i: batch, 6, directory=str(tmp_path / "ckpt"),
+            save_interval_steps=2,
+        )
+        assert result.last_step == 5
+        assert result.steps_run == 6
+        snap = step.goodput.snapshot()
+        assert snap["accepted"] == 6
+        assert snap["goodput"] == 1.0
+        # the run checkpointed: a fresh fit resumes from the last
+        # interval save (step 4) and replays only the tail
+        step2 = build_demo(2, 1, verify="off")
+        result2 = step2.fit(
+            lambda i: batch, 6, directory=str(tmp_path / "ckpt"),
+        )
+        assert result2.resumed_from == 4
+        assert result2.steps_run == 1
+        assert result2.last_step == 5

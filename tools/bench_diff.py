@@ -32,6 +32,11 @@ Inputs are bench-line JSONL (``{"metric", "value", "unit",
 - ``degenerate: true`` rows (a multi-device config that ran dp=1/tp=1)
   are EXCLUDED from gating — a single-device proxy can neither regress
   nor prove a scale win;
+- EXCEPT the trainer's multi-device rows (``train3d_*``, the honest
+  replacements for the old degenerate ddp_syncbn/tp_gpt proxies —
+  ISSUE 12): ``--check-schema`` REFUSES a degenerate or dp=1/tp=1
+  train3d row outright, so the multi-device slot can never quietly
+  regress to a single-device proxy again;
 - ``value: null`` rows (explicit non-measurements) are excluded but
   reported;
 - direction is per metric: ``*_ms`` metrics and ``ms/...`` units are
@@ -61,6 +66,18 @@ CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline")
 #: the metric the roadmap's flatline lesson is about — the default
 #: --fail-on-flat target
 FLAT_DEFAULT = "long_context_flash_attn_tflops"
+
+#: metric prefixes whose rows must be HONEST multi-device shapes: the
+#: degenerate-row exclusion does NOT apply — a dp=1/tp=1 run of these
+#: is a schema failure, not an excluded row (the train3d rows replaced
+#: the degenerate ddp_syncbn/tp_gpt proxies precisely to outlaw this)
+HONEST_MULTI_DEVICE_PREFIXES = ("train3d_",)
+
+
+def _must_be_multi_device(metric: str) -> bool:
+    return metric.endswith("_step_ms") and any(
+        metric.startswith(p) for p in HONEST_MULTI_DEVICE_PREFIXES
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -247,6 +264,18 @@ def check_schema(records: List[dict]) -> List[str]:
             problems.append(f"{where}: unit is not a string")
         worlds = dict(_WORLD_RE.findall(rec.get("unit", "") or ""))
         flagged = bool(rec.get("degenerate", False))
+        if _must_be_multi_device(rec.get("metric", "")):
+            collapsed = not worlds or all(
+                int(n) == 1 for n in worlds.values()
+            )
+            if flagged or collapsed:
+                problems.append(
+                    f"{where}: train3d rows must be honest multi-device "
+                    f"shapes (dp/tp >= 2, never degenerate); unit says "
+                    f"{worlds or 'no world'}, degenerate={flagged} — "
+                    "run on a real (or mocked 8-device) mesh"
+                )
+                continue
         if worlds:
             collapsed = all(int(n) == 1 for n in worlds.values())
             if collapsed and not flagged:

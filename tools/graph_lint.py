@@ -20,6 +20,15 @@ Targets:
                        verify_tier1.sh SERVE gate.  --wire selects the
                        KV wire format here.
 
+  --target train       Build the composable trainer's demo config
+                       (apex_tpu.train.build_demo — the exact program
+                       bench.py --config train3d times) at --dp x --tp
+                       and lint the fused step against the trainer's
+                       OWN derived rule table, collective plan, and
+                       --budget.  Run under XLA_FLAGS=
+                       --xla_force_host_platform_device_count=8 for a
+                       real mesh (the verify_tier1.sh TRAIN gate does).
+
   --hlo FILE           Lint an optimized-HLO text dump (e.g. bench.py
                        --hlo-out) with the HLO-level passes only.
 
@@ -178,6 +187,25 @@ def lint_serve(args):
     return engine.lint()
 
 
+def lint_train(args):
+    """Check the composable trainer's fused dp×tp step.
+
+    The trainer verifies ITSELF at build (``TrainConfig(verify=
+    "error")`` raises on any ERROR finding — the ISSUE 12 contract);
+    here it builds with ``verify="off"`` and the report is produced
+    explicitly so findings RENDER (with the shard-plan/memory sections
+    attached) instead of aborting the tool."""
+    from apex_tpu.train import build_demo
+
+    step = build_demo(
+        args.dp, args.tp, wire=args.wire, verify="off",
+        hbm_budget=args.budget,
+    )
+    report = step.verify()
+    report.target = f"train/dp{args.dp}tp{args.tp}/{step.mode}"
+    return report
+
+
 def lint_hlo_file(args):
     from apex_tpu import analysis
 
@@ -201,7 +229,7 @@ def main():
         description="static graph lint over step programs "
         "(rule catalog: docs/analysis.md)"
     )
-    ap.add_argument("--target", choices=["resilient", "serve"],
+    ap.add_argument("--target", choices=["resilient", "serve", "train"],
                     default=None)
     ap.add_argument("--hlo", metavar="FILE", default=None,
                     help="lint an optimized-HLO text dump instead of "
@@ -209,6 +237,10 @@ def main():
     ap.add_argument("--wire", default="f32",
                     choices=["f32", "bf16", "int8"])
     ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--dp", type=int, default=2,
+                    help="train-target dp axis size (default 2)")
+    ap.add_argument("--tp", type=int, default=2,
+                    help="train-target tp axis size (default 2)")
     ap.add_argument("--expect", type=json.loads, default=None,
                     metavar="JSON", help="collective expectations")
     ap.add_argument("--donated", type=int, default=None,
@@ -229,6 +261,8 @@ def main():
         report = lint_hlo_file(args)
     elif args.target == "serve":
         report = lint_serve(args)
+    elif args.target == "train":
+        report = lint_train(args)
     else:
         report = lint_resilient(args)
 

@@ -20,6 +20,11 @@ EXACT programs the examples dispatch):
                        does).
   --target serve       the serve example's AOT prefill/decode programs
                        (KV page pool budgeted from its static shape).
+  --target train       the composable trainer's demo config
+                       (apex_tpu.train.build_demo) at --dp x --tp,
+                       against the trainer's OWN derived rule table and
+                       collective plan — the verify_tier1.sh TRAIN gate
+                       renders this on a mocked 8-device mesh.
   --hlo FILE           any optimized-HLO text dump.
 
 Options:
@@ -165,12 +170,16 @@ def main():
         description="human-readable shard plan + memory breakdown "
         "(docs/analysis.md 'Sharding & memory passes')"
     )
-    ap.add_argument("--target", choices=["resilient", "serve"],
+    ap.add_argument("--target", choices=["resilient", "serve", "train"],
                     default=None)
     ap.add_argument("--hlo", metavar="FILE", default=None)
     ap.add_argument("--wire", default="f32",
                     choices=["f32", "bf16", "int8"])
     ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--dp", type=int, default=2,
+                    help="train-target dp axis size (default 2)")
+    ap.add_argument("--tp", type=int, default=2,
+                    help="train-target tp axis size (default 2)")
     ap.add_argument("--budget", type=int, default=None, metavar="BYTES")
     ap.add_argument("--top", type=int, default=10)
     ap.add_argument("--json", metavar="FILE", default=None)
@@ -195,6 +204,8 @@ def main():
         report = gl.lint_hlo_file(args)
     elif args.target == "serve":
         report = gl.lint_serve(args)
+    elif args.target == "train":
+        report = gl.lint_train(args)
     else:
         report = gl.lint_resilient(args)
 

@@ -49,14 +49,28 @@
 # (1024, 1024) long-shape tile while eliminating >=30% of the sweep
 # grid.
 #
+# A TRAIN stage proves the composable trainer (ISSUE 12,
+# docs/training.md): tools/shard_report.py --target train builds the
+# apex_tpu.train demo config at dp=2, tp=2, and dp=2 x tp=2 on the
+# MOCKED 8-device mesh and must report zero ERRORs against the
+# trainer's OWN derived rule table + collective plan (the compiled
+# collective schedule EQUALS the declaration or the reshard pass
+# fails), with a non-degenerate static peak inside the 64 MiB budget —
+# drift in either direction (peak 0 = the estimator went blind; over
+# budget = the build lied about memory) hard-fails.  The dp>=2 arms
+# must come out mode=zero (the update-sharding heuristic genuinely
+# chose ZeRO) with the flat optimizer state compiled SHARDED.
+#
 # A PERF stage guards the perf-observability contract
 # (docs/observability.md "Attribution & roofline"):
 #   1. the committed r03→r05 flash-attention flatline MUST be caught by
 #      tools/bench_diff.py --fail-on-flat (and the same rounds must
 #      pass the plain regression gate — no false positive);
-#   2. a short CPU bench config (bench.py --config smoke) runs end to
-#      end and its lines pass the schema gate against the committed
-#      golden (key order, degenerate honesty vs the unit's dp=/tp=);
+#   2. short CPU bench configs (bench.py --config smoke / serve, plus
+#      --config train3d --lint on the mocked 8-device mesh) run end to
+#      end and their lines pass the schema gate against the committed
+#      golden (key order, degenerate honesty vs the unit's dp=/tp=,
+#      and the train3d rows' REQUIRED dp/tp >= 2 shapes);
 #   3. tools/step_profile.py --target resilient emits
 #      compute/collective/host-stall fractions summing to 1 +- 0.02
 #      with roofline-vs-StepMeter MFU agreement within 5% (the ISSUE 6
@@ -94,7 +108,7 @@
 # FLAGGED with a finding naming the governing program.
 #
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + perf + serve + ops
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + train + perf + serve + ops
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -104,6 +118,7 @@
 #   T1_SKIP_OBS=1               skip the observability pass
 #   T1_SKIP_FLIGHT=1            skip the flight-recorder pass
 #   T1_SKIP_LINT=1              skip the static-analysis pass
+#   T1_SKIP_TRAIN=1             skip the composable-trainer pass
 #   T1_SKIP_PERF=1              skip the perf-gate pass
 #   T1_SKIP_SERVE=1             skip the serving pass
 #   T1_SKIP_OPS=1               skip the live-ops-plane pass
@@ -363,6 +378,61 @@ PYEOF
     fi
 fi
 
+train_rc=0
+if [ "${T1_SKIP_TRAIN:-0}" != "1" ]; then
+    TRAIN_BUDGET=$((64 * 1024 * 1024))
+    for spec in "2 1 zero" "1 2 ddp" "2 2 zero"; do
+        set -- $spec
+        TDP=$1; TTP=$2; TMODE=$3
+        [ "$train_rc" -ne 0 ] && break
+        TRAIN_JSON="$(mktemp /tmp/_t1_train_${TDP}x${TTP}.XXXXXX.json)"
+        timeout -k 10 300 env JAX_PLATFORMS=cpu \
+            XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python tools/shard_report.py --target train \
+            --dp "$TDP" --tp "$TTP" --budget "$TRAIN_BUDGET" \
+            --json "$TRAIN_JSON" 2>&1 | tail -n 4 | tee -a "$LOG"
+        train_rc=${PIPESTATUS[0]}
+        if [ "$train_rc" -eq 0 ]; then
+            python - "$TRAIN_JSON" "$TRAIN_BUDGET" "$TDP" "$TTP" "$TMODE" \
+                <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+d = json.load(open(sys.argv[1]))
+budget, dp, tp, mode = (int(sys.argv[2]), int(sys.argv[3]),
+                        int(sys.argv[4]), sys.argv[5])
+assert d["errors"] == 0, f"trainer report carries {d['errors']} ERROR(s)"
+assert d["target"].endswith(f"dp{dp}tp{tp}/{mode}"), d["target"]
+peak = d["peak_hbm_bytes"]
+assert 0 < peak <= budget, f"peak {peak} outside (0, {budget}] — drift"
+for name in ("sharding", "reshard", "memory"):
+    assert name in d["pass_timings"], d["pass_timings"]
+rows = {r["name"]: r for r in d["shard_plan"]}
+assert all(r["verdict"] == "ok" for r in rows.values()), rows
+if mode == "zero":
+    # the heuristic chose ZeRO and the flat optimizer state COMPILED
+    # sharded — the headline feature, proven from the artifact
+    m = rows["state/opt/master"]
+    assert "devices=" in m["sharding"], m
+if tp > 1:
+    assert "devices=" in rows["state/params/w1"]["sharding"], rows
+print(f"train dp={dp} tp={tp} OK: mode={mode}, peak_hbm={peak} bytes, "
+      f"{len(rows)} plan rows all conformant, schedule == declaration")
+PYEOF
+            train_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$train_rc" -eq 0 ]; then
+            rm -f "$TRAIN_JSON"
+        else
+            echo "TIER1-TRAIN: dp=$TDP tp=$TTP failed (report at" \
+                "$TRAIN_JSON)" | tee -a "$LOG"
+        fi
+    done
+    if [ "$train_rc" -eq 0 ]; then
+        echo "TIER1-TRAIN: PASS"
+    else
+        echo "TIER1-TRAIN: FAIL (rc=$train_rc)"
+    fi
+fi
+
 perf_rc=0
 if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
     # 1a. the flatline catch: r03 vs r05 sat at 43 TFLOP/s — the gate
@@ -395,6 +465,19 @@ if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
             timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
                 APEX_TPU_BENCH_WATCHDOG_S=0 \
                 python bench.py --config serve --metrics-out "$PERF_OUT" \
+                2>&1 | tail -n 2 | tee -a "$LOG"
+            perf_rc=${PIPESTATUS[0]}
+        fi
+        # the trainer's honest multi-device rows (ISSUE 12): built on
+        # the MOCKED 8-device mesh with --lint, so the golden stream
+        # carries dp/tp >= 2 shapes the schema gate REQUIRES (a
+        # degenerate train3d row is a schema failure, not an exclusion)
+        if [ "$perf_rc" -eq 0 ]; then
+            timeout -k 10 300 env JAX_PLATFORMS=cpu \
+                XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+                APEX_TPU_BENCH_WATCHDOG_S=0 \
+                python bench.py --config train3d --lint \
+                --metrics-out "$PERF_OUT" \
                 2>&1 | tail -n 2 | tee -a "$LOG"
             perf_rc=${PIPESTATUS[0]}
         fi
@@ -671,17 +754,18 @@ fi
 
 if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
     && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] \
-    && [ "$perf_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ] \
-    && [ "$ops_rc" -eq 0 ]; then
+    && [ "$train_rc" -eq 0 ] && [ "$perf_rc" -eq 0 ] \
+    && [ "$serve_rc" -eq 0 ] && [ "$ops_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, train rc=$train_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$obs_rc" -ne 0 ] && exit "$obs_rc"
 [ "$flight_rc" -ne 0 ] && exit "$flight_rc"
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
+[ "$train_rc" -ne 0 ] && exit "$train_rc"
 [ "$perf_rc" -ne 0 ] && exit "$perf_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
 exit "$ops_rc"
