@@ -37,6 +37,7 @@ __all__ = [
     "restore_checkpoint",
     "latest_step",
     "all_steps",
+    "restore_step_dir",
     "CheckpointManager",
     "snapshot_training_state",
     "restore_training_state",
@@ -53,6 +54,16 @@ def _abspath(path) -> str:
     return os.path.abspath(os.fspath(path))
 
 
+def _saveable(state):
+    """Normalize leaves orbax's standard handler refuses: numpy SCALARS
+    (``np.int64(7)`` — ``np.generic``, not ``np.ndarray``) become 0-d
+    arrays.  They restore as 0-d ``np.ndarray`` — same value, and
+    ``int()``/``np.asarray()`` consumers are unchanged."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, state
+    )
+
+
 # ---------------------------------------------------------------------------
 # one-shot save / restore
 # ---------------------------------------------------------------------------
@@ -67,7 +78,7 @@ def save_checkpoint(path, state, *, force: bool = False) -> None:
     """
     ocp = _ocp()
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(_abspath(path), state, force=force)
+        ckptr.save(_abspath(path), _saveable(state), force=force)
 
 
 def restore_checkpoint(path, template: Optional[Any] = None):
@@ -97,7 +108,8 @@ def _manager_options(max_to_keep, save_interval_steps):
 
 
 def latest_step(directory) -> Optional[int]:
-    """Newest step number under ``directory`` (None if absent/empty).
+    """Newest COMPLETE step number under ``directory`` (None if
+    absent/empty).
 
     Read-only and cheap: a plain directory scan — no manager is
     constructed, and a missing directory is NOT created (a typo'd resume
@@ -107,11 +119,81 @@ def latest_step(directory) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+#: files whose presence at the top of a step directory proves the save
+#: COMMITTED: orbax writes them inside the staging dir and the atomic
+#: rename publishes them with everything else (``commit_success.txt``
+#: is the marker orbax uses on filesystems without atomic rename).
+_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "_METADATA", "commit_success.txt")
+
+
+def _is_complete_step_dir(path: str) -> bool:
+    """A step directory counts only with a commit marker on board.
+
+    Orbax's own enumeration accepts ANY digit-named directory — which
+    resurrects half-written steps after a crash that got as far as
+    creating the directory (a non-atomic filesystem, a torn non-orbax
+    write, debris renamed by hand).  Restoring such a step fails at
+    best and silently loads garbage at worst; it must be invisible so
+    resume falls back to the previous complete step.
+    """
+    if any(os.path.exists(os.path.join(path, m)) for m in _COMMIT_MARKERS):
+        return True
+    # manager layouts written by older orbax versions carry the marker
+    # only inside the `default/` item dir, with nothing at step level —
+    # a valid pre-existing checkpoint must not become invisible (resume
+    # silently restarting from step 0 would overwrite prior progress)
+    return any(
+        os.path.exists(os.path.join(path, "default", m))
+        for m in _COMMIT_MARKERS
+    )
+
+
 def all_steps(directory):
-    """Step numbers under ``directory`` (read-only; [] if absent)."""
-    if not os.path.isdir(_abspath(directory)):
+    """COMPLETE step numbers under ``directory`` (read-only; [] if
+    absent).  Uncommitted debris (``*.orbax-checkpoint-tmp-*``) and
+    half-written step dirs without a commit marker are ignored — the
+    crash-consistency contract resume relies on."""
+    directory = _abspath(directory)
+    if not os.path.isdir(directory):
         return []
-    return sorted(_ocp().utils.checkpoint_steps(_abspath(directory)))
+    return sorted(
+        s
+        for s in _ocp().utils.checkpoint_steps(directory)
+        if _is_complete_step_dir(os.path.join(directory, str(s)))
+    )
+
+
+def restore_step_dir(directory, step: int, *, template=None):
+    """Restore step ``step`` of ``directory``, layout-agnostic.
+
+    Handles both on-disk shapes a step-numbered checkpoint tree can
+    carry: the ``CheckpointManager`` layout (``<step>/default/...``)
+    and the flat :class:`~apex_tpu.goodput.AsyncCheckpointEngine` /
+    ``StandardCheckpointer`` layout (``<step>/...``) — so a run can
+    switch engines between restarts and every reader (the serve
+    example's train→serve handoff, ``run_resilient`` auto-resume)
+    restores through ONE code path.
+    """
+    base = os.path.join(_abspath(directory), str(int(step)))
+    if not _is_complete_step_dir(base):
+        raise FileNotFoundError(
+            f"step {step} under {directory} is missing or incomplete "
+            "(no commit marker — a half-written checkpoint)"
+        )
+    # Disambiguate by where orbax put the item-level _METADATA: the
+    # flat StandardCheckpointer layout carries it at the top of the
+    # step dir, the manager layout only inside its `default/` item
+    # dir.  Checking the marker (not just isdir) keeps a FLAT
+    # checkpoint whose state tree has a top-level "default" key from
+    # being misread as the nested layout.
+    nested = os.path.join(base, "default")
+    if os.path.exists(os.path.join(base, "_METADATA")):
+        path = base
+    elif os.path.isdir(nested):
+        path = nested
+    else:
+        path = base
+    return restore_checkpoint(path, template)
 
 
 class CheckpointManager:
@@ -171,18 +253,19 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no checkpoint under {self._mgr.directory}"
                 )
-        args = (
-            self._ocp.args.StandardRestore(template)
-            if template is not None
-            else None
+        # layout-agnostic: also restores flat step dirs written by the
+        # async engine (a run may switch engines between restarts)
+        return restore_step_dir(
+            self._mgr.directory, step, template=template
         )
-        return self._mgr.restore(step, args=args)
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        # the hardened module scan, not orbax's: half-written step
+        # dirs (digit-named, no commit marker) must stay invisible
+        return latest_step(self._mgr.directory)
 
     def all_steps(self):
-        return sorted(self._mgr.all_steps())
+        return all_steps(self._mgr.directory)
 
     def should_save(self, step: int) -> bool:
         return self._mgr.should_save(step)
@@ -200,6 +283,7 @@ def snapshot_training_state(
     step: Optional[int] = None,
     amp_handle=None,
     amp_state=None,
+    stream=None,
     extra=None,
 ):
     """Bundle everything needed to resume into one checkpointable tree.
@@ -211,6 +295,13 @@ def snapshot_training_state(
       pass that tree (or the whole AmpState) as ``extra`` if used.
     - RNG: the per-mode tracker keys (≙ ``CudaRNGStatesTracker.get_states``)
       are captured automatically.
+    - ``stream``: the input-pipeline cursor
+      (:meth:`apex_tpu.goodput.ResumableStream.state` /
+      :func:`apex_tpu.goodput.stream_state`) — saved under
+      ``"stream"`` so every checkpoint pins the exact sample sequence;
+      validate it on resume with
+      :func:`apex_tpu.goodput.verify_stream_state` (it lands in the
+      restored dict, not the :func:`restore_training_state` tuple).
     """
     from apex_tpu.transformer.tensor_parallel.random import (
         get_tpu_rng_tracker,
@@ -226,6 +317,8 @@ def snapshot_training_state(
     rng = get_tpu_rng_tracker().get_states()
     if rng:
         state["rng"] = rng
+    if stream is not None:
+        state["stream"] = stream
     if extra is not None:
         state["extra"] = extra
     return state

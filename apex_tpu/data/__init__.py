@@ -24,6 +24,7 @@ import json
 import os
 import queue
 import threading
+import time
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
@@ -225,11 +226,29 @@ class DevicePrefetcher:
     with a ``device``/``Sharding``) so the transfer overlaps the step
     running on-device.  Iterate it like the original loader; call
     ``close()`` (or use as context manager) to stop the worker.
+
+    Backpressure is bounded by construction — the hand-off queue holds
+    at most ``depth`` batches, so a consumer that stops pulling stalls
+    the worker instead of buffering the dataset into RAM — and both
+    sides of the balance are measured: :attr:`stall_fraction` is the
+    share of wall time the CONSUMER spent blocked on an empty queue
+    (the input-bound signal, published to the board as
+    ``data/input_stall_fraction`` for
+    :class:`~apex_tpu.observability.health.InputStallRule` and for
+    cross-checking the attribution layer's host-stall bucket), and
+    :meth:`metrics` adds the producer-side wait plus queue occupancy.
+
+    The board gauge is a SINGLE key: it belongs to the training input
+    pipeline.  A second prefetcher in the same process (an eval
+    loader, a side pipeline) would clobber it and misdirect
+    ``InputStallRule`` — give it ``board_key=None`` (metrics stay
+    available via :meth:`metrics`) or its own key.
     """
 
     _DONE = object()
 
-    def __init__(self, it, device=None, depth: int = 2):
+    def __init__(self, it, device=None, depth: int = 2, *,
+                 board_key: "str | None" = "data/input_stall_fraction"):
         import jax
 
         self._jax = jax
@@ -237,6 +256,12 @@ class DevicePrefetcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._src = iter(it)
+        self._board_key = board_key
+        self._t0 = time.monotonic()
+        self._consumer_wait_s = 0.0  # queue empty: input-bound
+        self._producer_wait_s = 0.0  # queue full: compute-bound (healthy)
+        self._batches = 0
+        self._occupancy_sum = 0.0
         self._worker = threading.Thread(target=self._fill, daemon=True)
         self._worker.start()
 
@@ -244,9 +269,11 @@ class DevicePrefetcher:
         """Enqueue with stop-aware timeout polling; False when stopped
         (an unbounded blocking put could pin the worker forever if the
         consumer abandons iteration without close())."""
+        t0 = time.monotonic()
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
+                self._producer_wait_s += time.monotonic() - t0
                 return True
             except queue.Full:
                 continue
@@ -275,6 +302,8 @@ class DevicePrefetcher:
         # Stop-aware polling get, mirroring _put: an untimed get could hang
         # forever if close() (from another thread) drains the sentinel out
         # from under us.
+        t0 = time.monotonic()
+        self._occupancy_sum += self._q.qsize() / self._q.maxsize
         while True:
             if self._stop.is_set():
                 raise StopIteration
@@ -283,6 +312,15 @@ class DevicePrefetcher:
                 break
             except queue.Empty:
                 continue
+        if self._batches == 0:
+            # the first fetch waits on worker spin-up + first fill — a
+            # cold mmap/parse of the source is pipeline warm-up, not a
+            # steady-state stall, and folding it in would keep the
+            # fraction inflated (and InputStallRule paging) long into a
+            # healthy run.  Start the stall clock at the first hand-off.
+            self._t0 = time.monotonic()
+        else:
+            self._consumer_wait_s += time.monotonic() - t0
         if item is self._DONE:
             # terminal: the worker exits after one sentinel — record the
             # state so further next() calls don't block on an empty queue
@@ -291,7 +329,39 @@ class DevicePrefetcher:
         if isinstance(item, BaseException):
             self._stop.set()
             raise item
+        self._batches += 1
+        # publish only once the fraction means something: the first
+        # batch's worker spin-up over a near-zero wall time would read
+        # as a storm and page InputStallRule on every cold start
+        if self._board_key is not None and self._batches >= 8:
+            from apex_tpu.observability.metrics import board
+
+            board.set(self._board_key, self.stall_fraction)
         return item
+
+    @property
+    def stall_fraction(self) -> float:
+        """Share of wall time the consumer spent blocked on an empty
+        prefetch queue — the "chip starved for input" fraction the
+        attribution layer's host-stall bucket should roughly agree
+        with."""
+        wall = time.monotonic() - self._t0
+        return min(1.0, self._consumer_wait_s / wall) if wall > 0 else 0.0
+
+    def metrics(self) -> dict:
+        """The pipeline-balance ledger: consumer stall (input-bound),
+        producer wait (compute-bound backpressure — healthy), mean
+        queue occupancy at fetch, batches served."""
+        return {
+            "batches": self._batches,
+            "stall_fraction": self.stall_fraction,
+            "consumer_wait_s": self._consumer_wait_s,
+            "producer_wait_s": self._producer_wait_s,
+            "mean_occupancy": (
+                self._occupancy_sum / self._batches if self._batches else 0.0
+            ),
+            "depth": self._q.maxsize,
+        }
 
     def close(self):
         self._stop.set()

@@ -1,0 +1,56 @@
+"""``apex_tpu.goodput`` — the zero-stall I/O plane between trainer and host.
+
+ROADMAP item 5: on a preemptible fleet the cheapest capacity is the
+capacity you can lose at any moment, and what decides whether that is
+viable is **goodput** — the fraction of executed steps that survive as
+saved progress.  Two things erode it: checkpoint writes riding the
+step path (stall per save), and input pipelines that cannot resume
+mid-stream (replayed or skipped work per eviction).  This package
+removes both:
+
+- :mod:`apex_tpu.goodput.async_ckpt` —
+  :class:`~apex_tpu.goodput.async_ckpt.AsyncCheckpointEngine`:
+  copy-on-snapshot to host buffers (async device→host, overlapping
+  the running step), a background writer driving the sharded orbax
+  save with atomic step-dir commit, a barrier only at finalize, and a
+  phase-event stream the span/health layers consume
+  (``ckpt/snapshot`` / ``ckpt/write`` / ``ckpt/finalize`` on the
+  Perfetto timeline; ``goodput/ckpt/stall_frac`` on the board).
+- :mod:`apex_tpu.goodput.stream` —
+  :class:`~apex_tpu.goodput.stream.ResumableStream`: a deterministic
+  step-indexed ``batch_fn`` over the :mod:`apex_tpu.data` loader with
+  O(1) seek, bounded-backpressure device prefetch, and a fully
+  checkpointable cursor (:func:`~apex_tpu.goodput.stream.stream_state`
+  / :func:`~apex_tpu.goodput.stream.verify_stream_state`) saved inside
+  every checkpoint — resume continues the exact sample sequence, so a
+  stormed run's loss trajectory is bit-identical to an uninterrupted
+  one.
+
+``run_resilient`` / ``TrainStep.fit`` adopt the engine by default
+(``checkpoint="async"``); the proof rides ``tools/goodput_drill.py``
+and ``bench.py --config goodput`` (the verify_tier1 GOODPUT gate: ≥99%
+goodput under an ``APEX_TPU_CHAOS`` preemption storm, bit-exact
+resumed losses, <1% checkpoint stall).  See ``docs/goodput.md``.
+"""
+
+from apex_tpu.goodput.async_ckpt import (  # noqa: F401
+    AsyncCheckpointEngine,
+    host_snapshot,
+    resolve_queue_depth,
+)
+from apex_tpu.goodput.stream import (  # noqa: F401
+    ResumableStream,
+    StreamStateError,
+    stream_state,
+    verify_stream_state,
+)
+
+__all__ = [
+    "AsyncCheckpointEngine",
+    "host_snapshot",
+    "resolve_queue_depth",
+    "ResumableStream",
+    "StreamStateError",
+    "stream_state",
+    "verify_stream_state",
+]

@@ -1,0 +1,561 @@
+"""Zero-stall async checkpoint engine — snapshot to host, write behind.
+
+The synchronous path (:class:`apex_tpu.checkpoint.CheckpointManager`)
+hands the live device state to orbax on the step path: the save call
+pays the device→host copy (and, depending on the backend, part of the
+serialization) before control returns to the training loop, so
+checkpoint cadence trades directly against step time.  On a
+preemptible fleet that tradeoff is fatal — you either checkpoint
+rarely (and lose work on every eviction) or often (and burn the step
+budget on I/O stalls).
+
+:class:`AsyncCheckpointEngine` splits the save the TorchTitan way
+(async distributed checkpointing, PAPERS.md):
+
+1. **snapshot** — :func:`host_snapshot` copies the state pytree to
+   host buffers using the same async device→host machinery the
+   :class:`~apex_tpu.observability.MetricRegistry` fetch cadence uses
+   (``copy_to_host_async`` issued for every leaf first, then
+   materialized — transfers overlap each other, and the step program
+   already running on device overlaps all of them).  The snapshot is
+   **copy-on-snapshot**: the caller may mutate, donate, or delete the
+   state the moment ``save`` returns.
+2. **background write** — one writer thread drains a bounded queue,
+   driving the sharded orbax save into ``<dir>/<step>``.  Orbax stages
+   into ``<step>.orbax-checkpoint-tmp-*`` and commits by atomic
+   rename, so a crash/SIGTERM mid-write leaves only debris that
+   :func:`apex_tpu.checkpoint.all_steps` ignores — the previous
+   checkpoint stays intact and restorable.
+3. **barrier only at finalize** — :meth:`wait_until_finished` joins
+   the queue (``run_resilient`` calls it at rollback anchoring, before
+   the forced preemption checkpoint, and at shutdown, so in-flight
+   writes always drain).  Nothing else on the step path blocks on the
+   write.
+
+The step path's ONLY checkpoint cost is the snapshot + enqueue, and
+the engine accounts for it: every completed phase lands as an event
+(:meth:`drain_events` — ``run_resilient`` forwards them to the
+observer protocol's ``on_checkpoint``, where
+:class:`~apex_tpu.observability.spans.SpanRecorder` turns them into
+``ckpt/snapshot`` / ``ckpt/write`` / ``ckpt/finalize`` spans on the
+Perfetto timeline) and as board gauges
+(``goodput/ckpt/stall_frac`` is what
+:class:`~apex_tpu.observability.health.CheckpointStallRule` pages on).
+
+Failure contract (mirrors the sync manager's scope note): a
+background write that fails permanently loses that one step's
+checkpoint, never crash consistency — the error is deferred and
+raised at the next synchronization point, whichever comes first: the
+NEXT ``save`` call (so the
+:class:`~apex_tpu.resilience.runner.ResilientCheckpointManager` retry
+wrapper clears it and re-enqueues the current step) or
+:meth:`wait_until_finished` (so a shutdown/preemption drain can never
+report success for a final checkpoint that never reached disk).  The
+incomplete step stays invisible to ``latest_step``; resume falls back
+one interval.
+
+See ``docs/goodput.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from apex_tpu import checkpoint as _ckpt
+
+__all__ = [
+    "host_snapshot",
+    "resolve_queue_depth",
+    "AsyncCheckpointEngine",
+]
+
+
+def host_snapshot(state):
+    """Copy a state pytree to host buffers, snapshot-isolated.
+
+    - ``jax.Array`` leaves: ``copy_to_host_async`` is issued for EVERY
+      leaf before any is materialized, so the device→host transfers
+      overlap each other (and whatever is running on device).  Fully
+      addressable arrays come back as numpy; a non-addressable
+      (multi-host sharded) leaf passes through untouched — orbax owns
+      its distributed write, and jax arrays are immutable so the
+      snapshot hazard does not apply to them.
+    - numpy leaves are **copied** — the caller mutating them in place
+      after ``save`` returns must not corrupt the written checkpoint
+      (the documented hazard of handing live buffers to an async
+      writer).
+    - python scalars pass through (immutable).
+
+    Costs and caveats the caller owns:
+
+    - The snapshot holds ONE full host copy of the state — a leaf
+      sharded across local devices is gathered into a single
+      contiguous buffer (orbax's inline path streamed per shard), so
+      budget host RAM for the whole logical state per in-flight save.
+    - A **non-addressable** (multi-host sharded) leaf is NOT snapshot
+      isolated: immutability protects it from mutation, but a step
+      that **donates** such a leaf while the background write is still
+      serializing it invalidates the buffer mid-write.  On multi-host
+      meshes, either keep checkpointed leaves out of ``donate_argnums``
+      or barrier on ``wait_until_finished`` before the next donated
+      step.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    for x in leaves:
+        copy = getattr(x, "copy_to_host_async", None)
+        if copy is not None and getattr(x, "is_fully_addressable", True):
+            copy()
+    out = []
+    for x in leaves:
+        if isinstance(x, jax.Array):
+            if getattr(x, "is_fully_addressable", True):
+                out.append(np.asarray(x))
+            else:
+                out.append(x)
+        elif isinstance(x, np.ndarray):
+            out.append(np.array(x, copy=True))
+        elif isinstance(x, np.generic):
+            # numpy SCALAR: immutable, but orbax's standard handler
+            # refuses the type — normalize to a 0-d array (same value)
+            out.append(np.asarray(x))
+        else:
+            out.append(x)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+_SENTINEL = object()
+
+#: env override for the write-queue depth (same idiom as
+#: ``APEX_TPU_COMM_CHUNKS``): env > explicit ``queue_depth`` arg >
+#: default 4.  The default absorbs a few intervals of write jitter at
+#: production cadences (saves minutes apart, writes seconds long); a
+#: compressed-timescale harness (the CI storm drill saves every few
+#: hundred ms onto whatever disk the runner has) raises it so the
+#: measured stall fraction keeps meaning "the step path pays only the
+#: snapshot" instead of "this machine's disk was slow today".
+ENV_QUEUE_DEPTH = "APEX_TPU_CKPT_QUEUE"
+
+
+def resolve_queue_depth(queue_depth: Optional[int] = None) -> int:
+    """Write-queue depth: env :data:`ENV_QUEUE_DEPTH` > explicit arg >
+    default 4.  Always >= 1 — depth 0 would turn every save into a
+    synchronous write."""
+    env = os.environ.get(ENV_QUEUE_DEPTH)
+    if env:
+        depth = int(env)
+    elif queue_depth is not None:
+        depth = int(queue_depth)
+    else:
+        depth = 4
+    return max(1, depth)
+
+
+class AsyncCheckpointEngine:
+    """Step-numbered async checkpoints: host snapshot + background write.
+
+    API-compatible with :class:`apex_tpu.checkpoint.CheckpointManager`
+    (``save``/``restore``/``latest_step``/``all_steps``/``should_save``/
+    ``wait_until_finished``/``close``, context-managed), so
+    ``run_resilient`` swaps between the two behind one name.  On top:
+
+    - ``save`` returns the moment the host snapshot is enqueued; the
+      bounded queue (``queue_depth`` — :func:`resolve_queue_depth`:
+      env ``APEX_TPU_CKPT_QUEUE`` > arg > default 4, which absorbs a
+      few intervals of write jitter, e.g. the first save's cold orbax
+      setup) is the backpressure valve: a writer that falls behind
+      stalls the NEXT save's enqueue, never unboundedly buffering
+      snapshots in RAM.
+    - ``drain_events()`` hands back completed phase records
+      (``{"phase": "write"|"finalize", "step", "t0", "t1", ...}``,
+      monotonic seconds) for the observer/span layer.
+    - ``stats()`` is the cumulative ledger (saves, failures, snapshot/
+      enqueue/write milliseconds, stall fraction).
+
+    Step enumeration and the interval policy are resume-aware: a fresh
+    engine on an existing directory continues the cadence from the
+    newest complete step on disk.
+    """
+
+    def __init__(
+        self,
+        directory,
+        *,
+        max_to_keep: Optional[int] = None,
+        save_interval_steps: int = 1,
+        queue_depth: Optional[int] = None,
+    ):
+        if save_interval_steps < 1:
+            raise ValueError("save_interval_steps must be >= 1")
+        self._directory = os.path.abspath(os.fspath(directory))
+        os.makedirs(self._directory, exist_ok=True)
+        self._interval = int(save_interval_steps)
+        self._max_to_keep = max_to_keep
+        self._last_saved: Optional[int] = _ckpt.latest_step(self._directory)
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=resolve_queue_depth(queue_depth)
+        )
+        self._events: "collections.deque" = collections.deque(maxlen=1024)
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._ckptr = None  # one StandardCheckpointer, writer-thread only
+        self._first_save_t: Optional[float] = None
+        self._stats: Dict[str, float] = {
+            "saves": 0.0,
+            "writes": 0.0,
+            "failures": 0.0,
+            "snapshot_ms_total": 0.0,
+            "enqueue_wait_ms_total": 0.0,
+            "write_ms_total": 0.0,
+            "finalize_ms_total": 0.0,
+            "last_snapshot_ms": 0.0,
+            "last_write_ms": 0.0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="apex-tpu-ckpt-writer",
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        """Drain pending writes, stop the writer, release orbax.
+        Never raises (it runs from ``__exit__``, possibly during
+        exception handling) — but a deferred write error is WARNED,
+        not swallowed: without a later ``save``/finalize to raise it,
+        close is the last place a lost final write can be reported."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            # FIFO: queued snapshots are written before the sentinel
+            # stops the loop — close() IS the shutdown drain
+            while True:
+                try:
+                    self._q.put(_SENTINEL, timeout=0.5)
+                    break
+                except queue.Full:
+                    if not self._thread.is_alive():
+                        break
+            self._thread.join(timeout=120)
+            if self._thread.is_alive():
+                # the daemon writer dies with the process; whatever is
+                # still queued/mid-write never reaches disk — that must
+                # not be silent (run_resilient drains via
+                # wait_until_finished first, but a bare context-manager
+                # user's last checkpoints are on the line here)
+                import warnings
+
+                warnings.warn(
+                    "checkpoint writer still busy after 120s close() "
+                    "drain; pending background writes will be lost "
+                    "when the process exits",
+                    RuntimeWarning,
+                )
+        elif self._ckptr is not None:
+            self._close_ckptr()
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint write failed during close "
+                f"({type(err).__name__}: {err}); the failed step is "
+                "not on disk — resume falls back one interval",
+                RuntimeWarning,
+            )
+
+    def _close_ckptr(self) -> None:
+        if self._ckptr is not None:
+            try:
+                self._ckptr.close()
+            except Exception:
+                pass
+            self._ckptr = None
+
+    # -- queries -----------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        """Newest COMPLETE step on disk (queued writes excluded — a
+        step is not a checkpoint until its commit rename lands)."""
+        return _ckpt.latest_step(self._directory)
+
+    def all_steps(self) -> List[int]:
+        return _ckpt.all_steps(self._directory)
+
+    def should_save(self, step: int) -> bool:
+        """Interval policy (orbax semantics: first save always, then
+        ``interval`` steps after the last saved one)."""
+        return (
+            self._last_saved is None
+            or step >= self._last_saved + self._interval
+        )
+
+    # -- io ----------------------------------------------------------------
+    def save(self, step: int, state, *, force: bool = False) -> bool:
+        """Snapshot ``state`` to host and enqueue the background write.
+
+        Returns False when the interval policy skips the step.  Raises
+        a deferred background-write error from a PREVIOUS save (one
+        shot: the caller's retry re-enters with the error cleared and
+        the current step is enqueued — the failed step falls back one
+        interval, exactly the sync manager's documented semantics).
+        """
+        if self._closed:
+            # a save after close() would silently resurrect a writer
+            # nothing ever drains again — the drain-on-exit guarantee
+            # only holds if the lifecycle stays closed
+            raise RuntimeError("save() on a closed AsyncCheckpointEngine")
+        self._raise_deferred()
+        if not force and not self.should_save(step):
+            return False
+        t0 = time.monotonic()
+        host = host_snapshot(state)
+        t1 = time.monotonic()
+        self._ensure_thread()
+        # the enqueue wait is only known after put() returns, but the
+        # writer may already hold the item by then — hand it a shared
+        # slot instead.  The writer reads it when emitting the write
+        # event (after the orbax save, long past the fill below), so
+        # the event's step-path cost is snapshot AND enqueue.
+        enq_slot: List[float] = []
+        self._q.put((int(step), host, bool(force), t0, t1, enq_slot))
+        t2 = time.monotonic()
+        enq_slot.append((t2 - t1) * 1e3)
+        self._last_saved = int(step)
+        st = self._stats
+        st["saves"] += 1.0
+        st["snapshot_ms_total"] += (t1 - t0) * 1e3
+        st["enqueue_wait_ms_total"] += (t2 - t1) * 1e3
+        st["last_snapshot_ms"] = (t1 - t0) * 1e3
+        if self._first_save_t is None:
+            self._first_save_t = t0
+        self._publish()
+        return True
+
+    def _raise_deferred(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def restore(self, step: Optional[int] = None, *, template=None):
+        """Restore ``step`` (default: newest complete) — drains pending
+        writes first so a just-enqueued save is restorable.  A deferred
+        write error stays deferred (to the next ``save``/finalize): a
+        lost write must not block restoring the previous complete step
+        — that fall-back IS the failure contract."""
+        self._q.join()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self._directory}"
+                )
+        return _ckpt.restore_step_dir(
+            self._directory, int(step), template=template
+        )
+
+    def wait_until_finished(self) -> None:
+        """The finalize barrier: block until every enqueued write has
+        committed, then raise any deferred write error (cleared, like
+        ``save`` — but a shutdown/preemption drain must never report
+        success for a checkpoint that never reached disk)."""
+        t0 = time.monotonic()
+        self._q.join()
+        dt = time.monotonic() - t0
+        if dt > 1e-4:  # an actual wait, not the no-op fast path
+            self._stats["finalize_ms_total"] += dt * 1e3
+            self._events.append({
+                "phase": "finalize", "step": self._last_saved,
+                "t0": t0, "t1": t0 + dt,
+            })
+            self._publish()
+        self._raise_deferred()
+
+    # -- the background writer ---------------------------------------------
+    def _writer_loop(self) -> None:
+        try:
+            import orbax.checkpoint as ocp
+
+            if self._ckptr is None:
+                self._ckptr = ocp.StandardCheckpointer()
+        except BaseException as e:
+            # bootstrap failed (orbax missing/broken): become a pure
+            # drainer — ``q.join()`` callers must never deadlock on
+            # items this writer can no longer write.  The error
+            # surfaces through the normal deferral contract (next
+            # save/finalize); close()'s sentinel ends the loop.
+            with self._lock:
+                self._error = e
+            self._stats["failures"] += 1.0
+            while True:
+                item = self._q.get()
+                if item is not _SENTINEL:
+                    # every snapshot this drainer swallows is a LOST
+                    # checkpoint: re-arm the error each time (a save()
+                    # raising it clears it one-shot) so no later
+                    # synchronization point can report success while
+                    # writes are silently dropped.  Re-arm BEFORE
+                    # task_done: a q.join() waiter must observe the
+                    # error the moment the join releases.
+                    with self._lock:
+                        if self._error is None:
+                            self._error = e
+                    self._stats["failures"] += 1.0
+                self._q.task_done()
+                if item is _SENTINEL:
+                    return
+        while True:
+            item = self._q.get()
+            try:
+                if item is _SENTINEL:
+                    return
+                step, host, force, t0, t1, enq_slot = item
+                self._write_one(step, host, force, t0, t1, enq_slot)
+            finally:
+                self._q.task_done()
+                if item is _SENTINEL:
+                    self._close_ckptr()
+
+    def _write_one(
+        self, step, host, force, snap_t0, snap_t1, enq_slot=(),
+    ) -> None:
+        path = os.path.join(self._directory, str(step))
+        w0 = time.monotonic()
+        ok = True
+        try:
+            if self._commit_hook is not None:
+                self._commit_hook(step)
+            self._ckptr.save(path, host, force=force or os.path.exists(path))
+            self._ckptr.wait_until_finished()
+            self._prune()
+        except BaseException as e:  # deferred to the next save() call
+            ok = False
+            with self._lock:
+                self._error = e
+            self._stats["failures"] += 1.0
+        w1 = time.monotonic()
+        st = self._stats
+        if ok:
+            st["writes"] += 1.0
+            st["write_ms_total"] += (w1 - w0) * 1e3
+            st["last_write_ms"] = (w1 - w0) * 1e3
+        self._events.append({
+            "phase": "write", "step": int(step), "ok": ok,
+            "t0": w0, "t1": w1,
+            "snapshot_t0": snap_t0, "snapshot_t1": snap_t1,
+            "enqueue_ms": enq_slot[0] if enq_slot else 0.0,
+        })
+        self._publish()
+
+    #: test hook: raises planted mid-write failures INSIDE the writer
+    #: (after the snapshot, before the commit) — the on-disk shape of a
+    #: host that died mid-save, without killing the test process
+    _commit_hook = None
+
+    def _prune(self) -> None:
+        # failed-write debris first (runs on the writer thread between
+        # writes, so nothing of OURS is in flight): any tmp staging
+        # dir or markerless digit dir is a dead crash/kill leftover —
+        # on the preemptible fleets this engine targets they would
+        # otherwise accumulate one full-state payload per eviction.
+        # Single-writer only: on a multi-process mesh the directory is
+        # shared, and what looks like debris here may be another
+        # host's LIVE staging dir (or a final dir whose commit marker
+        # has not landed on a non-atomic fs) — there orbax owns its
+        # own staging cleanup, so the GC stands down.
+        if jax.process_count() == 1:
+            try:
+                entries = os.listdir(self._directory)
+            except OSError:
+                entries = []
+            for name in entries:
+                path = os.path.join(self._directory, name)
+                if not os.path.isdir(path):
+                    continue
+                if ".orbax-checkpoint-tmp-" in name or (
+                    name.isdigit()
+                    and not _ckpt._is_complete_step_dir(path)
+                ):
+                    shutil.rmtree(path, ignore_errors=True)
+        if self._max_to_keep is None:
+            return
+        steps = self.all_steps()
+        for s in steps[: max(0, len(steps) - self._max_to_keep)]:
+            shutil.rmtree(
+                os.path.join(self._directory, str(s)), ignore_errors=True
+            )
+
+    # -- telemetry ---------------------------------------------------------
+    def drain_events(self) -> List[dict]:
+        """Completed phase records since the last drain (write spans
+        land here from the writer thread; ``run_resilient`` forwards
+        them to ``observer.on_checkpoint(step, info)``)."""
+        out = []
+        while True:
+            try:
+                out.append(self._events.popleft())
+            except IndexError:
+                return out
+
+    #: below this much wall time since the first save the fraction is
+    #: statistically meaningless (one snapshot over a near-zero
+    #: denominator reads as a huge stall) — report 0.0 = "no evidence
+    #: yet" instead of paging the watchdog on cold start
+    MIN_STALL_WINDOW_S = 1.0
+
+    def stall_fraction(self) -> float:
+        """Fraction of wall time since the first save that the STEP
+        PATH spent inside ``save`` (snapshot + enqueue wait) — the
+        number the <1%-overhead acceptance gate pins.  Background
+        write time is deliberately excluded: it overlaps training.
+        0.0 until :data:`MIN_STALL_WINDOW_S` of wall time has accrued
+        (a cold-start fraction over milliseconds is noise, not a
+        stall)."""
+        if self._first_save_t is None:
+            return 0.0
+        wall = time.monotonic() - self._first_save_t
+        if wall < self.MIN_STALL_WINDOW_S:
+            return 0.0
+        st = self._stats
+        stalled = (
+            st["snapshot_ms_total"] + st["enqueue_wait_ms_total"]
+        ) / 1e3
+        return min(1.0, stalled / wall)
+
+    def stats(self) -> Dict[str, float]:
+        out = dict(self._stats)
+        out["pending"] = float(self._q.qsize())
+        out["stall_frac"] = self.stall_fraction()
+        return out
+
+    def _publish(self) -> None:
+        from apex_tpu.observability.metrics import board
+
+        st = self._stats
+        board.set("goodput/ckpt/saves", st["saves"])
+        board.set("goodput/ckpt/writes", st["writes"])
+        board.set("goodput/ckpt/failures", st["failures"])
+        board.set("goodput/ckpt/last_snapshot_ms", st["last_snapshot_ms"])
+        board.set("goodput/ckpt/last_write_ms", st["last_write_ms"])
+        board.set("goodput/ckpt/stall_frac", self.stall_fraction())

@@ -94,15 +94,18 @@ from apex_tpu.observability.flight import (  # noqa: F401
     parse_flight_spec,
 )
 from apex_tpu.observability.health import (  # noqa: F401
+    CheckpointStallRule,
     CollectiveFractionRule,
     HealthEvent,
     HostStallRule,
+    InputStallRule,
     MemoryBudgetRule,
     QueueDepthRule,
     QueueWaitFractionRule,
     TTFTRule,
     Watchdog,
     default_rules,
+    goodput_rules,
     serve_rules,
 )
 from apex_tpu.observability.spans import (  # noqa: F401
@@ -190,9 +193,12 @@ __all__ = [
     "Watchdog",
     "HealthEvent",
     "default_rules",
+    "goodput_rules",
     "serve_rules",
+    "CheckpointStallRule",
     "CollectiveFractionRule",
     "HostStallRule",
+    "InputStallRule",
     "MemoryBudgetRule",
     "TTFTRule",
     "QueueDepthRule",

@@ -245,6 +245,18 @@ class FlightRecorder:
             error=None if error is None else f"{type(error).__name__}: {error}",
         )
 
+    def on_checkpoint(self, step, info=None) -> None:
+        """A checkpoint event — the enqueue (``info=None``) or a
+        completed async-engine phase (``info`` = the engine's event
+        record: write/finalize timings, ok flag).  A postmortem wants
+        these next to the step frames: "did the state at death ever
+        reach disk" is the first question."""
+        data = dict(info) if info else {"phase": "enqueue"}
+        data.pop("step", None)
+        self.note(
+            "checkpoint", step=-1 if step is None else int(step), **data
+        )
+
     def note_health(self, event) -> None:
         """Record a :class:`apex_tpu.observability.health.HealthEvent`."""
         self.note(

@@ -90,10 +90,13 @@ __all__ = [
     "CollectiveFractionRule",
     "HostStallRule",
     "MemoryBudgetRule",
+    "CheckpointStallRule",
+    "InputStallRule",
     "TTFTRule",
     "QueueDepthRule",
     "QueueWaitFractionRule",
     "default_rules",
+    "goodput_rules",
     "serve_rules",
     "Watchdog",
 ]
@@ -635,6 +638,108 @@ class MemoryBudgetRule(Rule):
             )
             return [ev[0]._replace(severity="warn")]
         return []
+
+
+class CheckpointStallRule(Rule):
+    """The checkpoint engine's step-path stall fraction
+    (``goodput/ckpt/stall_frac``, published by
+    :class:`apex_tpu.goodput.AsyncCheckpointEngine` on every save —
+    snapshot + enqueue wait over wall time, background write time
+    excluded) crosses the overhead budget.  The default 1% is the
+    GOODPUT acceptance bar (docs/goodput.md): above it the "zero
+    stall" contract is broken — typically the writer falling behind
+    the save cadence, so the bounded queue's backpressure has reached
+    the step path.  Critical at 2x the budget."""
+
+    name = "ckpt_stall"
+    severity = "warn"
+
+    def __init__(self, max_fraction: float = 0.01, cooldown: int = 128):
+        super().__init__(cooldown)
+        self.max_fraction = max_fraction
+
+    def evaluate(self, wd, step):
+        from apex_tpu.observability.metrics import board
+
+        frac = board.get("goodput/ckpt/stall_frac")
+        if frac is None or float(frac) <= self.max_fraction:
+            return []
+        frac = float(frac)
+        ev = self._event(
+            step, frac, self.max_fraction,
+            f"checkpoint stall fraction {frac:.4f} over the "
+            f"{self.max_fraction:.2%} budget — the background writer "
+            "is not keeping up with the save cadence (backpressure "
+            "reached the step path); lengthen save_interval_steps or "
+            "speed up storage",
+        )
+        if frac > 2 * self.max_fraction:
+            return [ev[0]._replace(severity="critical")]
+        return ev
+
+
+class InputStallRule(Rule):
+    """The input pipeline's stall fraction
+    (``data/input_stall_fraction``, published by
+    :class:`apex_tpu.data.DevicePrefetcher` — consumer time blocked on
+    an empty prefetch queue over wall time) crosses ``max_fraction``:
+    the chip is data-starved.  Cross-check against the attribution
+    layer's host-stall bucket (``attribution/host_stall_fraction`` — docs/
+    observability.md "Attribution & roofline"): input stall without
+    host stall means the gap is hidden by dispatch depth; both high
+    means the loader genuinely gates the step."""
+
+    name = "input_stall"
+    severity = "warn"
+
+    def __init__(self, max_fraction: float = 0.15, cooldown: int = 128):
+        super().__init__(cooldown)
+        self.max_fraction = max_fraction
+
+    def evaluate(self, wd, step):
+        from apex_tpu.observability.metrics import board
+
+        frac = board.get("data/input_stall_fraction")
+        if frac is None or float(frac) <= self.max_fraction:
+            return []
+        frac = float(frac)
+        # the key publish_attribution actually writes (attribution.py)
+        host_stall = board.get("attribution/host_stall_fraction")
+        xref = (
+            f" (attribution host-stall bucket reads {float(host_stall):.3f})"
+            if host_stall is not None else ""
+        )
+        return self._event(
+            step, frac, self.max_fraction,
+            f"input-stall fraction {frac:.3f} over {self.max_fraction:.2f}"
+            f" — the step consumer is blocking on the prefetch queue; "
+            f"raise the prefetch depth or feed from faster storage{xref}",
+        )
+
+
+def goodput_rules(floor: float = 0.99, **overrides) -> List[Rule]:
+    """The preemptible-fleet rule set (docs/goodput.md): the goodput
+    floor at the deployment bar (default 99% — the storm-drill
+    acceptance number), checkpoint stall over budget, input
+    starvation, plus the substrate rules.  Same override convention as
+    :func:`default_rules`."""
+    specs = {
+        "goodput_floor": GoodputFloorRule,
+        "ckpt_stall": CheckpointStallRule,
+        "input_stall": InputStallRule,
+        "stale_fetch": StaleFetchRule,
+        "hung_step": HungStepRule,
+    }
+    unknown = set(overrides) - set(specs)
+    if unknown:
+        raise ValueError(f"unknown goodput health rules: {sorted(unknown)}")
+    # merge, not setdefault: goodput_rules(floor=0.999,
+    # goodput_floor={"cooldown": 64}) must keep the explicit floor (an
+    # override dict that names "floor" itself still wins)
+    overrides["goodput_floor"] = {
+        "floor": floor, **overrides.get("goodput_floor", {})
+    }
+    return [cls(**overrides.get(name, {})) for name, cls in specs.items()]
 
 
 def serve_rules(**overrides) -> List[Rule]:

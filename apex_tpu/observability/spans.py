@@ -359,11 +359,39 @@ class SpanRecorder:
             f"{type(error).__name__}: {error}",
         )
 
-    def on_checkpoint(self, step: int) -> None:
-        self.instant(
-            "train/checkpoint", self.now(), track=TRACK_TRAIN,
-            step=int(step),
-        )
+    def on_checkpoint(self, step: int, info=None) -> None:
+        """A checkpoint event.  Bare (``info=None``): the enqueue
+        instant, as before.  With ``info`` (an async-engine phase
+        record — ``run_resilient`` forwards
+        :meth:`apex_tpu.goodput.AsyncCheckpointEngine.drain_events`):
+        the completed phase lands as a real interval on the train
+        track — ``ckpt/snapshot`` + ``ckpt/write`` for a background
+        write, ``ckpt/finalize`` for a drain barrier — so the Perfetto
+        timeline shows checkpoint I/O overlapping the steps it ran
+        under."""
+        step = -1 if step is None else int(step)
+        if info is None:
+            self.instant(
+                "train/checkpoint", self.now(), track=TRACK_TRAIN,
+                step=step,
+            )
+            return
+        phase = info.get("phase", "write")
+        if phase == "write":
+            s0, s1 = info.get("snapshot_t0"), info.get("snapshot_t1")
+            if s0 is not None and s1 is not None:
+                self.span(
+                    "ckpt/snapshot", s0, s1, track=TRACK_TRAIN, step=step,
+                )
+            self.span(
+                "ckpt/write", info["t0"], info["t1"], track=TRACK_TRAIN,
+                step=step, ok=bool(info.get("ok", True)),
+            )
+        else:
+            self.span(
+                f"ckpt/{phase}", info["t0"], info["t1"],
+                track=TRACK_TRAIN, step=step,
+            )
 
     def note_health(self, event) -> None:
         """Record a :class:`~apex_tpu.observability.health.HealthEvent`

@@ -28,8 +28,11 @@ indexed by step so replay after rollback/resume feeds the same data.
 The loop narrates itself to an optional ``observer`` (duck-typed; every
 method optional): ``on_step(step, skipped, info)`` per executed step,
 ``on_rollback(step, anchor, skips, discarded)``, ``on_resume(step)``,
-``on_preempt(step)``, ``on_checkpoint(step)`` when a save is enqueued,
-and ``on_retry(what, attempt, error)`` for
+``on_preempt(step)``, ``on_checkpoint(step)`` when a save is enqueued
+— and, on the default async engine, ``on_checkpoint(step, info)``
+again when the background write/finalize completes, ``info`` carrying
+the phase's monotonic span timings (the ``ckpt/*`` intervals on the
+Perfetto timeline) — and ``on_retry(what, attempt, error)`` for
 checkpoint-I/O retries (bridged from
 :mod:`apex_tpu.resilience.retry` for the duration of the run).
 ``discarded`` is the EXACT count of accepted-but-unsaved steps the
@@ -97,7 +100,17 @@ class PreemptionHandler:
 
 
 class ResilientCheckpointManager:
-    """:class:`apex_tpu.checkpoint.CheckpointManager` + retry + chaos.
+    """Checkpoint engine + retry + chaos, behind one manager surface.
+
+    ``engine="async"`` (the default) rides the
+    :class:`apex_tpu.goodput.AsyncCheckpointEngine` — copy-on-snapshot
+    to host, background write, barrier only at finalize — so the step
+    path never pays the write (docs/goodput.md).  ``engine="sync"``
+    keeps the orbax :class:`apex_tpu.checkpoint.CheckpointManager`;
+    its saves get the same **copy-on-snapshot isolation** here (the
+    state is host-snapshotted ONCE before the enqueue), so a caller
+    mutating or donating the state right after ``save`` returns can
+    never corrupt the written checkpoint on either engine.
 
     Save/restore I/O errors are retried per ``policy`` and only then
     raised.  The chaos ``partial`` save mode drops orbax-style
@@ -105,13 +118,14 @@ class ResilientCheckpointManager:
     directory before failing — the on-disk shape of a host that died
     mid-write — which is exactly what ``latest_step`` must ignore.
 
-    Scope note: orbax saves are *async* — ``save`` returns after the
-    enqueue, so the retry here covers the enqueue path (plus any deferred
-    error orbax surfaces at the next ``save`` call; retrying that call
-    clears the stale error and re-queues the current step).  A background
-    write that fails permanently loses that one step's checkpoint, never
-    crash consistency: the incomplete step stays invisible to
-    ``latest_step`` and resume falls back one interval.
+    Scope note: saves are *async* on both engines — ``save`` returns
+    after the enqueue, so the retry here covers the enqueue path (plus
+    any deferred background-write error surfaced at the next ``save``
+    call; retrying that call clears the stale error and re-queues the
+    current step).  A background write that fails permanently loses
+    that one step's checkpoint, never crash consistency: the
+    incomplete step stays invisible to ``latest_step`` and resume
+    falls back one interval.
     """
 
     def __init__(
@@ -121,13 +135,30 @@ class ResilientCheckpointManager:
         max_to_keep: Optional[int] = None,
         save_interval_steps: int = 1,
         policy: Optional[RetryPolicy] = None,
+        engine: str = "async",
     ):
         self._directory = os.path.abspath(os.fspath(directory))
-        self._inner = CheckpointManager(
-            self._directory,
-            max_to_keep=max_to_keep,
-            save_interval_steps=save_interval_steps,
-        )
+        if engine == "async":
+            from apex_tpu.goodput import AsyncCheckpointEngine
+
+            self._inner = AsyncCheckpointEngine(
+                self._directory,
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            )
+        elif engine == "sync":
+            self._inner = CheckpointManager(
+                self._directory,
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            )
+        else:
+            raise ValueError(
+                f"engine must be 'async' or 'sync', got {engine!r}"
+            )
+        #: which save engine backs this manager ("async" | "sync") —
+        #: run_resilient keys its durability bookkeeping on it
+        self.engine = engine
         self._policy = policy or RetryPolicy(backoff=0.05, max_backoff=1.0)
 
     # -- lifecycle ---------------------------------------------------------
@@ -154,7 +185,34 @@ class ResilientCheckpointManager:
         return self._inner.should_save(step)
 
     # -- guarded io --------------------------------------------------------
+    def drain_events(self):
+        """Completed checkpoint phase events (async engine only; [] on
+        sync) — ``run_resilient`` forwards them to ``on_checkpoint``."""
+        drain = getattr(self._inner, "drain_events", None)
+        return drain() if drain is not None else []
+
+    def stats(self):
+        """The async engine's cumulative ledger ({} on sync)."""
+        stats = getattr(self._inner, "stats", None)
+        return stats() if stats is not None else {}
+
     def save(self, step: int, state, *, force: bool = False) -> bool:
+        if self.engine == "sync" and (
+            force or self._inner.should_save(step)
+        ):
+            # copy-on-snapshot for the sync path too (the async engine
+            # snapshots internally, inside its own stall accounting):
+            # the orbax enqueue must never hold live caller buffers —
+            # state mutated after save() returns stays out of the file.
+            # Gated on the interval policy: run_resilient calls save on
+            # every accepted step, and paying a full host copy of the
+            # state on interval-skipped steps would be a step-path
+            # stall, not isolation.  ONE snapshot, outside the retry
+            # closure: retries re-use it.
+            from apex_tpu.goodput import host_snapshot
+
+            state = host_snapshot(state)
+
         def _save():
             chaos.maybe_fail(
                 chaos.CHECKPOINT_SAVE, step, partial_dir=self._directory
@@ -223,8 +281,17 @@ class ObserverFanout:
     def on_preempt(self, *args) -> None:
         self._fan("on_preempt", *args)
 
-    def on_checkpoint(self, *args) -> None:
-        self._fan("on_checkpoint", *args)
+    def on_checkpoint(self, step, info=None) -> None:
+        # per-child arity adaptation: a legacy 1-arg child still gets
+        # the enqueue instants; only 2-arg children see phase records
+        for o in self.observers:
+            fn = getattr(o, "on_checkpoint", None)
+            if fn is None:
+                continue
+            if info is None:
+                fn(step)
+            elif _takes_checkpoint_info(fn):
+                fn(step, info)
 
     def on_retry(self, *args, **kwargs) -> None:
         for o in self.observers:
@@ -286,9 +353,18 @@ def run_resilient(
     observer: Any = None,
     flight: Any = None,
     spans: Any = None,
+    checkpoint: str = "async",
 ) -> RunResult:
     """Drive ``step_fn`` for ``num_steps`` with auto-resume, preemption
     handling, checkpoint retries, and skip-budget rollback.
+
+    ``checkpoint`` selects the save engine (docs/goodput.md):
+    ``"async"`` (default) snapshots to host and writes in the
+    background — the step path pays only the snapshot, in-flight
+    writes drain at rollback anchoring / preemption / shutdown, and
+    every completed write lands on the observer stream as
+    ``on_checkpoint(step, info)`` with enqueue/write span timings;
+    ``"sync"`` keeps the orbax manager on the step path.
 
     Idempotent by construction: call it again after any interruption and
     it continues from the last complete checkpoint.  Returns a
@@ -342,7 +418,7 @@ def run_resilient(
             num_steps=num_steps, save_interval_steps=save_interval_steps,
             max_to_keep=max_to_keep, rollback_after=rollback_after,
             max_rollbacks=max_rollbacks, policy=policy, signals=signals,
-            observer=observer,
+            observer=observer, checkpoint=checkpoint,
         )
     except BaseException as e:
         # BaseException on purpose: KeyboardInterrupt / SystemExit are
@@ -371,10 +447,89 @@ def run_resilient(
     return result
 
 
+#: memo for _takes_checkpoint_info, keyed on the underlying function
+#: (bound methods are recreated per attribute access; their __func__
+#: is stable) — the answer never changes per callable, and paying
+#: inspect.signature per phase event per observer would put repeated
+#: introspection on the step loop
+_CKPT_INFO_ARITY: dict = {}
+
+
+def _takes_checkpoint_info(fn) -> bool:
+    """True if ``fn(step, info)`` is callable — the 2-arg
+    ``on_checkpoint`` protocol.  Observers written to the pre-goodput
+    protocol (``on_checkpoint(step)`` only) keep working: they get the
+    enqueue instants and simply never see the phase records."""
+    import inspect
+
+    key = getattr(fn, "__func__", fn)
+    try:
+        return _CKPT_INFO_ARITY[key]
+    except (KeyError, TypeError):  # TypeError: unhashable callable
+        pass
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        out = True  # builtins/partials we can't introspect: assume new
+    else:
+        n = 0
+        out = False
+        for p in sig.parameters.values():
+            if p.kind is p.VAR_POSITIONAL:
+                out = True
+                break
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                n += 1
+        out = out or n >= 2
+    try:
+        _CKPT_INFO_ARITY[key] = out
+    except TypeError:
+        pass
+    return out
+
+
+def _drain_writes_best_effort(mgr, where: str) -> None:
+    """Drain in-flight writes, but keep a mid-run drain from turning a
+    single lost background write into a run abort: the anchor/forced
+    save that follows falls back to the previous COMPLETE step — which
+    is the failure contract — so warn and continue.  The SHUTDOWN
+    drain deliberately does not use this: there the error must
+    propagate."""
+    try:
+        mgr.wait_until_finished()
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"checkpoint write failed, surfaced at {where} "
+            f"({type(e).__name__}: {e}); falling back to the previous "
+            "complete checkpoint",
+            RuntimeWarning,
+        )
+
+
+def _drain_ckpt_events(mgr, observer):
+    """Forward completed checkpoint phases (background writes,
+    finalize barriers) onto the observer stream — the span layer
+    renders them as ``ckpt/*`` intervals on the Perfetto timeline.
+    Legacy 1-arg ``on_checkpoint`` observers are skipped, not crashed:
+    the phase records are additive telemetry.  Returns the drained
+    events for the caller's own bookkeeping (durability retirement)."""
+    events = mgr.drain_events()
+    if not events or observer is None:
+        return events
+    fn = getattr(observer, "on_checkpoint", None)
+    if fn is None or not _takes_checkpoint_info(fn):
+        return events
+    for ev in events:
+        fn(ev.get("step"), ev)
+    return events
+
+
 def _run_resilient_inner(
     step_fn, init_state, batch_fn, *, directory, num_steps,
     save_interval_steps, max_to_keep, rollback_after, max_rollbacks,
-    policy, signals, observer,
+    policy, signals, observer, checkpoint,
 ) -> RunResult:
     state = init_state
     resumed_from = None
@@ -396,6 +551,7 @@ def _run_resilient_inner(
         max_to_keep=max_to_keep,
         save_interval_steps=save_interval_steps,
         policy=policy,
+        engine=checkpoint,
     ) as mgr, PreemptionHandler(signals=signals) as preempt:
         latest = mgr.latest_step()
         if latest is not None:
@@ -435,7 +591,8 @@ def _run_resilient_inner(
                             "replays deterministically; refusing to "
                             "livelock"
                         )
-                    mgr.wait_until_finished()
+                    _drain_writes_best_effort(mgr, "rollback anchoring")
+                    _drain_ckpt_events(mgr, observer)
                     anchor = mgr.latest_step()
                     rollbacks += 1
                     streak = consecutive_skips
@@ -468,15 +625,31 @@ def _run_resilient_inner(
                 saved = mgr.save(step, state)
                 unsaved_accepted.append(step)
                 if saved:
-                    # steps at or before the PREVIOUS save are durable
-                    # even if this enqueued save later fails on write
-                    unsaved_accepted = [
-                        s for s in unsaved_accepted if s > prev_save_step
-                    ]
-                    prev_save_step = step
-                    # checkpoint ENQUEUED (orbax saves are async): the
+                    if mgr.engine == "sync":
+                        # no write-completion events on the sync orbax
+                        # manager — keep its one-save-lag approximation:
+                        # steps at or before the PREVIOUS save are
+                        # presumed durable once this save is enqueued
+                        unsaved_accepted = [
+                            s for s in unsaved_accepted
+                            if s > prev_save_step
+                        ]
+                        prev_save_step = step
+                    # checkpoint ENQUEUED (saves are async): the
                     # event a timeline wants next to rollback anchors
                     _notify(observer, "on_checkpoint", step)
+            # completed background writes land on the observer stream
+            # as they finish — one cheap deque drain per step.  A
+            # CONFIRMED commit is the async engine's durability signal
+            # for retiring at-risk steps: an ENQUEUE is not durable —
+            # with queue_depth > 1 an older in-flight write can still
+            # fail, and `discarded` is documented as EXACT.
+            for ev in _drain_ckpt_events(mgr, observer):
+                if ev.get("phase") == "write" and ev.get("ok"):
+                    durable = int(ev["step"])
+                    unsaved_accepted = [
+                        s for s in unsaved_accepted if s > durable
+                    ]
             step += 1
 
         if preempt.requested:
@@ -500,10 +673,16 @@ def _run_resilient_inner(
                     f" {e}); writing the final checkpoint anyway",
                     RuntimeWarning,
                 )
-            mgr.wait_until_finished()
+            _drain_writes_best_effort(mgr, "pre-preemption-save drain")
             if completed not in mgr.all_steps():
                 mgr.save(completed, state, force=True)
+        # the shutdown drain: in-flight background writes commit before
+        # the run returns (the finalize barrier — the ONLY blocking
+        # point the async engine has).  This one PROPAGATES a deferred
+        # write error: a run must never return success claiming a final
+        # checkpoint that never reached disk.
         mgr.wait_until_finished()
+        _drain_ckpt_events(mgr, observer)
         return RunResult(
             state=state,
             last_step=completed,

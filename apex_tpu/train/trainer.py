@@ -532,6 +532,7 @@ class TrainStep:
         flight: Any = None,
         reporter: Any = None,
         report_every: int = 10,
+        checkpoint: str = "async",
     ):
         """Drive the step with the production defaults wired in:
         :func:`apex_tpu.resilience.run_resilient` (auto-resume,
@@ -539,7 +540,12 @@ class TrainStep:
         :class:`~apex_tpu.observability.GoodputAccountant` on the
         observer stream, a :class:`~apex_tpu.observability.StepMeter`,
         and a flight recorder armable via ``APEX_TPU_FLIGHT``
-        (``flight=`` to pass one explicitly).  Returns the
+        (``flight=`` to pass one explicitly).  ``checkpoint="async"``
+        (default) saves through the zero-stall
+        :class:`~apex_tpu.goodput.AsyncCheckpointEngine` — host
+        snapshot on the step path, background write, drain at
+        shutdown (docs/goodput.md); ``"sync"`` keeps the orbax
+        manager inline.  Returns the
         :class:`~apex_tpu.resilience.runner.RunResult`; the goodput
         ledger lands on ``self.goodput``."""
         from apex_tpu import observability as obs
@@ -581,4 +587,5 @@ class TrainStep:
             max_to_keep=max_to_keep,
             observer=ObserverFanout([goodput, observer]),
             flight=flight,
+            checkpoint=checkpoint,
         )

@@ -49,6 +49,7 @@ import contextlib
 import functools
 import json
 import os
+import shutil
 import sys
 import threading
 import time
@@ -1309,6 +1310,115 @@ def bench_smoke(trace_dir=None, dim=128, batch=64, chunk=4, trials=2):
     )
 
 
+def bench_goodput(trace_dir=None, steps=60, preempt_every=12):
+    """The preemptible-fleet I/O plane (docs/goodput.md), measured:
+    reuses ``tools/goodput_drill.py``'s storm (the GOODPUT gate's
+    exact machinery — uninterrupted reference + APEX_TPU_CHAOS
+    preemption storm over the resilient example's real programs, fed
+    by the resumable stream, saved by the async engine) and emits the
+    headline rows: storm goodput %, the step path's zero-stall
+    percentage, checkpoint enqueue/finalize stall ms, input-stall
+    fraction, and the resumed-loss drift (which must be 0.0 — a
+    nonzero value here means determinism broke, not that a knob needs
+    tuning).  CI-grade numbers on CPU; not TPU perf claims."""
+    import importlib.util
+    import tempfile
+
+    # APEX_TPU_GOODPUT_ARTIFACT: reuse an evidence artifact a previous
+    # drill wrote (verify_tier1.sh runs the GOODPUT gate first and
+    # hands its --json here) instead of paying a second full
+    # reference+storm+resume drill for the same numbers.  Ignored
+    # unless the artifact matches the requested storm geometry.
+    art = None
+    reuse = os.environ.get("APEX_TPU_GOODPUT_ARTIFACT")
+    if reuse and os.path.exists(reuse):
+        try:
+            with open(reuse) as f:
+                cand = json.load(f)
+            if (cand.get("steps") == steps
+                    and cand.get("preempt_every") == preempt_every):
+                art = cand
+        except (OSError, ValueError):
+            art = None
+    if art is None:
+        root = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "goodput_drill",
+            os.path.join(root, "tools", "goodput_drill.py"),
+        )
+        gd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(gd)
+        workdir = tempfile.mkdtemp(prefix="apex_tpu_bench_goodput_")
+        try:
+            art = gd.run_drill(
+                steps=steps, preempt_every=preempt_every,
+                workdir=workdir,
+            )
+        finally:
+            # CI runs this config every PERF pass: don't leave a
+            # corpus + three checkpoint trees in /tmp per invocation
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def med(xs):
+        # 0.0 on empty, never NaN: on fast storage every write can
+        # settle before a drain point, leaving no finalize events —
+        # and a NaN row would sail through every bench_diff
+        # comparison (all NaN compares are False) instead of gating
+        return sorted(xs)[len(xs) // 2] if xs else 0.0
+
+    a = art["accountant"]
+    storm = (
+        "preempt every %d of %d steps + 1 healed save fault; accepted=%d "
+        "skipped=%d discarded=%d resumes=%d; async ckpt engine + "
+        "resumable stream; docs/goodput.md"
+        % (preempt_every, steps, a["accepted"], a["skipped"],
+           a["discarded"], a["resumes"])
+    )
+    _emit(
+        "goodput_storm_pct", round(art["goodput"] * 100, 3),
+        "%% productive/executed steps under the chaos storm (%s)" % storm,
+        None,
+    )
+    _emit(
+        "goodput_zero_stall_pct",
+        round((1.0 - art["ckpt"]["stall_frac"]) * 100, 3),
+        "%% of run wall time NOT stalled on checkpointing (snapshot+"
+        "enqueue over wall on the full-length reference run, "
+        "background writes excluded — the <1%% overhead bound "
+        "inverted; %d saves)" % int(art["ckpt"]["saves"]),
+        None,
+    )
+    _emit(
+        "goodput_ckpt_enqueue_ms",
+        round(med(art["ckpt"]["snapshot_ms"]), 3),
+        "ms median host-snapshot+enqueue per save — the ONLY "
+        "checkpoint cost on the step path (write runs behind)",
+        None,
+    )
+    _emit(
+        "goodput_ckpt_finalize_ms",
+        round(med(art["ckpt"]["finalize_ms"]), 3),
+        "ms median finalize barrier (rollback anchor / preemption / "
+        "shutdown drains — off the step path by design)",
+        None,
+    )
+    _emit(
+        "goodput_input_stall_frac",
+        round(art["input_stall_fraction"], 5),
+        "fraction of wall time the consumer blocked on the prefetch "
+        "queue (DevicePrefetcher depth=2 over the token loader)",
+        None,
+    )
+    _emit(
+        "goodput_resume_loss_drift",
+        art["loss_trajectory"]["max_abs_drift"],
+        "max |stormed - uninterrupted| per-step loss over %d steps "
+        "(MUST be 0.0: resume is bit-exact by contract)"
+        % art["loss_trajectory"]["ref_steps"],
+        None,
+    )
+
+
 _CONFIGS = {
     "resnet50": bench_resnet50,
     "ddp_syncbn": bench_ddp_syncbn,
@@ -1320,12 +1430,14 @@ _CONFIGS = {
     "long_attn": bench_long_attn,
     "smoke": bench_smoke,
     "serve": bench_serve,
+    "goodput": bench_goodput,
 }
 
-#: configs `--config all` skips: smoke/serve are CI schema drivers, and
-#: ddp_syncbn/tp_gpt are the degenerate-prone proxies train3d REPLACES
-#: in the batch (still invocable by name for historical comparisons)
-_ALL_EXCLUDED = ("smoke", "serve", "ddp_syncbn", "tp_gpt")
+#: configs `--config all` skips: smoke/serve/goodput are CI schema/
+#: acceptance drivers, and ddp_syncbn/tp_gpt are the degenerate-prone
+#: proxies train3d REPLACES in the batch (still invocable by name for
+#: historical comparisons)
+_ALL_EXCLUDED = ("smoke", "serve", "goodput", "ddp_syncbn", "tp_gpt")
 
 
 def main(config="bert_lamb", trace_dir=None):

@@ -178,6 +178,11 @@ def main():
                     choices=["f32", "bf16", "int8"],
                     help="wire format of the boundary gradient sync "
                     "(docs/comm.md; tiny leaves stay on the exact psum)")
+    ap.add_argument("--ckpt-engine", default="async",
+                    choices=["async", "sync"],
+                    help="checkpoint save engine (docs/goodput.md): "
+                    "async = zero-stall host snapshot + background "
+                    "write (default); sync = orbax manager inline")
     ap.add_argument("--metrics-out", default=None,
                     help="JSONL telemetry path — turns on the full "
                     "observability pipe (docs/observability.md)")
@@ -316,6 +321,7 @@ def main():
             rollback_after=5,
             observer=ObserverFanout([goodput, watchdog]),
             flight=flight,
+            checkpoint=args.ckpt_engine,
         )
     finally:
         # even a raising run (e.g. max_rollbacks exhausted) must close
@@ -369,6 +375,18 @@ def main():
         f"steps_run={result.steps_run} skipped={result.skipped_steps} "
         f"rollbacks={result.rollbacks} preempted={result.preempted}"
     )
+    saves = obs.board.get("goodput/ckpt/saves")
+    if saves:
+        # the async engine's ledger (docs/goodput.md): the only step-path
+        # cost is the snapshot — stall_frac is the <1% acceptance number
+        print(
+            "ckpt: engine=%s saves=%d writes=%d stall_frac=%.5f "
+            "last_write=%.1fms"
+            % (args.ckpt_engine, saves,
+               obs.board.get("goodput/ckpt/writes", 0),
+               obs.board.get("goodput/ckpt/stall_frac", 0.0),
+               obs.board.get("goodput/ckpt/last_write_ms", 0.0))
+        )
     final_loss = float(
         jnp.mean((x_all @ result.state["params"]["w"] - y_all) ** 2)
     )

@@ -513,6 +513,12 @@ class TestBenchDiff:
             # check_schema refuses degenerate train3d rows
             "train3d_dp2_step_ms", "train3d_tp2_step_ms",
             "train3d_dp2tp2_step_ms", "train3d_lint_errors",
+            # the goodput storm-drill rows (ISSUE 13): chaos-storm
+            # goodput, zero-stall bound, ckpt enqueue/finalize stall,
+            # input-stall fraction, bit-exact-resume drift
+            "goodput_storm_pct", "goodput_zero_stall_pct",
+            "goodput_ckpt_enqueue_ms", "goodput_ckpt_finalize_ms",
+            "goodput_input_stall_frac", "goodput_resume_loss_drift",
         }
 
 

@@ -94,6 +94,32 @@ def test_latest_step_ignores_uncommitted_debris(tmp_path):
         np.testing.assert_allclose(np.asarray(out["w"]), [2.0, 2.0])
 
 
+def test_half_written_step_dir_is_invisible(tmp_path):
+    """Crash consistency, the harder shape: a crash that got as far as
+    CREATING the step directory (non-atomic fs, torn non-orbax write)
+    but never committed.  Orbax's own enumeration would report it as a
+    valid step; ours must not — resume has to pick the previous
+    COMPLETE step, and restoring the planted step must refuse."""
+    state = {"w": jnp.zeros((2,))}
+    with ckpt.CheckpointManager(tmp_path) as mgr:
+        for step in range(3):
+            mgr.save(step, {"w": state["w"] + step})
+        mgr.wait_until_finished()
+    # a half-written step 7: digit-named dir, payload bytes, NO commit
+    # marker — newer than every complete step
+    partial = tmp_path / "7"
+    partial.mkdir()
+    (partial / "params").write_text("torn half-written payload")
+    assert ckpt.all_steps(tmp_path) == [0, 1, 2]
+    assert ckpt.latest_step(tmp_path) == 2
+    with pytest.raises(FileNotFoundError, match="incomplete"):
+        ckpt.restore_step_dir(tmp_path, 7, template=state)
+    with ckpt.CheckpointManager(tmp_path) as mgr:
+        assert mgr.latest_step() == 2  # the manager surface agrees
+        out = mgr.restore(template=state)
+        np.testing.assert_allclose(np.asarray(out["w"]), [2.0, 2.0])
+
+
 def test_manager_restore_empty_raises(tmp_path):
     with ckpt.CheckpointManager(tmp_path / "empty") as mgr:
         with pytest.raises(FileNotFoundError):

@@ -1,0 +1,544 @@
+"""apex_tpu.goodput — zero-stall async checkpointing + resumable
+streaming input (docs/goodput.md).
+
+Pins the subsystem's three contracts: snapshot isolation (state
+mutated after save() returns never corrupts the written checkpoint),
+crash consistency (a mid-write death leaves the previous checkpoint
+intact and invisible debris), and deterministic resume (a stormed
+run's batch/loss sequence is bit-identical to an uninterrupted one,
+with the stream cursor riding inside the checkpoint).
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.data import DataLoader, TokenFileDataset, write_token_file
+from apex_tpu.goodput import (
+    AsyncCheckpointEngine,
+    ResumableStream,
+    StreamStateError,
+    host_snapshot,
+    stream_state,
+    verify_stream_state,
+)
+from apex_tpu.observability.metrics import board
+from apex_tpu.resilience import (
+    ObserverFanout,
+    ResilientCheckpointManager,
+    chaos,
+    run_resilient,
+)
+
+
+def _bits(tree):
+    return [
+        np.asarray(x).tobytes() for x in jax.tree_util.tree_leaves(tree)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# host_snapshot: copy-on-snapshot isolation
+# ---------------------------------------------------------------------------
+
+
+def test_host_snapshot_isolates_numpy_mutation():
+    arr = np.arange(4.0, dtype=np.float32)
+    snap = host_snapshot({"a": arr, "j": jnp.ones((2,)), "s": 3})
+    arr[:] = -1.0
+    np.testing.assert_array_equal(snap["a"], [0.0, 1.0, 2.0, 3.0])
+    assert isinstance(snap["j"], np.ndarray)  # device leaves land on host
+    assert snap["s"] == 3
+
+
+def test_host_snapshot_preserves_dtypes():
+    snap = host_snapshot({
+        "bf": jnp.ones((3,), jnp.bfloat16),
+        "i": np.asarray(7, np.int64),
+    })
+    assert snap["bf"].dtype == jnp.bfloat16
+    assert snap["i"].dtype == np.int64
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointEngine
+# ---------------------------------------------------------------------------
+
+
+def test_engine_roundtrip_interval_retention(tmp_path):
+    state = {"w": jnp.arange(4.0), "n": np.asarray(0, np.int64)}
+    with AsyncCheckpointEngine(
+        tmp_path, max_to_keep=2, save_interval_steps=2
+    ) as eng:
+        for step in range(6):
+            saved = eng.save(step, {"w": state["w"] + step, "n": state["n"]})
+            assert saved == (step % 2 == 0)  # interval policy
+        eng.wait_until_finished()
+        assert eng.all_steps() == [2, 4]  # max_to_keep pruned step 0
+        assert eng.latest_step() == 4
+        out = eng.restore(template=state)
+        np.testing.assert_allclose(np.asarray(out["w"]), [4, 5, 6, 7])
+        st = eng.stats()
+        assert st["saves"] == 3 and st["writes"] == 3
+        assert st["failures"] == 0
+
+
+def test_engine_save_returns_before_write_lands(tmp_path):
+    """The zero-stall contract: save() returns after snapshot+enqueue;
+    the step dir appears only once the BACKGROUND write commits (the
+    finalize barrier observes it)."""
+    import threading
+
+    gate = threading.Event()
+    eng = AsyncCheckpointEngine(tmp_path)
+    eng._commit_hook = lambda step: gate.wait(timeout=30)
+    try:
+        assert eng.save(0, {"w": jnp.ones((2,))})
+        # enqueued but the writer is gated: nothing on disk yet
+        assert eng.latest_step() is None
+        gate.set()
+        eng.wait_until_finished()
+        assert eng.latest_step() == 0
+    finally:
+        gate.set()
+        eng.close()
+
+
+def test_engine_queue_depth_resolution(tmp_path, monkeypatch):
+    """Depth resolution order: env APEX_TPU_CKPT_QUEUE > explicit arg
+    > default 4; floored at 1 (depth 0 would make every save
+    synchronous)."""
+    from apex_tpu.goodput import resolve_queue_depth
+
+    monkeypatch.delenv("APEX_TPU_CKPT_QUEUE", raising=False)
+    assert resolve_queue_depth() == 4
+    assert resolve_queue_depth(9) == 9
+    assert resolve_queue_depth(0) == 1
+    monkeypatch.setenv("APEX_TPU_CKPT_QUEUE", "16")
+    assert resolve_queue_depth() == 16
+    assert resolve_queue_depth(2) == 16  # env wins over the arg
+    eng = AsyncCheckpointEngine(tmp_path, queue_depth=2)
+    try:
+        assert eng._q.maxsize == 16
+    finally:
+        eng.close()
+
+
+def test_engine_mutation_after_save_is_invisible(tmp_path):
+    """The ISSUE's snapshot hazard, pinned at the engine: mutate the
+    state right after save() returns — the written checkpoint must
+    carry the pre-mutation values."""
+    with AsyncCheckpointEngine(tmp_path) as eng:
+        arr = np.ones((8,), np.float32)
+        eng.save(0, {"a": arr})
+        arr[:] = 999.0  # the hazard
+        eng.wait_until_finished()
+        out = eng.restore(0)
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.ones((8,)))
+
+
+def test_engine_midwrite_crash_keeps_previous_intact(tmp_path):
+    """A writer that dies mid-write (commit hook raises — the on-disk
+    moment BEFORE the atomic rename) must leave the previous complete
+    step restorable, the failed step invisible, and surface the error
+    at the next synchronization point — the finalize barrier here, or
+    the next ``save`` (the deferred-error retry contract)."""
+    with AsyncCheckpointEngine(tmp_path) as eng:
+        eng.save(0, {"w": jnp.zeros((2,))})
+        eng.wait_until_finished()
+
+        def die(step):
+            raise OSError(f"disk died mid-write of step {step}")
+
+        eng._commit_hook = die
+        eng.save(1, {"w": jnp.ones((2,))})
+        # the finalize barrier must NOT report success for a write
+        # that never reached disk (the shutdown/preemption drain)
+        with pytest.raises(OSError, match="mid-write"):
+            eng.wait_until_finished()
+        eng._commit_hook = None
+        # previous checkpoint intact, failed step invisible — and
+        # restore() keeps working: fall-back IS the failure contract
+        assert eng.all_steps() == [0]
+        out = eng.restore(0)
+        np.testing.assert_array_equal(np.asarray(out["w"]), [0.0, 0.0])
+        # the raise cleared the error: the next save re-enters clean
+        assert eng.save(2, {"w": jnp.full((2,), 2.0)})
+        eng.wait_until_finished()
+        assert eng.all_steps() == [0, 2]
+        assert eng.stats()["failures"] == 1
+
+
+def test_engine_deferred_error_surfaces_at_next_save(tmp_path):
+    """Without an intervening finalize, the deferred write error
+    surfaces at the NEXT save, once — the RCM retry wrapper clears it
+    and re-enqueues the current step."""
+    with AsyncCheckpointEngine(tmp_path) as eng:
+        eng.save(0, {"w": jnp.zeros((2,))})
+        eng.wait_until_finished()
+
+        def die(step):
+            raise OSError(f"disk died mid-write of step {step}")
+
+        eng._commit_hook = die
+        eng.save(1, {"w": jnp.ones((2,))})
+        eng._q.join()  # write settled, error still deferred
+        eng._commit_hook = None
+        with pytest.raises(OSError, match="mid-write"):
+            eng.save(2, {"w": jnp.ones((2,))})
+        assert eng.save(2, {"w": jnp.full((2,), 2.0)})  # retry clears
+        eng.wait_until_finished()
+        assert eng.all_steps() == [0, 2]
+        assert eng.stats()["failures"] == 1
+
+
+def test_engine_writer_bootstrap_failure_does_not_deadlock(
+    tmp_path, monkeypatch
+):
+    """A writer thread that cannot bootstrap (orbax broken) must not
+    leave enqueued items un-task_done'd — ``q.join()`` callers
+    (finalize, shutdown) would deadlock.  The failure surfaces through
+    the normal deferral contract instead."""
+    import orbax.checkpoint as ocp
+
+    def boom(*a, **k):
+        raise RuntimeError("orbax broken at writer bootstrap")
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", boom)
+    eng = AsyncCheckpointEngine(tmp_path)
+    try:
+        assert eng.save(0, {"w": jnp.ones((2,))})
+        with pytest.raises(RuntimeError, match="writer bootstrap"):
+            eng.wait_until_finished()  # returns (no hang) and raises
+        assert eng.all_steps() == []
+        # the dead writer keeps DRAINING but every swallowed snapshot
+        # is a lost checkpoint — the error must re-arm per dropped
+        # item (before task_done, so a join waiter observes it), so no
+        # later sync point reports success for writes that never
+        # reached disk
+        eng._interval = 1
+        eng.save(1, {"w": jnp.ones((2,))})  # enqueue ok (err cleared)...
+        with pytest.raises(RuntimeError, match="writer bootstrap"):
+            eng.wait_until_finished()  # ...but its drop re-armed
+        assert eng.all_steps() == []
+    finally:
+        eng.close()
+
+
+def test_engine_events_carry_phase_spans(tmp_path):
+    with AsyncCheckpointEngine(tmp_path) as eng:
+        eng.save(0, {"w": jnp.ones((2,))})
+        eng.wait_until_finished()
+        evs = eng.drain_events()
+    writes = [e for e in evs if e["phase"] == "write"]
+    assert len(writes) == 1 and writes[0]["step"] == 0
+    w = writes[0]
+    assert w["snapshot_t0"] <= w["snapshot_t1"] <= w["t0"] <= w["t1"]
+    assert w["ok"] is True
+    assert eng.drain_events() == []  # drained
+
+
+def test_engine_close_drains_pending_writes(tmp_path):
+    eng = AsyncCheckpointEngine(tmp_path)
+    eng.save(0, {"w": jnp.ones((4,))})
+    eng.close()  # no wait_until_finished: close IS the shutdown drain
+    assert ckpt.latest_step(tmp_path) == 0
+
+
+def test_rcm_sync_engine_gets_snapshot_isolation(tmp_path):
+    """The satellite fix: the SYNC manager path snapshots before the
+    orbax enqueue too — params mutated right after save() returns
+    stay out of the written checkpoint."""
+    with ResilientCheckpointManager(tmp_path, engine="sync") as mgr:
+        arr = np.ones((8,), np.float32)
+        assert mgr.save(0, {"a": arr})
+        arr[:] = -5.0
+        mgr.wait_until_finished()
+        out = mgr.restore(0)
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.ones((8,)))
+
+
+# ---------------------------------------------------------------------------
+# stream state + ResumableStream
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def loader(tmp_path):
+    toks = np.arange(1000, 1000 + 4096, dtype=np.uint16)
+    p = tmp_path / "corpus.bin"
+    write_token_file(p, toks)
+    ds = TokenFileDataset(p, seq_len=128)  # 32 samples
+    return DataLoader(ds, batch_size=4, seed=7)  # 8 batches/epoch
+
+
+def test_stream_state_roundtrip_through_checkpoint(loader, tmp_path):
+    """The cursor is an ordinary pytree leaf: snapshot_training_state
+    carries it, the engine writes it, verify_stream_state accepts it
+    back and returns the exact batch index."""
+    state = ckpt.snapshot_training_state(
+        {"w": jnp.zeros((2,))}, step=11, stream=stream_state(loader, 12),
+    )
+    with AsyncCheckpointEngine(tmp_path / "c") as eng:
+        eng.save(11, state)
+        restored = eng.restore(11)
+    assert verify_stream_state(loader, restored["stream"]) == 12
+
+
+def test_stream_state_mismatch_is_loud(loader, tmp_path):
+    st = stream_state(loader, 5)
+    ds = loader.dataset
+    for other, what in (
+        (DataLoader(ds, batch_size=4, seed=8), "seed"),
+        (DataLoader(ds, batch_size=2, seed=7), "batch_size"),
+        (DataLoader(ds, batch_size=4, seed=7, shard=(1, 2)), "rank"),
+        (DataLoader(ds, batch_size=4, seed=7, shuffle=False), "shuffle"),
+    ):
+        with pytest.raises(StreamStateError, match=what):
+            verify_stream_state(other, st)
+
+
+def test_resumable_stream_matches_plain_iteration(loader):
+    plain = list(__import__("itertools").islice(iter(loader), 12))
+    with ResumableStream(loader) as stream:
+        for k in range(12):
+            np.testing.assert_array_equal(stream(k), plain[k])
+
+
+def test_resumable_stream_seeks_on_rollback_and_resume(loader):
+    plain = list(__import__("itertools").islice(iter(loader), 20))
+    with ResumableStream(loader) as stream:
+        stream(0), stream(1), stream(2)
+        # rollback: jump backwards
+        np.testing.assert_array_equal(stream(1), plain[1])
+        # resume in a "fresh process": jump forward across the epoch
+        # boundary (8 batches/epoch)
+        np.testing.assert_array_equal(stream(13), plain[13])
+        np.testing.assert_array_equal(stream(14), plain[14])
+        assert stream.seeks == 2
+        assert int(stream.state()["next_batch"]) == 15
+
+
+def test_resumable_stream_prefetch_identical_and_gauges(loader):
+    board.clear()
+    # >= 8 batches: the prefetcher withholds the board gauge until the
+    # stall fraction is statistically meaningful (cold-start guard)
+    plain = list(__import__("itertools").islice(iter(loader), 10))
+    with ResumableStream(loader, prefetch=2) as stream:
+        for k in range(10):
+            got = stream(k)
+            assert isinstance(got, jax.Array)
+            np.testing.assert_array_equal(np.asarray(got), plain[k])
+        assert 0.0 <= stream.stall_fraction() <= 1.0
+    assert board.get("data/input_stall_fraction") is not None
+
+
+def test_prefetcher_metrics_ledger(loader):
+    from apex_tpu.data import DevicePrefetcher
+
+    with DevicePrefetcher(loader.epoch(0), depth=2) as pf:
+        n = sum(1 for _ in pf)
+    m = pf.metrics()
+    assert m["batches"] == n == loader.batches_per_epoch
+    assert 0.0 <= m["stall_fraction"] <= 1.0
+    assert m["depth"] == 2
+
+
+# ---------------------------------------------------------------------------
+# run_resilient integration: events, spans, rules
+# ---------------------------------------------------------------------------
+
+
+def _counting_job():
+    def batch_fn(step):
+        return jnp.asarray(float(step + 1), jnp.float32)
+
+    def step_fn(state, batch):
+        return {"acc": state["acc"] + batch}, {"skipped": False}
+
+    return {"acc": jnp.zeros((), jnp.float32)}, step_fn, batch_fn
+
+
+def test_run_resilient_forwards_write_events_and_spans(tmp_path):
+    from apex_tpu.observability.spans import SpanRecorder
+
+    init, step_fn, batch_fn = _counting_job()
+    rec = SpanRecorder()
+    infos = []
+
+    class Obs:
+        def on_checkpoint(self, step, info=None):
+            if info is not None:
+                infos.append(info)
+
+    run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path, num_steps=3,
+        observer=ObserverFanout([Obs(), rec]), spans=rec,
+    )
+    phases = {i["phase"] for i in infos}
+    assert "write" in phases
+    steps_written = {i["step"] for i in infos if i["phase"] == "write"}
+    assert steps_written == {0, 1, 2}
+    names = [s["name"] for s in rec.snapshot()]
+    assert "ckpt/write" in names and "ckpt/snapshot" in names
+
+
+def test_run_resilient_legacy_one_arg_observer_survives(tmp_path):
+    """An observer written to the pre-goodput protocol
+    (``on_checkpoint(step)`` — no info parameter) must keep working
+    under the default async engine: it gets the enqueue instants and
+    never sees the additive phase records, bare or fanned out."""
+    init, step_fn, batch_fn = _counting_job()
+    enqueues = []
+
+    class Legacy:
+        def on_checkpoint(self, step):
+            enqueues.append(step)
+
+    run_resilient(
+        step_fn, init, batch_fn, directory=str(tmp_path / "bare"),
+        num_steps=3, observer=Legacy(),
+    )
+    assert enqueues == [0, 1, 2]
+
+    enqueues.clear()
+    run_resilient(
+        step_fn, init, batch_fn, directory=str(tmp_path / "fanout"),
+        num_steps=3, observer=ObserverFanout([Legacy()]),
+    )
+    assert enqueues == [0, 1, 2]
+
+
+def test_run_resilient_sync_engine_still_works(tmp_path):
+    init, step_fn, batch_fn = _counting_job()
+    res = run_resilient(
+        step_fn, init, batch_fn, directory=tmp_path, num_steps=3,
+        checkpoint="sync",
+    )
+    assert res.last_step == 2
+    assert ckpt.latest_step(tmp_path) == 2
+
+
+def test_checkpoint_stall_rule_pages_over_budget():
+    from apex_tpu.observability import CheckpointStallRule, Watchdog
+
+    board.clear()
+    wd = Watchdog(rules=[CheckpointStallRule(max_fraction=0.01)],
+                  check_every=1)
+    board.set("goodput/ckpt/stall_frac", 0.005)
+    wd.on_step(1, False)
+    assert wd.events == []
+    board.set("goodput/ckpt/stall_frac", 0.05)  # 5x the budget
+    wd.on_step(2, False)
+    assert [e.rule for e in wd.events] == ["ckpt_stall"]
+    assert wd.events[0].severity == "critical"  # > 2x budget
+
+
+def test_input_stall_rule_pages_and_cross_references():
+    from apex_tpu.observability import InputStallRule, Watchdog
+
+    board.clear()
+    wd = Watchdog(rules=[InputStallRule(max_fraction=0.15)], check_every=1)
+    board.set("data/input_stall_fraction", 0.4)
+    # the key publish_attribution actually writes (pinned so the xref
+    # branch exercises the production key, not a test-invented one)
+    board.set("attribution/host_stall_fraction", 0.3)
+    wd.on_step(1, False)
+    assert [e.rule for e in wd.events] == ["input_stall"]
+    assert "host-stall" in wd.events[0].message
+    assert "0.300" in wd.events[0].message
+
+
+def test_goodput_rules_composition():
+    from apex_tpu.observability import goodput_rules
+
+    rules = goodput_rules(floor=0.97)
+    names = [r.name for r in rules]
+    assert names == ["goodput_floor", "ckpt_stall", "input_stall",
+                     "stale_fetch", "hung_step"]
+    assert rules[0].floor == 0.97
+    with pytest.raises(ValueError, match="unknown"):
+        goodput_rules(nope={})
+
+
+# ---------------------------------------------------------------------------
+# the mini storm: preemption chaos, stream-fed, bit-exact resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_preemption_storm_stream_fed_bit_exact(tmp_path, loader):
+    """The tentpole acceptance in miniature (tools/goodput_drill.py is
+    the full version): a preemption storm over a stream-fed run, with
+    the stream cursor checkpointed inside the state, sustains 100%
+    goodput and reproduces the uninterrupted loss sequence bit-exactly."""
+    from apex_tpu.observability import GoodputAccountant
+
+    w_true = np.linspace(-1, 1, 8 * 4, dtype=np.float32).reshape(8, 4)
+
+    def make_batch(toks):
+        x = (toks[:, :8].astype(np.float32) / 6000.0) - 0.5
+        return x, x @ w_true
+
+    @jax.jit
+    def sgd(w, batch):
+        x, y = batch
+
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, loss
+
+    def run(directory, stream, faults=(), losses=None):
+        cur = {"step": -1}
+
+        def batch_fn(step):
+            cur["step"] = step
+            return make_batch(stream(step))
+
+        def step_fn(state, batch):
+            new_w, loss = sgd(state["w"], batch)
+            step = cur["step"]
+            new_state = {"w": new_w, "stream": stream.state(step + 1)}
+            if losses is not None:
+                losses[step] = float(loss)
+            return new_state, {"skipped": False}
+
+        init = {"w": jnp.zeros((8, 4)), "stream": stream.state(0)}
+        acct = GoodputAccountant()
+        with chaos.inject(*faults):
+            while True:
+                res = run_resilient(
+                    step_fn, init, batch_fn, directory=directory,
+                    num_steps=16, save_interval_steps=4, observer=acct,
+                )
+                if not res.preempted:
+                    return res, acct
+
+    losses_ref = {}
+    ref_stream = ResumableStream(loader)
+    run(tmp_path / "ref", ref_stream, losses=losses_ref)
+    ref_stream.close()
+
+    losses_storm = {}
+    storm_stream = ResumableStream(loader)
+    res, acct = run(
+        tmp_path / "storm", storm_stream,
+        faults=(chaos.Fault(chaos.PREEMPTION, steps=(5, 11)),),
+        losses=losses_storm,
+    )
+    storm_stream.close()
+
+    assert acct.resumes == 2  # two relaunches after the two evictions
+    assert acct.goodput() >= 0.99
+    assert losses_storm == losses_ref  # bit-exact trajectory
+    # the stream cursor inside the final checkpoint points past the run
+    restored = ckpt.restore_step_dir(tmp_path / "storm", 15)
+    assert verify_stream_state(loader, restored["stream"]) == 16

@@ -519,6 +519,24 @@ def test_checkpoint_save_fault_heals_on_retry(tmp_path):
 
 
 @pytest.mark.chaos
+def test_partial_mode_drops_orbax_debris(tmp_path):
+    """chaos ``partial`` save mode must actually create orbax-style
+    uncommitted staging debris before raising — pinned directly on
+    maybe_fail (the engine's background GC collects such debris, so
+    integration tests can't assert its creation without racing)."""
+    with chaos.inject(
+        chaos.Fault(chaos.CHECKPOINT_SAVE, steps=(3,), mode="partial")
+    ):
+        with pytest.raises(chaos.InjectedFault):
+            chaos.maybe_fail(chaos.CHECKPOINT_SAVE, 3, partial_dir=tmp_path)
+    debris = [p for p in os.listdir(tmp_path)
+              if p.startswith("3.orbax-checkpoint-tmp-")]
+    assert debris, os.listdir(tmp_path)
+    # the debris carries a payload file (a torn write, not an empty dir)
+    assert os.listdir(tmp_path / debris[0])
+
+
+@pytest.mark.chaos
 def test_interrupted_save_never_corrupts_latest(tmp_path):
     """Acceptance (crash consistency): a save that dies mid-write (debris
     on disk, exception raised, retries exhausted) leaves latest_step()
@@ -535,10 +553,13 @@ def test_interrupted_save_never_corrupts_latest(tmp_path):
                     step_fn, init, batch_fn, directory=tmp_path,
                     num_steps=6, policy=policy,
                 )
-    # the torn write left orbax-style debris behind...
-    debris = [p for p in os.listdir(tmp_path) if "tmp" in p]
-    assert debris, os.listdir(tmp_path)
-    # ...which step enumeration must ignore
+    # The torn write left orbax-style debris behind — unless the async
+    # engine's writer-thread GC already collected it (a background
+    # write completing after the fault prunes dead staging dirs, which
+    # is a race this test must not depend on).  Plant debris of both
+    # shapes so enumeration provably ignores it either way.
+    (tmp_path / "4.orbax-checkpoint-tmp-99").mkdir(exist_ok=True)
+    (tmp_path / "5").mkdir(exist_ok=True)  # digit-named, no commit marker
     with ResilientCheckpointManager(tmp_path) as mgr:
         assert mgr.latest_step() == 2
         assert mgr.all_steps() == [0, 1, 2]

@@ -1,0 +1,486 @@
+"""Chaos-storm goodput drill — the GOODPUT acceptance gate's engine.
+
+Drives the resilient example's REAL training program
+(``examples/simple/resilient/train_resilient.py::build_training`` — the
+same compiled steps the OBS/FLIGHT/LINT gates audit) through an
+``APEX_TPU_CHAOS``-style preemption storm, fed by the goodput
+subsystem's resumable stream (``apex_tpu.goodput.ResumableStream`` over
+a synthetic token corpus), checkpointed by the zero-stall async engine,
+and proves the three headline numbers (docs/goodput.md):
+
+1. **goodput >= 99%** — the :class:`GoodputAccountant` ledger across
+   every relaunch of the storm (preempt every ``--preempt-every``
+   steps, plus one save I/O fault that must heal on retry);
+2. **bit-exact resume** — the stormed run's per-step loss sequence
+   equals an uninterrupted reference run's, bit for bit (the stream
+   cursor rides inside every checkpoint and is verified on restore);
+3. **checkpoint stall < 1%** — the step path's snapshot+enqueue time
+   over wall time (``goodput/ckpt/stall_frac``), watched live by
+   :func:`apex_tpu.observability.goodput_rules` (zero pages on a
+   healthy storm).
+
+It then plants the two on-disk shapes of a mid-write death — orbax tmp
+debris AND a digit-named half-written step dir newer than every
+complete step — and proves the previous checkpoint stays the resume
+anchor (``latest_step`` ignores both; a relaunch resumes from it).
+
+``--json`` writes the full evidence artifact; ``bench.py --config
+goodput`` reuses :func:`run_drill` for its golden-pinned rows.
+
+Usage::
+
+    python tools/goodput_drill.py --steps 60 --preempt-every 12 \
+        --json /tmp/goodput_drill.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_example():
+    path = os.path.join(
+        REPO, "examples", "simple", "resilient", "train_resilient.py"
+    )
+    spec = importlib.util.spec_from_file_location("train_resilient", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _make_stream(workdir, rows, seed=17, prefetch=2):
+    """A resumable stream over a synthetic token corpus sized so the
+    drill crosses epoch boundaries (the seek math's hard case)."""
+    from apex_tpu.data import (
+        DataLoader,
+        TokenFileDataset,
+        synthetic_token_corpus,
+    )
+    from apex_tpu.goodput import ResumableStream
+
+    corpus = synthetic_token_corpus(
+        os.path.join(workdir, "drill_corpus.bin"),
+        vocab_size=4096, num_tokens=rows * 12 * 24, seed=seed,
+    )
+    ds = TokenFileDataset(corpus, seq_len=12)
+    loader = DataLoader(ds, batch_size=rows, seed=seed)
+    return ResumableStream(loader, prefetch=prefetch), loader
+
+
+def run_drill(
+    steps: int = 60,
+    preempt_every: int = 12,
+    save_every: int = 8,
+    step_floor_ms: float = 75.0,
+    workdir: str = "/tmp/apex_tpu_goodput_drill",
+) -> dict:
+    """Run the reference + storm pair and return the evidence dict.
+
+    ``step_floor_ms`` floors each step's wall time so the CPU toy step
+    stands in for a realistic device step — the <1% stall bound is a
+    claim about checkpoint overhead relative to real step time, and a
+    microsecond toy step would turn it into a claim about nothing.
+    The 75ms default is a mid-size-model device step, chosen with
+    CI headroom in mind: on an oversubscribed runner the snapshot's
+    sub-ms cost inflates severalfold from scheduler/GIL contention
+    (observed ~4ms/save under 3x CPU oversubscription), and the bound
+    must reflect the engine's overhead, not the runner's weather.
+    """
+    import shutil
+
+    mod = _load_example()
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+
+    # The drill compresses production cadence ~1000x: saves land every
+    # few hundred ms of floored toy steps, but the write behind them
+    # costs whatever this runner's disk costs TODAY (observed 0.1s
+    # quiet, >1s under CI load).  At the default queue depth a loaded
+    # disk fills the queue and save()'s enqueue blocks — and the stall
+    # fraction stops measuring the engine's step-path cost (the
+    # snapshot, the <1% claim) and starts measuring disk weather.
+    # Size the queue to absorb every save of one invocation; bounded
+    # backpressure itself is pinned by the unit tier
+    # (tests/test_goodput.py), not by this gate.
+    saves_per_invocation = -(-steps // save_every) + 2
+    prior_depth = os.environ.get("APEX_TPU_CKPT_QUEUE")
+    os.environ["APEX_TPU_CKPT_QUEUE"] = str(
+        max(8, saves_per_invocation)
+    )
+    try:
+        return _run_drill_inner(
+            mod, steps, preempt_every, save_every, step_floor_ms,
+            workdir,
+        )
+    finally:
+        if prior_depth is None:
+            os.environ.pop("APEX_TPU_CKPT_QUEUE", None)
+        else:
+            os.environ["APEX_TPU_CKPT_QUEUE"] = prior_depth
+
+
+def _run_drill_inner(
+    mod, steps, preempt_every, save_every, step_floor_ms, workdir,
+) -> dict:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu import observability as obs
+    from apex_tpu.goodput import verify_stream_state
+    from apex_tpu.observability.metrics import board
+    from apex_tpu.observability.spans import SpanRecorder
+    from apex_tpu.resilience import ObserverFanout, chaos, run_resilient
+
+    t = mod.build_training(accum=1, wire="f32", fetch_every=8)
+    rows, registry = t["rows"], t["registry"]
+    compute_grads, apply_update = t["compute_grads"], t["apply_update"]
+    rs = np.random.RandomState(3)
+    w_true = jnp.asarray(rs.randn(8, 4), jnp.float32)
+
+    def make_batch(toks):
+        x = jnp.asarray(
+            toks[:, :8].astype(np.float32) / 4096.0 - 0.5, jnp.float32
+        )
+        return (
+            x.reshape(1, rows, 8),
+            (x @ w_true).reshape(1, rows, 4),
+        )
+
+    def run(directory, stream, *, faults=(), losses=None, observers=(),
+            spans=None, num_steps=steps, acct=None):
+        """run_resilient in a relaunch loop (each preemption = one
+        process death + restart), accumulating one ledger."""
+        cur = {"step": -1}
+
+        def batch_fn(step):
+            cur["step"] = step
+            return make_batch(stream(step))
+
+        def step_fn(state, batch):
+            t0 = time.monotonic()
+            inner = state["train"]
+            loss, scaled = compute_grads(
+                inner["params"], inner["scaler"], batch
+            )
+            scaled = chaos.corrupt_tree(scaled, cur["step"])
+            new_inner, verdict = apply_update(scaled, inner, loss)
+            registry.observe(cur["step"], new_inner["metrics"])
+            if losses is not None:
+                losses[cur["step"]] = float(loss)
+            if step_floor_ms > 0:  # emulate a realistic device step
+                rest = step_floor_ms / 1e3 - (time.monotonic() - t0)
+                if rest > 0:
+                    time.sleep(rest)
+            return (
+                {"train": new_inner,
+                 "stream": stream.state(cur["step"] + 1)},
+                {"skipped": verdict.skipped},
+            )
+
+        init = {"train": t["state"], "stream": stream.state(0)}
+        acct = acct if acct is not None else obs.GoodputAccountant()
+        ledger = {"invocations": 0, "saves": 0.0, "writes": 0.0,
+                  "failures": 0.0, "max_stall_frac": 0.0,
+                  "snapshot_ms": [], "write_ms": [], "finalize_ms": []}
+
+        class PhaseCollector:
+            def on_checkpoint(self, step, info=None):
+                if info is None:
+                    return
+                if info.get("phase") == "finalize":
+                    ledger["finalize_ms"].append(
+                        (info["t1"] - info["t0"]) * 1e3
+                    )
+                    return
+                if info.get("phase") != "write":
+                    return
+                ledger["write_ms"].append((info["t1"] - info["t0"]) * 1e3)
+                if info.get("snapshot_t1") is not None:
+                    # the full step-path cost of this save: snapshot
+                    # plus the enqueue wait (nonzero when the bounded
+                    # queue backpressures) — what the
+                    # goodput_ckpt_enqueue_ms bench row claims
+                    ledger["snapshot_ms"].append(
+                        (info["snapshot_t1"] - info["snapshot_t0"]) * 1e3
+                        + info.get("enqueue_ms", 0.0)
+                    )
+
+        # spans joins the fan-out inside run_resilient itself (the
+        # spans= argument) — adding it here would double-record
+        fanout = ObserverFanout([acct, PhaseCollector(), *observers])
+        with chaos.inject(*faults):
+            while True:
+                res = run_resilient(
+                    step_fn, init, batch_fn, directory=directory,
+                    num_steps=num_steps, save_interval_steps=save_every,
+                    max_to_keep=3, rollback_after=5,
+                    observer=fanout, spans=spans,
+                )
+                ledger["invocations"] += 1
+                for key in ("saves", "writes", "failures"):
+                    ledger[key] += board.get(f"goodput/ckpt/{key}", 0.0)
+                ledger["max_stall_frac"] = max(
+                    ledger["max_stall_frac"],
+                    board.get("goodput/ckpt/stall_frac", 0.0),
+                )
+                if not res.preempted:
+                    return res, acct, ledger
+
+    # -- 0. warm the checkpoint path ---------------------------------------
+    # the first orbax save of a process pays one-time setup (event
+    # loops, type registries, handler caches — ~1s on CPU); in
+    # production it amortizes over hours, in a 1-2s drill it would
+    # dominate the stall fraction.  One throwaway save measures the
+    # engine at steady state.
+    from apex_tpu.goodput import AsyncCheckpointEngine
+
+    with AsyncCheckpointEngine(os.path.join(workdir, "warmup")) as warm:
+        warm.save(0, {"w": np.zeros((4,), np.float32)})
+        warm.wait_until_finished()
+
+    # -- 1. uninterrupted reference ----------------------------------------
+    # also the cleanest overhead measurement: a full-length run whose
+    # only checkpoint cost is the step-path snapshot (the storm's
+    # per-invocation windows are too short to judge a fraction on)
+    losses_ref: dict = {}
+    ref_stream, _ = _make_stream(workdir, rows)
+    ref_res, _, ref_ledger = run(
+        os.path.join(workdir, "ref"), ref_stream, losses=losses_ref
+    )
+    ref_stream.close()
+
+    # -- 2. the storm ------------------------------------------------------
+    # the APEX_TPU_CHAOS spec, built through the same parser real runs
+    # use: preempt every N steps, plus ONE save I/O fault that must
+    # heal on retry (the accountant's retry column proves it fired)
+    preempts = ",".join(
+        str(s) for s in range(preempt_every, steps, preempt_every)
+    )
+    spec = f"preemption@{preempts};checkpoint_save:raise:x1@{save_every}"
+    faults, seed = chaos.parse_spec(spec)
+
+    losses_storm: dict = {}
+    storm_dir = os.path.join(workdir, "storm")
+    storm_stream, storm_loader = _make_stream(workdir, rows)
+    spans = SpanRecorder(8192, directory=os.path.join(workdir, "spans"))
+    pages: list = []
+    acct = obs.GoodputAccountant()
+    watchdog = obs.Watchdog(
+        # ckpt_stall watches the LIVE short-window fraction, which on
+        # a loaded CI runner jitters with scheduler spikes the
+        # full-run acceptance number (ckpt.stall_frac, asserted <1%)
+        # averages out — a 5% live budget keeps the zero-pages
+        # assertion about the engine, not the runner's weather, while
+        # still catching a writer that genuinely falls behind
+        obs.goodput_rules(floor=0.99, ckpt_stall={"max_fraction": 0.05}),
+        registry=registry, goodput=acct,
+        on_unhealthy=pages.append, check_every=4,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # the healed retry
+        storm_res, acct, ledger = run(
+            storm_dir, storm_stream,
+            faults=faults, losses=losses_storm,
+            observers=[watchdog], spans=spans, acct=acct,
+        )
+
+    # bit-exactness: every step of the storm equals the reference
+    drift = max(
+        (abs(losses_storm[s] - losses_ref[s]) for s in losses_ref),
+        default=float("inf"),
+    ) if set(losses_storm) == set(losses_ref) else float("inf")
+
+    # the stream cursor inside the NEWEST checkpoint, verified against
+    # the loader it indexes (the final steps past the last interval
+    # are re-run on resume — the cursor must point exactly there)
+    last_saved = ckpt.latest_step(storm_dir)
+    restored = ckpt.restore_step_dir(storm_dir, last_saved)
+    cursor = verify_stream_state(storm_loader, restored["stream"])
+    storm_stream.close()
+
+    span_names = [s["name"] for s in spans.snapshot()]
+
+    # -- 3. the planted mid-write kill -------------------------------------
+    latest_before = ckpt.latest_step(storm_dir)
+    # shape A: orbax tmp debris (died before the commit rename)
+    debris = os.path.join(
+        storm_dir, f"{latest_before + 1}.orbax-checkpoint-tmp-drill"
+    )
+    os.makedirs(debris, exist_ok=True)
+    with open(os.path.join(debris, "params"), "w") as f:
+        f.write("torn write\n")
+    # shape B: a digit-named dir with payload but NO commit marker
+    # (non-atomic fs / torn non-orbax write) — newer than everything
+    half = os.path.join(storm_dir, str(latest_before + 2))
+    os.makedirs(half, exist_ok=True)
+    with open(os.path.join(half, "params"), "w") as f:
+        f.write("half-written payload\n")
+    latest_after = ckpt.latest_step(storm_dir)
+    # a relaunch must resume from the intact previous checkpoint
+    resume_stream, _ = _make_stream(workdir, rows)
+    resume_res, _, _ = run(
+        storm_dir, resume_stream, num_steps=steps,
+    )
+    resume_stream.close()
+
+    return {
+        "steps": steps,
+        "preempt_every": preempt_every,
+        "save_every": save_every,
+        "chaos_spec": spec,
+        "goodput": acct.goodput(),
+        "accountant": acct.snapshot(),
+        "invocations": ledger["invocations"],
+        "ckpt": {
+            "saves": ledger["saves"] + ref_ledger["saves"],
+            "writes": ledger["writes"] + ref_ledger["writes"],
+            "failures": ledger["failures"],
+            # the <1% overhead claim, measured on the full-length
+            # uninterrupted run: the storm's per-invocation windows
+            # (preempt_every steps, barely past the engine's minimum
+            # stall window) are too short to judge a fraction on — one
+            # scheduler-starved snapshot on a loaded CI box reads as
+            # multiple percent there while the same spike is noise
+            # over the full run.  The storm max stays as telemetry.
+            "stall_frac": ref_ledger["max_stall_frac"],
+            "storm_max_stall_frac": ledger["max_stall_frac"],
+            "snapshot_ms": sorted(
+                ref_ledger["snapshot_ms"] + ledger["snapshot_ms"]
+            ),
+            "write_ms": sorted(
+                ref_ledger["write_ms"] + ledger["write_ms"]
+            ),
+            "finalize_ms": sorted(
+                ref_ledger["finalize_ms"] + ledger["finalize_ms"]
+            ),
+        },
+        "input_stall_fraction": board.get(
+            "data/input_stall_fraction", 0.0
+        ),
+        "loss_trajectory": {
+            "ref_steps": len(losses_ref),
+            "storm_steps": len(losses_storm),
+            "max_abs_drift": drift,
+            "bit_exact": drift == 0.0,
+            "final_loss": losses_ref.get(steps - 1),
+        },
+        "stream_cursor": {
+            "restored_next_batch": cursor,
+            "expected": last_saved + 1,
+        },
+        "spans": {
+            "ckpt_snapshot": span_names.count("ckpt/snapshot"),
+            "ckpt_write": span_names.count("ckpt/write"),
+            "ckpt_finalize": span_names.count("ckpt/finalize"),
+            "train_step": span_names.count("train/step"),
+        },
+        "watchdog_pages": [
+            {"rule": e.rule, "severity": e.severity, "message": e.message}
+            for e in pages
+        ],
+        "planted_midwrite": {
+            "latest_before": latest_before,
+            "latest_after_plant": latest_after,
+            "previous_intact": latest_after == latest_before,
+            "resumed_from": resume_res.resumed_from,
+            "resume_ok": resume_res.resumed_from == latest_before,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+    )
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--preempt-every", type=int, default=12)
+    ap.add_argument("--save-every", type=int, default=8)
+    ap.add_argument("--step-floor-ms", type=float, default=75.0)
+    ap.add_argument("--dir", default="/tmp/apex_tpu_goodput_drill")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="write the evidence artifact")
+    ap.add_argument("--floor", type=float, default=0.99,
+                    help="goodput acceptance floor")
+    ap.add_argument("--max-stall", type=float, default=0.01,
+                    help="checkpoint stall-fraction acceptance bound")
+    args = ap.parse_args(argv)
+
+    art = run_drill(
+        steps=args.steps, preempt_every=args.preempt_every,
+        save_every=args.save_every, step_floor_ms=args.step_floor_ms,
+        workdir=args.dir,
+    )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(art, f, indent=1)
+
+    print(
+        "goodput drill: goodput=%.4f (accepted=%d skipped=%d "
+        "discarded=%d retries=%d resumes=%d over %d invocations)"
+        % (art["goodput"], art["accountant"]["accepted"],
+           art["accountant"]["skipped"], art["accountant"]["discarded"],
+           art["accountant"]["retries"], art["accountant"]["resumes"],
+           art["invocations"])
+    )
+    print(
+        "  ckpt: saves=%d writes=%d stall_frac=%.5f  spans: "
+        "snapshot=%d write=%d finalize=%d"
+        % (art["ckpt"]["saves"], art["ckpt"]["writes"],
+           art["ckpt"]["stall_frac"], art["spans"]["ckpt_snapshot"],
+           art["spans"]["ckpt_write"], art["spans"]["ckpt_finalize"])
+    )
+    print(
+        "  resume: bit_exact=%s cursor=%s planted_midwrite intact=%s "
+        "resume_ok=%s watchdog_pages=%d"
+        % (art["loss_trajectory"]["bit_exact"],
+           art["stream_cursor"]["restored_next_batch"],
+           art["planted_midwrite"]["previous_intact"],
+           art["planted_midwrite"]["resume_ok"],
+           len(art["watchdog_pages"]))
+    )
+
+    failures = []
+    if art["goodput"] < args.floor:
+        failures.append(
+            f"goodput {art['goodput']:.4f} under floor {args.floor}"
+        )
+    if not art["loss_trajectory"]["bit_exact"]:
+        failures.append(
+            "resumed loss trajectory drifted: max_abs_drift="
+            f"{art['loss_trajectory']['max_abs_drift']}"
+        )
+    if art["ckpt"]["stall_frac"] >= args.max_stall:
+        failures.append(
+            f"ckpt stall {art['ckpt']['stall_frac']:.5f} >= "
+            f"{args.max_stall}"
+        )
+    if not art["planted_midwrite"]["previous_intact"]:
+        failures.append("planted mid-write debris changed latest_step")
+    if not art["planted_midwrite"]["resume_ok"]:
+        failures.append("relaunch did not resume from the intact step")
+    if art["stream_cursor"]["restored_next_batch"] != \
+            art["stream_cursor"]["expected"]:
+        failures.append("checkpointed stream cursor off")
+    if art["spans"]["ckpt_write"] == 0 or art["spans"]["ckpt_snapshot"] == 0:
+        failures.append("no ckpt spans on the timeline")
+    if art["watchdog_pages"]:
+        failures.append(f"watchdog paged: {art['watchdog_pages']}")
+    for f_ in failures:
+        print(f"GOODPUT DRILL FAIL: {f_}", file=sys.stderr)
+    if not failures:
+        print("goodput drill: PASS")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
