@@ -102,6 +102,7 @@ from apex_tpu.observability.health import (  # noqa: F401
     MemoryBudgetRule,
     QueueDepthRule,
     QueueWaitFractionRule,
+    ServeFaultRule,
     TTFTRule,
     Watchdog,
     default_rules,
@@ -203,6 +204,7 @@ __all__ = [
     "TTFTRule",
     "QueueDepthRule",
     "QueueWaitFractionRule",
+    "ServeFaultRule",
     "SpanRecorder",
     "wall_clock_anchor",
     "monotonic_to_epoch",

@@ -43,6 +43,10 @@ rule                      fires when
                           exceeds a budget (admission starved —
                           ``serve/ttft_queue_wait_fraction``) —
                           :func:`serve_rules` only
+:class:`ServeFaultRule`   the serving failure ledger moved — engine
+                          faults/rebuilds, poisoned quarantines
+                          (critical), decode timeouts, exhausted
+                          retries — :func:`serve_rules` only
 ========================  =================================================
 
 Training loops use :func:`default_rules`; the serving path
@@ -95,6 +99,7 @@ __all__ = [
     "TTFTRule",
     "QueueDepthRule",
     "QueueWaitFractionRule",
+    "ServeFaultRule",
     "default_rules",
     "goodput_rules",
     "serve_rules",
@@ -589,6 +594,67 @@ class QueueWaitFractionRule(Rule):
         return []
 
 
+class ServeFaultRule(Rule):
+    """The serving failure ledger moved (docs/serving.md "Failure
+    semantics & degradation ladder"): engine faults and supervised
+    rebuilds, poisoned-request quarantines, per-request decode
+    timeouts, exhausted re-admission retries.  Each watched counter
+    that increased since the last fetch emits one event carrying the
+    delta — a recovered fault is WORKING AS DESIGNED but must never be
+    invisible.  Poisoned quarantines page critical (non-finite logits
+    mean numerics corruption upstream of the scheduler); everything
+    else warns."""
+
+    name = "serve_faults"
+
+    #: counter -> severity when it moves
+    WATCHED = (
+        ("serve/engine_faults", "warn"),
+        ("serve/engine_rebuilds", "warn"),
+        ("serve/shed_poisoned", "critical"),
+        ("serve/decode_timeouts", "warn"),
+        ("serve/shed_retries_exhausted", "warn"),
+        ("serve/admission_faults", "warn"),
+        ("serve/kv_alloc_faults", "warn"),
+    )
+
+    def __init__(self, cooldown: int = 0):
+        super().__init__(cooldown)
+        self._last: Dict[str, float] = {}
+        self._last_fetched: Optional[int] = None
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        fetched = reg.fetched_step
+        if fetched is None or fetched == self._last_fetched:
+            return []
+        self._last_fetched = fetched
+        values = reg.values()
+        events = []
+        for key, severity in self.WATCHED:
+            value = values.get(key)
+            if value is None:
+                continue
+            prev = self._last.get(key, 0.0)
+            self._last[key] = float(value)
+            delta = float(value) - prev
+            if delta <= 0:
+                continue
+            events.append(
+                HealthEvent(
+                    self.name, severity, int(step), float(value),
+                    prev,
+                    f"{key} advanced by {delta:.0f} (now {value:.0f}) — "
+                    "a fault was absorbed by the serving recovery "
+                    "machinery; check the span timeline for the "
+                    "retrying/shed chains",
+                )
+            )
+        return events
+
+
 class MemoryBudgetRule(Rule):
     """The static peak-HBM estimate published by the graph linter
     (``analysis/peak_hbm_bytes`` — :func:`apex_tpu.analysis.memory
@@ -753,6 +819,7 @@ def serve_rules(**overrides) -> List[Rule]:
         "ttft": TTFTRule,
         "queue_depth": QueueDepthRule,
         "queue_wait_fraction": QueueWaitFractionRule,
+        "serve_faults": ServeFaultRule,
         "stale_fetch": StaleFetchRule,
         "hung_step": HungStepRule,
     }

@@ -23,7 +23,9 @@ Design rules:
   its own anchor; the merge tool converts to epoch microseconds).
 - **a stable event vocabulary** — serve requests walk
   ``queued → admitted → prefill → decode[i] → done | shed(reason)``
-  (driven from the :class:`~apex_tpu.serve.scheduler.Request` runtime
+  with a validated ``retrying`` recovery phase between faults and
+  re-admission (driven from the
+  :class:`~apex_tpu.serve.scheduler.Request` runtime
   ledger); training steps, rollbacks, resumes, retries, checkpoints
   and preemption come from the ``run_resilient`` observer protocol;
   :class:`~apex_tpu.observability.health.HealthEvent` s and
@@ -67,6 +69,7 @@ __all__ = [
     "REQ_QUEUED",
     "REQ_PREFILL",
     "REQ_DECODE",
+    "REQ_RETRYING",
     "REQ_DONE",
     "REQ_SHED",
     "REQ_TERMINAL",
@@ -90,6 +93,7 @@ TRACK_TRACE = "trace"
 REQ_QUEUED = "queued"
 REQ_PREFILL = "prefill"
 REQ_DECODE = "decode"
+REQ_RETRYING = "retrying"
 REQ_DONE = "done"
 REQ_SHED = "shed"
 REQ_TERMINAL = frozenset({REQ_DONE, REQ_SHED})
@@ -98,11 +102,20 @@ REQ_TERMINAL = frozenset({REQ_DONE, REQ_SHED})
 #: and raises.  ``queued → prefill`` is the admission edge (the
 #: recorder emits a ``req/admitted`` instant on it); a request can be
 #: shed from any live phase but can never leave a terminal one.
+#: ``retrying`` is the fault-recovery phase (docs/serving.md "Failure
+#: semantics"): a prefill/decode fault sends the request back through
+#: bounded re-admission with its pages and generated prefix retained —
+#: it can only re-enter through ``prefill``/``decode`` or be shed; it
+#: can never complete straight from ``retrying`` (``retrying → done``
+#: would claim tokens no decode produced), and a terminal ``shed``
+#: can never be re-admitted (``shed → decode`` raises — recovery must
+#: go through an explicit re-submission, a NEW request id).
 _REQ_TRANSITIONS: Dict[Optional[str], frozenset] = {
     None: frozenset({REQ_QUEUED}),
     REQ_QUEUED: frozenset({REQ_PREFILL, REQ_SHED}),
-    REQ_PREFILL: frozenset({REQ_DECODE, REQ_DONE, REQ_SHED}),
-    REQ_DECODE: frozenset({REQ_DONE, REQ_SHED}),
+    REQ_PREFILL: frozenset({REQ_DECODE, REQ_DONE, REQ_SHED, REQ_RETRYING}),
+    REQ_DECODE: frozenset({REQ_DONE, REQ_SHED, REQ_RETRYING}),
+    REQ_RETRYING: frozenset({REQ_PREFILL, REQ_DECODE, REQ_SHED}),
 }
 
 

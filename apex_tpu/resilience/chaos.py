@@ -7,7 +7,9 @@ schedule is a pure function of ``(seed, site, mode, step)`` — a SHA-256
 coin, not ``random`` — so every host of a multi-process job, and every
 re-execution of a test, injects the exact same faults.
 
-Sites and their modes:
+Sites and their modes (the **registered-site registry** — a spec
+clause naming a site or mode outside it raises at parse time, so a
+typo'd drill can never silently inject nothing and "pass"):
 
 ========================  ==========================================
 ``GRADS``                 ``nan`` / ``inf`` poison a gradient pytree
@@ -16,7 +18,17 @@ Sites and their modes:
 ``COLLECTIVE``            ``raise`` / ``stall``
 ``RENDEZVOUS``            ``raise`` / ``stall``
 ``PREEMPTION``            SIGTERM to the current process
+``SERVE_PREFILL``         ``raise`` / ``stall`` / ``nan`` (poison)
+``SERVE_DECODE``          ``raise`` / ``stall`` / ``nan`` / ``inf``
+``SERVE_ADMISSION``       ``raise`` / ``stall``
+``SERVE_KV_ALLOC``        ``fail`` (forced alloc failure) / ``raise``
 ========================  ==========================================
+
+The ``serve.*`` sites live in the serving path
+(:mod:`apex_tpu.serve.engine` / :mod:`apex_tpu.serve.scheduler`), so
+ONE ``APEX_TPU_CHAOS`` spec drives training and serving drills through
+the same parser, coin, and hit accounting.  Subsystems can extend the
+registry with :func:`register_site`.
 
 Activation is explicit (:func:`configure` / the :func:`inject` context
 manager, used by tests) or ambient via ``APEX_TPU_CHAOS`` for real runs::
@@ -48,8 +60,15 @@ __all__ = [
     "COLLECTIVE",
     "RENDEZVOUS",
     "PREEMPTION",
+    "SERVE_PREFILL",
+    "SERVE_DECODE",
+    "SERVE_ADMISSION",
+    "SERVE_KV_ALLOC",
     "Fault",
     "InjectedFault",
+    "register_site",
+    "registered_sites",
+    "site_modes",
     "configure",
     "clear",
     "inject",
@@ -68,15 +87,64 @@ CHECKPOINT_RESTORE = "checkpoint_restore"
 COLLECTIVE = "collective"
 RENDEZVOUS = "rendezvous"
 PREEMPTION = "preemption"
+#: serving-path sites (docs/serving.md "Failure semantics"): hooks
+#: live in apex_tpu/serve/engine.py (prefill/decode) and scheduler.py
+#: (admission / page allocation)
+SERVE_PREFILL = "serve.prefill"
+SERVE_DECODE = "serve.decode"
+SERVE_ADMISSION = "serve.admission"
+SERVE_KV_ALLOC = "serve.kv_alloc"
 
-_SITES = (
-    GRADS,
-    CHECKPOINT_SAVE,
-    CHECKPOINT_RESTORE,
-    COLLECTIVE,
-    RENDEZVOUS,
-    PREEMPTION,
-)
+#: site -> (allowed modes, default mode).  parse_spec and Fault both
+#: validate against this registry: an unknown site OR an unknown mode
+#: raises instead of building a fault that never fires.
+_SITE_REGISTRY: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+
+
+def register_site(
+    site: str, modes: Tuple[str, ...], default_mode: Optional[str] = None,
+) -> str:
+    """Register an injection site and its legal modes (idempotent for
+    an identical re-registration; conflicting modes raise).  Returns
+    the site name so callers can do ``SITE = register_site(...)``."""
+    if not site or not modes:
+        raise ValueError("a chaos site needs a name and at least one mode")
+    default_mode = default_mode or modes[0]
+    if default_mode not in modes:
+        raise ValueError(
+            f"default mode {default_mode!r} not in modes {modes} "
+            f"for site {site!r}"
+        )
+    spec = (tuple(modes), default_mode)
+    prev = _SITE_REGISTRY.get(site)
+    if prev is not None and prev != spec:
+        raise ValueError(
+            f"chaos site {site!r} already registered with modes "
+            f"{prev[0]} (default {prev[1]!r})"
+        )
+    _SITE_REGISTRY[site] = spec
+    return site
+
+
+def registered_sites() -> Tuple[str, ...]:
+    return tuple(_SITE_REGISTRY)
+
+
+def site_modes(site: str) -> Tuple[str, ...]:
+    """The legal modes of a registered site (KeyError on unknown)."""
+    return _SITE_REGISTRY[site][0]
+
+
+register_site(GRADS, ("nan", "inf"), "nan")
+register_site(CHECKPOINT_SAVE, ("raise", "partial", "stall"), "raise")
+register_site(CHECKPOINT_RESTORE, ("raise", "stall"), "raise")
+register_site(COLLECTIVE, ("raise", "stall"), "raise")
+register_site(RENDEZVOUS, ("raise", "stall"), "raise")
+register_site(PREEMPTION, ("raise",), "raise")  # mode is vestigial
+register_site(SERVE_PREFILL, ("raise", "stall", "nan"), "raise")
+register_site(SERVE_DECODE, ("raise", "stall", "nan", "inf"), "raise")
+register_site(SERVE_ADMISSION, ("raise", "stall"), "raise")
+register_site(SERVE_KV_ALLOC, ("fail", "raise"), "fail")
 
 
 class InjectedFault(RuntimeError):
@@ -108,9 +176,16 @@ class Fault:
     stall_seconds: float = 0.05
 
     def __post_init__(self):
-        if self.site not in _SITES:
+        if self.site not in _SITE_REGISTRY:
             raise ValueError(
-                f"unknown chaos site {self.site!r}; one of {_SITES}"
+                f"unknown chaos site {self.site!r}; one of "
+                f"{registered_sites()}"
+            )
+        modes = _SITE_REGISTRY[self.site][0]
+        if self.mode not in modes:
+            raise ValueError(
+                f"unknown mode {self.mode!r} for chaos site "
+                f"{self.site!r}; one of {modes}"
             )
 
 
@@ -178,6 +253,13 @@ def parse_spec(spec: str) -> Tuple[Tuple[Fault, ...], int]:
         checkpoint_save:raise:x1@5  # ONE save IO error at step 5 (heals)
         preemption@12               # SIGTERM at step 12
         grads:inf:p=0.001           # seeded 0.1%-per-step Inf burst
+        serve.decode:nan@9          # poisoned logits at decode iter 9
+
+    Sites and modes are validated against the registered-site registry
+    — an unknown site (``grdas:...``) or a typo'd token that would
+    otherwise be swallowed as a bogus mode (``grads:nan:p0.001``)
+    raises ``ValueError`` naming the clause, instead of yielding a
+    fault that silently never fires while a chaos drill "passes".
     """
     out: List[Fault] = []
     seed = 0
@@ -188,21 +270,34 @@ def parse_spec(spec: str) -> Tuple[Tuple[Fault, ...], int]:
         steps: Tuple[int, ...] = ()
         probability = 0.0
         max_hits: Optional[int] = None
+        raw = clause
         if "@" in clause:
             clause, _, steplist = clause.partition("@")
             steps = tuple(int(s) for s in steplist.split(",") if s)
         parts = clause.split(":")
         site, rest = parts[0], parts[1:]
+        if site not in _SITE_REGISTRY:
+            raise ValueError(
+                f"unknown chaos site {site!r} in spec clause {raw!r}; "
+                f"registered sites: {registered_sites()}"
+            )
+        modes, default_mode = _SITE_REGISTRY[site]
         mode = None
         for token in rest:
             if token.startswith("p="):
                 probability = float(token[2:])
             elif token.startswith("x") and token[1:].isdigit():
                 max_hits = int(token[1:])
-            else:
+            elif token in modes:
                 mode = token
+            else:
+                raise ValueError(
+                    f"unknown token {token!r} in spec clause {raw!r}: "
+                    f"not a mode of site {site!r} {modes}, a "
+                    f"probability (p=F), or a hit bound (xN)"
+                )
         if mode is None:
-            mode = "nan" if site == GRADS else "raise"
+            mode = default_mode
         out.append(
             Fault(
                 site=site,

@@ -20,7 +20,14 @@ blockwise int8 codec reused as the KV and weight wire formats.
 - :mod:`apex_tpu.serve.scheduler` —
   :class:`ContinuousBatchingScheduler`: page-granular admission into
   the running decode batch, TTFT SLO deadlines, graceful shedding on
-  pool exhaustion.
+  pool exhaustion — and the serving resilience layer: bounded
+  re-admission retries with the generated prefix retained,
+  poisoned-request quarantine, supervised engine rebuild, an explicit
+  overload degradation ladder (queue-cap fast-reject, token clamping,
+  deadline shedding), and rolling-restart ``drain()``.  Chaos sites
+  at ``serve.prefill``/``serve.decode``/``serve.admission``/
+  ``serve.kv_alloc`` make every failure path drillable from one
+  ``APEX_TPU_CHAOS`` spec (``tools/serve_chaos_drill.py``).
 
 Fused decode attention lives with the other kernels
 (:func:`apex_tpu.ops.paged_decode_attention` /

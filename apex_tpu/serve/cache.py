@@ -116,6 +116,46 @@ class PagePool:
                 raise ValueError(f"double free of page {p}")
             self._free.append(p)
 
+    def leak_check(self, owned) -> None:
+        """Assert the pool's accounting is EXACT against the live
+        ownership ledger: every allocated page is owned by exactly one
+        live request and every owned page is allocated.
+
+        ``owned`` is an iterable of per-request page lists (the
+        scheduler's slots + retrying queue entries).  Raises
+        ``ValueError`` naming the leaked (allocated but unowned),
+        foreign (owned but free/out-of-range), or double-owned pages —
+        the invariant the serving chaos drill re-proves after every
+        injected fault (docs/serving.md "Failure semantics")."""
+        owned_flat: List[int] = []
+        for pages in owned:
+            owned_flat.extend(pages)
+        owned_set = set(owned_flat)
+        problems = []
+        if len(owned_flat) != len(owned_set):
+            seen, dups = set(), set()
+            for p in owned_flat:
+                (dups if p in seen else seen).add(p)
+            problems.append(f"pages owned by more than one request: "
+                            f"{sorted(dups)}")
+        allocated = set(range(1, self.num_pages)) - set(self._free)
+        leaked = allocated - owned_set
+        foreign = owned_set - allocated
+        if leaked:
+            problems.append(
+                f"leaked pages (allocated, owned by no live request): "
+                f"{sorted(leaked)}"
+            )
+        if foreign:
+            problems.append(
+                f"foreign pages (owned but not allocated): "
+                f"{sorted(foreign)}"
+            )
+        if problems:
+            raise ValueError(
+                "PagePool leak check failed: " + "; ".join(problems)
+            )
+
 
 # ---------------------------------------------------------------------------
 # device-side pure helpers (called inside the engine's jitted steps)

@@ -25,6 +25,15 @@ the proofs about them:
   optionally on the blockwise int8 KV wire, and optionally int8-packed
   weights (:func:`apex_tpu.serve.model.quantize_params`) dequantized
   inside the compiled step.
+- **failure surface** — every step program computes an in-step
+  non-finite screen over its logits (:attr:`last_prefill_finite` /
+  :attr:`last_decode_finite` — the scheduler's poisoned-request
+  quarantine evidence, no logits readback), chaos hooks at the
+  ``serve.prefill`` / ``serve.decode`` sites make faults injectable
+  from one ``APEX_TPU_CHAOS`` spec, and :meth:`rebuild` is the
+  supervised recovery: re-run the AOT build (re-verified) while the
+  cache arrays and pool are retained so surviving requests resume
+  from their pages.  See docs/serving.md "Failure semantics".
 
 Bucketed padding: a prompt compiles against the smallest bucket that
 holds it (buckets are page multiples, powers-of-two by default), so the
@@ -40,6 +49,7 @@ owns admission/shedding/SLOs, and both feed the same
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -48,6 +58,7 @@ import numpy as np
 
 from apex_tpu.models.gpt import GptConfig
 from apex_tpu.observability.metrics import board
+from apex_tpu.resilience import chaos
 from apex_tpu.serve import cache as cache_lib
 from apex_tpu.serve import model as model_lib
 
@@ -184,8 +195,19 @@ class InferenceEngine:
         self.decode_iters = 0
         self.prefill_calls = 0
         #: per-program AOT compile counter — the observable
-        #: retrace-freedom pin (steady state never increments it)
+        #: retrace-freedom pin (steady state never increments it; a
+        #: supervised :meth:`rebuild` does, honestly)
         self.compile_counts: Dict[str, int] = {}
+        #: supervised recoveries (:meth:`rebuild` calls) — 0 in steady
+        #: state; every increment is a fault the scheduler survived
+        self.rebuilds = 0
+        #: the in-step non-finite screens of the LAST prefill/decode
+        #: call — ``last_prefill_finite`` a bool, ``last_decode_finite``
+        #: an ``(max_batch,)`` bool array (None before the first call).
+        #: Computed INSIDE the compiled steps (no logits readback); the
+        #: scheduler's poisoned-request quarantine reads them.
+        self.last_prefill_finite: bool = True
+        self.last_decode_finite: Optional[np.ndarray] = None
         self.reports: Dict[str, object] = {}
         self._sentinels: Dict[str, object] = {}
         self._publish_build_gauges()
@@ -290,6 +312,36 @@ class InferenceEngine:
         self._get_decode()
         return self
 
+    def rebuild(self, *, full: bool = False):
+        """Supervised recovery (docs/serving.md "Failure semantics"):
+        re-run the AOT build — including the build-time ``verify``
+        lint, so the replacement program is re-PROVEN, not assumed —
+        and swap it in atomically, while the KV cache arrays and the
+        page pool are retained, so surviving requests resume decoding
+        from their existing pages with the generated prefix intact.
+
+        The incumbent decode program stays SERVING until the
+        replacement is ready: a transient fault does not corrupt a
+        compiled executable, so recovery must not pause the batch for
+        a recompile (if the incumbent is genuinely wedged it faults
+        again and the scheduler's ``rebuild_limit`` bounds the loop —
+        the scheduler defers this call to an idle point and escalates
+        to a synchronous rebuild on a repeat fault).  By default only
+        the decode program is rebuilt; ``full=True`` additionally
+        drops every prefill bucket, which then recompiles lazily on
+        next use.  The swap is one atomic attribute write.
+        """
+        self.rebuilds += 1
+        if full:
+            self._prefill.clear()
+            for name in list(self._sentinels):
+                if name.startswith("prefill"):
+                    del self._sentinels[name]
+        fn, args = self._decode_fn()
+        self._decode = self._compile("decode", fn, args)
+        board.set("serve/engine_rebuilds", self.rebuilds)
+        return self
+
     def _get_prefill(self, bucket: int):
         if bucket not in self._prefill:
             fn, args = self._prefill_fn(bucket)
@@ -349,10 +401,32 @@ class InferenceEngine:
             f"{self.serve.max_context}"
         )
 
+    @staticmethod
+    def _chaos_gate(site: str, call_idx: int):
+        """Serving chaos hook (one ``APEX_TPU_CHAOS`` spec drives train
+        AND serve drills): ``raise`` mode raises :class:`~apex_tpu.
+        resilience.chaos.InjectedFault` standing in for a wedged or
+        crashed step, ``stall`` sleeps (a hung device call — the
+        scheduler's per-request decode timeouts see it), ``nan``/
+        ``inf`` return the fault so the caller poisons its non-finite
+        verdict (the quarantine drill).  ``call_idx`` is the 0-based
+        prefill-call / decode-iteration index."""
+        fault = chaos.active(site, call_idx)
+        if fault is None:
+            return None
+        if fault.mode == "stall":
+            time.sleep(fault.stall_seconds)
+            return None
+        if fault.mode in ("nan", "inf"):
+            return fault
+        raise chaos.InjectedFault(site, call_idx, fault.mode)
+
     def prefill(self, prompt_ids, page_ids) -> Tuple[np.ndarray, int]:
         """Run the prompt through the bucketed prefill: writes its K/V
         into ``page_ids`` (null-padded to the bucket's page count) and
-        returns ``(last_logits (V,), first_token)``."""
+        returns ``(last_logits (V,), first_token)``.  The in-step
+        non-finite screen lands on :attr:`last_prefill_finite`."""
+        poison = self._chaos_gate(chaos.SERVE_PREFILL, self.prefill_calls)
         n = len(prompt_ids)
         bucket = self.bucket_for(n)
         np_b = bucket // self.serve.page_size
@@ -370,11 +444,12 @@ class InferenceEngine:
         self.prefill_calls += 1
         rec = self.spans
         t0 = rec.now() if rec is not None else None
-        logits, next_token, self.cache = compiled(*args)
+        logits, next_token, finite, self.cache = compiled(*args)
         # logits stay ON DEVICE (lazy jax.Array): only the sampled
-        # token crosses to the host — the logits matrix is (V,)/(B, V)
-        # and most callers never read it
+        # token and the scalar finite screen cross to the host — the
+        # logits matrix is (V,)/(B, V) and most callers never read it
         first = int(next_token)
+        self.last_prefill_finite = bool(finite) and poison is None
         if rec is not None:
             # int(next_token) above synced, so the span covers the real
             # device time, not just the async dispatch
@@ -392,7 +467,10 @@ class InferenceEngine:
         idle slot).  Returns ``(logits (B, V), next_tokens (B,))`` —
         ``next_tokens`` on host (the scheduler needs them), ``logits``
         left as a lazy on-device array so the hot serving loop never
-        pays the (B, V) device→host copy it does not read."""
+        pays the (B, V) device→host copy it does not read.  The
+        per-slot in-step non-finite screen lands on
+        :attr:`last_decode_finite` (the quarantine evidence)."""
+        poison = self._chaos_gate(chaos.SERVE_DECODE, self.decode_iters)
         compiled = self._get_decode()
         args = (
             self.params,
@@ -405,8 +483,18 @@ class InferenceEngine:
         self.decode_iters += 1
         rec = self.spans
         t0 = rec.now() if rec is not None else None
-        logits, next_tokens, self.cache = compiled(*args)
+        logits, next_tokens, finite, self.cache = compiled(*args)
         out = np.asarray(next_tokens)
+        finite_np = np.array(finite)
+        if poison is not None:
+            # an injected poisoned-logits fault: flag the first LIVE
+            # slot exactly as the in-step screen would flag a real
+            # non-finite row — the quarantine path downstream is the
+            # production path, only the evidence is simulated
+            live = np.flatnonzero(np.asarray(lengths) > 0)
+            if live.size:
+                finite_np[live[0]] = False
+        self.last_decode_finite = finite_np
         if rec is not None:
             # np.asarray(next_tokens) above synced — real device time
             from apex_tpu.observability.spans import TRACK_ENGINE

@@ -248,7 +248,10 @@ def prefill_body(
     its garbage K/V land in pages the decode ``lengths`` never reads
     (or in the null page).
 
-    Returns ``(logits (V,) f32, next_token () int32, kv_pages)``.
+    Returns ``(logits (V,) f32, next_token () int32, finite () bool,
+    kv_pages)`` — ``finite`` is the in-step non-finite screen
+    (``isfinite(logits).all()``): the quarantine evidence the scheduler
+    reads WITHOUT paying the (V,) device→host logits copy.
     """
     params = dequantize_params(params)
     tree = _tree(params)
@@ -305,7 +308,8 @@ def prefill_body(
     h_last = _layer_norm(h_last, tree["ln_f"], cfg.layer_norm_eps)
     logits = _logits(tree, h_last, cfg.dtype)[0]  # (V,) f32
     next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return logits, next_token, kv_pages
+    finite = jnp.isfinite(logits).all()
+    return logits, next_token, finite, kv_pages
 
 
 # ---------------------------------------------------------------------------
@@ -330,7 +334,12 @@ def decode_body(
     attention (query RoPE + int8 dequant fused in the kernel).  Idle
     slots (``lengths == 0``) write into the null page and read zeros.
 
-    Returns ``(logits (B, V) f32, next_tokens (B,) int32, kv_pages)``.
+    Returns ``(logits (B, V) f32, next_tokens (B,) int32, finite (B,)
+    bool, kv_pages)`` — ``finite[b]`` is slot ``b``'s in-step
+    non-finite screen over its logits row: a poisoned sequence (NaN in
+    its KV pages or a numerically blown state) flags ONLY its own
+    slot, so the scheduler's quarantine can evict the offender without
+    touching the rest of the batch or reading the (B, V) logits back.
     """
     params = dequantize_params(params)
     tree = _tree(params)
@@ -403,4 +412,5 @@ def decode_body(
     h = _layer_norm(x, tree["ln_f"], cfg.layer_norm_eps)
     logits = _logits(tree, h, cfg.dtype)  # (B, V) f32
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return logits, next_tokens, kv_pages
+    finite = jnp.isfinite(logits).all(axis=-1)
+    return logits, next_tokens, finite, kv_pages

@@ -26,6 +26,52 @@ Every shed carries a **reason** (:data:`SHED_REASONS`): the single
 3%" becomes "we shed 3%, all of it deadline-in-queue — admission is
 starved, not the decode batch".
 
+**Failure is a scheduling input** (docs/serving.md "Failure semantics
+& degradation ladder").  The request lifecycle carries recovery
+guarantees:
+
+- **bounded re-admission retries** — a prefill/decode fault or a
+  blown per-request decode timeout sends the request to the
+  ``retrying`` phase with its pages and generated prefix RETAINED;
+  re-admission resumes decode from the last completed iteration (no
+  re-prefill once the first token exists), bounded by ``max_retries``
+  and ledgered as ``shed(retries_exhausted)`` past it;
+- **poisoned-request quarantine** — a non-finite logits row (the
+  engine's in-step screen) evicts ONLY the offending slot, ledgered
+  ``shed(poisoned)``; the rest of the batch keeps decoding;
+- **engine supervision** — a crashed decode step moves every running
+  request to ``retrying`` (re-admitted on the very next iteration,
+  riding the incumbent compiled program) and schedules the engine's
+  supervised :meth:`~apex_tpu.serve.engine.InferenceEngine.rebuild`
+  for the next idle point, escalating to a synchronous rebuild on a
+  repeat fault (bounded by ``rebuild_limit``) — one transient fault
+  never turns into a recompile-sized latency cliff for the whole
+  queue;
+- **graceful drain** (:meth:`ContinuousBatchingScheduler.drain`) —
+  rolling-restart shutdown: stop admitting new work, finish running
+  (and retrying) decodes, shed the never-admitted queue loudly as
+  ``shed(draining)``, and report the drained state with the page pool
+  provably empty.
+
+Overload walks an explicit **degradation ladder**, each rung a
+distinct ledger reason on the span state machine, metrics board, and
+OpenMetrics export:
+
+1. **backpressure** — a bounded admission queue (``max_queue_depth``)
+   fast-rejects at submit time, ``shed(queue_full)``: the client gets
+   an immediate retry-elsewhere signal instead of a blown deadline;
+2. **max-new-tokens clamping** — past ``clamp_occupancy`` pool
+   pressure (or a half-full bounded queue), admissions are clamped to
+   ``clamp_max_new_tokens`` (``serve/clamped`` counter + a
+   ``req/clamped`` span instant carrying the original budget);
+3. **deadline shedding** — the existing TTFT-SLO rung,
+   ``shed(deadline)``.
+
+:meth:`leak_check` (``PagePool.leak_check`` against the live ownership
+ledger) is asserted after every shed/free path when ``leak_checks=``
+is on (the default), so page accounting stays provably exact through
+every fault.
+
 Every iteration publishes the serving gauges through the shared
 :class:`~apex_tpu.observability.metrics.MetricRegistry` — queue depth,
 batch fill, page-pool occupancy, tokens/s, TTFT — the same spine
@@ -72,6 +118,7 @@ from apex_tpu.observability.ometrics import (
     DEFAULT_LATENCY_BUCKETS_MS,
     Histogram,
 )
+from apex_tpu.resilience import chaos
 from apex_tpu.serve.cache import NULL_PAGE
 
 __all__ = [
@@ -87,6 +134,10 @@ _ids = itertools.count()
 
 QUEUED = "queued"
 RUNNING = "running"
+#: fault recovery: the request left the batch (or never reached it)
+#: after a fault and waits at the queue front for bounded re-admission
+#: with its pages and generated prefix retained
+RETRYING = "retrying"
 DONE = "done"
 SHED = "shed"
 
@@ -95,13 +146,22 @@ SHED = "shed"
 #: exhausted), ``growth_victim`` (youngest running request shed to free
 #: a growth page), ``pool_exhausted`` (a running request could not grow
 #: even after a victim shed), ``oversize`` (prompt exceeds the max
-#: context).
+#: context), ``poisoned`` (non-finite logits row — quarantined, only
+#: the offending slot), ``queue_full`` (backpressure fast-reject at the
+#: bounded admission queue), ``retries_exhausted`` (a faulting request
+#: burned its re-admission budget), ``draining`` (never-admitted work
+#: rejected during a graceful rolling-restart drain).
 SHED_DEADLINE = "deadline"
 SHED_GROWTH_VICTIM = "growth_victim"
 SHED_POOL_EXHAUSTED = "pool_exhausted"
 SHED_OVERSIZE = "oversize"
+SHED_POISONED = "poisoned"
+SHED_QUEUE_FULL = "queue_full"
+SHED_RETRIES_EXHAUSTED = "retries_exhausted"
+SHED_DRAINING = "draining"
 SHED_REASONS = (
     SHED_DEADLINE, SHED_GROWTH_VICTIM, SHED_POOL_EXHAUSTED, SHED_OVERSIZE,
+    SHED_POISONED, SHED_QUEUE_FULL, SHED_RETRIES_EXHAUSTED, SHED_DRAINING,
 )
 
 #: TTFT attribution components (ms); they sum to the measured TTFT by
@@ -148,6 +208,11 @@ class Request:
     #: deadline, only as a growth-page victim)
     slo_ttft_ms: Optional[float] = None
     eos_token: Optional[int] = None
+    #: per-request decode timeout: a decode iteration this request rode
+    #: exceeding it discards the iteration's token for THIS request and
+    #: sends it through bounded re-admission retry (prefix preserved).
+    #: None inherits the scheduler's default (usually also None).
+    decode_timeout_ms: Optional[float] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
 
     # -- runtime ledger (scheduler-owned) --------------------------------
@@ -172,6 +237,12 @@ class Request:
     #: into the ``serve/engine`` span track)
     first_decode_iter: Optional[int] = None
     last_decode_iter: Optional[int] = None
+    #: re-admission retries consumed (bounded by the scheduler's
+    #: ``max_retries``); the last cause rides the span record
+    retries: int = 0
+    #: original ``max_new_tokens`` when the overload ladder clamped it
+    #: (None = never clamped)
+    clamped_from: Optional[int] = None
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -206,10 +277,19 @@ def declare_serve_metrics(registry) -> None:
     """Declare the serving metric set on a registry (idempotent)."""
     for g in ("serve/queue_depth", "serve/batch_fill",
               "serve/page_occupancy", "serve/tokens_per_s",
-              "serve/ttft_ms"):
+              "serve/ttft_ms", "serve/draining"):
         registry.gauge(g)
     for c in ("serve/admitted", "serve/completed", "serve/shed",
-              "serve/tokens_out", "serve/prefills", "serve/decode_steps"):
+              "serve/tokens_out", "serve/prefills", "serve/decode_steps",
+              # the failure/degradation ledger (docs/serving.md
+              # "Failure semantics"): retries + re-admissions, clamped
+              # admissions, per-request decode timeouts, engine faults
+              # and supervised rebuilds, chaos-visible admission and
+              # page-allocation faults, graceful drains
+              "serve/retries", "serve/readmitted", "serve/clamped",
+              "serve/decode_timeouts", "serve/engine_faults",
+              "serve/engine_rebuilds", "serve/admission_faults",
+              "serve/kv_alloc_faults", "serve/drains"):
         registry.counter(c)
     # per-reason shed breakdown (sums to serve/shed)
     for reason in SHED_REASONS:
@@ -241,11 +321,37 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, *, registry=ENGINE_REGISTRY,
                  clock=time.monotonic, window: int = 32,
-                 spans=None, attribution_window: int = 128):
+                 spans=None, attribution_window: int = 128,
+                 max_queue_depth: Optional[int] = None,
+                 max_retries: int = 2,
+                 decode_timeout_ms: Optional[float] = None,
+                 clamp_max_new_tokens: Optional[int] = None,
+                 clamp_occupancy: float = 0.75,
+                 clamp_queue_depth: Optional[int] = None,
+                 rebuild_limit: int = 2,
+                 leak_checks: bool = True):
         self.engine = engine
         self.pool = engine.pool
         self.serve = engine.serve
         self.clock = clock
+        # failure/degradation knobs (docs/serving.md "Failure
+        # semantics & degradation ladder")
+        self.max_queue_depth = max_queue_depth
+        self.max_retries = max_retries
+        self.decode_timeout_ms = decode_timeout_ms
+        self.clamp_max_new_tokens = clamp_max_new_tokens
+        self.clamp_occupancy = clamp_occupancy
+        self.clamp_queue_depth = clamp_queue_depth
+        if clamp_queue_depth is None and max_queue_depth is not None:
+            self.clamp_queue_depth = max(1, max_queue_depth // 2)
+        self.rebuild_limit = rebuild_limit
+        self.leak_checks = leak_checks
+        self.draining = False
+        self._rebuild_pending = False
+        self._rebuilds_started = 0
+        self._admissions = 0   # chaos index for the serve.admission site
+        self._kv_allocs = 0    # chaos index for the serve.kv_alloc site
+        self.leak_checks_run = 0
         self.queue: Deque[Request] = collections.deque()
         self.slots: List[Optional[Request]] = [None] * self.serve.max_batch
         self.completed: List[Request] = []
@@ -305,13 +411,26 @@ class ContinuousBatchingScheduler:
     def submit(self, req: Request) -> Request:
         req.status = QUEUED
         req.submitted_at = self.clock()
-        self.queue.append(req)
         if self.spans is not None:
             self.spans.request_event(
                 req.rid, QUEUED, req.submitted_at,
                 prompt_tokens=len(req.prompt),
                 slo_ttft_ms=req.slo_ttft_ms,
             )
+        # degradation rung 1 — backpressure: a bounded queue rejects at
+        # the front door (the client can retry elsewhere NOW) instead
+        # of queueing work that will only blow its deadline later.  A
+        # draining scheduler rejects everything new the same loud way.
+        if self.draining:
+            self._shed_request(req, SHED_DRAINING)
+            return req
+        if (
+            self.max_queue_depth is not None
+            and len(self.queue) >= self.max_queue_depth
+        ):
+            self._shed_request(req, SHED_QUEUE_FULL)
+            return req
+        self.queue.append(req)
         return req
 
     def _page_table_row(self, req: Request) -> np.ndarray:
@@ -365,11 +484,121 @@ class ContinuousBatchingScheduler:
                 self._comps.append(comps)
         else:
             self.shed.append(req)
+        if self.leak_checks:
+            # every shed/free path funnels through here: page
+            # accounting is re-proven exact on each of them
+            self.leak_check()
 
     def _shed_request(self, req: Request, reason: str) -> None:
         self._retire(req, SHED, reason)
         self._count("serve/shed")
         self._count(f"serve/shed_{reason}")
+
+    # -- page accounting ---------------------------------------------------
+    def owned_pages(self) -> List[List[int]]:
+        """The live ownership ledger: per-request page lists across the
+        running slots AND the retrying queue entries (a retrying
+        request keeps its pages — that is what makes resume cheap)."""
+        owned = [r.pages for r in self.slots if r is not None and r.pages]
+        owned.extend(r.pages for r in self.queue if r.pages)
+        return owned
+
+    def leak_check(self) -> None:
+        """Assert ``PagePool`` accounting is exact against
+        :meth:`owned_pages` (raises ``ValueError`` naming the pages).
+        Runs automatically after every shed/free path when
+        ``leak_checks=True`` (the default).  The check is
+        O(num_pages) per retirement — negligible at test/CI pool
+        sizes; a latency-critical deployment with a very large pool
+        can pass ``leak_checks=False`` and rely on the chaos drill's
+        continuous proof instead."""
+        self.pool.leak_check(self.owned_pages())
+        self.leak_checks_run += 1
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Pool allocation behind the ``serve.kv_alloc`` chaos site: an
+        active fault forces the all-or-nothing failure path (returns
+        None), driving the same shedding/backpressure machinery a
+        genuinely exhausted pool drives — no separate failure code."""
+        idx = self._kv_allocs
+        self._kv_allocs += 1
+        if chaos.active(chaos.SERVE_KV_ALLOC, idx) is not None:
+            self._count("serve/kv_alloc_faults")
+            return None
+        return self.pool.alloc(n)
+
+    # -- fault recovery ----------------------------------------------------
+    def _send_to_retry(self, req: Request, cause: str) -> None:
+        """Bounded re-admission: the request keeps its pages and its
+        generated prefix and re-enters through the queue FRONT; past
+        ``max_retries`` it is shed as ``retries_exhausted`` instead of
+        looping forever on a persistent fault."""
+        if req.retries >= self.max_retries:
+            self._shed_request(req, SHED_RETRIES_EXHAUSTED)
+            return
+        req.retries += 1
+        req.status = RETRYING
+        req.blocked_since = None
+        self._count("serve/retries")
+        if self.spans is not None:
+            self.spans.request_event(
+                req.rid, RETRYING, self.clock(),
+                cause=cause, attempt=req.retries,
+            )
+        self.queue.appendleft(req)
+        if self.leak_checks:
+            self.leak_check()
+
+    def _on_engine_fault(self, error: BaseException) -> None:
+        """Supervise an engine decode fault with an escalating policy:
+
+        - every running request moves to ``retrying`` (pages + prefix
+          retained) and re-enters the batch on the very next
+          iteration, riding the INCUMBENT compiled program — a
+          transient fault does not corrupt an executable, and pausing
+          the whole batch for a recompile would turn one fault into a
+          latency cliff for every queued request;
+        - a supervised AOT rebuild (re-verified replacement program)
+          is scheduled and runs at the next idle point (queue and
+          slots empty, or :meth:`drain`) — off the traffic path, where
+          the recompile cannot contend with live prefill/decode;
+        - a SECOND fault arriving before the deferred rebuild ran
+          escalates: the optimistic read was wrong, the program is
+          suspect, and the rebuild runs synchronously NOW (the honest
+          pause).  Past ``rebuild_limit`` the fault is re-raised — a
+          persistently crashing engine must not loop silently."""
+        self._count("serve/engine_faults")
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self.slots[i] = None
+            self._send_to_retry(req, f"engine:{type(error).__name__}")
+        if self._rebuilds_started >= self.rebuild_limit:
+            raise RuntimeError(
+                f"engine fault after {self._rebuilds_started} supervised "
+                f"rebuilds (rebuild_limit={self.rebuild_limit})"
+            ) from error
+        if self._rebuild_pending:
+            self._run_rebuild()  # repeat fault: rebuild before retrying
+        else:
+            self._rebuild_pending = True
+
+    def _run_rebuild(self) -> None:
+        self._rebuild_pending = False
+        self._rebuilds_started += 1
+        self._count("serve/engine_rebuilds")
+        try:
+            self.engine.rebuild()
+        except BaseException as e:
+            raise RuntimeError("supervised engine rebuild failed") from e
+
+    def flush_rebuild(self) -> bool:
+        """Run a deferred engine rebuild now if one is owed (idle
+        point / rolling restart); returns True when a rebuild ran."""
+        if not self._rebuild_pending:
+            return False
+        self._run_rebuild()
+        return True
 
     # -- admission --------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
@@ -378,32 +607,100 @@ class ContinuousBatchingScheduler:
                 return i
         return None
 
+    def _overloaded(self) -> bool:
+        """Degradation rung 2's trigger: pool pressure past
+        ``clamp_occupancy`` or a bounded queue past
+        ``clamp_queue_depth``."""
+        if self.pool.occupancy() >= self.clamp_occupancy:
+            return True
+        return (
+            self.clamp_queue_depth is not None
+            and len(self.queue) >= self.clamp_queue_depth
+        )
+
+    def _readmit(self, req: Request, slot: int) -> bool:
+        """Re-admit a retrying request that already has its first
+        token: pages and prefix were retained, so it drops straight
+        back into a decode slot and resumes from where it left off —
+        no re-prefill, no TTFT mutation."""
+        now = self.clock()
+        req.status = RUNNING
+        req.blocked_since = None
+        self.slots[slot] = req
+        self._count("serve/readmitted")
+        if self.spans is not None:
+            self.spans.request_event(
+                req.rid, "decode", now,
+                resumed=True, attempt=req.retries,
+            )
+        return True
+
     def _admit_one(self) -> bool:
-        """Try to move the queue head into a free slot (prefill now).
-        Returns True when a request was admitted or shed (progress)."""
+        """Try to move the queue head into a free slot (prefill now,
+        or straight back to decode for a retrying request).  Returns
+        True when a request was admitted or shed (progress)."""
         if not self.queue:
             return False
         slot = self._free_slot()
         if slot is None:
             return False
+        # chaos: the serve.admission site — a transient admission-path
+        # fault leaves the head queued (retried next iteration), never
+        # kills the process
+        idx = self._admissions
+        self._admissions += 1
+        try:
+            chaos.maybe_fail(chaos.SERVE_ADMISSION, idx)
+        except chaos.InjectedFault:
+            self._count("serve/admission_faults")
+            return False
         req = self.queue[0]
+        if self.draining and req.status != RETRYING:
+            # drain admits nothing new; in-flight (retrying) work may
+            # still re-enter to finish
+            self.queue.popleft()
+            self._shed_request(req, SHED_DRAINING)
+            return True
+        if req.status == RETRYING and req.first_token_at is not None:
+            self.queue.popleft()
+            return self._readmit(req, slot)
         if len(req.prompt) > self.serve.max_context:
             self.queue.popleft()
             self._shed_request(req, SHED_OVERSIZE)
             return True
         need = self.pool.pages_for(len(req.prompt))
-        pages = self.pool.alloc(need)
-        if pages is None:
-            # pool exhausted: shed only once the TTFT budget is already
-            # blown — before that the request just waits its turn
-            if (
-                req.slo_ttft_ms is not None
-                and 1e3 * (self.clock() - req.submitted_at) > req.slo_ttft_ms
-            ):
-                self.queue.popleft()
-                self._shed_request(req, SHED_DEADLINE)
-                return True
-            return False
+        if len(req.pages) < need:
+            pages = self._alloc(need)
+            if pages is None:
+                # pool exhausted: shed only once the TTFT budget is
+                # already blown — before that the request just waits
+                if (
+                    req.slo_ttft_ms is not None
+                    and 1e3 * (self.clock() - req.submitted_at)
+                    > req.slo_ttft_ms
+                ):
+                    self.queue.popleft()
+                    self._shed_request(req, SHED_DEADLINE)
+                    return True
+                return False
+        else:
+            pages = req.pages  # retained across a prefill retry
+        # degradation rung 2 — clamp the token budget while overloaded:
+        # admit MORE requests shallower instead of fewer deeper
+        if (
+            self.clamp_max_new_tokens is not None
+            and req.max_new_tokens > self.clamp_max_new_tokens
+            and self._overloaded()
+        ):
+            req.clamped_from = req.max_new_tokens
+            req.max_new_tokens = self.clamp_max_new_tokens
+            self._count("serve/clamped")
+            if self.spans is not None:
+                self.spans.instant(
+                    "req/clamped", self.clock(), track="serve/requests",
+                    lane=req.rid, max_new_tokens=req.max_new_tokens,
+                    clamped_from=req.clamped_from,
+                )
         self.queue.popleft()
         now = self.clock()
         self._close_blocked(req, now)
@@ -414,8 +711,23 @@ class ContinuousBatchingScheduler:
                 req.rid, "prefill", now,
                 bucket=self.engine.bucket_for(len(req.prompt)),
                 prompt_tokens=len(req.prompt), pages=len(pages),
+                **({"attempt": req.retries} if req.retries else {}),
             )
-        _, first = self.engine.prefill(req.prompt, pages)
+        try:
+            _, first = self.engine.prefill(req.prompt, pages)
+        except Exception as e:
+            # a crashed prefill is transient by default: the request
+            # keeps its pages and re-enters through bounded retry (the
+            # pages carry no trusted content yet — the retry prefills
+            # them again)
+            self._count("serve/engine_faults")
+            self._send_to_retry(req, f"prefill:{type(e).__name__}")
+            return True
+        if not self.engine.last_prefill_finite:
+            # poisoned at the first token: quarantine the request, not
+            # the process — its logits are not evidence of anything
+            self._shed_request(req, SHED_POISONED)
+            return True
         req.ctx_len = len(req.prompt)
         req.tokens.append(first)
         req.first_token_at = self.clock()
@@ -456,7 +768,7 @@ class ContinuousBatchingScheduler:
         page if the sequence is about to cross a page boundary."""
         if req.ctx_len // self.serve.page_size < len(req.pages):
             return True
-        got = self.pool.alloc(1)
+        got = self._alloc(1)
         if got is None:
             return False
         req.pages.extend(got)
@@ -498,13 +810,48 @@ class ContinuousBatchingScheduler:
             tables[i] = self._page_table_row(req)
         if not any(s is not None for s in self.slots):
             return
-        _, next_tokens = self.engine.decode(tokens, lengths, tables)
+        t0 = self.clock()
+        try:
+            _, next_tokens = self.engine.decode(tokens, lengths, tables)
+        except Exception as e:
+            # a crashed decode step produced nothing host-side: every
+            # rider keeps its prefix and pages and re-enters through
+            # bounded retry while the engine rebuilds under supervision
+            self._on_engine_fault(e)
+            return
+        elapsed_ms = 1e3 * (self.clock() - t0)
+        finite = self.engine.last_decode_finite
         self._count("serve/decode_steps")
         # engine-numbered iteration id: the correlation key linking a
         # request's decode span to the engine batch iterations it rode
         it = getattr(self.engine, "decode_iters", None)
         for i, req in enumerate(self.slots):
             if req is None:
+                continue
+            if finite is not None and not bool(finite[i]):
+                # poisoned-request quarantine: a non-finite logits row
+                # evicts ONLY the offending slot — its token is
+                # garbage, its KV is suspect — while the rest of the
+                # batch keeps its tokens from this very iteration
+                self.slots[i] = None
+                self._shed_request(req, SHED_POISONED)
+                continue
+            timeout_ms = (
+                req.decode_timeout_ms
+                if req.decode_timeout_ms is not None
+                else self.decode_timeout_ms
+            )
+            if timeout_ms is not None and elapsed_ms > timeout_ms:
+                # a hung iteration (per-request budget): discard this
+                # request's token from the suspect step — the KV append
+                # is positionally idempotent, so the retried decode
+                # rewrites the same slot — and re-admit with the prefix
+                # preserved
+                self._count("serve/decode_timeouts")
+                self.slots[i] = None
+                self._send_to_retry(
+                    req, f"decode_timeout:{elapsed_ms:.0f}ms"
+                )
                 continue
             if it is not None:
                 if req.first_decode_iter is None:
@@ -579,21 +926,79 @@ class ContinuousBatchingScheduler:
             # admission gave up with requests still queued: they are
             # resource-blocked (no slot / pool cannot cover the head)
             # from here until the next admission attempt — the
-            # queue_wait TTFT component
+            # queue_wait TTFT component.  Only pre-first-token requests
+            # accrue it: a retrying request past its first token is in
+            # RECOVERY wait, which must not pollute TTFT attribution
+            # (the components would stop summing to the measured TTFT).
             now = self.clock()
             for r in self.queue:
-                if r.blocked_since is None:
+                if r.first_token_at is None and r.blocked_since is None:
                     r.blocked_since = now
         self._decode_once()
         self._step += 1
         self._publish()
+        if self._rebuild_pending and not self.pending:
+            # idle point reached in a caller-driven step() loop: run
+            # the owed rebuild now, off the traffic path (run()/drain()
+            # reach the same flush through their own exits)
+            self.flush_rebuild()
 
     def run(self, max_steps: int = 10_000) -> None:
-        """Drain: step until every submitted request completed or shed."""
+        """Drain: step until every submitted request completed or shed.
+        An engine rebuild deferred during the run executes at the idle
+        exit — off the traffic path."""
         for _ in range(max_steps):
             if not self.pending:
+                self.flush_rebuild()
                 return
             self.step()
         raise RuntimeError(
             f"scheduler did not drain within {max_steps} iterations"
         )
+
+    def drain(self, max_steps: int = 10_000) -> Dict[str, object]:
+        """Graceful drain for a rolling restart (docs/serving.md
+        "Failure semantics"): stop admitting new work (submissions and
+        the never-admitted queue are shed loudly as ``draining`` — the
+        client retries on another replica), let running decodes AND
+        in-flight retrying re-admissions finish, then report the
+        drained state with the page pool provably empty.  The
+        scheduler stays drained: subsequent submits are rejected until
+        :meth:`resume` is called."""
+        self.draining = True
+        self._count("serve/drains")
+        self._gauge("serve/draining", 1.0)
+        # reject never-admitted work now; retrying requests are
+        # in-flight (they hold pages and a prefix) and get to finish
+        kept = [r for r in self.queue if r.status == RETRYING]
+        rejected = [r for r in self.queue if r.status != RETRYING]
+        self.queue = collections.deque(kept)
+        for req in rejected:
+            self._shed_request(req, SHED_DRAINING)
+        for _ in range(max_steps):
+            if not self.pending:
+                break
+            self.step()
+        else:
+            raise RuntimeError(
+                f"drain did not complete within {max_steps} iterations"
+            )
+        self.flush_rebuild()  # settle any rebuild owed from the storm
+        self.leak_check()
+        self._publish()
+        return {
+            "drained": True,
+            "completed": len(self.completed),
+            "shed": len(self.shed),
+            "pool_in_use": self.pool.in_use,
+            "engine_rebuilds": self.engine.rebuilds,
+            "leak_checks_run": self.leak_checks_run,
+        }
+
+    def resume(self) -> None:
+        """Leave the drained state (the rolling restart completed):
+        submissions are accepted again and the ``serve/draining``
+        gauge clears — a resumed replica must not keep reporting
+        itself as draining."""
+        self.draining = False
+        self._gauge("serve/draining", 0.0)

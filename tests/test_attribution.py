@@ -508,6 +508,10 @@ class TestBenchDiff:
             # the live ops plane rows (ISSUE 11): exporter scrape cost
             # + the deterministic burn-rate drill
             "ops_scrape_ms", "slo_alerts_fired",
+            # the serving resilience rows (ISSUE 14): request goodput
+            # under the serve chaos storm + p99 TTFT inflation vs the
+            # fault-free reference (deterministic virtual-clock drill)
+            "serve_chaos_goodput_pct", "serve_chaos_p99_inflation",
             # the composable trainer's honest multi-device rows
             # (ISSUE 12): dp/tp >= 2 on the mocked 8-device mesh —
             # check_schema refuses degenerate train3d rows

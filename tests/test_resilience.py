@@ -141,6 +141,58 @@ def test_chaos_unknown_site_rejected():
         chaos.Fault("not_a_site", steps=(1,))
 
 
+@pytest.mark.chaos
+def test_parse_spec_rejects_unknown_site():
+    """The registered-site registry (ISSUE 14 satellite): a typo'd
+    site in an APEX_TPU_CHAOS spec must raise naming the clause and
+    the registry — never build a fault that silently fires nowhere
+    while a drill 'passes'."""
+    with pytest.raises(ValueError, match=r"grdas.*registered sites"):
+        chaos.parse_spec("grdas:nan@3")
+
+
+@pytest.mark.chaos
+def test_parse_spec_rejects_typod_token_as_bogus_mode():
+    """The silent-miss bug: 'p0.001' (missing '=') used to be
+    swallowed as a MODE, overwriting 'nan' and leaving a fault with
+    no steps and probability 0.0 — registered, never firing.  Now it
+    raises naming the token."""
+    with pytest.raises(ValueError, match=r"p0\.001"):
+        chaos.parse_spec("grads:nan:p0.001")
+    # a mode that exists on another site is still rejected HERE
+    with pytest.raises(ValueError, match="partial"):
+        chaos.parse_spec("grads:partial@3")
+
+
+@pytest.mark.chaos
+def test_serve_sites_registered_with_modes():
+    sites = chaos.registered_sites()
+    for site in (chaos.SERVE_PREFILL, chaos.SERVE_DECODE,
+                 chaos.SERVE_ADMISSION, chaos.SERVE_KV_ALLOC):
+        assert site in sites
+    assert "nan" in chaos.site_modes(chaos.SERVE_DECODE)
+    assert "fail" in chaos.site_modes(chaos.SERVE_KV_ALLOC)
+    # one spec drives train AND serve through the same parser
+    faults, _ = chaos.parse_spec(
+        "grads:nan@3;serve.decode:nan@5;serve.kv_alloc@2"
+    )
+    assert [f.site for f in faults] == [
+        chaos.GRADS, chaos.SERVE_DECODE, chaos.SERVE_KV_ALLOC,
+    ]
+    assert faults[2].mode == "fail"  # the site's registered default
+
+
+@pytest.mark.chaos
+def test_register_site_conflicts_rejected():
+    chaos.register_site("unit.test_site", ("raise",), "raise")
+    # identical re-registration is idempotent
+    chaos.register_site("unit.test_site", ("raise",), "raise")
+    with pytest.raises(ValueError, match="already registered"):
+        chaos.register_site("unit.test_site", ("raise", "stall"))
+    with pytest.raises(ValueError, match="default mode"):
+        chaos.register_site("unit.other_site", ("raise",), "stall")
+
+
 # ---------------------------------------------------------------------------
 # guarded step
 # ---------------------------------------------------------------------------

@@ -542,6 +542,406 @@ class TestServeHealthRules:
             serve_rules(mfu_floor={})
 
 
+class TestPagePoolLeakCheck:
+    def test_exact_ownership_passes(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        a = pool.alloc(2)
+        b = pool.alloc(3)
+        pool.leak_check([a, b])
+        pool.free(b)
+        pool.leak_check([a])
+        pool.free(a)
+        pool.leak_check([])
+
+    def test_leaked_page_named(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        a = pool.alloc(2)
+        with pytest.raises(ValueError, match=rf"leaked.*{a[1]}"):
+            pool.leak_check([[a[0]]])
+
+    def test_foreign_page_named(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        a = pool.alloc(1)
+        free_page = 7 if a[0] != 7 else 6
+        with pytest.raises(ValueError, match="foreign"):
+            pool.leak_check([a, [free_page]])
+
+    def test_double_owned_page_named(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        a = pool.alloc(2)
+        with pytest.raises(ValueError, match="more than one request"):
+            pool.leak_check([a, [a[0]]])
+
+
+# ---------------------------------------------------------------------------
+# serving resilience: retries, quarantine, timeouts, ladder, drain
+# (docs/serving.md "Failure semantics & degradation ladder")
+# ---------------------------------------------------------------------------
+
+
+def _registry():
+    from apex_tpu.observability import MetricRegistry
+
+    return MetricRegistry(fetch_every=1)
+
+
+def _vals(reg):
+    reg.fetch()
+    return reg.values()
+
+
+class TestServeResilience:
+    def _prompt(self, rs, n):
+        return [int(t) for t in rs.randint(0, 64, size=n)]
+
+    def test_decode_fault_retries_preserve_prefix(self, gpt):
+        """A crashed decode iteration sends every rider through
+        bounded re-admission with pages and prefix retained — the
+        resumed f32 token stream is BIT-IDENTICAL to an unfaulted
+        run's (the scheduler-level half of the rebuild-determinism
+        satellite)."""
+        from apex_tpu.resilience import chaos
+
+        rs = np.random.RandomState(20)
+        prompts = [self._prompt(rs, 6) for _ in range(2)]
+
+        def run(faults):
+            eng = make_engine(gpt)
+            sched = ContinuousBatchingScheduler(eng)
+            with chaos.inject(*faults):
+                reqs = [
+                    sched.submit(Request(prompt=list(p), max_new_tokens=6))
+                    for p in prompts
+                ]
+                sched.run()
+            return eng, sched, [r.tokens for r in reqs]
+
+        _, _, clean = run(())
+        eng, sched, faulted = run(
+            (chaos.Fault(chaos.SERVE_DECODE, steps=(2,), mode="raise",
+                         max_hits=1),)
+        )
+        assert faulted == clean  # prefix preserved, resume exact
+        assert eng.rebuilds == 1  # deferred rebuild flushed at idle
+        assert all(r.status == "done" for r in sched.completed)
+        assert sched.pool.in_use == 0
+        assert sched.leak_checks_run > 0
+
+    def test_persistent_decode_fault_exhausts_rebuild_limit(self, gpt):
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng, rebuild_limit=1)
+        rs = np.random.RandomState(21)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_DECODE, steps=tuple(range(64)), mode="raise",
+        )):
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=4))
+            with pytest.raises(RuntimeError, match="rebuild_limit"):
+                sched.run()
+
+    def test_prefill_fault_retried_then_shed_when_persistent(self, gpt):
+        from apex_tpu.resilience import chaos
+
+        rs = np.random.RandomState(22)
+        # transient: one fault, heals on retry
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_PREFILL, steps=(0,), mode="raise", max_hits=1,
+        )):
+            req = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                       max_new_tokens=2))
+            sched.run()
+        assert req.status == "done" and req.retries == 1
+        assert len(req.tokens) == 2
+        # persistent: the re-admission budget bounds the loop
+        eng2 = make_engine(gpt)
+        reg = _registry()
+        sched2 = ContinuousBatchingScheduler(
+            eng2, registry=reg, max_retries=2,
+        )
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_PREFILL, steps=tuple(range(16)), mode="raise",
+        )):
+            req2 = sched2.submit(Request(prompt=self._prompt(rs, 6)))
+            sched2.run()
+        assert req2.status == "shed"
+        assert req2.shed_reason == "retries_exhausted"
+        assert req2.retries == 2
+        assert sched2.pool.in_use == 0  # retained pages freed at shed
+        vals = _vals(reg)
+        assert vals["serve/shed_retries_exhausted"] == 1.0
+        assert vals["serve/retries"] == 2.0
+        assert vals["serve/engine_faults"] == 3.0  # initial + 2 retries
+
+    def test_poisoned_decode_evicts_only_offending_slot(self, gpt):
+        """Non-finite logits quarantine THE slot, never the batch: the
+        co-resident request keeps the tokens of that very iteration."""
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(23)
+        victim = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                      max_new_tokens=8))
+        bystander = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                         max_new_tokens=8))
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_DECODE, steps=(1,), mode="nan", max_hits=1,
+        )):
+            sched.run()
+        assert victim.status == "shed"
+        assert victim.shed_reason == "poisoned"
+        assert bystander.status == "done"
+        assert len(bystander.tokens) == 8
+        assert sched.pool.in_use == 0
+        vals = _vals(reg)
+        assert vals["serve/shed_poisoned"] == 1.0
+        assert vals["serve/shed"] == 1.0
+
+    def test_poisoned_prefill_quarantined_at_first_token(self, gpt):
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(24)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_PREFILL, steps=(0,), mode="nan", max_hits=1,
+        )):
+            req = sched.submit(Request(prompt=self._prompt(rs, 6)))
+            sched.run()
+        assert req.status == "shed" and req.shed_reason == "poisoned"
+        assert req.tokens == []  # the poisoned first token is not kept
+        assert sched.pool.in_use == 0
+
+    def test_decode_timeout_is_per_request(self, gpt):
+        """A chaos stall makes one iteration slow; ONLY the request
+        carrying a decode timeout discards that iteration and goes
+        through retry — its co-rider keeps the token."""
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            max_retries=8)
+        rs = np.random.RandomState(25)
+        timed = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                     max_new_tokens=4,
+                                     decode_timeout_ms=20.0))
+        free = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                    max_new_tokens=4))
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_DECODE, steps=(1,), mode="stall", max_hits=1,
+        )):
+            sched.run()
+        assert timed.status == "done" and timed.retries >= 1
+        assert free.status == "done" and free.retries == 0
+        assert len(timed.tokens) == 4 and len(free.tokens) == 4
+        assert _vals(reg)["serve/decode_timeouts"] >= 1.0
+
+    def test_admission_fault_is_transient(self, gpt):
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(26)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_ADMISSION, steps=(0, 1), mode="raise",
+        )):
+            req = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                       max_new_tokens=2))
+            sched.run()
+        assert req.status == "done"
+        assert _vals(reg)["serve/admission_faults"] == 2.0
+
+    def test_kv_alloc_fault_degrades_gracefully(self, gpt):
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(27)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_KV_ALLOC, steps=(0,), mode="fail", max_hits=1,
+        )):
+            req = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                       max_new_tokens=2))
+            sched.run()
+        assert req.status == "done"  # waited one iteration, then ran
+        assert _vals(reg)["serve/kv_alloc_faults"] == 1.0
+
+    def test_queue_cap_fast_rejects_excess(self, gpt):
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            max_queue_depth=2)
+        rs = np.random.RandomState(28)
+        reqs = [
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=2))
+            for _ in range(5)
+        ]
+        rejected = [r for r in reqs if r.shed_reason == "queue_full"]
+        assert len(rejected) == 3  # exactly the over-cap excess
+        assert all(r.done_at is not None for r in rejected)
+        sched.run()
+        assert [r.status for r in reqs[:2]] == ["done", "done"]
+        vals = _vals(reg)
+        assert vals["serve/shed_queue_full"] == 3.0
+        assert vals["serve/shed"] == 3.0
+
+    def test_clamp_rung_bounds_token_budget(self, gpt):
+        eng = make_engine(gpt, num_pages=9, max_pages_per_seq=4)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(
+            eng, registry=reg,
+            clamp_max_new_tokens=2, clamp_occupancy=0.25,
+        )
+        rs = np.random.RandomState(29)
+        first = sched.submit(Request(prompt=self._prompt(rs, 16),
+                                     max_new_tokens=10))
+        second = sched.submit(Request(prompt=self._prompt(rs, 16),
+                                      max_new_tokens=10))
+        sched.run()
+        # occupancy crossed the threshold once the first was resident
+        assert first.status == "done" and second.status == "done"
+        assert second.clamped_from == 10
+        assert second.max_new_tokens == 2 and len(second.tokens) == 2
+        assert _vals(reg)["serve/clamped"] >= 1.0
+
+    def test_drain_finishes_running_and_sheds_queued(self, gpt):
+        eng = make_engine(gpt)  # max_batch=2
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(30)
+        reqs = [
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=6))
+            for _ in range(4)
+        ]
+        sched.step()  # two admitted, two still queued
+        report = sched.drain()
+        assert report["drained"] and report["pool_in_use"] == 0
+        assert [r.status for r in reqs[:2]] == ["done", "done"]
+        assert all(r.shed_reason == "draining" for r in reqs[2:])
+        vals = _vals(reg)
+        assert vals["serve/drains"] == 1.0
+        assert vals["serve/shed_draining"] == 2.0
+        # a drained scheduler rejects new work loudly
+        late = sched.submit(Request(prompt=self._prompt(rs, 6)))
+        assert late.status == "shed" and late.shed_reason == "draining"
+
+    def test_step_loop_flushes_deferred_rebuild_at_idle(self, gpt):
+        """A caller-driven step() loop (the documented drive pattern)
+        must still execute the deferred rebuild once the scheduler
+        goes idle — not only run()/drain()."""
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(34)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_DECODE, steps=(1,), mode="raise", max_hits=1,
+        )):
+            req = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                       max_new_tokens=4))
+            while sched.pending:
+                sched.step()
+        assert req.status == "done"
+        assert eng.rebuilds == 1  # flushed by step(), off the traffic path
+
+    def test_resume_clears_drained_state_and_gauge(self, gpt):
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(35)
+        sched.drain()
+        rejected = sched.submit(Request(prompt=self._prompt(rs, 6)))
+        assert rejected.shed_reason == "draining"
+        sched.resume()
+        accepted = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                        max_new_tokens=2))
+        sched.run()
+        assert accepted.status == "done"
+        assert _vals(reg)["serve/draining"] == 0.0
+
+    def test_shed_breakdown_still_sums_with_new_reasons(self, gpt):
+        from apex_tpu.serve import SHED_REASONS
+
+        assert {"poisoned", "queue_full", "retries_exhausted",
+                "draining"} < set(SHED_REASONS)
+
+
+class TestEngineRecovery:
+    def test_rebuild_decode_is_bit_identical(self, gpt):
+        """Satellite: a restored engine's decode over RETAINED KV
+        pages is bit-identical to the uninterrupted run — same pin
+        style as goodput's resume-loss-drift check (drift must be 0.0,
+        not small)."""
+        cfg, _, _ = gpt
+        rs = np.random.RandomState(31)
+        prompt = [int(t) for t in rs.randint(0, cfg.vocab_size, size=9)]
+
+        def decode_stream(rebuild_at):
+            eng = make_engine(gpt)
+            pages = eng.pool.alloc(eng.pool.pages_for(len(prompt)))
+            _, tok = eng.prefill(prompt, pages)
+            ctx = len(prompt)
+            table = np.zeros((2, 8), np.int32)
+            out_tokens, out_logits = [], []
+            for step in range(6):
+                if step == rebuild_at:
+                    eng.rebuild()
+                if ctx // 8 >= len(pages):
+                    pages += eng.pool.alloc(1)
+                table[0, : len(pages)] = pages
+                logits, nxt = eng.decode(
+                    np.array([tok, 0]), np.array([ctx + 1, 0]), table
+                )
+                out_tokens.append(int(nxt[0]))
+                out_logits.append(np.asarray(logits[0]))
+                ctx += 1
+                tok = int(nxt[0])
+            return eng, out_tokens, out_logits
+
+        _, clean_toks, clean_logits = decode_stream(rebuild_at=None)
+        eng, toks, logits = decode_stream(rebuild_at=3)
+        assert eng.rebuilds == 1
+        assert eng.compile_counts["decode"] == 2  # honest recompile
+        assert toks == clean_toks
+        for a, b in zip(logits, clean_logits):
+            np.testing.assert_array_equal(a, b)  # bit-identical
+
+    def test_full_rebuild_drops_prefill_buckets_lazily(self, gpt):
+        eng = make_engine(gpt).build(buckets=(8,))
+        assert eng.compile_counts == {"prefill_8": 1, "decode": 1}
+        eng.rebuild(full=True)
+        assert eng.compile_counts["decode"] == 2
+        # prefill recompiles lazily on next use
+        rs = np.random.RandomState(32)
+        pages = eng.pool.alloc(1)
+        eng.prefill([int(t) for t in rs.randint(0, 64, size=5)], pages)
+        assert eng.compile_counts["prefill_8"] == 2
+        eng.pool.free(pages)
+
+    def test_finite_screens_default_clean(self, gpt):
+        eng = make_engine(gpt)
+        rs = np.random.RandomState(33)
+        pages = eng.pool.alloc(1)
+        eng.prefill([int(t) for t in rs.randint(0, 64, size=5)], pages)
+        assert eng.last_prefill_finite is True
+        table = np.zeros((2, 8), np.int32)
+        table[0, :1] = pages
+        eng.decode(np.array([1, 0]), np.array([6, 0]), table)
+        assert eng.last_decode_finite is not None
+        assert bool(eng.last_decode_finite.all())
+        eng.pool.free(pages)
+
+
 class TestBf16Serving:
     def test_bf16_engine_runs_and_is_sane(self):
         """The default training dtype (bf16) serves: greedy decode

@@ -202,6 +202,161 @@ class TestRequestLifecycle:
             rec.request_event(1, REQ_PREFILL, 4.0)
 
 
+class TestRecoveryLifecycle:
+    """The fault-recovery vocabulary (docs/serving.md "Failure
+    semantics"): ``retrying`` transitions, the ``shed(poisoned)``
+    terminal, and the illegal recovery paths the validated state
+    machine must reject."""
+
+    def test_decode_retry_roundtrip_chain(self):
+        from apex_tpu.observability.spans import REQ_RETRYING
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(9, REQ_QUEUED, 1.0)
+        rec.request_event(9, REQ_PREFILL, 2.0)
+        rec.request_event(9, REQ_DECODE, 3.0)
+        rec.request_event(9, REQ_RETRYING, 4.0, cause="engine:Boom",
+                          attempt=1)
+        rec.request_event(9, REQ_DECODE, 5.0, resumed=True)
+        rec.request_event(9, REQ_DONE, 6.0, tokens=4)
+        names = _names(rec)
+        assert names["req/retrying"] == 1
+        assert names["req/decode"] == 2
+        assert names["req/done"] == 1
+        retry = [e for e in rec.snapshot()
+                 if e["name"] == "req/retrying"][0]
+        # the recovery interval carries its cause AND the resume marker
+        assert (retry["t0"], retry["t1"]) == (4.0, 5.0)
+        assert retry["args"]["cause"] == "engine:Boom"
+        assert retry["args"]["resumed"] is True
+        assert rec.open_requests == {}
+
+    def test_prefill_retry_reenters_through_prefill(self):
+        from apex_tpu.observability.spans import REQ_RETRYING
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(3, REQ_QUEUED, 1.0)
+        rec.request_event(3, REQ_PREFILL, 2.0)
+        rec.request_event(3, REQ_RETRYING, 3.0, cause="prefill:Boom")
+        rec.request_event(3, REQ_PREFILL, 4.0, attempt=1)
+        rec.request_event(3, REQ_DECODE, 5.0, ttft_ms=4000.0)
+        rec.request_event(3, REQ_DONE, 6.0)
+        assert _names(rec)["req/prefill"] == 2
+        assert rec.open_requests == {}
+
+    def test_shed_poisoned_from_decode(self):
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(4, REQ_QUEUED, 1.0)
+        rec.request_event(4, REQ_PREFILL, 2.0)
+        rec.request_event(4, REQ_DECODE, 3.0)
+        rec.request_event(4, REQ_SHED, 4.0, reason="poisoned")
+        shed = [e for e in rec.snapshot() if e["name"] == "req/shed"][0]
+        assert shed["args"]["reason"] == "poisoned"
+        assert rec.open_requests == {}
+
+    def test_shed_from_retrying_allowed(self):
+        from apex_tpu.observability.spans import REQ_RETRYING
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(5, REQ_QUEUED, 1.0)
+        rec.request_event(5, REQ_PREFILL, 2.0)
+        rec.request_event(5, REQ_RETRYING, 3.0)
+        rec.request_event(5, REQ_SHED, 4.0, reason="retries_exhausted")
+        assert rec.open_requests == {}
+
+    def test_retrying_cannot_complete_directly(self):
+        """retrying -> done is illegal: completion must go back
+        through a decode (or prefill) that actually produced tokens."""
+        from apex_tpu.observability.spans import REQ_RETRYING
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(6, REQ_QUEUED, 1.0)
+        rec.request_event(6, REQ_PREFILL, 2.0)
+        rec.request_event(6, REQ_RETRYING, 3.0)
+        with pytest.raises(ValueError, match="out-of-order request"):
+            rec.request_event(6, REQ_DONE, 4.0)
+
+    def test_shed_cannot_be_readmitted(self):
+        """shed -> decode without re-admission is illegal: a terminal
+        shed is final — recovery means a NEW request id."""
+        from apex_tpu.observability.spans import REQ_RETRYING
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(7, REQ_QUEUED, 1.0)
+        rec.request_event(7, REQ_PREFILL, 2.0)
+        rec.request_event(7, REQ_SHED, 3.0, reason="poisoned")
+        for state in (REQ_DECODE, REQ_RETRYING, REQ_PREFILL):
+            with pytest.raises(ValueError, match="out-of-order request"):
+                rec.request_event(7, state, 4.0)
+
+    def test_queued_cannot_jump_to_retrying(self):
+        """retrying is a FAULT phase: a request that never reached
+        prefill has nothing to retry."""
+        from apex_tpu.observability.spans import REQ_RETRYING
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(8, REQ_QUEUED, 1.0)
+        with pytest.raises(ValueError, match="out-of-order request"):
+            rec.request_event(8, REQ_RETRYING, 2.0)
+
+    def test_scheduler_records_retry_chain_end_to_end(self):
+        """The scheduler's real fault path produces the validated
+        chain: decode fault -> retrying span (with cause) ->
+        re-admitted decode -> done, and the clamp rung lands as a
+        req/clamped instant."""
+        import numpy as np
+
+        from apex_tpu.models.gpt import GptConfig, GptModel
+        from apex_tpu.resilience import chaos
+        from apex_tpu.serve import (
+            ContinuousBatchingScheduler,
+            InferenceEngine,
+            Request,
+            ServeConfig,
+        )
+
+        cfg = GptConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_seq_len=128, dtype=jnp.float32,
+        )
+        model = GptModel(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((8, 1), jnp.int32)
+        )
+        eng = InferenceEngine(
+            cfg, params,
+            ServeConfig(page_size=8, num_pages=32, max_batch=2,
+                        max_pages_per_seq=8, verify=False),
+        )
+        rec = SpanRecorder(capacity=4096)
+        sched = ContinuousBatchingScheduler(
+            eng, spans=rec,
+            clamp_max_new_tokens=3, clamp_occupancy=0.01,
+        )
+        rs = np.random.RandomState(40)
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_DECODE, steps=(1,), mode="raise", max_hits=1,
+        )):
+            a = sched.submit(Request(
+                prompt=[int(t) for t in rs.randint(0, 64, size=6)],
+                max_new_tokens=6,
+            ))
+            b = sched.submit(Request(
+                prompt=[int(t) for t in rs.randint(0, 64, size=6)],
+                max_new_tokens=6,
+            ))
+            sched.run()
+        assert a.status == "done" and b.status == "done"
+        names = _names(rec)
+        assert names.get("req/retrying", 0) >= 1
+        assert names.get("req/clamped", 0) >= 1  # occupancy rung fired
+        assert rec.open_requests == {}
+        retry = [e for e in rec.snapshot()
+                 if e["name"] == "req/retrying"][0]
+        assert retry["args"]["cause"].startswith("engine:")
+        assert retry["args"]["attempt"] == 1
+
+
 # ---------------------------------------------------------------------------
 # run_resilient observer bridge + trace window markers
 # ---------------------------------------------------------------------------

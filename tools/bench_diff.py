@@ -170,8 +170,11 @@ def collapse(records: List[dict]) -> Dict[str, dict]:
 
 
 def direction(metric: str, unit: str = "") -> str:
-    """"lower" for time-like metrics, else "higher"."""
+    """"lower" for time-like and inflation-ratio metrics, else
+    "higher"."""
     if metric.endswith("_ms") or metric.endswith("_s"):
+        return "lower"
+    if metric.endswith("_inflation"):
         return "lower"
     if (unit or "").strip().startswith("ms"):
         return "lower"

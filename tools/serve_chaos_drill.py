@@ -1,0 +1,530 @@
+"""Serving chaos drill — the SERVE-CHAOS acceptance gate's engine.
+
+Proves the serving resilience layer end to end (docs/serving.md
+"Failure semantics & degradation ladder"): a Poisson load runs twice
+through the REAL engine + continuous-batching scheduler — once
+fault-free (the reference), once under an ``APEX_TPU_CHAOS``-style
+storm injecting faults at all four serving chaos sites
+(``serve.prefill``, ``serve.decode``, ``serve.admission``,
+``serve.kv_alloc``) — and the drill asserts the four headline
+guarantees:
+
+1. **zero process deaths** — every fault is absorbed by the recovery
+   machinery (bounded re-admission retries, poisoned-request
+   quarantine, supervised background engine rebuild); the storm run
+   completing IS the proof;
+2. **zero leaked pages** — ``PagePool.leak_check`` runs after every
+   shed/free path (``leak_checks=True``) and the pool is exactly empty
+   once every request is terminal;
+3. **every request exactly one accounted terminal** — completed + shed
+   equals offered, no request span chain is left open, and (with
+   ``--spans``) ``tools/timeline.py --json`` re-proves chain
+   completeness from the dump;
+4. **bounded p99 TTFT inflation** — storm p99 TTFT within
+   ``--max-p99-inflation`` (default 2x) of the fault-free reference:
+   graceful degradation, not collapse.  Both loads run on a
+   deterministic virtual clock (one tick per scheduler iteration), so
+   TTFT measures SCHEDULING delay — queue wait, retry round-trips,
+   fault recovery — reproducibly per seed, immune to CI-runner
+   weather; and the supervised rebuild is deferred off the traffic
+   path precisely so a recompile never lands in anyone's TTFT.
+
+An **overload probe** then walks the degradation ladder
+deterministically (no timing dependence — a synchronous burst of
+``3 x max_queue_depth`` submissions against a small queue cap):
+rung 1 backpressure must fast-reject exactly the over-cap excess as
+``shed(queue_full)``, rung 2 must clamp admissions to
+``clamp_max_new_tokens`` (``serve/clamped``), and every probe request
+still reaches exactly one terminal.
+
+A final **drain phase** exercises the rolling-restart path on the
+still-chaos-scarred scheduler: new work is submitted, admission is
+stopped mid-flight (``drain()``), running decodes finish, the
+never-admitted queue sheds loudly as ``shed(draining)``, and the pool
+is re-proven empty.
+
+``--json`` writes the evidence artifact (``bench.py --config serve``
+reuses it via ``APEX_TPU_SERVE_CHAOS_ARTIFACT`` for its
+``serve_chaos_*`` golden rows); ``--spans`` records every storm/drain
+request's span chain for the timeline gate.
+
+Usage::
+
+    python tools/serve_chaos_drill.py --json /tmp/serve_chaos.json \
+        --spans /tmp/serve_chaos_spans.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: the default storm: every serving chaos site fires at least once,
+#: through the SAME spec grammar / parser / hit accounting an
+#: ``APEX_TPU_CHAOS`` env drill uses.  Indices are 0-based call
+#: counters per site (prefill calls, decode iterations, admission
+#: attempts, pool allocations).
+#: stall-mode faults are deliberately absent: a 50ms injected hang is
+#: bigger than the whole fault-free p99, so it belongs to the
+#: deterministic unit tier (tests/test_serve.py pins the per-request
+#: decode-timeout rung under a chaos stall), not to a drill whose
+#: acceptance is a p99 bound.
+DEFAULT_CHAOS_SPEC = (
+    "serve.prefill:raise:x1@2;"
+    "serve.decode:raise:x1@6;"
+    "serve.decode:nan:x2@10,16;"
+    "serve.admission:raise:x2@4,5;"
+    "serve.kv_alloc:fail:x2@9,12"
+)
+
+#: injected fault counts per ledger counter the artifact must show —
+#: derived from DEFAULT_CHAOS_SPEC (a custom --chaos skips the pins)
+DEFAULT_EXPECTED = {
+    "engine_faults": 2,      # 1 prefill raise + 1 decode raise
+    "engine_rebuilds": 1,    # decode raise -> supervised rebuild
+    "poisoned": 2,           # 2 nan decode iterations, 1 slot each
+    "admission_faults": 2,
+    "kv_alloc_faults": 2,
+}
+
+
+def build_engine(args, *, registry=None):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GptConfig, GptModel
+    from apex_tpu.serve import InferenceEngine, ServeConfig
+
+    cfg = GptConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=args.heads,
+        intermediate_size=2 * args.hidden, max_seq_len=256,
+        dtype=jnp.float32,
+    )
+    serve_cfg = ServeConfig(
+        page_size=args.page_size, num_pages=args.pages,
+        max_batch=args.batch, max_pages_per_seq=args.pages_per_seq,
+        verify=args.verify,
+    )
+    model = GptModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(1),
+        jax.random.randint(jax.random.PRNGKey(0), (16, 1), 0,
+                           cfg.vocab_size),
+    )
+    return InferenceEngine(cfg, params, serve_cfg,
+                           registry=registry).build()
+
+
+class VirtualClock:
+    """A deterministic scheduler clock: one fixed tick per drill loop
+    iteration.  Chaos injection is seeded and exact (``chaos.py``'s
+    whole design); the drill's latency verdict must be too — measured
+    on wall time, the p99-inflation ratio of two short runs is a coin
+    flip against CI-runner hiccups an order of magnitude larger than a
+    decode iteration.  On the virtual clock, TTFT measures SCHEDULING
+    delay in iteration units (queue wait, retry round-trips, fault
+    recovery) — exactly what the resilience layer controls — and the
+    drill's numbers reproduce bit-for-bit per seed."""
+
+    def __init__(self, tick_s: float = 0.005):
+        self.t = 0.0
+        self.tick_s = tick_s
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self) -> None:
+        self.t += self.tick_s
+
+
+def run_load(sched, clock, args, *, label):
+    """One closed-loop Poisson load (same shape as serve_bench's) on
+    the drill's virtual clock: deterministic arrival/length draws
+    under --seed.  (The per-request decode-timeout rung needs real
+    elapsed time to fire and is pinned by the deterministic unit tier
+    instead — tests/test_serve.py.)"""
+    import numpy as np
+
+    from apex_tpu.serve import Request
+
+    rs = np.random.RandomState(args.seed)
+    gaps = rs.exponential(1.0 / args.rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    prompt_lens = rs.choice(args.prompt_mix, size=args.requests)
+    out_lens = rs.choice(args.output_mix, size=args.requests)
+
+    submitted = 0
+    reqs = []
+    while submitted < args.requests or sched.pending:
+        now = clock()
+        while submitted < args.requests and arrivals[submitted] <= now:
+            reqs.append(sched.submit(Request(
+                prompt=list(rs.randint(0, args.vocab,
+                                       size=prompt_lens[submitted])),
+                max_new_tokens=int(out_lens[submitted]),
+            )))
+            submitted += 1
+        if sched.pending:
+            sched.step()
+        clock.advance()
+    wall = clock()
+
+    from apex_tpu.observability.meter import percentile
+
+    done = [r for r in reqs if r.status == "done"]
+    shed = [r for r in reqs if r.status == "shed"]
+    ttfts = sorted(r.ttft_ms for r in done if r.ttft_ms is not None)
+    shed_reasons = {}
+    for r in shed:
+        key = r.shed_reason or "?"
+        shed_reasons[key] = shed_reasons.get(key, 0) + 1
+    unterminated = [r.rid for r in reqs if r.status not in ("done", "shed")]
+    return {
+        "label": label,
+        "offered": len(reqs),
+        "completed": len(done),
+        "shed": len(shed),
+        "shed_reasons": shed_reasons,
+        "unterminated": unterminated,
+        "retries_total": sum(r.retries for r in reqs),
+        "clamped": sum(1 for r in reqs if r.clamped_from is not None),
+        "ttft_ms": {
+            "p50": percentile(ttfts, 0.50),
+            "p99": percentile(ttfts, 0.99),
+            "samples": len(ttfts),
+        },
+        "wall_s": wall,
+    }
+
+
+def run_drill(args) -> dict:
+    import numpy as np
+
+    from apex_tpu.observability import MetricRegistry
+    from apex_tpu.observability.spans import SpanRecorder, wall_clock_anchor
+    from apex_tpu.resilience import chaos
+    from apex_tpu.serve import ContinuousBatchingScheduler, Request
+
+    faults, seed = chaos.parse_spec(args.chaos)
+    sites = sorted({f.site for f in faults})
+
+    # -- 1. fault-free reference ------------------------------------------
+    ref_engine = build_engine(args)
+    ref_clock = VirtualClock()
+    ref_sched = ContinuousBatchingScheduler(
+        ref_engine, registry=None, clock=ref_clock,
+        max_queue_depth=args.max_queue_depth,
+        clamp_max_new_tokens=args.clamp_max_new_tokens,
+        clamp_occupancy=args.clamp_occupancy,
+    )
+    reference = run_load(ref_sched, ref_clock, args, label="reference")
+    ref_sched.leak_check()
+
+    # -- 2. the chaos storm ------------------------------------------------
+    recorder = None
+    if args.spans:
+        recorder = SpanRecorder(capacity=args.span_capacity)
+    registry = MetricRegistry(fetch_every=1)
+    storm_engine = build_engine(args, registry=registry)
+    storm_clock = VirtualClock()
+    storm_sched = ContinuousBatchingScheduler(
+        storm_engine, registry=registry, spans=recorder,
+        clock=storm_clock,
+        max_queue_depth=args.max_queue_depth,
+        clamp_max_new_tokens=args.clamp_max_new_tokens,
+        clamp_occupancy=args.clamp_occupancy,
+    )
+    with chaos.inject(*faults, seed=seed):
+        storm = run_load(storm_sched, storm_clock, args, label="storm")
+    storm_sched.leak_check()
+
+    # -- 3. deterministic overload probe: the degradation ladder -----------
+    # a synchronous burst against a small queue cap — no Poisson, no
+    # clock dependence: exactly (burst - cap) submissions MUST
+    # fast-reject at rung 1, and admissions under the backed-up queue
+    # MUST clamp at rung 2.  Shares the storm's engine/registry/
+    # recorder so the rung counters land on the same board and span
+    # record the gate audits.
+    probe_cap = 4
+    probe_clamp = 4
+    probe_sched = ContinuousBatchingScheduler(
+        storm_engine, registry=registry, spans=recorder,
+        clock=storm_clock,
+        max_queue_depth=probe_cap,
+        clamp_max_new_tokens=probe_clamp,
+        clamp_queue_depth=2,
+    )
+    rs = np.random.RandomState(args.seed + 7)
+    burst = [
+        probe_sched.submit(Request(
+            prompt=list(rs.randint(0, args.vocab, size=args.prompt_mix[0])),
+            max_new_tokens=16,
+        ))
+        for _ in range(3 * probe_cap)
+    ]
+    probe_sched.run()
+    probe = {
+        "burst": len(burst),
+        "queue_cap": probe_cap,
+        "queue_full": sum(
+            1 for r in burst if r.shed_reason == "queue_full"
+        ),
+        "clamped": sum(1 for r in burst if r.clamped_from is not None),
+        "completed": sum(1 for r in burst if r.status == "done"),
+        "unterminated": [
+            r.rid for r in burst if r.status not in ("done", "shed")
+        ],
+    }
+    probe_sched.leak_check()
+
+    # -- 4. graceful drain on the storm-scarred scheduler ------------------
+    rs = np.random.RandomState(args.seed + 1)
+    drain_reqs = [
+        storm_sched.submit(Request(
+            prompt=list(rs.randint(0, args.vocab, size=args.prompt_mix[0])),
+            max_new_tokens=8,
+        ))
+        for _ in range(args.drain_requests)
+    ]
+    storm_sched.step()
+    drain_report = storm_sched.drain()
+    drain_statuses = {}
+    for r in drain_reqs:
+        drain_statuses[r.status] = drain_statuses.get(r.status, 0) + 1
+    drain_shed_draining = sum(
+        1 for r in drain_reqs if r.shed_reason == "draining"
+    )
+
+    if recorder is not None:
+        recorder.dump(reason="serve_chaos_drill", path=args.spans)
+
+    registry.fetch()
+    reg = {
+        k: v for k, v in registry.values().items()
+        if k.startswith("serve/")
+    }
+
+    ref_p99 = reference["ttft_ms"]["p99"]
+    storm_p99 = storm["ttft_ms"]["p99"]
+    inflation = (
+        storm_p99 / ref_p99
+        if ref_p99 and ref_p99 == ref_p99 and storm_p99 == storm_p99
+        else float("nan")
+    )
+    offered_total = storm["offered"] + probe["burst"] + len(drain_reqs)
+    done_total = len(storm_sched.completed) + len(probe_sched.completed)
+    shed_total = len(storm_sched.shed) + len(probe_sched.shed)
+
+    return {
+        "anchor": wall_clock_anchor(),
+        "config": {
+            k: getattr(args, k) for k in (
+                "requests", "rate", "prompt_mix", "output_mix", "seed",
+                "batch", "page_size", "pages", "pages_per_seq",
+                "max_queue_depth", "clamp_max_new_tokens",
+                "drain_requests",
+            )
+        },
+        "chaos_spec": args.chaos,
+        "chaos_sites": sites,
+        "reference": reference,
+        "storm": storm,
+        "overload_probe": probe,
+        "p99_ttft_inflation": inflation,
+        "process_deaths": 0,  # reaching this line IS the evidence
+        "terminals": {
+            "offered": offered_total,
+            "completed": done_total,
+            "shed": shed_total,
+            "accounted": done_total + shed_total == offered_total,
+            "open_spans": (
+                len(recorder.open_requests) if recorder is not None else None
+            ),
+        },
+        "pages": {
+            "pool_in_use_end": storm_sched.pool.in_use,
+            "leak_checks_run": storm_sched.leak_checks_run,
+        },
+        "engine": {
+            "rebuilds": storm_engine.rebuilds,
+            "compile_counts": dict(storm_engine.compile_counts),
+        },
+        "registry": reg,
+        "drain": {
+            **drain_report,
+            "statuses": drain_statuses,
+            "shed_draining": drain_shed_draining,
+        },
+        "spans_file": args.spans,
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="serving chaos drill (docs/serving.md "
+        '"Failure semantics & degradation ladder")',
+    )
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--rate", type=float, default=30.0,
+                    help="Poisson arrival rate, requests/s (virtual "
+                    "time; ~50%% decode-capacity utilization)")
+    ap.add_argument("--prompt-mix", type=int, nargs="+",
+                    default=[8, 16, 24], dest="prompt_mix")
+    ap.add_argument("--output-mix", type=int, nargs="+",
+                    default=[8, 16, 24], dest="output_mix")
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--pages", type=int, default=64)
+    ap.add_argument("--pages-per-seq", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--verify", action="store_true",
+                    help="run analysis verification at (re)build — "
+                    "slower; the SERVE gate lints the same programs")
+    ap.add_argument("--chaos", default=DEFAULT_CHAOS_SPEC,
+                    help="APEX_TPU_CHAOS-grammar storm spec (default "
+                    "fires all four serve sites)")
+    ap.add_argument("--max-queue-depth", type=int, default=12)
+    ap.add_argument("--clamp-max-new-tokens", type=int, default=12)
+    ap.add_argument("--clamp-occupancy", type=float, default=0.6)
+    ap.add_argument("--drain-requests", type=int, default=6)
+    ap.add_argument("--max-p99-inflation", type=float, default=2.0)
+    ap.add_argument("--json", default=None, metavar="OUT")
+    ap.add_argument("--spans", default=None, metavar="OUT")
+    ap.add_argument("--span-capacity", type=int, default=65536)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    art = run_drill(args)
+    if args.json:
+        from apex_tpu.observability.flight import json_safe
+
+        with open(args.json, "w") as f:
+            json.dump(json_safe(art), f, indent=1, allow_nan=False)
+            f.write("\n")
+
+    ref, storm = art["reference"], art["storm"]
+    print(
+        "serve chaos drill: storm %d/%d completed (%d shed: %s), "
+        "reference %d/%d"
+        % (storm["completed"], storm["offered"], storm["shed"],
+           ", ".join(f"{k}={v}"
+                     for k, v in sorted(storm["shed_reasons"].items()))
+           or "none",
+           ref["completed"], ref["offered"])
+    )
+    print(
+        "  p99 TTFT: storm %.2fms vs reference %.2fms (inflation "
+        "%.2fx, bound %.1fx)"
+        % (storm["ttft_ms"]["p99"], ref["ttft_ms"]["p99"],
+           art["p99_ttft_inflation"], args.max_p99_inflation)
+    )
+    print(
+        "  recovery: rebuilds=%d retries=%d readmitted=%d timeouts=%d "
+        "clamped=%d; pages: in_use=%d leak_checks=%d"
+        % (art["engine"]["rebuilds"],
+           art["registry"].get("serve/retries", 0),
+           art["registry"].get("serve/readmitted", 0),
+           art["registry"].get("serve/decode_timeouts", 0),
+           art["registry"].get("serve/clamped", 0),
+           art["pages"]["pool_in_use_end"],
+           art["pages"]["leak_checks_run"])
+    )
+    probe = art["overload_probe"]
+    print(
+        "  ladder probe: burst=%d cap=%d -> queue_full=%d clamped=%d "
+        "completed=%d"
+        % (probe["burst"], probe["queue_cap"], probe["queue_full"],
+           probe["clamped"], probe["completed"])
+    )
+    print(
+        "  drain: %s (shed_draining=%d)"
+        % (art["drain"]["statuses"], art["drain"]["shed_draining"])
+    )
+
+    failures = []
+    t = art["terminals"]
+    if not t["accounted"]:
+        failures.append(
+            f"unaccounted terminals: {t['completed']}+{t['shed']} != "
+            f"{t['offered']}"
+        )
+    if t["open_spans"]:
+        failures.append(f"{t['open_spans']} request span chains left open")
+    if storm["unterminated"]:
+        failures.append(f"unterminated requests: {storm['unterminated']}")
+    if art["pages"]["pool_in_use_end"] != 0:
+        failures.append(
+            f"leaked pages: pool in_use={art['pages']['pool_in_use_end']}"
+        )
+    infl = art["p99_ttft_inflation"]
+    if not (infl == infl and infl <= args.max_p99_inflation):
+        failures.append(
+            f"p99 TTFT inflation {infl:.2f}x over the "
+            f"{args.max_p99_inflation:.1f}x bound"
+        )
+    if args.chaos == DEFAULT_CHAOS_SPEC:
+        reg = art["registry"]
+        pins = {
+            "serve/engine_faults": DEFAULT_EXPECTED["engine_faults"],
+            "serve/engine_rebuilds": DEFAULT_EXPECTED["engine_rebuilds"],
+            "serve/shed_poisoned": DEFAULT_EXPECTED["poisoned"],
+            "serve/admission_faults": DEFAULT_EXPECTED["admission_faults"],
+            "serve/kv_alloc_faults": DEFAULT_EXPECTED["kv_alloc_faults"],
+        }
+        for key, want in pins.items():
+            if reg.get(key, 0) != want:
+                failures.append(
+                    f"{key}={reg.get(key, 0)} != injected {want} — a "
+                    "fault fired without its ledger entry (or never "
+                    "fired at all)"
+                )
+        if art["registry"].get("serve/retries", 0) < 1:
+            failures.append("no re-admission retries under the storm")
+    want_rejects = probe["burst"] - probe["queue_cap"]
+    if probe["queue_full"] != want_rejects:
+        failures.append(
+            f"backpressure rung: {probe['queue_full']} queue_full "
+            f"rejects != the over-cap excess {want_rejects}"
+        )
+    if probe["clamped"] < 2:
+        failures.append(
+            f"clamp rung: only {probe['clamped']} admissions clamped "
+            "under a backed-up queue"
+        )
+    if probe["unterminated"]:
+        failures.append(
+            f"overload probe left unterminated requests: "
+            f"{probe['unterminated']}"
+        )
+    if not art["drain"]["drained"] or art["drain"]["pool_in_use"] != 0:
+        failures.append(f"drain not clean: {art['drain']}")
+    if (
+        art["config"]["drain_requests"] > art["config"]["batch"]
+        and art["drain"]["shed_draining"] == 0
+    ):
+        failures.append("drain shed no queued request as 'draining'")
+
+    for msg in failures:
+        print(f"SERVE CHAOS DRILL FAIL: {msg}", file=sys.stderr)
+    if not failures:
+        print("serve chaos drill: PASS")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

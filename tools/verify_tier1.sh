@@ -107,6 +107,28 @@
 # with --memstats-fake-scale 2.0 (a planted static-vs-live drift) is
 # FLAGGED with a finding naming the governing program.
 #
+# A SERVE-CHAOS stage proves the serving resilience layer end to end
+# (docs/serving.md "Failure semantics & degradation ladder", ISSUE 14):
+# tools/serve_chaos_drill.py runs a fault-free Poisson reference, then
+# the same load under an APEX_TPU_CHAOS-grammar storm firing all four
+# serving chaos sites (serve.prefill raise, serve.decode raise+nan,
+# serve.admission raise, serve.kv_alloc fail), then a deterministic
+# overload-ladder probe (queue-cap fast-reject + max-new-tokens clamp)
+# and a graceful drain.  The drill hard-fails unless: zero process
+# deaths (it finishing IS the proof), PagePool.leak_check clean after
+# every fault with the pool exactly empty at the end, every request in
+# exactly one accounted terminal state, p99 TTFT <= 2x the fault-free
+# reference, every injected fault visible on its ledger counter
+# (engine_faults/rebuilds, shed_poisoned, admission/kv_alloc faults),
+# the ladder rejecting exactly the over-cap burst excess, and the
+# drain report clean.  The gate then re-proves chain completeness from
+# the span dump via tools/timeline.py --json and re-asserts the
+# headline numbers from the artifact.  The artifact is handed to the
+# PERF stage (APEX_TPU_SERVE_CHAOS_ARTIFACT) so bench.py --config
+# serve emits its serve_chaos_* golden rows from the SAME storm
+# instead of paying a second one — which is why SERVE-CHAOS runs
+# before PERF.
+#
 # A GOODPUT stage proves the preemptible-fleet I/O plane end to end
 # (ISSUE 13, docs/goodput.md): tools/goodput_drill.py runs the
 # resilient example's real programs through an APEX_TPU_CHAOS-style
@@ -122,7 +144,7 @@
 # silently.
 #
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + train + goodput + perf + serve + ops
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + train + goodput + serve-chaos + perf + serve + ops
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -137,6 +159,7 @@
 #   T1_SKIP_SERVE=1             skip the serving pass
 #   T1_SKIP_OPS=1               skip the live-ops-plane pass
 #   T1_SKIP_GOODPUT=1           skip the goodput storm-drill pass
+#   T1_SKIP_SERVECHAOS=1        skip the serving chaos-drill pass
 
 set -o pipefail
 
@@ -503,6 +526,75 @@ PYEOF
     fi
 fi
 
+servechaos_rc=0
+if [ "${T1_SKIP_SERVECHAOS:-0}" != "1" ]; then
+    SC_JSON="$(mktemp /tmp/_t1_servechaos.XXXXXX.json)"
+    SC_SPANS="$(mktemp /tmp/_t1_servechaos_spans.XXXXXX.json)"
+    SC_TRACE="$(mktemp /tmp/_t1_servechaos_trace.XXXXXX.json)"
+    # the drill hard-fails on its own acceptance set (deaths, leaks,
+    # terminals, p99 bound, ledger pins, ladder, drain) — see the
+    # header comment
+    timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+        python tools/serve_chaos_drill.py \
+        --json "$SC_JSON" --spans "$SC_SPANS" \
+        2>&1 | tail -n 7 | tee -a "$LOG"
+    servechaos_rc=${PIPESTATUS[0]}
+    if [ "$servechaos_rc" -eq 0 ]; then
+        # chain completeness re-proven from the span dump: every storm
+        # + probe + drain request walked
+        # queued -> ... [retrying ...] -> exactly one terminal
+        timeout -k 10 120 env JAX_PLATFORMS=cpu \
+            python tools/timeline.py --spans "$SC_SPANS" \
+            --out "$SC_TRACE" --json 2>&1 | tee -a "$LOG"
+        servechaos_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$servechaos_rc" -eq 0 ]; then
+        python - "$SC_JSON" "$SC_SPANS" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+a = json.load(open(sys.argv[1]))
+spans = json.load(open(sys.argv[2]))
+assert a["process_deaths"] == 0
+assert len(a["chaos_sites"]) == 4, a["chaos_sites"]  # all four serve sites
+t = a["terminals"]
+assert t["accounted"] and t["completed"] + t["shed"] == t["offered"], t
+assert t["open_spans"] == 0, t
+p = a["pages"]
+assert p["pool_in_use_end"] == 0, p
+assert p["leak_checks_run"] > 0, p
+infl = a["p99_ttft_inflation"]
+assert infl == infl and infl <= 2.0, f"p99 inflation {infl}"
+assert a["engine"]["rebuilds"] >= 1, a["engine"]
+reg = a["registry"]
+assert reg.get("serve/shed_poisoned", 0) >= 1, "quarantine never fired"
+assert reg.get("serve/retries", 0) >= 1, "no re-admission retries"
+probe = a["overload_probe"]
+assert probe["queue_full"] == probe["burst"] - probe["queue_cap"], probe
+assert probe["clamped"] >= 2, probe
+d = a["drain"]
+assert d["drained"] and d["pool_in_use"] == 0 and d["shed_draining"] >= 1, d
+# the retrying recovery phase is ON the span record, not just counted
+names = {e["name"] for e in spans["spans"]}
+assert "req/retrying" in names, sorted(names)
+assert "req/clamped" in names, sorted(names)
+print(f"SERVE-CHAOS artifact OK: {t['completed']}/{t['offered']} "
+      f"terminal-accounted, p99 inflation {infl:.2f}x (<=2x), "
+      f"{a['engine']['rebuilds']} rebuild(s), "
+      f"{reg.get('serve/shed_poisoned', 0):.0f} quarantined, "
+      f"{p['leak_checks_run']} leak checks clean")
+PYEOF
+        servechaos_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$servechaos_rc" -eq 0 ]; then
+        # keep SC_JSON: the PERF stage's bench --config serve reuses it
+        # (APEX_TPU_SERVE_CHAOS_ARTIFACT) instead of a second storm
+        rm -f "$SC_SPANS" "$SC_TRACE"
+        echo "TIER1-SERVECHAOS: PASS"
+    else
+        echo "TIER1-SERVECHAOS: FAIL (rc=$servechaos_rc; artifacts at" \
+            "$SC_JSON $SC_SPANS $SC_TRACE)"
+    fi
+fi
+
 perf_rc=0
 if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
     # 1a. the flatline catch: r03 vs r05 sat at 43 TFLOP/s — the gate
@@ -532,11 +624,22 @@ if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
             2>&1 | tail -n 2 | tee -a "$LOG"
         perf_rc=${PIPESTATUS[0]}
         if [ "$perf_rc" -eq 0 ]; then
+            # the serve config's serve_chaos_* rows reuse the
+            # SERVE-CHAOS stage's evidence artifact (one storm per CI
+            # pass); with the stage skipped or failed the bench runs
+            # its own drill
+            SC_REUSE=""
+            if [ "${T1_SKIP_SERVECHAOS:-0}" != "1" ] \
+                && [ "$servechaos_rc" -eq 0 ] && [ -s "${SC_JSON:-}" ]; then
+                SC_REUSE="$SC_JSON"
+            fi
             timeout -k 10 300 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
                 APEX_TPU_BENCH_WATCHDOG_S=0 \
+                APEX_TPU_SERVE_CHAOS_ARTIFACT="$SC_REUSE" \
                 python bench.py --config serve --metrics-out "$PERF_OUT" \
                 2>&1 | tail -n 2 | tee -a "$LOG"
             perf_rc=${PIPESTATUS[0]}
+            [ -n "$SC_REUSE" ] && rm -f "$SC_REUSE"
         fi
         # the trainer's honest multi-device rows (ISSUE 12): built on
         # the MOCKED 8-device mesh with --lint, so the golden stream
@@ -847,10 +950,10 @@ if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
     && [ "$flight_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] \
     && [ "$train_rc" -eq 0 ] && [ "$perf_rc" -eq 0 ] \
     && [ "$serve_rc" -eq 0 ] && [ "$ops_rc" -eq 0 ] \
-    && [ "$goodput_rc" -eq 0 ]; then
+    && [ "$goodput_rc" -eq 0 ] && [ "$servechaos_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, train rc=$train_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc, goodput rc=$goodput_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, train rc=$train_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc, goodput rc=$goodput_rc, serve-chaos rc=$servechaos_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
@@ -861,4 +964,5 @@ fi
 [ "$perf_rc" -ne 0 ] && exit "$perf_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
 [ "$ops_rc" -ne 0 ] && exit "$ops_rc"
-exit "$goodput_rc"
+[ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
+exit "$servechaos_rc"
