@@ -1,0 +1,34 @@
+"""apex_tpu.fleetctl — the fleet control plane (docs/serving.md).
+
+Multi-replica serving that survives replica death, preemption storms,
+and rolling deploys: in-process :class:`EngineReplica`\\ s (each its
+own engine/scheduler/pool/registry) behind one :class:`Router`, with
+burn-rate :class:`Autoscaler` capacity control and a deterministic
+:class:`Fleet` tick loop drillable on a virtual clock
+(``tools/fleet_drill.py``).
+"""
+
+from apex_tpu.fleetctl.autoscale import Autoscaler, AutoscalerConfig
+from apex_tpu.fleetctl.fleet import Fleet, declare_fleet_metrics
+from apex_tpu.fleetctl.replica import (
+    DEAD,
+    DRAINING,
+    EJECTED,
+    LIVE,
+    EngineReplica,
+)
+from apex_tpu.fleetctl.router import Router, aggregate_expositions
+
+__all__ = [
+    "LIVE",
+    "DRAINING",
+    "EJECTED",
+    "DEAD",
+    "EngineReplica",
+    "Router",
+    "aggregate_expositions",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "Fleet",
+    "declare_fleet_metrics",
+]

@@ -1,0 +1,528 @@
+"""The fleet control plane: N replicas, one door, failure as input.
+
+:class:`Fleet` composes the pieces — :class:`~apex_tpu.fleetctl.
+replica.EngineReplica` (engine + scheduler + own pool/registry),
+:class:`~apex_tpu.fleetctl.router.Router` (least-loaded dispatch +
+re-routing), :class:`~apex_tpu.fleetctl.autoscale.Autoscaler`
+(burn-rate capacity control) — into one deterministic tick loop
+(:meth:`Fleet.step`), drillable on a virtual clock:
+
+1. chaos: the ``fleet.replica_crash`` / ``fleet.preempt`` sites fire
+   against the tick index — a crash evacuates the victim NOW (running
+   work through the shared retry budget, queue re-routed with pages
+   dropped and prompts kept), a preempt notice starts a graceful
+   drain (running work finishes over the grace ticks, never-admitted
+   work re-routes immediately);
+2. the rolling-update state machine advances (drain one replica at a
+   time — never the last live one — rebuild with the new weights
+   through the supervised path, re-admit);
+3. the router dispatches the door (``fleet.router`` chaos can fault a
+   whole tick — requests wait);
+4. every live/draining replica takes one scheduler iteration; drains
+   that emptied are sealed (pool re-proven empty) and dispatched on
+   their reason (preempt/scale-in → dead, deploy → redeploy);
+5. health: a replica whose progress counter froze for ``hung_ticks``
+   with work pending is EJECTED (evacuated, re-routable later via
+   :meth:`rejoin`); an optional per-replica goodput burn page ejects
+   the same way;
+6. the autoscaler evaluates; executed decisions spawn or drain-retire
+   a replica and land as ``fleet_scale_out``/``fleet_scale_in``
+   health instants on the shared span timeline.
+
+Fleet **goodput** is accounted across churn: a request counts exactly
+once fleet-wide (``completed`` on whichever replica finished it, a
+terminal ``shed`` wherever it truly ended) — re-routes are ledgered
+per-replica as ``shed(rerouted)`` but are NOT terminals.  See
+docs/serving.md ("Fleet operations").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from apex_tpu.observability.health import HealthEvent
+from apex_tpu.observability.metrics import MetricRegistry
+from apex_tpu.observability.slo import BurnRateTracker
+from apex_tpu.resilience import chaos
+from apex_tpu.serve.scheduler import Request
+
+from apex_tpu.fleetctl.replica import (
+    DEAD,
+    DRAINING,
+    EJECTED,
+    LIVE,
+    EngineReplica,
+)
+from apex_tpu.fleetctl.router import Router, aggregate_expositions
+
+__all__ = ["declare_fleet_metrics", "Fleet"]
+
+
+def declare_fleet_metrics(registry) -> None:
+    """Declare the fleet ledger on a registry (idempotent)."""
+    for c in ("fleet/submitted", "fleet/routed", "fleet/rerouted",
+              "fleet/router_faults", "fleet/replica_crashes",
+              "fleet/preempts", "fleet/ejections", "fleet/rejoins",
+              "fleet/scale_out", "fleet/scale_in", "fleet/deploys",
+              "fleet/spawned"):
+        registry.counter(c)
+    for g in ("fleet/replicas_live", "fleet/door_depth"):
+        registry.gauge(g)
+
+
+class Fleet:
+    """N in-process replicas behind one router, one tick at a time.
+
+    ``replica_factory(name)`` builds a fresh :class:`EngineReplica`
+    (its own engine, pool, registry) wired to the SHARED fleet clock
+    and span recorder — that wiring is the factory's contract; the
+    fleet only names and owns the result.
+    """
+
+    def __init__(self, replica_factory: Callable[[str], EngineReplica],
+                 *, replicas: int = 2, clock=time.monotonic, spans=None,
+                 autoscaler=None, registry: Optional[MetricRegistry] = None,
+                 hung_ticks: int = 200,
+                 eject_burn_factor: Optional[float] = None,
+                 eject_burn_window_s: float = 2.0,
+                 eject_objective: float = 0.8):
+        self.clock = clock
+        self.spans = spans
+        self.registry = (
+            registry if registry is not None
+            else MetricRegistry(fetch_every=1)
+        )
+        declare_fleet_metrics(self.registry)
+        self._mstate = self.registry.init()
+        self.router = Router(clock=clock, spans=spans, count=self._count)
+        self.replica_factory = replica_factory
+        self.replicas: List[EngineReplica] = []
+        self._next_id = 0
+        self.tick = 0
+        self.autoscaler = autoscaler
+        self.hung_ticks = int(hung_ticks)
+        self._progress: Dict[str, tuple] = {}  # name -> (tick, counter)
+        self.eject_burn_factor = eject_burn_factor
+        self._eject_trackers: Dict[str, BurnRateTracker] = {}
+        self._eject_burn_window_s = float(eject_burn_window_s)
+        self._eject_objective = float(eject_objective)
+        #: the in-progress rolling update, or None
+        self.deploy: Optional[Dict[str, object]] = None
+        #: completed rolling updates, newest last
+        self.deploy_history: List[Dict[str, object]] = []
+        self.health_events: List[HealthEvent] = []
+        for _ in range(int(replicas)):
+            self._spawn()
+
+    # -- plumbing ----------------------------------------------------------
+    def _count(self, name: str, n: float = 1.0) -> None:
+        self._mstate = self.registry.update(self._mstate, {name: n})
+
+    def _gauge(self, name: str, value: float) -> None:
+        self._mstate = self.registry.update(
+            self._mstate, {name: float(value)}
+        )
+
+    def _note(self, event: HealthEvent) -> None:
+        self.health_events.append(event)
+        if self.spans is not None:
+            self.spans.note_health(event)
+
+    def _spawn(self) -> EngineReplica:
+        name = f"r{self._next_id}"
+        self._next_id += 1
+        rep = self.replica_factory(name)
+        rep.name = name
+        self.replicas.append(rep)
+        self._count("fleet/spawned")
+        if self.deploy is not None:
+            # born mid-deploy: the factory built it with the OLD
+            # weights — swap in the deploy's params before it takes
+            # any traffic, or the "rolling update complete" claim
+            # would be false for the newest replica
+            rep.redeploy(self.deploy["params"])
+            self.deploy["updated"].append(name)
+        if self.eject_burn_factor is not None:
+            self._eject_trackers[name] = BurnRateTracker(
+                self._eject_objective, self._eject_burn_window_s,
+            )
+        return rep
+
+    def replica(self, name: str) -> EngineReplica:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica named {name!r}")
+
+    @property
+    def live(self) -> List[EngineReplica]:
+        return [r for r in self.replicas if r.state == LIVE]
+
+    @property
+    def pending(self) -> bool:
+        """Work anywhere in the fleet: at the door, on a live or
+        draining replica, or a rolling update still in progress."""
+        if self.door_depth:
+            return True
+        if self.deploy is not None:
+            return True
+        return any(
+            r.sched.pending for r in self.replicas
+            if r.state in (LIVE, DRAINING)
+        )
+
+    @property
+    def door_depth(self) -> int:
+        return len(self.router.door)
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, req: Request) -> Request:
+        return self.router.submit(req)
+
+    # -- failure handling --------------------------------------------------
+    def _evacuate_to_router(self, rep: EngineReplica, cause: str) -> int:
+        moved = 0
+        for req in rep.evacuate(cause):
+            self.router.reroute(req)
+            moved += 1
+        return moved
+
+    def crash(self, rep: EngineReplica, cause: str = "replica_crash") -> int:
+        """Kill a replica NOW (the ``fleet.replica_crash`` path): its
+        work evacuates through the shared retry budget and the replica
+        is dead.  Returns how many requests moved to the router."""
+        self._count("fleet/replica_crashes")
+        moved = self._evacuate_to_router(rep, cause)
+        rep.state = DEAD
+        self._note(HealthEvent(
+            "fleet_replica_crash", "critical", self.tick, float(moved),
+            0.0,
+            f"replica {rep.name} crashed ({cause}); {moved} requests "
+            f"re-routed, {len(self.live)} replicas live",
+        ))
+        return moved
+
+    def preempt(self, rep: EngineReplica) -> None:
+        """Deliver a preempt notice (the ``fleet.preempt`` path): the
+        replica drains gracefully — never-admitted work re-routes NOW,
+        running work finishes over the following ticks (the grace
+        period) — then leaves the fleet."""
+        self._count("fleet/preempts")
+        rerouted = rep.begin_drain(self.router.reroute, reason="preempt")
+        self._note(HealthEvent(
+            "fleet_preempt", "warn", self.tick, float(rerouted), 0.0,
+            f"replica {rep.name} preempted: draining, {rerouted} "
+            f"queued requests re-routed",
+        ))
+
+    def eject(self, rep: EngineReplica, cause: str) -> int:
+        """Health-based ejection (burn-rate page, hung iteration):
+        evacuate like a crash, but keep the replica for a possible
+        :meth:`rejoin` once the operator (or a drill) clears it."""
+        self._count("fleet/ejections")
+        moved = self._evacuate_to_router(rep, cause)
+        rep.state = EJECTED
+        self._note(HealthEvent(
+            "fleet_eject", "critical", self.tick, float(moved), 0.0,
+            f"replica {rep.name} ejected ({cause}); {moved} requests "
+            f"re-routed",
+        ))
+        return moved
+
+    def rejoin(self, rep: EngineReplica) -> None:
+        """Re-admit an ejected replica to the routing set."""
+        if rep.state != EJECTED:
+            raise RuntimeError(
+                f"replica {rep.name} cannot rejoin from {rep.state!r}"
+            )
+        self._count("fleet/rejoins")
+        rep.state = LIVE
+        rep.end_cause = None
+        self._progress.pop(rep.name, None)
+        self._note(HealthEvent(
+            "fleet_rejoin", "info", self.tick, 0.0, 0.0,
+            f"replica {rep.name} rejoined the fleet",
+        ))
+
+    # -- health detection --------------------------------------------------
+    def _check_hung(self, rep: EngineReplica) -> bool:
+        """A live replica with pending work whose progress counter has
+        not moved for ``hung_ticks`` is wedged — eject it."""
+        if not rep.sched.pending:
+            self._progress.pop(rep.name, None)
+            return False
+        seen = self._progress.get(rep.name)
+        now = rep.progress
+        if seen is None or seen[1] != now:
+            self._progress[rep.name] = (self.tick, now)
+            return False
+        if self.tick - seen[0] >= self.hung_ticks:
+            self.eject(rep, "hung")
+            return True
+        return False
+
+    def _check_burn(self, rep: EngineReplica) -> bool:
+        """Optional per-replica goodput burn page → ejection."""
+        if self.eject_burn_factor is None:
+            return False
+        tracker = self._eject_trackers.get(rep.name)
+        if tracker is None:
+            return False
+        good, total = rep.goodput_counts()
+        if total <= 0:
+            return False
+        now = self.clock()
+        tracker.observe(good, total, now)
+        burn = tracker.burn_rate(self._eject_burn_window_s / 2.0, now)
+        if burn is not None and burn >= self.eject_burn_factor:
+            self.eject(rep, f"burn_rate:{burn:.1f}x")
+            return True
+        return False
+
+    # -- rolling update ----------------------------------------------------
+    def start_rolling_update(self, params) -> None:
+        """Begin a zero-downtime deploy of ``params``: replicas drain
+        ONE AT A TIME (never the last live one — the fleet keeps
+        serving throughout), rebuild through the supervised path, and
+        re-admit.  Advanced by :meth:`step`; done when
+        :attr:`deploy` is None again."""
+        if self.deploy is not None:
+            raise RuntimeError("a rolling update is already in progress")
+        self.deploy = {
+            "params": params,
+            "remaining": [r.name for r in self.live],
+            "current": None,
+            "updated": [],
+            "started_tick": self.tick,
+            "draining_shed_before": self.shed_count("draining"),
+        }
+
+    def _advance_deploy(self) -> None:
+        d = self.deploy
+        if d is None:
+            return
+        if d["current"] is not None:
+            return  # the per-replica drain completes in the step loop
+        while d["remaining"]:
+            name = d["remaining"][0]
+            rep = self.replica(name)
+            if rep.state != LIVE:
+                # crashed/preempted away mid-deploy: nothing to update
+                d["remaining"].pop(0)
+                continue
+            if len(self.live) <= 1 and (
+                rep.sched.pending or self.door_depth
+            ):
+                # zero-downtime invariant: never drain the LAST live
+                # replica out from under traffic — wait for a
+                # scale-out (still allowed mid-deploy) or for the
+                # traffic to clear.  A lone IDLE replica with an empty
+                # door swaps instantly instead: the drain seals and
+                # redeploys on this same tick, before any request can
+                # be routed at it.
+                return
+            d["remaining"].pop(0)
+            d["current"] = name
+            rep.begin_drain(self.router.reroute, reason="deploy")
+            return
+        # everything updated — seal the deploy
+        d["finished_tick"] = self.tick
+        d["draining_shed_after"] = self.shed_count("draining")
+        d["lost_requests"] = (
+            d["draining_shed_after"] - d["draining_shed_before"]
+        )
+        del d["params"]
+        self.deploy_history.append(d)
+        self.deploy = None
+        self._count("fleet/deploys")
+        self._note(HealthEvent(
+            "fleet_deploy", "info", self.tick, float(d["lost_requests"]),
+            0.0,
+            f"rolling update complete: {len(d['updated'])} replicas "
+            f"over ticks {d['started_tick']}..{d['finished_tick']}, "
+            f"{d['lost_requests']} requests lost to draining",
+        ))
+
+    def _seal_drain(self, rep: EngineReplica) -> None:
+        report = rep.finish_drain()
+        reason = rep.drain_reason
+        d = self.deploy
+        if reason == "deploy" and d is not None and d["current"] == rep.name:
+            rep.redeploy(d["params"])
+            d["updated"].append(rep.name)
+            d["current"] = None
+        else:
+            rep.state = DEAD
+            rep.end_cause = reason
+        assert report["pool_in_use"] == 0
+
+    # -- scaling -----------------------------------------------------------
+    def _scale_out(self, event: HealthEvent) -> EngineReplica:
+        self._count("fleet/scale_out")
+        rep = self._spawn()
+        self._note(event)
+        return rep
+
+    def _scale_in(self, event: HealthEvent) -> Optional[EngineReplica]:
+        candidates = self.live
+        if len(candidates) <= 1:
+            return None
+        # retire the least-loaded live replica (fewest requests to
+        # migrate), name as the deterministic tie-break
+        victim = min(candidates, key=lambda r: (r.depth, r.name))
+        self._count("fleet/scale_in")
+        victim.begin_drain(self.router.reroute, reason="scale_in")
+        self._note(event)
+        return victim
+
+    # -- the tick ----------------------------------------------------------
+    def step(self) -> None:
+        """One fleet tick (see the module docstring for the order)."""
+        tick = self.tick
+        # 1. chaos: crash / preempt against the tick index.  Victims
+        # are deterministic: the first live replica (crash) and the
+        # last (preempt) — distinct under storm specs that fire both.
+        live = self.live
+        if live and chaos.active(chaos.FLEET_REPLICA_CRASH, tick):
+            self.crash(live[0])
+        live = self.live
+        if live and chaos.active(chaos.FLEET_PREEMPT, tick):
+            self.preempt(live[-1])
+        # 2. rolling update state machine
+        self._advance_deploy()
+        # 3. route the door
+        self.router.dispatch(self.replicas, tick)
+        # 4. one scheduler iteration per active replica; seal finished
+        # drains
+        for rep in list(self.replicas):
+            if rep.state not in (LIVE, DRAINING):
+                continue
+            if rep.sched.pending:
+                rep.step()
+            if rep.state == DRAINING and not rep.sched.pending:
+                self._seal_drain(rep)
+        # 5. health: hung / burning replicas are ejected
+        for rep in self.live:
+            if not self._check_hung(rep):
+                self._check_burn(rep)
+        # 6. autoscale.  Scale-OUT stays armed during a rolling update
+        # (a deploy under pressure needs MORE capacity — and the
+        # zero-downtime guard in _advance_deploy may be waiting on
+        # exactly that); scale-in is suppressed until the deploy
+        # seals, so capacity only ratchets up mid-deploy.
+        if self.autoscaler is not None:
+            if not self.live and self.door_depth:
+                # total outage with traffic at the door: the burn-rate
+                # SLI has no live replica to sample, so the normal
+                # evaluation path can never fire — bootstrap capacity
+                # directly (one replica per tick until one is live)
+                self._scale_out(HealthEvent(
+                    "fleet_scale_out", "critical", tick,
+                    float(self.door_depth), 0.0,
+                    f"no live replicas with {self.door_depth} requests "
+                    f"at the door — emergency scale-out",
+                ))
+            else:
+                event = self.autoscaler.evaluate(self.live, tick)
+                if event is not None:
+                    if event.rule == "fleet_scale_out":
+                        self._scale_out(event)
+                    elif self.deploy is None:
+                        self._scale_in(event)
+        self._gauge("fleet/replicas_live", len(self.live))
+        self._gauge("fleet/door_depth", self.door_depth)
+        self.registry.observe(tick, self._mstate)
+        self.tick += 1
+
+    # -- accounting --------------------------------------------------------
+    def shed_count(self, reason: Optional[str] = None) -> int:
+        """Terminal sheds across EVERY replica ever in the fleet
+        (dead ones keep their ledger), optionally for one reason."""
+        n = 0
+        for rep in self.replicas:
+            for req in rep.sched.shed:
+                if reason is None or req.shed_reason == reason:
+                    n += 1
+        return n
+
+    def completed_count(self) -> int:
+        return sum(len(rep.sched.completed) for rep in self.replicas)
+
+    def goodput(self) -> Dict[str, object]:
+        """Fleet goodput across churn: every request exactly one
+        fleet-wide terminal, re-routes excluded (they are hops, not
+        outcomes)."""
+        completed = self.completed_count()
+        shed = self.shed_count()
+        in_flight = self.door_depth + sum(
+            r.depth for r in self.replicas if r.state in (LIVE, DRAINING)
+        )
+        submitted = completed + shed + in_flight
+        return {
+            "completed": completed,
+            "shed_terminal": shed,
+            "in_flight": in_flight,
+            "accounted": submitted,
+            "goodput": completed / submitted if submitted else None,
+        }
+
+    def leak_check(self) -> Dict[str, int]:
+        """Re-prove every replica's page accounting (live, draining,
+        ejected AND dead — an evacuated pool must be exactly empty)."""
+        in_use = {}
+        for rep in self.replicas:
+            rep.sched.leak_check()
+            in_use[rep.name] = rep.sched.pool.in_use
+        return in_use
+
+    def aggregate_values(self) -> Dict[str, float]:
+        """Fleet-wide counter view: every replica registry fetched and
+        its ``serve/*`` counters summed — the value source for
+        :func:`~apex_tpu.observability.slo.fleet_slo_rules`."""
+        out: Dict[str, float] = {}
+        for rep in self.replicas:
+            reg = rep.registry
+            if reg is None:
+                continue
+            reg.fetch()
+            for key, value in reg.values().items():
+                if key.startswith("serve/") and reg.kind(key) == "counter":
+                    out[key] = out.get(key, 0.0) + float(value)
+        return out
+
+    def aggregate_scrapes(self) -> Dict[str, object]:
+        """The router-side scrape fold: every replica with a running
+        :class:`~apex_tpu.observability.ometrics.OpsServer` is scraped
+        in-process and the expositions aggregate (counters sum)."""
+        texts = [
+            rep.ops.scrape() for rep in self.replicas
+            if rep.ops is not None
+        ]
+        return aggregate_expositions(texts)
+
+    def summary(self) -> Dict[str, object]:
+        """The drill/ops snapshot."""
+        return {
+            "tick": self.tick,
+            "replicas": [
+                {
+                    "name": r.name,
+                    "state": r.state,
+                    "end_cause": r.end_cause,
+                    "completed": len(r.sched.completed),
+                    "shed": len(r.sched.shed),
+                    "pool_in_use": r.sched.pool.in_use,
+                    "rebuilds": r.engine.rebuilds,
+                }
+                for r in self.replicas
+            ],
+            "door_depth": self.door_depth,
+            "goodput": self.goodput(),
+            "deploys": list(self.deploy_history),
+            "autoscaler_decisions": (
+                [e.rule for e in self.autoscaler.decisions]
+                if self.autoscaler is not None else []
+            ),
+            "health_events": [e.rule for e in self.health_events],
+        }

@@ -161,6 +161,7 @@ from apex_tpu.observability.slo import (  # noqa: F401
     LatencySLO,
     SLORule,
     Window,
+    fleet_slo_rules,
     serve_slo_rules,
 )
 from apex_tpu.observability.memstats import (  # noqa: F401
@@ -219,6 +220,7 @@ __all__ = [
     "SLORule",
     "Window",
     "serve_slo_rules",
+    "fleet_slo_rules",
     "MemStatsMonitor",
     "MemStatsRule",
     "DeviceMemoryProvider",

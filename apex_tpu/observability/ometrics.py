@@ -618,6 +618,14 @@ class OpsServer:
     ``2 × fetch_every`` contract.  Scrape count and duration publish to
     the board (``ops/scrapes``, ``ops/scrape_ms``) so the exporter
     observes itself.
+
+    ``port=0`` binds an OS-assigned ephemeral port; :attr:`bound_port`
+    (and the updated :attr:`port` / :attr:`url`) expose it after
+    :meth:`start` — how N fleet replicas in ONE process each export
+    ``/metrics`` without a port collision.  ``name=`` namespaces the
+    self-observation board keys (``ops/<name>/scrapes``, ...): without
+    it, N servers in one process would silently overwrite each other's
+    gauges on the shared board.
     """
 
     def __init__(
@@ -629,6 +637,7 @@ class OpsServer:
         collect=None,
         host: str = "127.0.0.1",
         port: int = 0,
+        name: Optional[str] = None,
     ):
         self.registries = list(registries)
         self.histograms = list(histograms)
@@ -636,10 +645,24 @@ class OpsServer:
         self.collect = collect
         self.host = host
         self.port = int(port)
+        self.name = name
         self.scrapes = 0
         self.last_scrape_ms: Optional[float] = None
         self._server = None
         self._thread = None
+
+    def _board_key(self, leaf: str) -> str:
+        return (
+            f"ops/{self.name}/{leaf}" if self.name else f"ops/{leaf}"
+        )
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        """The OS-assigned port after :meth:`start` (None before — a
+        requested ``port=0`` is a *wish*, not an address)."""
+        if self._server is None:
+            return None
+        return self._server.server_address[1]
 
     @classmethod
     def from_env(cls, spec: Optional[str] = None, **kwargs):
@@ -676,8 +699,8 @@ class OpsServer:
         if self.include_board:
             from apex_tpu.observability.metrics import board
 
-            board.set("ops/scrapes", self.scrapes)
-            board.set("ops/scrape_ms", self.last_scrape_ms)
+            board.set(self._board_key("scrapes"), self.scrapes)
+            board.set(self._board_key("scrape_ms"), self.last_scrape_ms)
         return text
 
     @property
@@ -720,7 +743,7 @@ class OpsServer:
         self._thread.start()
         from apex_tpu.observability.metrics import board
 
-        board.set("ops/port", self.port)
+        board.set(self._board_key("port"), self.port)
         return self
 
     def stop(self) -> None:

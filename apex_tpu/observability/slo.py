@@ -68,6 +68,8 @@ __all__ = [
     "BurnRateTracker",
     "SLORule",
     "serve_slo_rules",
+    "FLEET_TERMINAL_SHED_KEYS",
+    "fleet_slo_rules",
     "burn_rate_drill",
 ]
 
@@ -370,6 +372,95 @@ def serve_slo_rules(
             description="requests not shed for a blown TTFT deadline",
         ),
         windows, cooldown=cooldown, clock=clock,
+    ))
+    return rules
+
+
+#: the TERMINAL shed ledger keys — every ``serve/shed_<reason>``
+#: counter EXCEPT ``rerouted``, which is a hop (the request continues
+#: on another replica), not an outcome.  Deliberately a literal:
+#: ``tests/test_fleetctl.py`` pins it against
+#: ``apex_tpu.serve.scheduler.SHED_REASONS`` so a new shed reason
+#: cannot silently leak out of (or into) the fleet SLO denominators.
+FLEET_TERMINAL_SHED_KEYS = (
+    "serve/shed_deadline",
+    "serve/shed_growth_victim",
+    "serve/shed_pool_exhausted",
+    "serve/shed_oversize",
+    "serve/shed_poisoned",
+    "serve/shed_queue_full",
+    "serve/shed_retries_exhausted",
+    "serve/shed_draining",
+)
+
+
+def fleet_slo_rules(
+    *,
+    ttft_histogram=None,
+    ttft_threshold_ms: Optional[float] = None,
+    ttft_objective: float = 0.9,
+    goodput_objective: float = 0.95,
+    deploy_loss_objective: float = 0.999,
+    windows: Iterable[Window] = DEFAULT_WINDOWS,
+    cooldown: int = 64,
+    values_fn=None,
+    clock=time.monotonic,
+) -> List[SLORule]:
+    """The FLEET-level SLO set (docs/serving.md "Fleet operations"),
+    evaluated over counters aggregated ACROSS replicas (``values_fn``
+    is typically ``Fleet.aggregate_values`` — per-replica registries
+    fetched and their ``serve/*`` counters summed).
+
+    The per-replica ``serve_slo_rules`` denominators use the rolled-up
+    ``serve/shed`` counter; at fleet level that would be a LIE — a
+    re-routed request appears as ``shed(rerouted)`` on its source
+    replica while completing on its destination, so the fleet rules
+    sum the terminal reasons explicitly
+    (:data:`FLEET_TERMINAL_SHED_KEYS`):
+
+    - ``fleet_ttft`` — end-to-end TTFT (original ``submitted_at``
+      preserved across re-routes) under threshold, from the fleet-wide
+      histogram when one is supplied;
+    - ``fleet_goodput`` — completed vs terminally resolved across the
+      whole fleet, through any churn;
+    - ``fleet_deploy_loss`` — requests NOT terminally shed as
+      ``draining``: a zero-downtime rolling update must keep this
+      budget untouched (drains re-route; only a handoff-less or
+      refused drain sheds ``draining``).
+    """
+    rules: List[SLORule] = []
+    if ttft_histogram is not None and ttft_threshold_ms is not None:
+        rules.append(SLORule(
+            LatencySLO(
+                "fleet_ttft", ttft_objective, histogram=ttft_histogram,
+                threshold=ttft_threshold_ms,
+                description="end-to-end TTFT across the fleet",
+            ),
+            windows, cooldown=cooldown, values_fn=values_fn, clock=clock,
+        ))
+    total_keys = ("serve/completed",) + FLEET_TERMINAL_SHED_KEYS
+    rules.append(SLORule(
+        CounterRatioSLO(
+            "fleet_goodput", goodput_objective,
+            good_keys=("serve/completed",),
+            total_keys=total_keys,
+            description="fleet requests completed vs terminally "
+                        "resolved (re-routes are hops, not outcomes)",
+        ),
+        windows, cooldown=cooldown, values_fn=values_fn, clock=clock,
+    ))
+    rules.append(SLORule(
+        CounterRatioSLO(
+            "fleet_deploy_loss", deploy_loss_objective,
+            good_keys=("serve/completed",) + tuple(
+                k for k in FLEET_TERMINAL_SHED_KEYS
+                if k != "serve/shed_draining"
+            ),
+            total_keys=total_keys,
+            description="requests not lost to a drain (rolling "
+                        "updates must re-route, not shed)",
+        ),
+        windows, cooldown=cooldown, values_fn=values_fn, clock=clock,
     ))
     return rules
 

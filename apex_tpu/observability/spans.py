@@ -67,6 +67,7 @@ __all__ = [
     "TRACK_HEALTH",
     "TRACK_TRACE",
     "REQ_QUEUED",
+    "REQ_ROUTED",
     "REQ_PREFILL",
     "REQ_DECODE",
     "REQ_RETRYING",
@@ -91,6 +92,12 @@ TRACK_TRACE = "trace"
 
 # -- request lifecycle vocabulary -------------------------------------------
 REQ_QUEUED = "queued"
+#: fleet routing phase (``apex_tpu.fleetctl``): the request is in the
+#: router's hands between replicas — on first submission (the router
+#: picks a replica before the replica queues it) and on every
+#: re-route after a drain handoff, replica crash, or preemption (the
+#: span's ``replica`` arg names the destination)
+REQ_ROUTED = "routed"
 REQ_PREFILL = "prefill"
 REQ_DECODE = "decode"
 REQ_RETRYING = "retrying"
@@ -110,12 +117,20 @@ REQ_TERMINAL = frozenset({REQ_DONE, REQ_SHED})
 #: would claim tokens no decode produced), and a terminal ``shed``
 #: can never be re-admitted (``shed → decode`` raises — recovery must
 #: go through an explicit re-submission, a NEW request id).
+#: ``routed`` is the fleet-router phase: it brackets the hop between
+#: replicas (first submission, drain handoff, crash/preempt
+#: evacuation).  A routed request can only be queued on its target
+#: replica or shed by the router; ``queued``/``retrying`` can re-enter
+#: ``routed`` (a re-route), but a request mid-``prefill``/``decode``
+#: cannot — it must pass through ``retrying`` first (the re-route IS a
+#: fault recovery and must be charged against the retry budget).
 _REQ_TRANSITIONS: Dict[Optional[str], frozenset] = {
-    None: frozenset({REQ_QUEUED}),
-    REQ_QUEUED: frozenset({REQ_PREFILL, REQ_SHED}),
+    None: frozenset({REQ_QUEUED, REQ_ROUTED}),
+    REQ_ROUTED: frozenset({REQ_QUEUED, REQ_SHED}),
+    REQ_QUEUED: frozenset({REQ_PREFILL, REQ_SHED, REQ_ROUTED}),
     REQ_PREFILL: frozenset({REQ_DECODE, REQ_DONE, REQ_SHED, REQ_RETRYING}),
     REQ_DECODE: frozenset({REQ_DONE, REQ_SHED, REQ_RETRYING}),
-    REQ_RETRYING: frozenset({REQ_PREFILL, REQ_DECODE, REQ_SHED}),
+    REQ_RETRYING: frozenset({REQ_PREFILL, REQ_DECODE, REQ_SHED, REQ_ROUTED}),
 }
 
 

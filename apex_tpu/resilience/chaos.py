@@ -64,6 +64,9 @@ __all__ = [
     "SERVE_DECODE",
     "SERVE_ADMISSION",
     "SERVE_KV_ALLOC",
+    "FLEET_REPLICA_CRASH",
+    "FLEET_PREEMPT",
+    "FLEET_ROUTER",
     "Fault",
     "InjectedFault",
     "register_site",
@@ -94,6 +97,16 @@ SERVE_PREFILL = "serve.prefill"
 SERVE_DECODE = "serve.decode"
 SERVE_ADMISSION = "serve.admission"
 SERVE_KV_ALLOC = "serve.kv_alloc"
+#: fleet-control-plane sites (docs/serving.md "Fleet operations"):
+#: hooks live in apex_tpu/fleetctl — ``fleet.replica_crash`` kills a
+#: replica mid-iteration (its live requests evacuate under the shared
+#: retry budget), ``fleet.preempt`` delivers a SIGTERM-style preempt
+#: notice (drain + migrate), ``fleet.router`` faults one routing
+#: attempt (the request stays at the fleet door and re-routes next
+#: tick).  Indices are fleet ticks.
+FLEET_REPLICA_CRASH = "fleet.replica_crash"
+FLEET_PREEMPT = "fleet.preempt"
+FLEET_ROUTER = "fleet.router"
 
 #: site -> (allowed modes, default mode).  parse_spec and Fault both
 #: validate against this registry: an unknown site OR an unknown mode
@@ -145,6 +158,9 @@ register_site(SERVE_PREFILL, ("raise", "stall", "nan"), "raise")
 register_site(SERVE_DECODE, ("raise", "stall", "nan", "inf"), "raise")
 register_site(SERVE_ADMISSION, ("raise", "stall"), "raise")
 register_site(SERVE_KV_ALLOC, ("fail", "raise"), "fail")
+register_site(FLEET_REPLICA_CRASH, ("kill",), "kill")
+register_site(FLEET_PREEMPT, ("notice",), "notice")
+register_site(FLEET_ROUTER, ("raise",), "raise")
 
 
 class InjectedFault(RuntimeError):

@@ -48,6 +48,7 @@ from apex_tpu.serve.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     Request,
     SHED_REASONS,
+    SHED_REROUTED,
     TTFT_COMPONENTS,
     declare_serve_metrics,
     ttft_attribution,
@@ -62,6 +63,7 @@ __all__ = [
     "ContinuousBatchingScheduler",
     "Request",
     "SHED_REASONS",
+    "SHED_REROUTED",
     "TTFT_COMPONENTS",
     "declare_serve_metrics",
     "ttft_attribution",

@@ -127,6 +127,7 @@ __all__ = [
     "declare_serve_metrics",
     "ttft_attribution",
     "SHED_REASONS",
+    "SHED_REROUTED",
     "TTFT_COMPONENTS",
 ]
 
@@ -150,7 +151,10 @@ SHED = "shed"
 #: the offending slot), ``queue_full`` (backpressure fast-reject at the
 #: bounded admission queue), ``retries_exhausted`` (a faulting request
 #: burned its re-admission budget), ``draining`` (never-admitted work
-#: rejected during a graceful rolling-restart drain).
+#: rejected during a graceful rolling-restart drain), ``rerouted``
+#: (never-admitted work a :meth:`~ContinuousBatchingScheduler.drain`
+#: ``handoff=`` target accepted — the request is NOT terminal: it left
+#: THIS replica's ledger and continues on another one).
 SHED_DEADLINE = "deadline"
 SHED_GROWTH_VICTIM = "growth_victim"
 SHED_POOL_EXHAUSTED = "pool_exhausted"
@@ -159,9 +163,11 @@ SHED_POISONED = "poisoned"
 SHED_QUEUE_FULL = "queue_full"
 SHED_RETRIES_EXHAUSTED = "retries_exhausted"
 SHED_DRAINING = "draining"
+SHED_REROUTED = "rerouted"
 SHED_REASONS = (
     SHED_DEADLINE, SHED_GROWTH_VICTIM, SHED_POOL_EXHAUSTED, SHED_OVERSIZE,
     SHED_POISONED, SHED_QUEUE_FULL, SHED_RETRIES_EXHAUSTED, SHED_DRAINING,
+    SHED_REROUTED,
 )
 
 #: TTFT attribution components (ms); they sum to the measured TTFT by
@@ -347,6 +353,8 @@ class ContinuousBatchingScheduler:
         self.rebuild_limit = rebuild_limit
         self.leak_checks = leak_checks
         self.draining = False
+        self._drain_handoff = None
+        self._drain_rerouted = 0
         self._rebuild_pending = False
         self._rebuilds_started = 0
         self._admissions = 0   # chaos index for the serve.admission site
@@ -410,10 +418,16 @@ class ContinuousBatchingScheduler:
 
     def submit(self, req: Request) -> Request:
         req.status = QUEUED
-        req.submitted_at = self.clock()
+        now = self.clock()
+        if req.submitted_at is None:
+            # a re-routed request (fleet handoff / crash evacuation)
+            # keeps its ORIGINAL submission time: its end-to-end TTFT
+            # and SLO deadline are measured from the client's submit,
+            # not from the hop onto this replica
+            req.submitted_at = now
         if self.spans is not None:
             self.spans.request_event(
-                req.rid, QUEUED, req.submitted_at,
+                req.rid, QUEUED, now,
                 prompt_tokens=len(req.prompt),
                 slo_ttft_ms=req.slo_ttft_ms,
             )
@@ -493,6 +507,28 @@ class ContinuousBatchingScheduler:
         self._retire(req, SHED, reason)
         self._count("serve/shed")
         self._count(f"serve/shed_{reason}")
+
+    def _reroute_request(self, req: Request, handoff) -> bool:
+        """Offer a never-admitted request to a drain ``handoff``
+        target instead of shedding it (docs/serving.md "Fleet
+        operations").  Any retained pages are dropped FIRST — pages
+        are replica-local, a re-routed request re-prefills elsewhere —
+        then the target decides.  On acceptance the request leaves
+        this replica's ledger as ``shed(rerouted)`` on the counters
+        (so the per-reason breakdown still sums to ``serve/shed``) but
+        is NOT terminal: no shed span, no ``self.shed`` entry — the
+        handoff target owns its lifecycle now.  On refusal the caller
+        falls back to the loud ``shed(draining)`` path."""
+        if req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        if not handoff(req):
+            return False
+        self._count("serve/shed")
+        self._count(f"serve/shed_{SHED_REROUTED}")
+        if self.leak_checks:
+            self.leak_check()
+        return True
 
     # -- page accounting ---------------------------------------------------
     def owned_pages(self) -> List[List[int]]:
@@ -657,9 +693,15 @@ class ContinuousBatchingScheduler:
         req = self.queue[0]
         if self.draining and req.status != RETRYING:
             # drain admits nothing new; in-flight (retrying) work may
-            # still re-enter to finish
+            # still re-enter to finish.  With a handoff target the
+            # never-admitted head re-routes instead of shedding.
             self.queue.popleft()
-            self._shed_request(req, SHED_DRAINING)
+            if self._drain_handoff is not None and self._reroute_request(
+                req, self._drain_handoff
+            ):
+                self._drain_rerouted += 1
+            else:
+                self._shed_request(req, SHED_DRAINING)
             return True
         if req.status == RETRYING and req.first_token_at is not None:
             self.queue.popleft()
@@ -956,25 +998,24 @@ class ContinuousBatchingScheduler:
             f"scheduler did not drain within {max_steps} iterations"
         )
 
-    def drain(self, max_steps: int = 10_000) -> Dict[str, object]:
+    def drain(self, max_steps: int = 10_000, *,
+              handoff=None) -> Dict[str, object]:
         """Graceful drain for a rolling restart (docs/serving.md
-        "Failure semantics"): stop admitting new work (submissions and
-        the never-admitted queue are shed loudly as ``draining`` — the
-        client retries on another replica), let running decodes AND
-        in-flight retrying re-admissions finish, then report the
-        drained state with the page pool provably empty.  The
-        scheduler stays drained: subsequent submits are rejected until
-        :meth:`resume` is called."""
-        self.draining = True
-        self._count("serve/drains")
-        self._gauge("serve/draining", 1.0)
-        # reject never-admitted work now; retrying requests are
-        # in-flight (they hold pages and a prefix) and get to finish
-        kept = [r for r in self.queue if r.status == RETRYING]
-        rejected = [r for r in self.queue if r.status != RETRYING]
-        self.queue = collections.deque(kept)
-        for req in rejected:
-            self._shed_request(req, SHED_DRAINING)
+        "Failure semantics"): stop admitting new work, let running
+        decodes AND in-flight retrying re-admissions finish, then
+        report the drained state with the page pool provably empty.
+        The scheduler stays drained: subsequent submits are rejected
+        until :meth:`resume` is called.
+
+        ``handoff`` — a ``callable(Request) -> bool`` (e.g. a fleet
+        router's re-route hook): each never-admitted queue entry is
+        OFFERED to it instead of being shed; on acceptance the request
+        leaves this replica as ``shed(rerouted)`` on the ledger and
+        continues elsewhere with its prompt and shared retry budget
+        intact.  Without a handoff (or when it refuses) the entry is
+        shed loudly as ``draining`` — the client retries on another
+        replica itself."""
+        self.start_drain(handoff=handoff)
         for _ in range(max_steps):
             if not self.pending:
                 break
@@ -983,6 +1024,44 @@ class ContinuousBatchingScheduler:
             raise RuntimeError(
                 f"drain did not complete within {max_steps} iterations"
             )
+        return self.finish_drain()
+
+    def start_drain(self, *, handoff=None) -> int:
+        """Enter the draining state (phase 1 of :meth:`drain`): stop
+        admitting new work, hand never-admitted queue entries to
+        ``handoff`` (or shed them as ``draining``), keep in-flight
+        retrying work.  Returns the re-routed count.  Split out of
+        :meth:`drain` so a fleet control plane can drain a replica
+        INCREMENTALLY — ticking :meth:`step` itself on a shared fleet
+        clock while the other replicas keep serving — instead of
+        monopolizing the loop until this replica is empty; call
+        :meth:`finish_drain` once :attr:`pending` clears."""
+        self.draining = True
+        self._drain_handoff = handoff
+        self._count("serve/drains")
+        self._gauge("serve/draining", 1.0)
+        # hand off (or reject) never-admitted work now; retrying
+        # requests are in-flight (they hold pages and a prefix) and
+        # get to finish here
+        kept = [r for r in self.queue if r.status == RETRYING]
+        rejected = [r for r in self.queue if r.status != RETRYING]
+        self.queue = collections.deque(kept)
+        rerouted = 0
+        for req in rejected:
+            if handoff is not None and self._reroute_request(req, handoff):
+                rerouted += 1
+            else:
+                self._shed_request(req, SHED_DRAINING)
+        self._drain_rerouted = rerouted
+        return rerouted
+
+    def finish_drain(self) -> Dict[str, object]:
+        """Seal a drain (phase 3): settle any owed rebuild, re-prove
+        the pool empty, and report — :meth:`drain`'s exit, also called
+        directly by a fleet that drove the intervening steps itself."""
+        # an incremental drain can still be re-routing through
+        # _admit_one up to the last step — count those too
+        self._drain_handoff = None
         self.flush_rebuild()  # settle any rebuild owed from the storm
         self.leak_check()
         self._publish()
@@ -990,6 +1069,7 @@ class ContinuousBatchingScheduler:
             "drained": True,
             "completed": len(self.completed),
             "shed": len(self.shed),
+            "rerouted": self._drain_rerouted,
             "pool_in_use": self.pool.in_use,
             "engine_rebuilds": self.engine.rebuilds,
             "leak_checks_run": self.leak_checks_run,

@@ -70,6 +70,7 @@ _METRIC_NAMES = {
     "long_attn": "long_context_flash_attn_tflops",
     "zero": "zero_lamb_int8_wire_speedup",
     "serve": "serve_decode_tokens_per_s",
+    "fleet": "fleet_chaos_goodput_pct",
     "all": "bert_large_lamb_mfu",  # the headline stands in for the batch
 }
 
@@ -1482,6 +1483,91 @@ def bench_goodput(trace_dir=None, steps=60, preempt_every=12):
     )
 
 
+def bench_fleet(trace_dir=None):
+    """The fleet control plane (docs/serving.md "Fleet operations"),
+    measured: reuses ``tools/fleet_drill.py``'s seeded storm (the FLEET
+    gate's exact machinery — crash + preemption + arrival spike +
+    mid-load rolling deploy over an autoscaled multi-replica fleet on a
+    virtual clock, vs a fault-free fixed-size reference) and emits the
+    three headline rows: request goodput under the combined storm, the
+    number of accepted requests LOST by the rolling deploy (0 by
+    contract — a nonzero value means the zero-downtime guarantee broke,
+    not that a knob needs tuning), and the storm's p99 TTFT inflation
+    over the fault-free reference (bound 2.0 in the drill itself).
+    CI-grade numbers on CPU virtual time; not TPU perf claims.
+
+    The FLEET gate's evidence artifact is reused via
+    APEX_TPU_FLEET_ARTIFACT (verify_tier1.sh runs FLEET before PERF and
+    hands its --json here) so CI pays for ONE storm, not two — accepted
+    only when the artifact's recorded config and chaos spec equal the
+    drill's defaults, exactly like the serve-chaos reuse above."""
+    import importlib.util as _ilu
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    spec = _ilu.spec_from_file_location(
+        "fleet_drill", os.path.join(root, "tools", "fleet_drill.py"),
+    )
+    fd = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(fd)
+    defaults = fd.build_parser().parse_args([])
+    art = None
+    reuse = os.environ.get("APEX_TPU_FLEET_ARTIFACT")
+    if reuse and os.path.exists(reuse):
+        try:
+            with open(reuse) as f:
+                cand = json.load(f)
+            cfg_sec = cand.get("config", {})
+            if (cand.get("chaos_spec") == defaults.chaos
+                    and cfg_sec
+                    and all(getattr(defaults, k, None) == v
+                            for k, v in cfg_sec.items())):
+                art = cand
+        except (OSError, ValueError):
+            art = None
+    if art is None:
+        art = fd.run_drill(defaults)
+    storm = art["storm"]
+    fr = art["fleet_registry"]
+    lost = sum(d["lost_requests"] for d in art["deploys"])
+    desc = (
+        "storm %s; crashes=%d preempts=%d router_faults=%d rerouted=%d "
+        "scale_out=%d scale_in=%d deploys=%d replicas=%d"
+        % (art["chaos_spec"],
+           fr.get("fleet/replica_crashes", 0),
+           fr.get("fleet/preempts", 0),
+           fr.get("fleet/router_faults", 0),
+           fr.get("fleet/rerouted", 0),
+           fr.get("fleet/scale_out", 0),
+           fr.get("fleet/scale_in", 0),
+           fr.get("fleet/deploys", 0),
+           len(art["replicas"]))
+    )
+    _emit(
+        "fleet_chaos_goodput_pct",
+        round(100.0 * storm["completed"] / storm["offered"], 3)
+        if storm["offered"] else 0.0,
+        "%% requests completed under the fleet storm (%s)" % desc,
+        None,
+    )
+    _emit(
+        "fleet_deploy_lost_requests",
+        float(lost),
+        "accepted requests lost across %d rolling deploy(s) under the "
+        "storm (MUST be 0: drain+handoff re-routes, never sheds; %s)"
+        % (len(art["deploys"]), desc),
+        None,
+    )
+    inflation = art["p99_ttft_inflation"]
+    _emit(
+        "fleet_p99_inflation",
+        round(inflation, 3) if inflation == inflation else 0.0,
+        "x storm p99 TTFT over the fault-free fixed-size reference "
+        "(drill bound 2.0x; <1.0 means the autoscaled storm fleet "
+        "beat the reference; %s)" % desc,
+        None,
+    )
+
+
 _CONFIGS = {
     "resnet50": bench_resnet50,
     "ddp_syncbn": bench_ddp_syncbn,
@@ -1494,13 +1580,16 @@ _CONFIGS = {
     "smoke": bench_smoke,
     "serve": bench_serve,
     "goodput": bench_goodput,
+    "fleet": bench_fleet,
 }
 
-#: configs `--config all` skips: smoke/serve/goodput are CI schema/
-#: acceptance drivers, and ddp_syncbn/tp_gpt are the degenerate-prone
-#: proxies train3d REPLACES in the batch (still invocable by name for
-#: historical comparisons)
-_ALL_EXCLUDED = ("smoke", "serve", "goodput", "ddp_syncbn", "tp_gpt")
+#: configs `--config all` skips: smoke/serve/goodput/fleet are CI
+#: schema/acceptance drivers, and ddp_syncbn/tp_gpt are the
+#: degenerate-prone proxies train3d REPLACES in the batch (still
+#: invocable by name for historical comparisons)
+_ALL_EXCLUDED = (
+    "smoke", "serve", "goodput", "fleet", "ddp_syncbn", "tp_gpt"
+)
 
 
 def main(config="bert_lamb", trace_dir=None):

@@ -523,6 +523,12 @@ class TestBenchDiff:
             "goodput_storm_pct", "goodput_zero_stall_pct",
             "goodput_ckpt_enqueue_ms", "goodput_ckpt_finalize_ms",
             "goodput_input_stall_frac", "goodput_resume_loss_drift",
+            # the fleet control-plane rows (ISSUE 16): request goodput
+            # under the crash+preempt+spike+deploy storm, accepted
+            # requests lost by rolling deploys (must be 0), p99 TTFT
+            # inflation vs the fault-free fixed-size reference
+            "fleet_chaos_goodput_pct", "fleet_deploy_lost_requests",
+            "fleet_p99_inflation",
         }
 
 

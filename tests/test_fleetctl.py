@@ -1,0 +1,531 @@
+"""Fleet control plane — router, replicas, autoscaler, Fleet loop.
+
+Covers the ISSUE 16 acceptance surface: least-loaded routing with
+queue-headroom gating, prompt-only re-routing that preserves the
+SHARED retry budget and original ``submitted_at``, crash evacuation
+with provably-empty pools, graceful preemption drains that migrate
+work, zero-loss rolling updates through the supervised rebuild path,
+hung-replica ejection + rejoin, burn-rate autoscaling decisions
+(out/in/cooldown), per-replica ops export aggregation, and the
+fleet-level SLO rule key pins.  The full storm (crash + preempt +
+spike + deploy in one seeded run) lives in ``tools/fleet_drill.py``
+behind the FLEET CI gate.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.fleetctl import (
+    DEAD,
+    DRAINING,
+    EJECTED,
+    LIVE,
+    Autoscaler,
+    AutoscalerConfig,
+    EngineReplica,
+    Fleet,
+    Router,
+    aggregate_expositions,
+)
+from apex_tpu.models.gpt import GptConfig, GptModel
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.observability.ometrics import Histogram
+from apex_tpu.observability.slo import (
+    FLEET_TERMINAL_SHED_KEYS,
+    fleet_slo_rules,
+)
+from apex_tpu.observability.spans import SpanRecorder
+from apex_tpu.serve import (
+    InferenceEngine,
+    Request,
+    SHED_REASONS,
+    SHED_REROUTED,
+    ServeConfig,
+)
+
+
+class VClock:
+    def __init__(self, tick_s=0.005):
+        self.t = 0.0
+        self.tick_s = tick_s
+
+    def __call__(self):
+        return self.t
+
+    def advance(self):
+        self.t += self.tick_s
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GptConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_seq_len=128, dtype=jnp.float32,
+    )
+    model = GptModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((8, 1), jnp.int32)
+    )
+    return cfg, model, params
+
+
+def make_replica(gpt, name, clock, *, spans=None, **sched_kw):
+    cfg, _, params = gpt
+    registry = MetricRegistry(fetch_every=1)
+    engine = InferenceEngine(
+        cfg, params,
+        ServeConfig(page_size=8, num_pages=32, max_batch=2,
+                    max_pages_per_seq=8, verify=False),
+        registry=registry,
+    ).build()
+    return EngineReplica(name, engine, clock=clock, spans=spans,
+                         **sched_kw)
+
+
+def make_fleet(gpt, clock, *, n=2, spans=None, autoscaler=None,
+               hung_ticks=200, **sched_kw):
+    def factory(name):
+        return make_replica(gpt, name, clock, spans=spans, **sched_kw)
+
+    return Fleet(factory, replicas=n, clock=clock, spans=spans,
+                 autoscaler=autoscaler, hung_ticks=hung_ticks)
+
+
+def pump(fleet, clock, reqs, *, max_ticks=3000):
+    """Step the fleet until every request in ``reqs`` is terminal."""
+    for _ in range(max_ticks):
+        if all(r.status in ("done", "shed") for r in reqs):
+            return
+        fleet.step()
+        clock.advance()
+    raise AssertionError(
+        f"requests not terminal after {max_ticks} ticks: "
+        f"{[(r.rid, r.status) for r in reqs if r.status not in ('done', 'shed')]}"
+    )
+
+
+def req(n_prompt=4, n_out=4):
+    return Request(prompt=list(range(1, 1 + n_prompt)),
+                   max_new_tokens=n_out)
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+
+class TestRouter:
+    def test_pick_least_loaded_live_with_headroom(self, gpt):
+        clock = VClock()
+        a = make_replica(gpt, "a", clock, max_queue_depth=2)
+        b = make_replica(gpt, "b", clock, max_queue_depth=2)
+        # equal load: name breaks the tie
+        assert Router.pick([a, b]) is a
+        a.sched.submit(req())
+        assert Router.pick([a, b]) is b
+        # a full admission queue disqualifies a replica even if it is
+        # otherwise least-loaded — force-feeding it would shed
+        b.sched.submit(req())
+        b.sched.submit(req())
+        assert len(b.sched.queue) == 2
+        assert Router.pick([a, b]) is a
+        a.sched.submit(req())
+        assert Router.pick([a, b]) is None  # everyone saturated
+        b.state = DEAD
+        a.state = EJECTED
+        assert Router.pick([a, b]) is None
+
+    def test_reroute_resets_to_prompt_and_preserves_budget(self):
+        clock = VClock()
+        router = Router(clock=clock)
+        r = req()
+        r.submitted_at = 1.25
+        r.retries = 2
+        r.queue_blocked_s = 0.5
+        r.tokens = [7, 8]
+        r.ctx_len = 6
+        r.status = "running"
+        r.first_token_at = 2.0
+        assert router.reroute(r)
+        assert list(router.door) == [r]
+        assert r.tokens == [] and r.ctx_len == 0
+        assert r.status == "queued" and r.first_token_at is None
+        # the identity that makes fleet TTFT and the shared retry
+        # budget honest across hops:
+        assert r.submitted_at == 1.25
+        assert r.retries == 2
+        assert r.queue_blocked_s == 0.5
+
+    def test_reroute_rejects_page_holders(self):
+        router = Router(clock=VClock())
+        r = req()
+        r.pages = [3]
+        with pytest.raises(AssertionError):
+            router.reroute(r)
+
+    def test_dispatch_routes_and_records_span(self, gpt):
+        clock = VClock()
+        spans = SpanRecorder(capacity=256)
+        counts = {}
+
+        def count(name, n=1):
+            counts[name] = counts.get(name, 0) + n
+
+        router = Router(clock=clock, spans=spans, count=count)
+        a = make_replica(gpt, "a", clock, spans=spans)
+        r = router.submit(req())
+        assert counts == {"fleet/submitted": 1}
+        assert router.dispatch([a], tick=0) == 1
+        assert not router.door and len(a.sched.queue) == 1
+        assert counts["fleet/routed"] == 1
+        # the routed span opened with the destination replica and was
+        # closed by the target's own queued event
+        routed = [s for s in spans.snapshot()
+                  if s.get("name") == "req/routed"]
+        assert len(routed) == 1
+        assert routed[0]["args"]["replica"] == "a"
+
+    def test_router_chaos_holds_the_door(self, gpt):
+        from apex_tpu.resilience import chaos
+
+        clock = VClock()
+        counts = {}
+        router = Router(
+            clock=clock,
+            count=lambda k, n=1: counts.__setitem__(
+                k, counts.get(k, 0) + n
+            ),
+        )
+        a = make_replica(gpt, "a", clock)
+        router.submit(req())
+        fault, = chaos.parse_spec("fleet.router:raise:x1@0")[0]
+        with chaos.inject(fault, seed=0):
+            assert router.dispatch([a], tick=0) == 0
+            assert len(router.door) == 1  # retained, not lost
+            assert counts["fleet/router_faults"] == 1
+            assert router.dispatch([a], tick=1) == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet: failure handling
+# ---------------------------------------------------------------------------
+
+
+def running_replica(fleet, r):
+    """The replica whose slots currently hold request ``r``."""
+    for rep in fleet.replicas:
+        if any(s is r for s in rep.sched.slots):
+            return rep
+    return None
+
+
+class TestFleetFailures:
+    def test_shared_retry_budget_across_replicas(self, gpt):
+        """Satellite 3: a request that faults on replica A and again
+        on replica B consumes ONE shared ``max_retries`` budget and
+        ends as a terminal ``retries_exhausted`` — not an infinite
+        route loop."""
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2, max_retries=1)
+        r = fleet.submit(req(n_out=24))
+        crashed = 0
+        for _ in range(2000):
+            rep = running_replica(fleet, r)
+            if rep is not None and crashed < 2:
+                fleet.crash(rep)
+                crashed += 1
+            if r.status in ("done", "shed"):
+                break
+            fleet.step()
+            clock.advance()
+        assert crashed == 2
+        assert r.status == "shed"
+        assert r.shed_reason == "retries_exhausted"
+        assert r.retries == 1  # the budget, spent once, fleet-wide
+        # exactly one fleet-wide terminal: the shed happened on the
+        # SECOND crash's replica; no replica also completed it
+        assert fleet.completed_count() == 0
+        assert fleet.shed_count("retries_exhausted") == 1
+        assert all(v == 0 for v in fleet.leak_check().values())
+
+    def test_crash_evacuates_and_work_finishes_elsewhere(self, gpt):
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2, max_retries=3)
+        reqs = [fleet.submit(req(n_out=8)) for _ in range(4)]
+        for _ in range(3):  # route + admit somewhere
+            fleet.step()
+            clock.advance()
+        victim = next(
+            rep for rep in fleet.replicas if rep.sched.pending
+        )
+        fleet.crash(victim)
+        assert victim.state == DEAD
+        assert victim.sched.pool.in_use == 0  # evacuated, provably
+        pump(fleet, clock, reqs)
+        assert all(r.status == "done" for r in reqs)
+        assert fleet.completed_count() == 4
+        fr = fleet.registry.fetch()
+        assert fr["fleet/replica_crashes"] == 1
+        assert all(v == 0 for v in fleet.leak_check().values())
+
+    def test_preempt_drains_gracefully_and_migrates(self, gpt):
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2)
+        reqs = [fleet.submit(req(n_out=6)) for _ in range(4)]
+        for _ in range(3):
+            fleet.step()
+            clock.advance()
+        victim = next(
+            rep for rep in fleet.replicas if rep.sched.pending
+        )
+        fleet.preempt(victim)
+        assert victim.state == DRAINING
+        pump(fleet, clock, reqs)
+        assert victim.state == DEAD  # drained out, then left
+        assert all(r.status == "done" for r in reqs)
+        # ZERO terminal draining sheds: the drain re-routed instead
+        assert fleet.shed_count("draining") == 0
+        assert victim.drain_reports and (
+            victim.drain_reports[0]["reason"] == "preempt"
+        )
+
+    def test_eject_and_rejoin(self, gpt):
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2)
+        rep = fleet.replicas[0]
+        fleet.eject(rep, "burn_rate:9.0x")
+        assert rep.state == EJECTED
+        assert rep.end_cause == "burn_rate:9.0x"
+        with pytest.raises(RuntimeError):
+            fleet.rejoin(fleet.replicas[1])  # LIVE cannot "rejoin"
+        fleet.rejoin(rep)
+        assert rep.state == LIVE and rep.end_cause is None
+        fleet.step()  # counters publish on the tick cadence
+        fr = fleet.registry.fetch()
+        assert fr["fleet/ejections"] == 1 and fr["fleet/rejoins"] == 1
+        rules = [e.rule for e in fleet.health_events]
+        assert rules == ["fleet_eject", "fleet_rejoin"]
+
+    def test_hung_replica_is_ejected(self, gpt):
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=1, hung_ticks=3)
+        rep = fleet.replicas[0]
+        r = fleet.submit(req(n_out=8))
+        fleet.step()  # routed + admitted
+        clock.advance()
+        rep.step = lambda: None  # wedge the iteration loop
+        for _ in range(8):
+            fleet.step()
+            clock.advance()
+        assert rep.state == EJECTED
+        assert rep.end_cause == "hung"
+        assert rep.sched.pool.in_use == 0
+        # the request was evacuated back to the fleet door (no live
+        # replica to take it yet)
+        assert r in fleet.router.door
+
+
+# ---------------------------------------------------------------------------
+# fleet: rolling update
+# ---------------------------------------------------------------------------
+
+
+class TestRollingUpdate:
+    def test_zero_loss_rolling_update_under_load(self, gpt):
+        cfg, model, _ = gpt
+        params2 = model.init(
+            jax.random.PRNGKey(42), jnp.zeros((8, 1), jnp.int32)
+        )
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2)
+        names = [rep.name for rep in fleet.replicas]
+        reqs = [fleet.submit(req(n_out=6)) for _ in range(4)]
+        for _ in range(2):
+            fleet.step()
+            clock.advance()
+        fleet.start_rolling_update(params2)
+        with pytest.raises(RuntimeError):
+            fleet.start_rolling_update(params2)  # one at a time
+        reqs += [fleet.submit(req(n_out=4)) for _ in range(3)]
+        pump(fleet, clock, reqs)
+        for _ in range(50):  # let the deploy seal
+            if fleet.deploy is None:
+                break
+            fleet.step()
+            clock.advance()
+        assert fleet.deploy is None
+        d, = fleet.deploy_history
+        assert sorted(d["updated"]) == sorted(names)
+        assert d["lost_requests"] == 0  # the tentpole number
+        assert all(r.status == "done" for r in reqs)
+        for rep in fleet.replicas:
+            assert rep.state == LIVE
+            assert rep.engine.params is params2
+            assert rep.engine.rebuilds >= 1  # supervised rebuild path
+        fr = fleet.registry.fetch()
+        assert fr["fleet/deploys"] == 1
+        assert fleet.shed_count("draining") == 0
+
+    def test_last_live_replica_swap_waits_for_idle(self, gpt):
+        cfg, model, _ = gpt
+        params2 = model.init(
+            jax.random.PRNGKey(43), jnp.zeros((8, 1), jnp.int32)
+        )
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=1)
+        rep = fleet.replicas[0]
+        r = fleet.submit(req(n_out=6))
+        fleet.step()
+        clock.advance()
+        fleet.start_rolling_update(params2)
+        fleet.step()  # must NOT drain the only replica under traffic
+        assert rep.state == LIVE and fleet.deploy is not None
+        pump(fleet, clock, [r])
+        for _ in range(50):
+            if fleet.deploy is None:
+                break
+            fleet.step()
+            clock.advance()
+        # idle now: the instant swap ran, zero requests lost
+        assert fleet.deploy is None
+        assert rep.engine.params is params2 and rep.state == LIVE
+        assert r.status == "done"
+        assert fleet.deploy_history[0]["lost_requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+
+def fake_replica(depth, ttfts=(), threshold=100.0):
+    hist = Histogram("serve/ttft", (25.0, 50.0, 100.0, 200.0),
+                     unit="ms")
+    for v in ttfts:
+        hist.observe(v)
+    return types.SimpleNamespace(
+        depth=depth, sched=types.SimpleNamespace(ttft_hist=hist)
+    )
+
+
+class TestAutoscaler:
+    CFG = dict(min_replicas=1, max_replicas=4, queue_high=8.0,
+               queue_low=1.0, headroom_evals=2, cooldown_ticks=8,
+               eval_every=1, short_window_s=1.0, long_window_s=4.0,
+               out_factor=3.0, ttft_threshold_ms=100.0)
+
+    def test_scale_out_on_queue_pressure_and_cooldown(self):
+        scaler = Autoscaler(AutoscalerConfig(**self.CFG))
+        reps = [fake_replica(10), fake_replica(10)]
+        e = scaler.evaluate(reps, tick=0)
+        assert e is not None and e.rule == "fleet_scale_out"
+        assert "queue depth" in e.message
+        # cooldown mutes the actuator even under sustained pressure
+        assert scaler.evaluate(reps, tick=4) is None
+        assert scaler.evaluate(reps, tick=9) is not None
+
+    def test_scale_out_on_fast_burn(self):
+        scaler = Autoscaler(AutoscalerConfig(**self.CFG))
+        reps = [fake_replica(2.0)]  # below queue_high: burn must act
+        for i in range(6):
+            # every TTFT blows the 100ms threshold: error rate 1.0
+            # against a 0.1 budget = 10x burn >= the 3x page factor
+            reps[0].sched.ttft_hist.observe(150.0)
+            e = scaler.evaluate(reps, tick=i)
+            if e is not None:
+                assert e.rule == "fleet_scale_out"
+                assert "burn" in e.message
+                return
+        raise AssertionError("fast burn never paged a scale-out")
+
+    def test_scale_in_needs_sustained_headroom(self):
+        scaler = Autoscaler(AutoscalerConfig(**self.CFG))
+        reps = [fake_replica(0.0), fake_replica(0.0)]
+        assert scaler.evaluate(reps, tick=0) is None  # 1st headroom
+        e = scaler.evaluate(reps, tick=1)  # 2nd consecutive
+        assert e is not None and e.rule == "fleet_scale_in"
+        # at min_replicas the decision is never emitted
+        solo = [fake_replica(0.0)]
+        scaler2 = Autoscaler(AutoscalerConfig(**self.CFG))
+        for i in range(6):
+            assert scaler2.evaluate(solo, tick=i) is None
+
+    def test_headroom_resets_on_pressure(self):
+        scaler = Autoscaler(AutoscalerConfig(**self.CFG))
+        reps = [fake_replica(0.0), fake_replica(0.0)]
+        assert scaler.evaluate(reps, tick=0) is None
+        busy = [fake_replica(5.0), fake_replica(5.0)]
+        assert scaler.evaluate(busy, tick=1) is None  # mid pressure
+        assert scaler.evaluate(reps, tick=2) is None  # count restarts
+        assert scaler.evaluate(reps, tick=3) is not None
+
+
+# ---------------------------------------------------------------------------
+# ops aggregation + fleet SLO rules
+# ---------------------------------------------------------------------------
+
+
+class TestFleetObservability:
+    def test_aggregate_expositions_sums_counters(self):
+        h = Histogram("serve/ttft", (50.0,), unit="ms")
+        texts = []
+        for completed in (3.0, 4.0):
+            reg = MetricRegistry(fetch_every=1)
+            reg.counter("serve/completed")
+            reg.gauge("serve/queue_depth")
+            st = reg.update(reg.init(), {
+                "serve/completed": completed,
+                "serve/queue_depth": completed,
+            })
+            reg.observe(0, st)
+            reg.fetch()
+            from apex_tpu.observability.ometrics import render
+
+            texts.append(render([reg], [h], None))
+        agg = aggregate_expositions(texts)
+        assert agg["sources"] == 2
+        completed = [v for k, v in agg["counters"].items()
+                     if "completed" in k]
+        assert completed == [7.0]  # counters SUM across replicas
+        depth = [v for k, v in agg["gauges"].items()
+                 if "queue_depth" in k]
+        assert depth == [[3.0, 4.0]]  # gauges stay per-source
+
+    def test_replica_ops_servers_get_distinct_ports(self, gpt):
+        clock = VClock()
+        a = make_replica(gpt, "a", clock)
+        b = make_replica(gpt, "b", clock)
+        try:
+            sa, sb = a.start_ops(), b.start_ops()
+            assert sa.bound_port and sb.bound_port
+            assert sa.bound_port != sb.bound_port
+            agg = aggregate_expositions([sa.scrape(), sb.scrape()])
+            assert agg["sources"] == 2
+        finally:
+            a.stop_ops()
+            b.stop_ops()
+
+    def test_terminal_shed_keys_pin(self):
+        """A new shed reason must be classified: terminal (extend
+        FLEET_TERMINAL_SHED_KEYS) or a hop (extend the exclusion
+        below, with the reasoning rerouted has)."""
+        derived = tuple(
+            f"serve/shed_{r}" for r in SHED_REASONS
+            if r != SHED_REROUTED
+        )
+        assert derived == FLEET_TERMINAL_SHED_KEYS
+
+    def test_fleet_goodput_ignores_reroutes(self):
+        values = {"serve/completed": 90.0, "serve/shed": 40.0,
+                  "serve/shed_rerouted": 30.0,
+                  "serve/shed_draining": 10.0}
+        rules = fleet_slo_rules(values_fn=lambda: values)
+        by_name = {r.slo.name: r.slo for r in rules}
+        good, total = by_name["fleet_goodput"].counts(values)
+        # 30 re-routed hops are NOT failures: 90/(90+10), not 90/130
+        assert (good, total) == (90.0, 100.0)
+        good, total = by_name["fleet_deploy_loss"].counts(values)
+        assert (good, total) == (90.0, 100.0)  # draining IS a loss

@@ -384,6 +384,37 @@ class TestOpsServer:
         assert board.get("ops/scrapes") == 1
         assert board.get("ops/scrape_ms") is not None
 
+    def test_bound_port_none_before_start(self):
+        srv = OpsServer(port=0)
+        assert srv.bound_port is None
+        srv.start()
+        try:
+            assert srv.bound_port == srv.port > 0
+        finally:
+            srv.stop()
+
+    def test_port0_fleet_no_collision_and_namespaced_board(self):
+        """N replicas in ONE process (the fleet control plane's
+        layout): each port-0 server gets its own OS-assigned port, and
+        ``name=`` keeps their self-observation board keys from
+        overwriting each other."""
+        servers = [
+            OpsServer(registries=[_sample_registry()], port=0,
+                      name=f"r{i}").start()
+            for i in range(3)
+        ]
+        try:
+            ports = [s.bound_port for s in servers]
+            assert all(p and p > 0 for p in ports)
+            assert len(set(ports)) == 3
+            for i, srv in enumerate(servers):
+                srv.scrape()
+                assert board.get(f"ops/r{i}/scrapes") == 1
+                assert board.get(f"ops/r{i}/port") == srv.bound_port
+        finally:
+            for srv in servers:
+                srv.stop()
+
 
 # ---------------------------------------------------------------------------
 # overhead: the PR 3 bar, applied to the scrape path

@@ -869,11 +869,79 @@ class TestServeResilience:
         assert accepted.status == "done"
         assert _vals(reg)["serve/draining"] == 0.0
 
+    def test_drain_handoff_reroutes_instead_of_shedding(self, gpt):
+        """The fleet hook (docs/serving.md "Fleet operations"): with a
+        ``handoff``, drain hands never-admitted work out instead of
+        shedding it — ledgered as the DISTINCT ``rerouted`` reason
+        (still summing into ``serve/shed``), but NOT terminal: no shed
+        span, no ``sched.shed`` entry, the request continues
+        elsewhere."""
+        eng = make_engine(gpt)  # max_batch=2
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(36)
+        reqs = [
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=6))
+            for _ in range(4)
+        ]
+        sched.step()  # two admitted, two still queued
+        handed = []
+
+        def handoff(r):
+            handed.append(r)
+            return True
+
+        report = sched.drain(handoff=handoff)
+        assert report["drained"] and report["pool_in_use"] == 0
+        assert report["rerouted"] == 2
+        assert [r.status for r in reqs[:2]] == ["done", "done"]
+        assert handed == reqs[2:]
+        # re-routed requests are NOT terminal on this replica
+        assert all(r.status == "queued" for r in handed)
+        assert all(r.shed_reason is None for r in handed)
+        assert all(not r.pages for r in handed)  # pages replica-local
+        assert sched.shed == []
+        vals = _vals(reg)
+        assert vals["serve/shed_rerouted"] == 2.0
+        assert vals["serve/shed"] == 2.0  # breakdown still sums
+        assert vals["serve/shed_draining"] == 0.0
+
+    def test_incremental_drain_start_finish_split(self, gpt):
+        """A fleet control plane drains a replica INCREMENTALLY:
+        ``start_drain`` now, caller-driven ``step`` ticks, then
+        ``finish_drain`` seals with the pool re-proven empty."""
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(37)
+        reqs = [
+            sched.submit(Request(prompt=self._prompt(rs, 6),
+                                 max_new_tokens=6))
+            for _ in range(2)
+        ]
+        sched.step()
+        rerouted = sched.start_drain(handoff=lambda r: True)
+        assert sched.draining and rerouted == 0  # both were admitted
+        steps = 0
+        while sched.pending:
+            sched.step()
+            steps += 1
+        assert steps > 0  # the drain really spanned ticks
+        report = sched.finish_drain()
+        assert report["drained"] and report["pool_in_use"] == 0
+        assert all(r.status == "done" for r in reqs)
+
     def test_shed_breakdown_still_sums_with_new_reasons(self, gpt):
+        from apex_tpu.observability.ometrics import metric_name
         from apex_tpu.serve import SHED_REASONS
 
         assert {"poisoned", "queue_full", "retries_exhausted",
-                "draining"} < set(SHED_REASONS)
+                "draining", "rerouted"} < set(SHED_REASONS)
+        # the per-reason ledger counters must stay injective on the
+        # OpenMetrics export: two reasons mapping to one exposition
+        # family would silently merge on every fleet aggregation
+        exported = [metric_name(f"serve/shed_{r}") for r in SHED_REASONS]
+        assert len(set(exported)) == len(SHED_REASONS)
 
 
 class TestEngineRecovery:

@@ -299,6 +299,56 @@ class TestRecoveryLifecycle:
         with pytest.raises(ValueError, match="out-of-order request"):
             rec.request_event(8, REQ_RETRYING, 2.0)
 
+    def test_routed_hop_chain(self):
+        """The fleet re-route chain: queued -> routed (drain handoff)
+        -> queued on the destination, then a normal lifecycle.  The
+        routed span carries the destination replica and is closed by
+        the target's own queued event."""
+        from apex_tpu.observability.spans import REQ_ROUTED
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(9, REQ_ROUTED, 1.0, replica="r0")  # fresh dispatch
+        rec.request_event(9, REQ_QUEUED, 1.0)
+        rec.request_event(9, REQ_ROUTED, 2.0, replica="r1")  # drain handoff
+        rec.request_event(9, REQ_QUEUED, 2.5)
+        rec.request_event(9, REQ_PREFILL, 3.0)
+        rec.request_event(9, REQ_DECODE, 4.0)
+        rec.request_event(9, REQ_DONE, 5.0)
+        assert rec.open_requests == {}
+        routed = [e for e in rec.snapshot() if e["name"] == "req/routed"]
+        assert [s["args"]["replica"] for s in routed] == ["r0", "r1"]
+
+    def test_routed_from_retrying_after_crash_evacuation(self):
+        """A crash evacuation moves RUNNING work through retrying
+        (charging the shared budget) before the hop — retrying ->
+        routed is the legal crash-migration edge."""
+        from apex_tpu.observability.spans import REQ_RETRYING, REQ_ROUTED
+
+        rec = SpanRecorder(capacity=64)
+        rec.request_event(10, REQ_QUEUED, 1.0)
+        rec.request_event(10, REQ_PREFILL, 2.0)
+        rec.request_event(10, REQ_DECODE, 3.0)
+        rec.request_event(10, REQ_RETRYING, 4.0, cause="replica_crash")
+        rec.request_event(10, REQ_ROUTED, 4.5, replica="r2")
+        rec.request_event(10, REQ_QUEUED, 5.0)
+        assert rec.open_requests == {10: "queued"}
+
+    def test_inflight_phases_cannot_route_directly(self):
+        """prefill/decode -> routed is illegal: a migration of
+        in-flight work IS a fault recovery and must pass through
+        retrying, where the shared retry budget is charged — a free
+        hop would let a flapping replica bounce a request forever."""
+        from apex_tpu.observability.spans import REQ_ROUTED
+
+        for last in (REQ_PREFILL, REQ_DECODE):
+            rec = SpanRecorder(capacity=64)
+            rec.request_event(11, REQ_QUEUED, 1.0)
+            rec.request_event(11, REQ_PREFILL, 2.0)
+            if last == REQ_DECODE:
+                rec.request_event(11, REQ_DECODE, 3.0)
+            with pytest.raises(ValueError, match="out-of-order request"):
+                rec.request_event(11, REQ_ROUTED, 4.0, replica="r1")
+
     def test_scheduler_records_retry_chain_end_to_end(self):
         """The scheduler's real fault path produces the validated
         chain: decode fault -> retrying span (with cause) ->
