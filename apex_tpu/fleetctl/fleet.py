@@ -62,6 +62,7 @@ __all__ = ["declare_fleet_metrics", "Fleet"]
 def declare_fleet_metrics(registry) -> None:
     """Declare the fleet ledger on a registry (idempotent)."""
     for c in ("fleet/submitted", "fleet/routed", "fleet/rerouted",
+              "fleet/prefix_affinity_hits",
               "fleet/router_faults", "fleet/replica_crashes",
               "fleet/preempts", "fleet/ejections", "fleet/rejoins",
               "fleet/scale_out", "fleet/scale_in", "fleet/deploys",

@@ -193,6 +193,12 @@ class EngineReplica:
         while sched.queue:
             req = sched.queue.popleft()
             sched._reroute_request(req, accept)
+        if sched.prefix is not None:
+            # cached runs are replica-local history: release the
+            # cache's own references so the pool-empty proof below
+            # covers the cache too (borrowed copies were already
+            # dropped by the retry/re-route frees above)
+            sched.prefix.flush()
         sched.leak_check()
         assert sched.pool.in_use == 0, (
             f"replica {self.name} evacuated with pages in use"

@@ -82,20 +82,38 @@ class Router:
         req.blocked_since = None
         req.first_decode_iter = None
         req.last_decode_iter = None
+        # prefix-cache state is replica-local too: the source's hit
+        # (borrowed pages, skipped positions) means nothing on the
+        # destination — it probes its OWN cache afresh
+        req.prefill_pos = 0
+        req.cache_hit_tokens = 0
+        req.cache_hit_pages = 0
+        req.cache_probed = False
+        req.prefill_started_at = None
         self._count("fleet/rerouted")
         self.door.append(req)
         return True
 
     # -- dispatch ----------------------------------------------------------
     @staticmethod
-    def pick(replicas: Iterable[EngineReplica]) -> Optional[EngineReplica]:
+    def pick(replicas: Iterable[EngineReplica],
+             prompt=None) -> Optional[EngineReplica]:
         """The routing policy: least-loaded LIVE replica WITH queue
         headroom, name as the deterministic tie-break.  A replica
         whose bounded admission queue is already full is not a routing
         candidate — force-feeding it would convert fleet-survivable
         backpressure into terminal ``shed(queue_full)``; when every
         replica is saturated the door holds the traffic (that is the
-        queue-depth pressure the autoscaler scales out on)."""
+        queue-depth pressure the autoscaler scales out on).
+
+        **Prefix affinity**: with a ``prompt``, candidates whose
+        prefix cache already holds part of it are preferred — deepest
+        hit first (the probe is a non-touching
+        :meth:`~apex_tpu.serve.cache.PrefixCache.peek_tokens`, so
+        routing does not mutate any replica's LRU order), then the
+        same (depth, name) deterministic tie-break.  Replicas without
+        a cache probe as 0, so a cacheless fleet routes exactly as
+        before."""
         live = [
             r for r in replicas
             if r.state == LIVE and (
@@ -105,7 +123,22 @@ class Router:
         ]
         if not live:
             return None
+        if prompt:
+            best = min(
+                live,
+                key=lambda r: (-Router.peek_cached(r, prompt),
+                               r.depth, r.name),
+            )
+            if Router.peek_cached(best, prompt) > 0:
+                return best
         return min(live, key=lambda r: (r.depth, r.name))
+
+    @staticmethod
+    def peek_cached(rep: EngineReplica, prompt) -> int:
+        """Prompt tokens ``rep``'s prefix cache would cover (0 when the
+        replica runs without a cache)."""
+        prefix = rep.sched.prefix
+        return prefix.peek_tokens(prompt) if prefix is not None else 0
 
     def dispatch(self, replicas: List[EngineReplica], tick: int) -> int:
         """Route everything at the door to live replicas (one fleet
@@ -124,10 +157,13 @@ class Router:
             return 0
         dispatched = 0
         for _ in range(len(self.door)):
-            target = self.pick(replicas)
+            req = self.door[0]
+            target = self.pick(replicas, prompt=req.prompt)
             if target is None:
                 break
-            req = self.door.popleft()
+            self.door.popleft()
+            if self.peek_cached(target, req.prompt) > 0:
+                self._count("fleet/prefix_affinity_hits")
             now = self.clock()
             if self.spans is not None:
                 # the validated `routed` phase: opened here with the

@@ -22,6 +22,7 @@ typo'd drill can never silently inject nothing and "pass"):
 ``SERVE_DECODE``          ``raise`` / ``stall`` / ``nan`` / ``inf``
 ``SERVE_ADMISSION``       ``raise`` / ``stall``
 ``SERVE_KV_ALLOC``        ``fail`` (forced alloc failure) / ``raise``
+``SERVE_PREFIX_EVICT``    ``force`` (forced prefix-cache eviction)
 ========================  ==========================================
 
 The ``serve.*`` sites live in the serving path
@@ -64,6 +65,7 @@ __all__ = [
     "SERVE_DECODE",
     "SERVE_ADMISSION",
     "SERVE_KV_ALLOC",
+    "SERVE_PREFIX_EVICT",
     "FLEET_REPLICA_CRASH",
     "FLEET_PREEMPT",
     "FLEET_ROUTER",
@@ -97,6 +99,10 @@ SERVE_PREFILL = "serve.prefill"
 SERVE_DECODE = "serve.decode"
 SERVE_ADMISSION = "serve.admission"
 SERVE_KV_ALLOC = "serve.kv_alloc"
+#: forces a full prefix-cache eviction sweep at a scheduler step (the
+#: drill proving eviction under pressure never corrupts a borrowed
+#: stream — borrowed pages are refcount-pinned and survive the sweep)
+SERVE_PREFIX_EVICT = "serve.prefix_evict"
 #: fleet-control-plane sites (docs/serving.md "Fleet operations"):
 #: hooks live in apex_tpu/fleetctl — ``fleet.replica_crash`` kills a
 #: replica mid-iteration (its live requests evacuate under the shared
@@ -158,6 +164,7 @@ register_site(SERVE_PREFILL, ("raise", "stall", "nan"), "raise")
 register_site(SERVE_DECODE, ("raise", "stall", "nan", "inf"), "raise")
 register_site(SERVE_ADMISSION, ("raise", "stall"), "raise")
 register_site(SERVE_KV_ALLOC, ("fail", "raise"), "fail")
+register_site(SERVE_PREFIX_EVICT, ("force",), "force")
 register_site(FLEET_REPLICA_CRASH, ("kill",), "kill")
 register_site(FLEET_PREEMPT, ("notice",), "notice")
 register_site(FLEET_ROUTER, ("raise",), "raise")

@@ -24,10 +24,15 @@ blockwise int8 codec reused as the KV and weight wire formats.
   re-admission retries with the generated prefix retained,
   poisoned-request quarantine, supervised engine rebuild, an explicit
   overload degradation ladder (queue-cap fast-reject, token clamping,
-  deadline shedding), and rolling-restart ``drain()``.  Chaos sites
-  at ``serve.prefill``/``serve.decode``/``serve.admission``/
-  ``serve.kv_alloc`` make every failure path drillable from one
-  ``APEX_TPU_CHAOS`` spec (``tools/serve_chaos_drill.py``).
+  deadline shedding), and rolling-restart ``drain()``.  With
+  ``prefix_cache=True`` a content-addressed :class:`PrefixCache`
+  shares committed KV page runs across requests (copy-on-write,
+  LRU-evicted under pool pressure) and ``prefill_chunk_tokens=``
+  interleaves chunked prefills between decode iterations.  Chaos
+  sites at ``serve.prefill``/``serve.decode``/``serve.admission``/
+  ``serve.kv_alloc``/``serve.prefix_evict`` make every failure path
+  drillable from one ``APEX_TPU_CHAOS`` spec
+  (``tools/serve_chaos_drill.py``).
 
 Fused decode attention lives with the other kernels
 (:func:`apex_tpu.ops.paged_decode_attention` /
@@ -38,7 +43,9 @@ runnable train→serve round-trip: ``examples/simple/serve/``.
 from apex_tpu.serve.cache import (  # noqa: F401
     NULL_PAGE,
     PagePool,
+    PrefixCache,
     init_kv_pages,
+    prefix_keys,
 )
 from apex_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
@@ -57,7 +64,9 @@ from apex_tpu.serve.scheduler import (  # noqa: F401
 __all__ = [
     "NULL_PAGE",
     "PagePool",
+    "PrefixCache",
     "init_kv_pages",
+    "prefix_keys",
     "InferenceEngine",
     "ServeConfig",
     "ContinuousBatchingScheduler",

@@ -36,15 +36,20 @@ cache arrays so the scatters update pages in place
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.parallel import comm
 
 __all__ = [
     "NULL_PAGE",
     "PagePool",
+    "PrefixCache",
+    "prefix_keys",
     "init_kv_pages",
     "encode_kv",
     "pack_prompt_pages",
@@ -64,6 +69,15 @@ class PagePool:
     are usable.  ``alloc`` is all-or-nothing: a request that cannot get
     every page it asked for gets none (no partial admissions to later
     roll back — the scheduler's shedding logic stays trivial).
+
+    Pages are **refcounted**: ``alloc`` hands a page out at refcount 1,
+    :meth:`share` adds a reference (a prefix-cache borrow or the
+    cache's own hold on a committed run), and :meth:`free` RELEASES one
+    reference — the page returns to the free list only when the last
+    holder lets go.  Every existing free path (retire, shed, reroute)
+    is therefore automatically safe for shared pages: a retried request
+    that borrowed cached pages decrements, it never yanks pages a
+    co-rider still reads.
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -77,6 +91,8 @@ class PagePool:
         # content is dead by construction, and re-use keeps the touched
         # working set small)
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        #: allocated page -> reference count (absent = free)
+        self._refs: Dict[int, int] = {}
 
     @property
     def usable(self) -> int:
@@ -106,55 +122,302 @@ class PagePool:
         if n > len(self._free):
             return None
         taken = [self._free.pop() for _ in range(n)]
+        for p in taken:
+            self._refs[p] = 1
         return taken
 
+    def share(self, pages: List[int]) -> None:
+        """Add one reference per page (a prefix-cache borrow, or the
+        cache's own hold on a freshly committed run).  Sharing a page
+        that is not allocated is a bug loud enough to raise."""
+        for p in pages:
+            if p not in self._refs:
+                raise ValueError(f"cannot share unallocated page {p}")
+        for p in pages:
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        """Current reference count of ``page`` (0 = free)."""
+        return self._refs.get(page, 0)
+
     def free(self, pages: List[int]) -> None:
+        """Release one reference per page; a page returns to the free
+        list only at refcount 0 (shared pages survive their
+        co-holders' frees)."""
         for p in pages:
             if not 0 < p < self.num_pages:
                 raise ValueError(f"page {p} is not an allocatable page id")
-            if p in self._free:
+            if p not in self._refs:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+        for p in pages:
+            r = self._refs[p] - 1
+            if r:
+                self._refs[p] = r
+            else:
+                del self._refs[p]
+                self._free.append(p)
 
-    def leak_check(self, owned) -> None:
+    def leak_check(self, owned, cached=()) -> None:
         """Assert the pool's accounting is EXACT against the live
-        ownership ledger: every allocated page is owned by exactly one
-        live request and every owned page is allocated.
+        ownership ledger: every allocated page's refcount equals the
+        number of live holders claiming it, and every claimed page is
+        allocated.
 
         ``owned`` is an iterable of per-request page lists (the
-        scheduler's slots + retrying queue entries).  Raises
-        ``ValueError`` naming the leaked (allocated but unowned),
-        foreign (owned but free/out-of-range), or double-owned pages —
+        scheduler's slots + retrying queue entries); ``cached`` is the
+        prefix cache's committed-run pages (each entry holds exactly
+        one reference of its own).  Raises ``ValueError`` naming the
+        leaked (refcounted above the ownership ledger — e.g. allocated
+        but unowned), foreign (claimed but not allocated), or
+        double-owned (claimed by more holders than references — a
+        duplicate claim that never went through :meth:`share`) pages —
         the invariant the serving chaos drill re-proves after every
         injected fault (docs/serving.md "Failure semantics")."""
-        owned_flat: List[int] = []
+        want: Counter = Counter()
         for pages in owned:
-            owned_flat.extend(pages)
-        owned_set = set(owned_flat)
+            want.update(pages)
+        want.update(cached)
         problems = []
-        if len(owned_flat) != len(owned_set):
-            seen, dups = set(), set()
-            for p in owned_flat:
-                (dups if p in seen else seen).add(p)
-            problems.append(f"pages owned by more than one request: "
-                            f"{sorted(dups)}")
-        allocated = set(range(1, self.num_pages)) - set(self._free)
-        leaked = allocated - owned_set
-        foreign = owned_set - allocated
+        over = sorted(p for p, c in want.items()
+                      if c > self._refs.get(p, 0) and p in self._refs)
+        if over:
+            problems.append(f"pages owned by more than one request "
+                            f"without a shared reference: {over}")
+        leaked = sorted(p for p, r in self._refs.items() if r > want[p])
+        foreign = sorted(set(want) - set(self._refs))
         if leaked:
             problems.append(
-                f"leaked pages (allocated, owned by no live request): "
-                f"{sorted(leaked)}"
+                f"leaked pages (allocated references owned by no live "
+                f"request or cache entry): {leaked}"
             )
         if foreign:
             problems.append(
                 f"foreign pages (owned but not allocated): "
-                f"{sorted(foreign)}"
+                f"{foreign}"
             )
         if problems:
             raise ValueError(
                 "PagePool leak check failed: " + "; ".join(problems)
             )
+
+
+# ---------------------------------------------------------------------------
+# cross-request prefix cache: content hash -> committed KV page run
+# ---------------------------------------------------------------------------
+
+
+def prefix_keys(prompt, page_size: int) -> List[Tuple[bytes, int]]:
+    """Chained page-granularity content keys for a prompt:
+    ``key_i = H(key_{i-1} || tokens[i*page:(i+1)*page])`` — a page's key
+    commits to EVERY token before it, so two prompts share a key iff
+    they share the whole prefix up to that page.  The final partial
+    page (if any) gets a key too: only a whole-prompt hit can reuse a
+    partially-filled tail page, because its content embeds the exact
+    partial token run.  Returns ``[(key, tokens_through_here), ...]``.
+    """
+    out: List[Tuple[bytes, int]] = []
+    key = b"apex-prefix-v1"
+    for start in range(0, len(prompt), page_size):
+        block = np.asarray(prompt[start:start + page_size], np.int32)
+        key = hashlib.blake2b(
+            key + block.tobytes(), digest_size=16
+        ).digest()
+        out.append((key, start + len(block)))
+    return out
+
+
+class _CacheEntry:
+    __slots__ = ("key", "page", "tokens", "parent", "children", "tick")
+
+    def __init__(self, key, page, tokens, parent, tick):
+        self.key = key
+        self.page = page          # the committed device page id
+        self.tokens = tokens      # prompt tokens through this page
+        self.parent = parent      # previous key in the chain (or None)
+        self.children = 0         # cached entries chaining through us
+        self.tick = tick          # LRU clock
+
+    def __repr__(self):
+        return (f"_CacheEntry(page={self.page}, tokens={self.tokens}, "
+                f"children={self.children}, tick={self.tick})")
+
+
+class PrefixCache:
+    """Content-addressed map from chained prompt-prefix hashes to
+    committed KV page runs in one :class:`PagePool`.
+
+    - **commit** — after a prompt's prefill completes (and before its
+      first decode append), each of its pages is published under its
+      chain key with one cache-owned :meth:`PagePool.share` reference,
+      so the run outlives the committing request.
+    - **match** — an admitted prompt walks its key chain for the
+      longest cached run; :meth:`borrow` adds one reference per page
+      for the borrower (released by the borrower's ordinary
+      ``pool.free`` on retire/shed/retry — refcounts make every
+      existing free path shared-safe).
+    - **copy-on-write** — fully-filled shared pages are never written
+      again (decode appends land past them), so they are shared
+      forever; a shared partially-filled TAIL page is forked by the
+      scheduler before its first append (``refcount > 1`` at the
+      append page is the trigger).
+    - **eviction** — :meth:`evict` frees least-recently-used entries
+      with NO borrowers (pool refcount 1 = the cache's own reference),
+      leaf-first along the chain so a parent with a cached child is
+      never evicted from under it.
+
+    The cache is host-side bookkeeping only; page content lives in the
+    engine's donated KV arrays and is never touched here.
+    """
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.entries: Dict[bytes, _CacheEntry] = {}
+        self._tick = 0
+        # cumulative ledger (the scheduler mirrors these to counters)
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+        self.commits = 0
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def cached_pages(self) -> List[int]:
+        """Pages the cache holds a reference on (the ``cached=`` arm of
+        :meth:`PagePool.leak_check`)."""
+        return [e.page for e in self.entries.values()]
+
+    # -- lookup ------------------------------------------------------------
+    def _walk(self, prompt) -> List[_CacheEntry]:
+        """Longest cached run from page 0: consecutive full-page
+        entries, plus the partial tail entry only when everything
+        before it matched (a tail key embeds the whole prompt)."""
+        run: List[_CacheEntry] = []
+        for key, _end in prefix_keys(prompt, self.pool.page_size):
+            e = self.entries.get(key)
+            if e is None:
+                break
+            run.append(e)
+        return run
+
+    def peek_tokens(self, prompt) -> int:
+        """Match length in tokens WITHOUT touching LRU state or
+        borrowing — the router's affinity probe."""
+        run = self._walk(prompt)
+        return run[-1].tokens if run else 0
+
+    def match(self, prompt) -> Tuple[List[int], int]:
+        """``(pages, tokens)`` of the longest cached prefix run
+        (LRU-touched).  The pages are NOT yet borrowed — call
+        :meth:`borrow` once the request's remaining allocation
+        succeeded (all-or-nothing admission must not hold references
+        it may have to unwind)."""
+        run = self._walk(prompt)
+        self._tick += 1
+        if not run:
+            self.misses += 1
+            return [], 0
+        for e in run:
+            e.tick = self._tick
+        self.hits += 1
+        self.hit_tokens += run[-1].tokens
+        return [e.page for e in run], run[-1].tokens
+
+    def borrow(self, pages: List[int]) -> None:
+        """One reference per matched page for the borrowing request —
+        from here on the borrower's normal ``pool.free`` is the
+        release."""
+        self.pool.share(pages)
+
+    # -- publication -------------------------------------------------------
+    def commit(self, prompt, pages: List[int]) -> int:
+        """Publish a prefilled prompt's pages under their chain keys
+        (one cache-owned reference each); keys already cached keep
+        their incumbent page (two racing cold prefills of the same
+        prompt do not double-publish).  The chain stops at the first
+        key whose incumbent differs from ours — a child entry must
+        chain through OUR parent pages or a later match would stitch
+        pages from different runs.  Returns the number of new
+        entries."""
+        self._tick += 1
+        added = 0
+        parent = None
+        for (key, end), page in zip(
+            prefix_keys(prompt, self.pool.page_size), pages
+        ):
+            e = self.entries.get(key)
+            if e is not None:
+                e.tick = self._tick
+                if e.page != page:
+                    # an equivalent run is already published; our copy
+                    # of the suffix would chain through pages the
+                    # cached parent run does not reference
+                    break
+                parent = key
+                continue
+            self.pool.share([page])
+            self.entries[key] = _CacheEntry(
+                key, page, end, parent, self._tick
+            )
+            if parent is not None:
+                self.entries[parent].children += 1
+            parent = key
+            added += 1
+        if added:
+            self.commits += 1
+        return added
+
+    # -- eviction ----------------------------------------------------------
+    def _evictable(self) -> List[_CacheEntry]:
+        """Leaf entries (no cached children) with no live borrowers
+        (pool refcount 1 = only the cache's own reference), oldest
+        first."""
+        return sorted(
+            (e for e in self.entries.values()
+             if e.children == 0 and self.pool.refcount(e.page) == 1),
+            key=lambda e: (e.tick, e.page),
+        )
+
+    def _drop(self, e: _CacheEntry) -> None:
+        del self.entries[e.key]
+        if e.parent is not None and e.parent in self.entries:
+            self.entries[e.parent].children -= 1
+        self.pool.free([e.page])
+        self.evictions += 1
+
+    def evict(self, need: Optional[int] = None) -> int:
+        """Free least-recently-used borrower-free cached pages until
+        ``need`` pages came back to the pool (None = everything
+        evictable).  A parent whose last cached child is evicted
+        becomes a leaf and is considered in the same sweep.  Entries
+        with live borrowers are NEVER evicted — a borrowed stream's
+        pages stay resident by construction.  Returns pages freed."""
+        freed = 0
+        while need is None or freed < need:
+            cands = self._evictable()
+            if not cands:
+                break
+            take = cands if need is None else cands[: need - freed]
+            for e in take:
+                self._drop(e)
+                freed += 1
+                if need is not None and freed >= need:
+                    break
+        return freed
+
+    def flush(self) -> int:
+        """Teardown (drain seal / replica evacuation): release EVERY
+        cache-owned reference unconditionally — entries with live
+        borrowers only drop the cache's hold, the borrowers' own
+        references keep those pages allocated.  Returns the entry
+        count released."""
+        n = len(self.entries)
+        for e in list(self.entries.values()):
+            self.pool.free([e.page])
+        self.entries.clear()
+        self.evictions += n
+        return n
 
 
 # ---------------------------------------------------------------------------

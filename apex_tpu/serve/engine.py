@@ -96,6 +96,13 @@ class ServeConfig:
     #: "f32" keeps weights dense; "int8" packs large leaves on the
     #: comm codec and dequantizes inside the compiled step
     weight_wire: str = "f32"
+    #: static top-k cutoff for the fused in-step sampler (0 = full
+    #: vocab); per-request temperature rides the call (temp<=0 stays
+    #: greedy/argmax, bit-identical to the pre-sampling engine)
+    top_k: int = 0
+    #: PRNG seed for the fused sampler (one key per engine call,
+    #: folded with the call index — deterministic replay)
+    sample_seed: int = 0
     #: run analysis.check over every step program at build (ERROR
     #: findings raise)
     verify: bool = True
@@ -109,6 +116,8 @@ class ServeConfig:
     def __post_init__(self):
         if self.kv_wire not in ("f32", "int8"):
             raise ValueError(f"kv_wire must be f32|int8, got {self.kv_wire!r}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
         if self.weight_wire not in ("f32", "int8"):
             raise ValueError(
                 f"weight_wire must be f32|int8, got {self.weight_wire!r}"
@@ -183,7 +192,11 @@ class InferenceEngine:
             kv_wire=self.serve.kv_wire,
         )
         self._prefill: Dict[int, object] = {}
+        self._chunk: Dict[int, object] = {}
         self._decode = None
+        self._fork = None
+        # the fused sampler's key chain: one fold per engine call
+        self._rng_base = jax.random.PRNGKey(self.serve.sample_seed)
         #: optional :class:`~apex_tpu.observability.spans.SpanRecorder`
         #: — when set, every prefill/decode call records an
         #: ``engine/prefill`` / ``engine/decode`` span (the scheduler
@@ -223,13 +236,16 @@ class InferenceEngine:
         board.set("serve/weight_wire", s.weight_wire)
 
     def _prefill_fn(self, bucket: int):
-        np_ = bucket // self.serve.page_size
+        s = self.serve
+        np_ = bucket // s.page_size
 
-        def fn(params, kv_pages, tokens, length, page_ids):
+        def fn(params, kv_pages, tokens, length, page_ids, temp, rng):
             return model_lib.prefill_body(
                 self.cfg, params, kv_pages, tokens, length, page_ids,
-                page_size=self.serve.page_size,
-                kv_wire=self.serve.kv_wire,
+                temp, rng,
+                page_size=s.page_size,
+                kv_wire=s.kv_wire,
+                top_k=s.top_k,
             )
 
         fn.__name__ = f"serve_prefill_{bucket}"
@@ -239,16 +255,47 @@ class InferenceEngine:
             jnp.zeros((bucket, 1), jnp.int32),
             jnp.asarray(1, jnp.int32),
             jnp.zeros((np_,), jnp.int32),
+            jnp.zeros((), jnp.float32),
+            self._rng_base,
+        )
+        return fn, args
+
+    def _chunk_fn(self, bucket: int):
+        s = self.serve
+        np_ = bucket // s.page_size
+
+        def fn(params, kv_pages, tokens, length, offset, chunk_page_ids,
+               page_table, temp, rng):
+            return model_lib.chunk_prefill_body(
+                self.cfg, params, kv_pages, tokens, length, offset,
+                chunk_page_ids, page_table, temp, rng,
+                page_size=s.page_size,
+                kv_wire=s.kv_wire,
+                top_k=s.top_k,
+            )
+
+        fn.__name__ = f"serve_chunk_prefill_{bucket}"
+        args = (
+            self.params,
+            self.cache,
+            jnp.zeros((bucket, 1), jnp.int32),
+            jnp.asarray(1, jnp.int32),
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros((np_,), jnp.int32),
+            jnp.zeros((s.max_pages_per_seq,), jnp.int32),
+            jnp.zeros((), jnp.float32),
+            self._rng_base,
         )
         return fn, args
 
     def _decode_fn(self):
         s = self.serve
 
-        def fn(params, kv_pages, tokens, lengths, page_tables):
+        def fn(params, kv_pages, tokens, lengths, page_tables, temps, rng):
             return model_lib.decode_body(
                 self.cfg, params, kv_pages, tokens, lengths, page_tables,
-                page_size=s.page_size, kv_wire=s.kv_wire,
+                temps, rng,
+                page_size=s.page_size, kv_wire=s.kv_wire, top_k=s.top_k,
             )
 
         fn.__name__ = "serve_decode"
@@ -258,13 +305,34 @@ class InferenceEngine:
             jnp.zeros((s.max_batch,), jnp.int32),
             jnp.zeros((s.max_batch,), jnp.int32),
             jnp.zeros((s.max_batch, s.max_pages_per_seq), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.float32),
+            self._rng_base,
         )
         return fn, args
 
-    def _compile(self, name: str, fn, args):
+    def _fork_fn(self):
+        def fn(kv_pages, src, dst):
+            # copy-on-write fork: duplicate one page's rows (codes AND
+            # scale planes under the int8 wire) across every layer
+            return {
+                name: arr.at[:, dst].set(arr[:, src])
+                for name, arr in kv_pages.items()
+            }
+
+        fn.__name__ = "serve_fork_page"
+        args = (
+            self.cache,
+            jnp.asarray(0, jnp.int32),
+            jnp.asarray(0, jnp.int32),
+        )
+        return fn, args
+
+    def _compile(self, name: str, fn, args, *, donate: int = 1):
         from apex_tpu import analysis
 
-        compiled = jax.jit(fn, donate_argnums=(1,)).lower(*args).compile()
+        compiled = (
+            jax.jit(fn, donate_argnums=(donate,)).lower(*args).compile()
+        )
         if self.serve.verify:
             # lint the executable we just paid for (lint_hlo/lint_jaxpr
             # instead of analysis.check, which would trace+compile the
@@ -276,7 +344,7 @@ class InferenceEngine:
             hlo_text = compiled.as_text()
             report = analysis.lint_hlo(
                 hlo_text,
-                donated=len(jax.tree_util.tree_leaves(args[1])),
+                donated=len(jax.tree_util.tree_leaves(args[donate])),
                 hbm_budget=self.serve.hbm_budget_bytes,
                 name=f"serve/{name}",
             )
@@ -303,12 +371,20 @@ class InferenceEngine:
         self._sentinels[name] = analysis.RetraceSentinel(name=name)
         return compiled
 
-    def build(self, buckets: Optional[Tuple[int, ...]] = None):
+    def build(self, buckets: Optional[Tuple[int, ...]] = None, *,
+              chunked: bool = False):
         """Compile (and verify) the decode step and every prefill
         bucket eagerly.  Lazy compilation still happens on first use of
-        a bucket that was skipped here."""
+        a bucket that was skipped here.  ``chunked=True`` additionally
+        warms every chunk-prefill bucket and the COW fork program —
+        a prefix-cache/chunked-prefill deployment should pay those
+        compiles at build, not inside the first cache hit's TTFT."""
         for b in buckets if buckets is not None else self.serve.buckets():
             self._get_prefill(b)
+            if chunked:
+                self._get_chunk(b)
+        if chunked:
+            self._get_fork()
         self._get_decode()
         return self
 
@@ -334,8 +410,9 @@ class InferenceEngine:
         self.rebuilds += 1
         if full:
             self._prefill.clear()
+            self._chunk.clear()
             for name in list(self._sentinels):
-                if name.startswith("prefill"):
+                if name.startswith(("prefill", "chunk_prefill")):
                     del self._sentinels[name]
         fn, args = self._decode_fn()
         self._decode = self._compile("decode", fn, args)
@@ -349,6 +426,20 @@ class InferenceEngine:
                 f"prefill_{bucket}", fn, args
             )
         return self._prefill[bucket]
+
+    def _get_chunk(self, bucket: int):
+        if bucket not in self._chunk:
+            fn, args = self._chunk_fn(bucket)
+            self._chunk[bucket] = self._compile(
+                f"chunk_prefill_{bucket}", fn, args
+            )
+        return self._chunk[bucket]
+
+    def _get_fork(self):
+        if self._fork is None:
+            fn, args = self._fork_fn()
+            self._fork = self._compile("fork_page", fn, args, donate=0)
+        return self._fork
 
     def _get_decode(self):
         if self._decode is None:
@@ -421,11 +512,18 @@ class InferenceEngine:
             return fault
         raise chaos.InjectedFault(site, call_idx, fault.mode)
 
-    def prefill(self, prompt_ids, page_ids) -> Tuple[np.ndarray, int]:
+    def _sample_key(self, idx: int):
+        """Deterministic per-call PRNG key for the fused sampler."""
+        return jax.random.fold_in(self._rng_base, idx)
+
+    def prefill(self, prompt_ids, page_ids, *,
+                temperature: float = 0.0) -> Tuple[np.ndarray, int]:
         """Run the prompt through the bucketed prefill: writes its K/V
         into ``page_ids`` (null-padded to the bucket's page count) and
-        returns ``(last_logits (V,), first_token)``.  The in-step
-        non-finite screen lands on :attr:`last_prefill_finite`."""
+        returns ``(last_logits (V,), first_token)``.  The first token
+        is sampled in-step (``temperature<=0`` = greedy argmax); the
+        in-step non-finite screen lands on
+        :attr:`last_prefill_finite`."""
         poison = self._chaos_gate(chaos.SERVE_PREFILL, self.prefill_calls)
         n = len(prompt_ids)
         bucket = self.bucket_for(n)
@@ -439,6 +537,8 @@ class InferenceEngine:
         args = (
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(n, jnp.int32), jnp.asarray(ids),
+            jnp.asarray(temperature, jnp.float32),
+            self._sample_key(self.prefill_calls),
         )
         self._sentinels[name].observe(*args)
         self.prefill_calls += 1
@@ -461,7 +561,75 @@ class InferenceEngine:
             )
         return logits, first
 
-    def decode(self, tokens, lengths, page_tables):
+    def chunk_prefill(self, chunk_ids, offset, page_table_row,
+                      chunk_page_ids, *,
+                      temperature: float = 0.0) -> Tuple[np.ndarray, int]:
+        """One page-multiple prefill chunk with carry-in KV offset
+        (:func:`apex_tpu.serve.model.chunk_prefill_body`): positions
+        before ``offset`` are read from the paged cache through
+        ``page_table_row`` — committed prefix-cache pages and this
+        request's own earlier chunks alike — and the chunk's K/V are
+        written to ``chunk_page_ids`` (null entries skip pages a
+        borrowed cache run already holds).  Returns ``(last_logits
+        (V,), next_token)`` for the chunk's final live position; the
+        scheduler consumes the token only from the FINAL chunk.  Rides
+        the ``serve.prefill`` chaos site and
+        :attr:`last_prefill_finite` exactly like :meth:`prefill`."""
+        poison = self._chaos_gate(chaos.SERVE_PREFILL, self.prefill_calls)
+        n = len(chunk_ids)
+        bucket = self.bucket_for(n)
+        np_b = bucket // self.serve.page_size
+        tokens = np.zeros((bucket, 1), np.int32)
+        tokens[:n, 0] = np.asarray(chunk_ids, np.int32)
+        ids = np.full((np_b,), cache_lib.NULL_PAGE, np.int32)
+        ids[: len(chunk_page_ids)] = np.asarray(chunk_page_ids, np.int32)
+        table = np.full(
+            (self.serve.max_pages_per_seq,), cache_lib.NULL_PAGE, np.int32
+        )
+        table[: len(page_table_row)] = np.asarray(
+            page_table_row, np.int32
+        )
+        compiled = self._get_chunk(bucket)
+        name = f"chunk_prefill_{bucket}"
+        args = (
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(n, jnp.int32), jnp.asarray(offset, jnp.int32),
+            jnp.asarray(ids), jnp.asarray(table),
+            jnp.asarray(temperature, jnp.float32),
+            self._sample_key(self.prefill_calls),
+        )
+        self._sentinels[name].observe(*args)
+        self.prefill_calls += 1
+        rec = self.spans
+        t0 = rec.now() if rec is not None else None
+        logits, next_token, finite, self.cache = compiled(*args)
+        first = int(next_token)
+        self.last_prefill_finite = bool(finite) and poison is None
+        if rec is not None:
+            from apex_tpu.observability.spans import TRACK_ENGINE
+
+            rec.span(
+                "engine/prefill", t0, rec.now(), track=TRACK_ENGINE,
+                bucket=bucket, tokens=n, offset=int(offset),
+                call=self.prefill_calls, chunked=True,
+            )
+        return logits, first
+
+    def fork_page(self, src: int, dst: int) -> None:
+        """Copy-on-write fork: duplicate page ``src``'s content into
+        ``dst`` across every layer (codes AND scale planes under the
+        int8 KV wire) through one tiny compiled donated program — the
+        device half of the scheduler's shared-tail-page fork."""
+        compiled = self._get_fork()
+        args = (
+            self.cache,
+            jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32),
+        )
+        self._sentinels["fork_page"].observe(*args)
+        self.cache = compiled(*args)
+
+    def decode(self, tokens, lengths, page_tables, temps=None):
         """One decode iteration over the full slot array.  ``lengths``
         counts each slot's context INCLUDING the token being fed (0 =
         idle slot).  Returns ``(logits (B, V), next_tokens (B,))`` —
@@ -478,6 +646,9 @@ class InferenceEngine:
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(page_tables, jnp.int32),
+            jnp.zeros((self.serve.max_batch,), jnp.float32)
+            if temps is None else jnp.asarray(temps, jnp.float32),
+            self._sample_key(self.decode_iters),
         )
         self._sentinels["decode"].observe(*args)
         self.decode_iters += 1

@@ -48,7 +48,9 @@ __all__ = [
     "PackedWeight",
     "quantize_params",
     "dequantize_params",
+    "sample_tokens",
     "prefill_body",
+    "chunk_prefill_body",
     "decode_body",
 ]
 
@@ -204,6 +206,36 @@ def _mlp(x, bp, cfg):
 
 
 # ---------------------------------------------------------------------------
+# fused sampling: greedy / temperature / top-k inside the compiled step
+# ---------------------------------------------------------------------------
+
+
+def sample_tokens(logits, temps, rng, *, top_k: int = 0):
+    """Sample next tokens INSIDE the compiled step — the host never
+    round-trips the logits ("LLM Inference Acceleration via Efficient
+    Operation Fusion", PAPERS.md: keep the sampling tail fused).
+
+    ``logits`` is ``(..., V)`` f32, ``temps`` broadcasts against the
+    leading dims: a slot with ``temp <= 0`` decodes greedily (argmax —
+    bit-identical to the pre-sampling engine), a positive temperature
+    draws via the Gumbel-argmax trick over ``logits / temp`` after the
+    static ``top_k`` mask (0 = full vocab).  One PRNG key per engine
+    call keeps the draw deterministic given ``ServeConfig.sample_seed``
+    and the call index."""
+    temps = jnp.asarray(temps, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    vocab = logits.shape[-1]
+    masked = logits
+    if 0 < top_k < vocab:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        masked = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = masked / jnp.maximum(temps, 1e-6)[..., None]
+    gumbel = jax.random.gumbel(rng, logits.shape, dtype=jnp.float32)
+    sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+# ---------------------------------------------------------------------------
 # prefill: full-sequence forward that also yields per-position K/V
 # ---------------------------------------------------------------------------
 
@@ -237,9 +269,12 @@ def prefill_body(
     tokens,          # (S, 1) int32 — one sequence, bucket-padded
     length,          # ()    int32 — live prompt positions
     page_ids,        # (S/page,) int32 — null-page entries pad the tail
+    temp=None,       # ()    f32 sampling temperature (None = argmax)
+    rng=None,        # PRNG key for the fused sampler
     *,
     page_size: int,
     kv_wire: str = "f32",
+    top_k: int = 0,
 ):
     """Full prefill: forward the (padded) prompt, write every layer's
     K/V into the assigned pages, and return the last live position's
@@ -307,7 +342,177 @@ def prefill_body(
     )  # (1, hidden)
     h_last = _layer_norm(h_last, tree["ln_f"], cfg.layer_norm_eps)
     logits = _logits(tree, h_last, cfg.dtype)[0]  # (V,) f32
-    next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_token = sample_tokens(logits, temp, rng, top_k=top_k)
+    finite = jnp.isfinite(logits).all()
+    return logits, next_token, finite, kv_pages
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: a page-multiple prompt slice with carry-in KV offset
+# ---------------------------------------------------------------------------
+
+
+def _dequant_rows(codes, scale):
+    """(..., page, D) int8 codes + (..., page) f32 scales -> f32 rows
+    (the comm codec at block = D: one scale per row)."""
+    return codes.astype(jnp.float32) * scale[..., None]
+
+
+def chunk_prefill_body(
+    cfg: GptConfig,
+    params,
+    kv_pages: dict,
+    tokens,          # (C, 1) int32 — one chunk, bucket-padded
+    length,          # ()     int32 — live tokens in THIS chunk
+    offset,          # ()     int32 — absolute position of tokens[0]
+    chunk_page_ids,  # (C/page,) int32 — null entries skip the write
+                     # (cached pages a borrower must never rewrite)
+    page_table,      # (NP,)  int32 — the request's full page table
+    temp=None,       # ()     f32 sampling temperature (None = argmax)
+    rng=None,        # PRNG key for the fused sampler
+    *,
+    page_size: int,
+    kv_wire: str = "f32",
+    top_k: int = 0,
+):
+    """One page-multiple prefill chunk with **carry-in KV offset**: the
+    chunk's queries attend to every position before ``offset`` through
+    the paged cache (a dense gather over ``page_table`` — committed
+    prefix-cache pages and this request's own earlier chunks read the
+    same way) plus the in-chunk keys causally.  Writes the chunk's K/V
+    to ``chunk_page_ids``; entries pointing at the null page skip
+    pages a borrowed cache run already holds (re-running the final
+    chunk of a full-prefix hit recomputes the first token's logits
+    WITHOUT touching shared pages).
+
+    The chunk slicing is deterministic, so a cache-hit request that
+    re-runs the same final chunk over bit-identical cached pages
+    produces bit-identical logits to the cold run — the foundation of
+    the serve_bench bit-identity proof.
+
+    Returns ``(logits (V,) f32, next_token () int32, finite () bool,
+    kv_pages)`` for the LAST live chunk position (only the final chunk's
+    token is consumed; earlier chunks run for their KV writes).
+    """
+    params = dequantize_params(params)
+    tree = _tree(params)
+    x = _embed(tree["word_embeddings"], tokens, cfg.dtype)  # (C, 1, h)
+    c = tokens.shape[0]
+    heads = cfg.num_heads
+    head_dim = cfg.hidden_size // heads
+    positions = offset + jnp.arange(c, dtype=jnp.int32)
+    cos_rows = sin_rows = None
+    if cfg.rotary:
+        cos_t, sin_t = _rope_cos_sin(cfg.max_seq_len, head_dim)
+        cos_rows = jnp.take(cos_t, positions, axis=0)  # (C, D)
+        sin_rows = jnp.take(sin_t, positions, axis=0)
+    else:
+        rows = jnp.take(tree["position_embeddings"], positions, axis=0)
+        x = x + rows[:, None, :].astype(cfg.dtype)
+
+    bp = tree["layers"]["block"]
+    int8 = kv_wire == "int8"
+    t_ctx = page_table.shape[0] * page_size
+    # carry-in mask: gathered row t is absolute position t of this
+    # sequence; only positions before the chunk are valid carry
+    carry_valid = jnp.arange(t_ctx) < offset          # (T,)
+    causal = (
+        jnp.arange(c)[:, None] >= jnp.arange(c)[None, :]
+    )                                                  # (C, C) in-chunk
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(carry_valid[None, :], (c, t_ctx)), causal],
+        axis=1,
+    )[None]                                            # (1, C, T+C)
+    scale = head_dim**-0.5
+    big_neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    xs = (bp, kv_pages["k"], kv_pages["v"]) + (
+        (kv_pages["k_scale"], kv_pages["v_scale"]) if int8 else ()
+    )
+
+    def layer(x, xs):
+        if int8:
+            lp, k_l, v_l, ks_l, vs_l = xs
+        else:
+            lp, k_l, v_l = xs
+            ks_l = vs_l = None
+        y = _layer_norm(x, lp["ln_attn"], cfg.layer_norm_eps)
+        qkv = _linear(y, lp["qkv"], cfg.dtype)
+        qkv = qkv.reshape(c, 1, heads, 3, head_dim)
+        q, k, v = (
+            jnp.transpose(qkv[:, :, :, i], (1, 2, 0, 3)) for i in range(3)
+        )  # (1, H, C, D)
+        if cfg.rotary:
+            q = fused_apply_rotary_pos_emb_cached(q, cos_rows, sin_rows)
+            k = fused_apply_rotary_pos_emb_cached(k, cos_rows, sin_rows)
+        # carry-in K/V: dense gather of the whole page table, read
+        # through the cache wire (exactly how decode reads it)
+        if int8:
+            k_ctx = _dequant_rows(k_l[page_table], ks_l[page_table])
+            v_ctx = _dequant_rows(v_l[page_table], vs_l[page_table])
+        else:
+            k_ctx = k_l[page_table].astype(jnp.float32)
+            v_ctx = v_l[page_table].astype(jnp.float32)
+        # (NP, H, page, D) -> (H, T, D) in absolute position order
+        k_ctx = jnp.transpose(k_ctx, (1, 0, 2, 3)).reshape(
+            heads, t_ctx, head_dim
+        )
+        v_ctx = jnp.transpose(v_ctx, (1, 0, 2, 3)).reshape(
+            heads, t_ctx, head_dim
+        )
+        # in-chunk keys stay exact (the same in-flight numerics the
+        # monolithic prefill uses for every prompt position)
+        kf = k[0].astype(jnp.float32)                  # (H, C, D)
+        vf = v[0].astype(jnp.float32)
+        k_all = jnp.concatenate([k_ctx, kf], axis=1)   # (H, T+C, D)
+        v_all = jnp.concatenate([v_ctx, vf], axis=1)
+        qf = q[0].astype(jnp.float32)                  # (H, C, D)
+        scores = jnp.einsum("hcd,htd->hct", qf, k_all) * scale
+        scores = jnp.where(mask, scores, big_neg)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("hct,htd->hcd", probs, v_all)  # (H, C, D)
+        ctx = jnp.transpose(ctx, (1, 0, 2)).reshape(
+            c, 1, heads * head_dim
+        ).astype(cfg.dtype)
+        x = x + _linear(ctx, lp["out"], cfg.dtype)
+        x = _mlp(x, lp, cfg)
+        # write the chunk's K/V pages (null entries dump cached pages'
+        # re-runs into write-only garbage)
+        k_rows = jnp.transpose(k[0], (1, 0, 2))        # (C, H, D)
+        v_rows = jnp.transpose(v[0], (1, 0, 2))
+        k_blocks = cache_lib.pack_prompt_pages(k_rows, page_size)
+        v_blocks = cache_lib.pack_prompt_pages(v_rows, page_size)
+        if int8:
+            k_codes, k_sc = cache_lib.encode_kv(k_blocks)
+            v_codes, v_sc = cache_lib.encode_kv(v_blocks)
+            k_l = k_l.at[chunk_page_ids].set(k_codes.astype(k_l.dtype))
+            v_l = v_l.at[chunk_page_ids].set(v_codes.astype(v_l.dtype))
+            ks_l = ks_l.at[chunk_page_ids].set(k_sc)
+            vs_l = vs_l.at[chunk_page_ids].set(v_sc)
+            return x, (k_l, v_l, ks_l, vs_l)
+        k_l = k_l.at[chunk_page_ids].set(k_blocks.astype(k_l.dtype))
+        v_l = v_l.at[chunk_page_ids].set(v_blocks.astype(v_l.dtype))
+        return x, (k_l, v_l)
+
+    x, new = jax.lax.scan(layer, x, xs)
+    if int8:
+        kv_pages = dict(
+            kv_pages, k=new[0], v=new[1], k_scale=new[2], v_scale=new[3]
+        )
+    else:
+        kv_pages = dict(kv_pages, k=new[0], v=new[1])
+
+    h_last = jax.lax.dynamic_slice_in_dim(
+        x[:, 0], jnp.maximum(length - 1, 0), 1, 0
+    )  # (1, hidden)
+    h_last = _layer_norm(h_last, tree["ln_f"], cfg.layer_norm_eps)
+    logits = _logits(tree, h_last, cfg.dtype)[0]  # (V,) f32
+    if rng is None:
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_token = sample_tokens(logits, temp, rng, top_k=top_k)
     finite = jnp.isfinite(logits).all()
     return logits, next_token, finite, kv_pages
 
@@ -324,9 +529,12 @@ def decode_body(
     tokens,       # (B,) int32 — current token per slot
     lengths,      # (B,) int32 — context length AFTER this token; 0 = idle
     page_tables,  # (B, NP) int32
+    temps=None,   # (B,) f32 per-slot sampling temperature (None = argmax)
+    rng=None,     # PRNG key for the fused sampler
     *,
     page_size: int,
     kv_wire: str = "f32",
+    top_k: int = 0,
 ):
     """One continuous-batching decode iteration over the full slot
     array.  Per layer: project the token, rotate K, append K/V to this
@@ -411,6 +619,9 @@ def decode_body(
 
     h = _layer_norm(x, tree["ln_f"], cfg.layer_norm_eps)
     logits = _logits(tree, h, cfg.dtype)  # (B, V) f32
-    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_tokens = sample_tokens(logits, temps, rng, top_k=top_k)
     finite = jnp.isfinite(logits).all(axis=-1)
     return logits, next_tokens, finite, kv_pages

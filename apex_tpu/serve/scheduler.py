@@ -79,14 +79,28 @@ training telemetry rides, so :class:`~apex_tpu.observability.health.
 TTFTRule` / :class:`~apex_tpu.observability.health.QueueDepthRule`
 watchdogs page the same health layer (``docs/serving.md``).
 
+**Prefix caching & chunked prefill** (``docs/serving.md``): with
+``prefix_cache=True`` every admitted prompt is matched against a
+content-addressed cache of committed KV page runs
+(:class:`~apex_tpu.serve.cache.PrefixCache`) — hit pages are borrowed
+(refcounted, copy-on-write on the first divergent append) and their
+prefill is SKIPPED; only the prompt's final chunk re-runs, so a shared
+system prompt is paid for once.  ``prefill_chunk_tokens=`` additionally
+slices cold prefills into page-multiple chunks advanced one per step
+between decode iterations (the ``prefilling`` slot phase), so a long
+cold prompt no longer stalls running streams.  Both default OFF.
+
 **TTFT attribution** (``docs/observability.md``): each completed
-request's TTFT decomposes into three components that sum to the
+request's TTFT decomposes into four components that sum to the
 measured TTFT *by construction* (the same remainder discipline
 :mod:`~apex_tpu.observability.attribution` applies to step time):
 
 - ``queue_wait`` — time the request sat in the queue while admission
   was **resource-blocked** (no free decode slot, or the page pool
   could not cover the queue head);
+- ``cached_prefill`` — the prefix-cache share of the post-admission
+  phase (hash/match/borrow and page allocation up to the first engine
+  call); exactly 0.0 when the cache is off;
 - ``prefill``    — admission to first token (the prefill program);
 - ``contention`` — the remainder of the pre-admission wait: the
   request was admissible but the scheduler was busy running decode
@@ -119,7 +133,7 @@ from apex_tpu.observability.ometrics import (
     Histogram,
 )
 from apex_tpu.resilience import chaos
-from apex_tpu.serve.cache import NULL_PAGE
+from apex_tpu.serve.cache import NULL_PAGE, PrefixCache
 
 __all__ = [
     "Request",
@@ -135,6 +149,10 @@ _ids = itertools.count()
 
 QUEUED = "queued"
 RUNNING = "running"
+#: chunked prefill in flight: the request holds a decode slot (so its
+#: pages and position are pinned) but rides NO decode iteration until
+#: its final prefill chunk produced the first token
+PREFILLING = "prefilling"
 #: fault recovery: the request left the batch (or never reached it)
 #: after a fault and waits at the queue front for bounded re-admission
 #: with its pages and generated prefix retained
@@ -171,8 +189,11 @@ SHED_REASONS = (
 )
 
 #: TTFT attribution components (ms); they sum to the measured TTFT by
-#: construction — see the module docstring
-TTFT_COMPONENTS = ("queue_wait", "prefill", "contention")
+#: construction — see the module docstring.  ``cached_prefill`` is the
+#: prefix-cache share of the post-admission phase (hash/match/borrow/
+#: alloc up to the first engine call); it is EXACTLY 0.0 when the
+#: cache is off, so the legacy three-component sum is unchanged.
+TTFT_COMPONENTS = ("queue_wait", "cached_prefill", "prefill", "contention")
 
 def ttft_attribution(comps) -> Dict[str, object]:
     """Aggregate per-request TTFT components
@@ -219,6 +240,9 @@ class Request:
     #: sends it through bounded re-admission retry (prefix preserved).
     #: None inherits the scheduler's default (usually also None).
     decode_timeout_ms: Optional[float] = None
+    #: sampling temperature for the fused in-step sampler; <= 0 is
+    #: greedy argmax (bit-identical to the pre-sampler engine)
+    temperature: float = 0.0
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
 
     # -- runtime ledger (scheduler-owned) --------------------------------
@@ -249,6 +273,22 @@ class Request:
     #: original ``max_new_tokens`` when the overload ladder clamped it
     #: (None = never clamped)
     clamped_from: Optional[int] = None
+    # -- prefix cache / chunked prefill (scheduler-owned) ----------------
+    #: prompt tokens already covered by KV pages (cache hit + completed
+    #: prefill chunks); equals ``len(prompt)`` once prefill is done
+    prefill_pos: int = 0
+    #: prompt tokens the prefix cache covered at admission (0 = miss)
+    cache_hit_tokens: int = 0
+    #: leading pages of :attr:`pages` borrowed from the cache (refcount
+    #: shared — chunk writes to them are redirected to the null page)
+    cache_hit_pages: int = 0
+    #: the cache was already probed for this request (the match/borrow
+    #: runs ONCE, even when admission then blocks on the pool)
+    cache_probed: bool = False
+    #: first engine prefill/chunk call for this request — splits the
+    #: post-admission phase into ``cached_prefill`` (match/borrow/alloc)
+    #: and ``prefill`` (compute); None = cache off, component is 0.0
+    prefill_started_at: Optional[float] = None
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -257,9 +297,11 @@ class Request:
         return 1e3 * (self.first_token_at - self.submitted_at)
 
     def ttft_components(self) -> Optional[Dict[str, float]]:
-        """``{ttft_ms, queue_wait_ms, prefill_ms, contention_ms}`` —
-        the three components sum to ``ttft_ms`` by construction
-        (contention is the remainder of the pre-admission wait)."""
+        """``{ttft_ms, queue_wait_ms, cached_prefill_ms, prefill_ms,
+        contention_ms}`` — the four components sum to ``ttft_ms`` by
+        construction (contention is the remainder of the pre-admission
+        wait; ``cached_prefill_ms`` is exactly 0.0 when the prefix
+        cache is off)."""
         if (
             self.submitted_at is None
             or self.admitted_at is None
@@ -267,13 +309,23 @@ class Request:
         ):
             return None
         queue_wait = 1e3 * self.queue_blocked_s
-        prefill = 1e3 * (self.first_token_at - self.admitted_at)
+        cached = (
+            1e3 * (self.prefill_started_at - self.admitted_at)
+            if self.prefill_started_at is not None else 0.0
+        )
+        prefill = 1e3 * (
+            self.first_token_at
+            - (self.prefill_started_at
+               if self.prefill_started_at is not None
+               else self.admitted_at)
+        )
         contention = (
             1e3 * (self.admitted_at - self.submitted_at) - queue_wait
         )
         return {
             "ttft_ms": self.ttft_ms,
             "queue_wait_ms": queue_wait,
+            "cached_prefill_ms": cached,
             "prefill_ms": prefill,
             "contention_ms": contention,
         }
@@ -295,8 +347,17 @@ def declare_serve_metrics(registry) -> None:
               "serve/retries", "serve/readmitted", "serve/clamped",
               "serve/decode_timeouts", "serve/engine_faults",
               "serve/engine_rebuilds", "serve/admission_faults",
-              "serve/kv_alloc_faults", "serve/drains"):
+              "serve/kv_alloc_faults", "serve/drains",
+              # prefix-cache ledger (docs/serving.md "Prefix caching"):
+              # admission hits/misses, tokens whose prefill the cache
+              # skipped, COW tail-page forks, committed runs, LRU
+              # evictions under pool pressure + forced chaos sweeps
+              "serve/prefix_hits", "serve/prefix_misses",
+              "serve/prefix_hit_tokens", "serve/prefix_forks",
+              "serve/prefix_commits", "serve/prefix_evictions",
+              "serve/prefix_evict_faults"):
         registry.counter(c)
+    registry.gauge("serve/prefix_cached_pages")
     # per-reason shed breakdown (sums to serve/shed)
     for reason in SHED_REASONS:
         registry.counter(f"serve/shed_{reason}")
@@ -335,11 +396,27 @@ class ContinuousBatchingScheduler:
                  clamp_occupancy: float = 0.75,
                  clamp_queue_depth: Optional[int] = None,
                  rebuild_limit: int = 2,
-                 leak_checks: bool = True):
+                 leak_checks: bool = True,
+                 prefix_cache: bool = False,
+                 prefill_chunk_tokens: Optional[int] = None):
         self.engine = engine
         self.pool = engine.pool
         self.serve = engine.serve
         self.clock = clock
+        # cross-request prefix cache + chunked prefill (docs/serving.md
+        # "Prefix caching & chunked prefill"); both default OFF — the
+        # monolithic cold path stays byte-for-byte the legacy one
+        self.prefix = PrefixCache(self.pool) if prefix_cache else None
+        if prefill_chunk_tokens is not None and (
+            prefill_chunk_tokens <= 0
+            or prefill_chunk_tokens % self.serve.page_size
+        ):
+            raise ValueError(
+                "prefill_chunk_tokens must be a positive multiple of "
+                f"page_size={self.serve.page_size}, got "
+                f"{prefill_chunk_tokens}"
+            )
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         # failure/degradation knobs (docs/serving.md "Failure
         # semantics & degradation ladder")
         self.max_queue_depth = max_queue_depth
@@ -548,20 +625,37 @@ class ContinuousBatchingScheduler:
         sizes; a latency-critical deployment with a very large pool
         can pass ``leak_checks=False`` and rely on the chaos drill's
         continuous proof instead."""
-        self.pool.leak_check(self.owned_pages())
+        self.pool.leak_check(
+            self.owned_pages(),
+            cached=self.prefix.cached_pages()
+            if self.prefix is not None else (),
+        )
         self.leak_checks_run += 1
 
     def _alloc(self, n: int) -> Optional[List[int]]:
         """Pool allocation behind the ``serve.kv_alloc`` chaos site: an
         active fault forces the all-or-nothing failure path (returns
         None), driving the same shedding/backpressure machinery a
-        genuinely exhausted pool drives — no separate failure code."""
+        genuinely exhausted pool drives — no separate failure code.
+        An exhausted pool first reclaims idle prefix-cache runs (LRU,
+        never a borrowed page) before the failure path is taken —
+        cached history is strictly lower-priority than live work."""
         idx = self._kv_allocs
         self._kv_allocs += 1
         if chaos.active(chaos.SERVE_KV_ALLOC, idx) is not None:
             self._count("serve/kv_alloc_faults")
             return None
-        return self.pool.alloc(n)
+        got = self.pool.alloc(n)
+        if got is None and self.prefix is not None:
+            freed = self.prefix.evict(need=n)
+            if freed:
+                self._count("serve/prefix_evictions", freed)
+                # prove the ledger exact right after the sweep — before
+                # the retry hands out pages no request owns yet
+                if self.leak_checks:
+                    self.leak_check()
+                got = self.pool.alloc(n)
+        return got
 
     # -- fault recovery ----------------------------------------------------
     def _send_to_retry(self, req: Request, cause: str) -> None:
@@ -711,8 +805,32 @@ class ContinuousBatchingScheduler:
             self._shed_request(req, SHED_OVERSIZE)
             return True
         need = self.pool.pages_for(len(req.prompt))
+        if (
+            self.prefix is not None
+            and not req.cache_probed
+            and req.first_token_at is None
+        ):
+            # ONE cache probe per request: match + borrow pin the hit
+            # run (refcount+1 per page) BEFORE any allocation, so the
+            # LRU eviction the allocation below may trigger can never
+            # reclaim the pages this request is about to ride.  The
+            # borrowed pages sit on ``req.pages`` from here on — the
+            # ownership ledger covers them whether the request admits
+            # now, waits pool-blocked in the queue, retries, or sheds.
+            req.cache_probed = True
+            hit_pages, hit_tokens = self.prefix.match(req.prompt)
+            if hit_tokens:
+                self.prefix.borrow(hit_pages)
+                req.pages = list(hit_pages)
+                req.cache_hit_pages = len(hit_pages)
+                req.cache_hit_tokens = hit_tokens
+                self._count("serve/prefix_hits")
+                self._count("serve/prefix_hit_tokens", hit_tokens)
+            else:
+                self._count("serve/prefix_misses")
         if len(req.pages) < need:
-            pages = self._alloc(need)
+            grown = self._alloc(need - len(req.pages))
+            pages = None if grown is None else req.pages + grown
             if pages is None:
                 # pool exhausted: shed only once the TTFT budget is
                 # already blown — before that the request just waits
@@ -753,10 +871,21 @@ class ContinuousBatchingScheduler:
                 req.rid, "prefill", now,
                 bucket=self.engine.bucket_for(len(req.prompt)),
                 prompt_tokens=len(req.prompt), pages=len(pages),
+                **({"cached_tokens": req.cache_hit_tokens}
+                   if req.cache_hit_tokens else {}),
                 **({"attempt": req.retries} if req.retries else {}),
             )
+        if self.prefix is not None or self.prefill_chunk_tokens is not None:
+            # prefix-cache / chunked mode: the slot is taken NOW (pages
+            # and position pinned) but the prefill itself advances one
+            # page-multiple chunk per step, interleaved between decode
+            # iterations — a long cold prompt no longer stalls running
+            # streams, and a cache hit re-runs only its final chunk
+            return self._start_chunked_prefill(req, slot)
         try:
-            _, first = self.engine.prefill(req.prompt, pages)
+            _, first = self.engine.prefill(
+                req.prompt, pages, temperature=req.temperature
+            )
         except Exception as e:
             # a crashed prefill is transient by default: the request
             # keeps its pages and re-enters through bounded retry (the
@@ -770,6 +899,69 @@ class ContinuousBatchingScheduler:
             # the process — its logits are not evidence of anything
             self._shed_request(req, SHED_POISONED)
             return True
+        return self._finish_prefill(req, slot, first)
+
+    def _start_chunked_prefill(self, req: Request, slot: int) -> bool:
+        """Enter the ``prefilling`` phase: position the prefill cursor
+        past the cache hit (floored to the chunk grain so a hit re-runs
+        the exact same FINAL chunk the cold run executed — that is what
+        makes the hit's first token bit-identical under a fixed
+        ``prefill_chunk_tokens``) and park the request in its slot.
+        :meth:`_advance_prefills` runs one chunk per step from here."""
+        n = len(req.prompt)
+        grain = self.prefill_chunk_tokens or self.serve.page_size
+        # never skip the last position: its logits make the first token
+        req.prefill_pos = (min(req.cache_hit_tokens, n - 1) // grain) * grain
+        if self.prefix is not None:
+            req.prefill_started_at = self.clock()
+        req.status = PREFILLING
+        self.slots[slot] = req
+        return True
+
+    def _advance_prefill(self, req: Request, slot: int) -> None:
+        """Run ONE prefill chunk for a ``prefilling`` slot.  The chunk
+        starts page-aligned (admission floors the cursor, chunks are
+        page multiples), so chunk-local KV blocks map 1:1 onto the
+        request's absolute pages; blocks that land on borrowed cache
+        pages are redirected to the null page — a hit NEVER rewrites a
+        page another request may be reading."""
+        n = len(req.prompt)
+        ps = self.serve.page_size
+        start = req.prefill_pos
+        end = min(start + (self.prefill_chunk_tokens or n), n)
+        first_page = start // ps
+        chunk_pages = [
+            NULL_PAGE if pi < req.cache_hit_pages else req.pages[pi]
+            for pi in range(first_page, (end - 1) // ps + 1)
+        ]
+        try:
+            _, first = self.engine.chunk_prefill(
+                req.prompt[start:end], start, req.pages, chunk_pages,
+                temperature=req.temperature,
+            )
+        except Exception as e:
+            self._count("serve/engine_faults")
+            self.slots[slot] = None
+            self._send_to_retry(req, f"prefill:{type(e).__name__}")
+            return
+        if not self.engine.last_prefill_finite:
+            self.slots[slot] = None
+            self._shed_request(req, SHED_POISONED)
+            return
+        req.prefill_pos = end
+        if end == n:
+            self._finish_prefill(req, slot, first)
+
+    def _advance_prefills(self) -> None:
+        for i, req in enumerate(self.slots):
+            if req is not None and req.status == PREFILLING:
+                self._advance_prefill(req, i)
+
+    def _finish_prefill(self, req: Request, slot: int, first: int) -> bool:
+        """First-token bookkeeping shared by the monolithic and chunked
+        prefill paths; in cache mode also COMMITS the prompt's pages to
+        the prefix cache so every later request sharing the prefix pays
+        only its tail chunk."""
         req.ctx_len = len(req.prompt)
         req.tokens.append(first)
         req.first_token_at = self.clock()
@@ -781,6 +973,13 @@ class ContinuousBatchingScheduler:
         self._count("serve/tokens_out")
         self._gauge("serve/ttft_ms", req.ttft_ms)
         self.ttft_hist.observe(req.ttft_ms)
+        if self.prefix is not None:
+            added = self.prefix.commit(
+                req.prompt,
+                req.pages[: self.pool.pages_for(len(req.prompt))],
+            )
+            if added:
+                self._count("serve/prefix_commits", added)
         if self._finished(req):
             self.slots[slot] = None
             self._retire(req, DONE)
@@ -807,8 +1006,26 @@ class ContinuousBatchingScheduler:
     # -- decode -----------------------------------------------------------
     def _ensure_growth_page(self, req: Request) -> bool:
         """The next append lands at position ``ctx_len``; allocate its
-        page if the sequence is about to cross a page boundary."""
-        if req.ctx_len // self.serve.page_size < len(req.pages):
+        page if the sequence is about to cross a page boundary.  When
+        the target page is SHARED (a borrowed cache run's tail, or this
+        request's own pages after it committed them), it is
+        copy-on-write forked first: a fresh page gets a device copy of
+        the shared one, the shared reference is dropped, and the append
+        proceeds on the private copy — co-readers never see the
+        write."""
+        idx = req.ctx_len // self.serve.page_size
+        if idx < len(req.pages):
+            page = req.pages[idx]
+            if self.pool.refcount(page) > 1:
+                got = self._alloc(1)
+                if got is None:
+                    return False
+                self.engine.fork_page(page, got[0])
+                self.pool.free([page])
+                req.pages[idx] = got[0]
+                if req.cache_hit_pages > idx:
+                    req.cache_hit_pages = idx
+                self._count("serve/prefix_forks")
             return True
         got = self._alloc(1)
         if got is None:
@@ -820,11 +1037,14 @@ class ContinuousBatchingScheduler:
         b = len(self.slots)
         tokens = np.zeros((b,), np.int32)
         lengths = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
         tables = np.full(
             (b, self.serve.max_pages_per_seq), NULL_PAGE, np.int32
         )
         for i, req in enumerate(self.slots):
-            if req is None:
+            if req is None or req.status == PREFILLING:
+                # a prefilling slot rides no decode iteration — its
+                # context advances one chunk per step instead
                 continue
             if not self._ensure_growth_page(req):
                 # pool exhausted mid-decode: shed the youngest running
@@ -849,12 +1069,15 @@ class ContinuousBatchingScheduler:
                     continue
             tokens[i] = req.tokens[-1]
             lengths[i] = req.ctx_len + 1  # context incl. the fed token
+            temps[i] = req.temperature
             tables[i] = self._page_table_row(req)
-        if not any(s is not None for s in self.slots):
+        if not lengths.any():
             return
         t0 = self.clock()
         try:
-            _, next_tokens = self.engine.decode(tokens, lengths, tables)
+            _, next_tokens = self.engine.decode(
+                tokens, lengths, tables, temps
+            )
         except Exception as e:
             # a crashed decode step produced nothing host-side: every
             # rider keeps its prefix and pages and re-enters through
@@ -868,7 +1091,7 @@ class ContinuousBatchingScheduler:
         # request's decode span to the engine batch iterations it rode
         it = getattr(self.engine, "decode_iters", None)
         for i, req in enumerate(self.slots):
-            if req is None:
+            if req is None or req.status == PREFILLING:
                 continue
             if finite is not None and not bool(finite[i]):
                 # poisoned-request quarantine: a non-finite logits row
@@ -952,6 +1175,11 @@ class ContinuousBatchingScheduler:
         self._gauge("serve/batch_fill", self.batch_fill())
         self._gauge("serve/page_occupancy", self.pool.occupancy())
         self._gauge("serve/tokens_per_s", tps)
+        if self.prefix is not None:
+            self._gauge(
+                "serve/prefix_cached_pages",
+                float(len(self.prefix.cached_pages())),
+            )
         self._publish_attribution()
         if self._mstate is not None:
             self.registry.observe(self._step, self._mstate)
@@ -976,6 +1204,21 @@ class ContinuousBatchingScheduler:
             for r in self.queue:
                 if r.first_token_at is None and r.blocked_since is None:
                     r.blocked_since = now
+        if self.prefix is not None and chaos.active(
+            chaos.SERVE_PREFIX_EVICT, self._step
+        ) is not None:
+            # forced full eviction sweep (the ``serve.prefix_evict``
+            # chaos drill): every idle cached run is reclaimed at once
+            # — borrowed pages MUST survive (refcount > 1 is never
+            # evictable) and the ledger must stay exact, proven by the
+            # leak check right here
+            self._count("serve/prefix_evict_faults")
+            freed = self.prefix.evict()
+            if freed:
+                self._count("serve/prefix_evictions", freed)
+            if self.leak_checks:
+                self.leak_check()
+        self._advance_prefills()
         self._decode_once()
         self._step += 1
         self._publish()
@@ -1063,6 +1306,10 @@ class ContinuousBatchingScheduler:
         # _admit_one up to the last step — count those too
         self._drain_handoff = None
         self.flush_rebuild()  # settle any rebuild owed from the storm
+        if self.prefix is not None:
+            # a drained replica keeps no cached history: release every
+            # cache-owned reference so the pool is PROVABLY empty below
+            self.prefix.flush()
         self.leak_check()
         self._publish()
         return {

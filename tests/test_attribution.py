@@ -505,6 +505,10 @@ class TestBenchDiff:
             "smoke_mlp_step_ms", "smoke_dp_mlp_step_ms",
             "serve_prefill_tokens_per_s", "serve_decode_tokens_per_s",
             "serve_ttft_ms",
+            # the prefix-cache rows: warm-cache hit TTFT through the
+            # scheduler + the deterministic analytic prefill-FLOPs
+            # saving of a full hit (docs/serving.md "Prefix caching")
+            "serve_prefix_hit_ttft_ms", "serve_prefill_flops_saved_pct",
             # the live ops plane rows (ISSUE 11): exporter scrape cost
             # + the deterministic burn-rate drill
             "ops_scrape_ms", "slo_alerts_fired",

@@ -529,3 +529,122 @@ class TestFleetObservability:
         assert (good, total) == (90.0, 100.0)
         good, total = by_name["fleet_deploy_loss"].counts(values)
         assert (good, total) == (90.0, 100.0)  # draining IS a loss
+
+
+# ---------------------------------------------------------------------------
+# prefix-affinity routing + cache-armed failure handling
+# (docs/serving.md "Prefix caching & chunked prefill")
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixAffinity:
+    def test_pick_prefers_deepest_cache_hit(self, gpt):
+        clock = VClock()
+        a = make_replica(gpt, "a", clock, prefix_cache=True)
+        b = make_replica(gpt, "b", clock, prefix_cache=True)
+        prompt = list(range(1, 17))  # 2 full pages at page_size=8
+        warm = Request(prompt=list(prompt), max_new_tokens=2)
+        b.sched.submit(warm)
+        b.sched.run()
+        # no prompt (or no hit anywhere): the legacy (depth, name)
+        # tie-break is untouched
+        assert Router.pick([a, b]) is a
+        assert Router.pick([a, b], prompt=[60, 61, 62]) is a
+        # affinity: the replica already holding the prefix wins the tie
+        assert Router.pick([a, b], prompt=prompt) is b
+        assert Router.peek_cached(b, prompt) == 16
+        assert Router.peek_cached(a, prompt) == 0
+        # deepest hit wins: warm `a` with only the first page
+        a.sched.submit(Request(prompt=list(prompt[:8]), max_new_tokens=2))
+        a.sched.run()
+        assert Router.peek_cached(a, prompt) == 8
+        assert Router.pick([a, b], prompt=prompt) is b  # 16 > 8
+
+    def test_peek_cached_is_zero_without_cache(self, gpt):
+        clock = VClock()
+        a = make_replica(gpt, "a", clock)  # cacheless replica
+        assert Router.peek_cached(a, [1, 2, 3]) == 0
+        assert Router.pick([a], prompt=[1, 2, 3]) is a
+
+    def test_dispatch_counts_affinity_hits(self, gpt):
+        clock = VClock()
+        counts = {}
+        router = Router(
+            clock=clock,
+            count=lambda k, n=1: counts.__setitem__(
+                k, counts.get(k, 0) + n
+            ),
+        )
+        a = make_replica(gpt, "a", clock, prefix_cache=True)
+        b = make_replica(gpt, "b", clock, prefix_cache=True)
+        prompt = list(range(1, 17))
+        b.sched.submit(Request(prompt=list(prompt), max_new_tokens=2))
+        b.sched.run()
+        router.submit(Request(prompt=list(prompt), max_new_tokens=2))
+        router.submit(Request(prompt=[60, 61, 62, 63], max_new_tokens=2))
+        assert router.dispatch([a, b], tick=0) == 2
+        # exactly the shared-prompt request rode affinity, onto b
+        assert counts["fleet/prefix_affinity_hits"] == 1
+        assert len(b.sched.queue) == 1 and len(a.sched.queue) == 1
+        a.sched.run()
+        b.sched.run()
+        assert a.sched.pool.in_use - len(
+            a.sched.prefix.cached_pages()
+        ) == 0
+
+    def test_crash_evacuates_leak_clean_with_cache_armed(self, gpt):
+        """A crash mid-traffic with the prefix cache holding pages:
+        evacuation flushes the cache, the pool is provably empty, and
+        the evacuated requests finish elsewhere — the fleet-wide
+        ledger stays exact."""
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2, max_retries=3,
+                           prefix_cache=True)
+        shared = list(range(1, 20))  # partial-tail prompt
+        reqs = [
+            fleet.submit(Request(prompt=list(shared), max_new_tokens=12))
+            for _ in range(4)
+        ]
+        for _ in range(3):  # route + admit somewhere
+            fleet.step()
+            clock.advance()
+        victim = next(
+            rep for rep in fleet.replicas if rep.sched.pending
+        )
+        fleet.crash(victim)
+        assert victim.state == DEAD
+        assert victim.sched.pool.in_use == 0  # cache flushed + evacuated
+        pump(fleet, clock, reqs)
+        assert all(r.status == "done" for r in reqs)
+        assert fleet.completed_count() == 4
+        # the exact-ledger re-proof passes with caches armed: a live
+        # replica's residual pages are exactly its cached runs, the
+        # dead one's pool is exactly empty
+        held = fleet.leak_check()
+        for rep in fleet.replicas:
+            cached = (len(rep.sched.prefix.cached_pages())
+                      if rep.sched.prefix is not None else 0)
+            assert held[rep.name] == cached
+        assert held[victim.name] == 0
+
+    def test_preempt_drain_flushes_cache_and_migrates(self, gpt):
+        clock = VClock()
+        fleet = make_fleet(gpt, clock, n=2, prefix_cache=True)
+        shared = list(range(1, 17))
+        reqs = [
+            fleet.submit(Request(prompt=list(shared), max_new_tokens=12))
+            for _ in range(4)
+        ]
+        for _ in range(3):
+            fleet.step()
+            clock.advance()
+        victim = next(
+            rep for rep in fleet.replicas if rep.sched.pending
+        )
+        fleet.preempt(victim)
+        pump(fleet, clock, reqs)
+        assert victim.state == DEAD
+        assert victim.sched.pool.in_use == 0  # drain sealed cache-clean
+        assert all(r.status == "done" for r in reqs)
+        held = fleet.leak_check()
+        assert held[victim.name] == 0

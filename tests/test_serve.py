@@ -1039,3 +1039,391 @@ class TestBf16Serving:
         )
         assert np.isfinite(lg[0]).all()
         assert 0 <= int(nxt[0]) < cfg.vocab_size
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: refcounted pool, content-addressed runs, COW, chunked prefill
+# (docs/serving.md "Prefix caching & chunked prefill")
+# ---------------------------------------------------------------------------
+
+
+class TestPagePoolRefcounts:
+    def test_share_free_roundtrip(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        got = pool.alloc(2)
+        pool.share(got)
+        assert pool.refcount(got[0]) == 2
+        pool.free(got)  # one reference down: pages stay allocated
+        assert pool.in_use == 2
+        assert pool.refcount(got[0]) == 1
+        pool.free(got)  # last holder lets go: back on the free list
+        assert pool.in_use == 0
+        assert pool.refcount(got[0]) == 0
+
+    def test_share_unallocated_raises(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        with pytest.raises(ValueError, match="unallocated"):
+            pool.share([3])
+        with pytest.raises(ValueError):
+            pool.share([NULL_PAGE])
+
+    def test_double_free_still_loud_after_shares(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        got = pool.alloc(1)
+        pool.share(got)
+        pool.free(got)
+        pool.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            pool.free(got)
+
+    def test_leak_check_cached_arm(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        mine = pool.alloc(2)
+        cached = pool.alloc(1)
+        pool.share(cached)  # the cache's own hold on a borrowed run
+        pool.leak_check([mine, cached], cached=cached)
+        pool.free(cached)  # the borrower retires
+        pool.leak_check([mine], cached=cached)
+        # the cache's reference unaccounted -> leaked, loudly
+        with pytest.raises(ValueError, match="leaked"):
+            pool.leak_check([mine])
+        # a claim above the reference count is still double-ownership
+        with pytest.raises(ValueError, match="more than one request"):
+            pool.leak_check([mine, cached, cached], cached=cached)
+
+
+class TestPrefixCache:
+    def test_prefix_keys_chain_and_tail_commitment(self):
+        a = cache_lib.prefix_keys([1, 2, 3, 4, 5, 6], 4)
+        b = cache_lib.prefix_keys([1, 2, 3, 4, 9, 9], 4)
+        assert [end for _, end in a] == [4, 6]
+        assert a[0][0] == b[0][0]  # shared first page, same key
+        assert a[1][0] != b[1][0]  # diverging tail
+        # a partial-tail key embeds the WHOLE prompt: extending the
+        # prompt changes the second key even with the same 6 tokens
+        c = cache_lib.prefix_keys([1, 2, 3, 4, 5, 6, 7, 8], 4)
+        assert c[0][0] == a[0][0]
+        assert c[1][0] != a[1][0]
+
+    def test_commit_match_borrow(self):
+        pool = PagePool(num_pages=16, page_size=4)
+        cache = cache_lib.PrefixCache(pool)
+        prompt = list(range(10))  # 2 full pages + a partial tail
+        pages = pool.alloc(3)
+        assert cache.commit(prompt, pages) == 3
+        assert cache.commits == 1
+        # full hit: every page, INCLUDING the partial tail
+        hit, tokens = cache.match(prompt)
+        assert hit == pages and tokens == 10
+        cache.borrow(hit)
+        assert pool.refcount(pages[0]) == 3  # owner + cache + borrower
+        # shared-prefix hit: full pages only — the foreign partial
+        # tail's key embeds tokens this prompt does not have
+        hit2, tok2 = cache.match(list(range(8)) + [63, 62, 61])
+        assert hit2 == pages[:2] and tok2 == 8
+        assert cache.hits == 2 and cache.misses == 0
+        assert cache.hit_tokens == 18
+
+    def test_match_miss_and_nontouching_peek(self):
+        pool = PagePool(num_pages=16, page_size=4)
+        cache = cache_lib.PrefixCache(pool)
+        assert cache.match([1, 2, 3]) == ([], 0)
+        assert cache.misses == 1
+        pages = pool.alloc(1)
+        cache.commit([1, 2, 3, 4], pages)
+        tick = cache._tick
+        assert cache.peek_tokens([1, 2, 3, 4]) == 4
+        assert cache.peek_tokens([9, 9]) == 0
+        assert cache._tick == tick  # the router probe never touches LRU
+
+    def test_commit_existing_key_keeps_incumbent(self):
+        pool = PagePool(num_pages=16, page_size=4)
+        cache = cache_lib.PrefixCache(pool)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        a = pool.alloc(2)
+        assert cache.commit(prompt, a) == 2
+        b = pool.alloc(2)
+        # a racing cold prefill of the same prompt: incumbent wins,
+        # nothing double-publishes, the loser's pages stay the loser's
+        assert cache.commit(prompt, b) == 0
+        hit, tokens = cache.match(prompt)
+        assert hit == a and tokens == 8
+        pool.free(a)
+        pool.free(b)
+        cache.flush()
+        assert pool.in_use == 0
+
+    def test_evict_lru_leaf_first_and_borrowed_pinned(self):
+        pool = PagePool(num_pages=16, page_size=4)
+        cache = cache_lib.PrefixCache(pool)
+        a = pool.alloc(2)
+        cache.commit([1, 2, 3, 4, 5, 6, 7, 8], a)
+        pool.free(a)  # only the cache holds run A now
+        b = pool.alloc(1)
+        cache.commit([9, 9, 9, 9], b)
+        pool.free(b)
+        # A is LRU; its leaf (tail) page goes first — never the parent
+        # out from under a cached child
+        assert cache.evict(need=1) == 1
+        assert cache.peek_tokens([1, 2, 3, 4, 5, 6, 7, 8]) == 4
+        # a borrowed run is NEVER evicted, even by a full sweep
+        hit, _ = cache.match([9, 9, 9, 9])
+        cache.borrow(hit)
+        assert cache.evict() == 1  # only A's remaining page was free
+        assert cache.peek_tokens([9, 9, 9, 9]) == 4
+        pool.free(hit)
+        cache.flush()
+        assert pool.in_use == 0
+
+    def test_flush_releases_cache_holds_only(self):
+        pool = PagePool(num_pages=16, page_size=4)
+        cache = cache_lib.PrefixCache(pool)
+        pages = pool.alloc(1)
+        cache.commit([1, 2, 3, 4], pages)
+        assert cache.flush() == 1
+        assert pool.refcount(pages[0]) == 1  # the owner's ref survives
+        pool.free(pages)
+        assert pool.in_use == 0
+
+
+class TestFusedSampling:
+    def test_greedy_rows_are_argmax(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(jax.random.PRNGKey(1), (8, 64))
+        out = serve_model.sample_tokens(logits, np.zeros(8), rng)
+        assert (np.asarray(out) == np.argmax(logits, axis=-1)).all()
+
+    def test_temperature_draws_differ_and_are_deterministic(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (32, 64))
+        temps = np.full(32, 5.0)
+        a = serve_model.sample_tokens(logits, temps, jax.random.PRNGKey(2))
+        b = serve_model.sample_tokens(logits, temps, jax.random.PRNGKey(2))
+        c = serve_model.sample_tokens(logits, temps, jax.random.PRNGKey(3))
+        assert (np.asarray(a) == np.asarray(b)).all()  # same key, same draw
+        assert (np.asarray(a) != np.asarray(c)).any()  # new key, new draw
+        # hot draws leave the argmax at least somewhere over 32 rows
+        assert (np.asarray(a) != np.argmax(logits, axis=-1)).any()
+
+    def test_top_k_bounds_the_support(self):
+        logits = jax.random.normal(jax.random.PRNGKey(4), (64, 32))
+        temps = np.full(64, 10.0)  # hot enough to wander without a mask
+        out = np.asarray(serve_model.sample_tokens(
+            logits, temps, jax.random.PRNGKey(5), top_k=4
+        ))
+        top4 = np.argsort(np.asarray(logits), axis=-1)[:, -4:]
+        assert all(out[i] in top4[i] for i in range(64))
+
+    def test_mixed_batch_keeps_greedy_rows_exact(self):
+        logits = jax.random.normal(jax.random.PRNGKey(6), (8, 64))
+        temps = np.array([0.0, 1.0] * 4)
+        out = np.asarray(serve_model.sample_tokens(
+            logits, temps, jax.random.PRNGKey(7)
+        ))
+        greedy = np.argmax(np.asarray(logits), axis=-1)
+        assert (out[temps == 0.0] == greedy[temps == 0.0]).all()
+
+
+class TestPrefixScheduler:
+    def _prompt(self, rs, n):
+        return [int(t) for t in rs.randint(0, 64, size=n)]
+
+    def test_hit_skips_prefill_and_streams_bit_identical(self, gpt):
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            prefix_cache=True)
+        rs = np.random.RandomState(50)
+        prompt = self._prompt(rs, 19)  # 2 full pages + a partial tail
+        cold = sched.submit(Request(prompt=list(prompt), max_new_tokens=4))
+        sched.run()
+        calls_after_cold = eng.prefill_calls
+        warm = sched.submit(Request(prompt=list(prompt), max_new_tokens=4))
+        sched.run()
+        # the full prompt (partial tail included) matched, and the hit
+        # paid exactly ONE tail chunk instead of a full prefill
+        assert warm.cache_hit_tokens == 19
+        assert eng.prefill_calls == calls_after_cold + 1
+        assert warm.tokens == cold.tokens  # decode is bit-identical
+        vals = _vals(reg)
+        assert vals["serve/prefix_hits"] == 1.0
+        assert vals["serve/prefix_misses"] == 1.0
+        assert vals["serve/prefix_hit_tokens"] == 19.0
+        assert vals["serve/prefix_commits"] > 0.0
+        # the 4-way TTFT attribution: the hit carries a cached_prefill
+        # share and the components still sum exactly
+        c = warm.ttft_components()
+        assert c["cached_prefill_ms"] > 0.0
+        assert (
+            c["queue_wait_ms"] + c["cached_prefill_ms"]
+            + c["prefill_ms"] + c["contention_ms"]
+        ) == pytest.approx(c["ttft_ms"], abs=1e-6)
+        report = sched.drain()  # flushes the cache, re-proves the pool
+        assert report["pool_in_use"] == 0
+        assert sched.leak_checks_run > 0
+
+    def test_cow_fork_diverges_without_corrupting_cache(self, gpt):
+        """The committer keeps decoding into its own tail page AFTER
+        committing it (refcount 2 -> the append forks); a later hit
+        borrows the pristine cached run and must see the prompt's KV,
+        not the committer's appended tokens."""
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            prefix_cache=True)
+        rs = np.random.RandomState(51)
+        prompt = self._prompt(rs, 12)  # partial tail: 4 of 8 slots live
+        cold = sched.submit(Request(prompt=list(prompt), max_new_tokens=6))
+        sched.run()
+        warm = sched.submit(Request(prompt=list(prompt), max_new_tokens=6))
+        sched.run()
+        warm2 = sched.submit(Request(prompt=list(prompt), max_new_tokens=6))
+        sched.run()
+        assert warm.cache_hit_tokens == 12
+        assert warm.tokens == cold.tokens
+        assert warm2.tokens == cold.tokens  # the cached copy never drifted
+        assert _vals(reg)["serve/prefix_forks"] >= 3.0  # one per append
+        report = sched.drain()
+        assert report["pool_in_use"] == 0
+
+    def test_cow_fork_int8_tail(self, gpt):
+        """Same fork-then-diverge pin on the int8 KV wire: the fork
+        must copy codes AND scale planes."""
+        eng = make_engine(gpt, kv_wire="int8")
+        sched = ContinuousBatchingScheduler(eng, prefix_cache=True)
+        rs = np.random.RandomState(52)
+        prompt = self._prompt(rs, 12)
+        cold = sched.submit(Request(prompt=list(prompt), max_new_tokens=6))
+        sched.run()
+        warm = sched.submit(Request(prompt=list(prompt), max_new_tokens=6))
+        sched.run()
+        assert warm.cache_hit_tokens == 12
+        assert warm.tokens == cold.tokens
+        report = sched.drain()
+        assert report["pool_in_use"] == 0
+
+    def test_eviction_under_pressure_admits_new_work(self, gpt):
+        eng = make_engine(gpt, num_pages=5, max_pages_per_seq=4)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            prefix_cache=True)
+        rs = np.random.RandomState(53)
+        a = sched.submit(Request(prompt=self._prompt(rs, 16),
+                                 max_new_tokens=2))
+        sched.run()
+        assert a.status == "done"
+        # run A retired but its 2 pages stay cached; B's admission +
+        # growth need the pool back — idle cached pages are reclaimed
+        b = sched.submit(Request(prompt=self._prompt(rs, 16),
+                                 max_new_tokens=2))
+        sched.run()
+        assert b.status == "done"
+        assert _vals(reg)["serve/prefix_evictions"] >= 1.0
+        report = sched.drain()
+        assert report["pool_in_use"] == 0
+
+    def test_prefix_evict_drill_spares_borrowed_pages(self, gpt):
+        """The ``serve.prefix_evict`` chaos site: a forced full sweep
+        mid-traffic reclaims every idle cached run — but a hit's
+        borrowed pages survive (refcount > 1 is never evictable) and
+        the ledger stays exact under the in-drill leak check."""
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            prefix_cache=True)
+        rs = np.random.RandomState(54)
+        prompt = self._prompt(rs, 19)
+        cold = sched.submit(Request(prompt=list(prompt), max_new_tokens=4))
+        sched.run()
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_PREFIX_EVICT, steps=tuple(range(64)),
+            mode="force", max_hits=1,
+        )):
+            warm = sched.submit(Request(prompt=list(prompt),
+                                        max_new_tokens=4))
+            sched.run()
+        assert warm.status == "done"
+        assert warm.tokens == cold.tokens  # borrowed pages survived
+        vals = _vals(reg)
+        assert vals["serve/prefix_evict_faults"] == 1.0
+        assert sched.leak_checks_run > 0
+        report = sched.drain()
+        assert report["pool_in_use"] == 0
+
+    def test_shed_borrower_decrements_never_frees_shared(self, gpt):
+        """The shed/retry refcount pin (planted fault): a cache-hit
+        request whose prefill faults persistently is shed with
+        ``retries_exhausted`` — its page release must DECREMENT the
+        shared references, not return cached pages to the free list.
+        The cache's run survives intact: a later hit still matches the
+        full prompt and decodes bit-identical to the cold run."""
+        from apex_tpu.resilience import chaos
+
+        eng = make_engine(gpt)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg,
+                                            prefix_cache=True,
+                                            max_retries=1)
+        rs = np.random.RandomState(55)
+        prompt = self._prompt(rs, 19)
+        cold = sched.submit(Request(prompt=list(prompt), max_new_tokens=4))
+        sched.run()
+        cached_before = sorted(sched.prefix.cached_pages())
+        with chaos.inject(chaos.Fault(
+            chaos.SERVE_PREFILL, steps=tuple(range(64)), mode="raise",
+        )):
+            doomed = sched.submit(Request(prompt=list(prompt),
+                                          max_new_tokens=4))
+            sched.run()
+        assert doomed.status == "shed"
+        assert doomed.shed_reason == "retries_exhausted"
+        # the cached run is untouched by the borrower's demise
+        assert sorted(sched.prefix.cached_pages()) == cached_before
+        sched.leak_check()  # exact ledger, cache holds included
+        warm = sched.submit(Request(prompt=list(prompt), max_new_tokens=4))
+        sched.run()
+        assert warm.status == "done"
+        assert warm.cache_hit_tokens == 19
+        assert warm.tokens == cold.tokens
+        report = sched.drain()
+        assert report["pool_in_use"] == 0
+
+    def test_chunked_prefill_matches_monolithic_numerics(self, gpt):
+        """Cache OFF, chunking ON: the chunked first token equals the
+        monolithic engine's on the same prompt (greedy, f32)."""
+        cfg, model, params = gpt
+        rs = np.random.RandomState(56)
+        prompt = self._prompt(rs, 22)
+        eng_mono = make_engine(gpt)
+        mono = ContinuousBatchingScheduler(eng_mono)
+        a = mono.submit(Request(prompt=list(prompt), max_new_tokens=5))
+        mono.run()
+        eng_chunk = make_engine(gpt)
+        chunked = ContinuousBatchingScheduler(eng_chunk,
+                                              prefill_chunk_tokens=8)
+        b = chunked.submit(Request(prompt=list(prompt), max_new_tokens=5))
+        chunked.run()
+        assert a.status == "done" and b.status == "done"
+        assert b.tokens[0] == a.tokens[0]  # argmax agrees at f32 tol
+        assert b.tokens == a.tokens
+        assert eng_chunk.pool.in_use == 0
+
+    def test_chunk_grain_must_be_page_multiple(self, gpt):
+        eng = make_engine(gpt)  # page_size=8
+        with pytest.raises(ValueError, match="page"):
+            ContinuousBatchingScheduler(eng, prefill_chunk_tokens=12)
+        with pytest.raises(ValueError):
+            ContinuousBatchingScheduler(eng, prefill_chunk_tokens=0)
+
+    def test_cache_off_components_stay_three_way(self, gpt):
+        """Without the cache the new component is EXACTLY 0.0 — the
+        pre-existing 3-way attribution contract is unchanged."""
+        eng = make_engine(gpt)
+        sched = ContinuousBatchingScheduler(eng)
+        rs = np.random.RandomState(57)
+        req = sched.submit(Request(prompt=self._prompt(rs, 6),
+                                   max_new_tokens=2))
+        sched.run()
+        c = req.ttft_components()
+        assert c["cached_prefill_ms"] == 0.0

@@ -128,10 +128,12 @@ def build_engine(args):
     params = model.init(jax.random.PRNGKey(1), ids)
     registry = MetricRegistry(fetch_every=1)
     # build() compiles AND analysis-verifies every bucket + the decode
-    # step up front, so engine.reports is the acceptance evidence
+    # step up front, so engine.reports is the acceptance evidence; the
+    # chunk-prefill/fork programs warm too when the run will use them
+    # (a lazy compile inside the first cache hit would poison its TTFT)
     engine = InferenceEngine(
         cfg, params, serve_cfg, registry=registry
-    ).build()
+    ).build(chunked=bool(args.prefix_cache or args.chunk_tokens))
     return cfg, model, params, engine, registry
 
 
@@ -234,7 +236,30 @@ def run_load(sched, args, *, watchdog=None, monitor=None, ops=None):
     arrivals = np.cumsum(gaps)
     prompt_lens = rs.choice(args.prompt_mix, size=args.requests)
     out_lens = rs.choice(args.output_mix, size=args.requests)
+    # shared-prefix workload (the prefix-cache proof): --shared-frac of
+    # the requests open with the SAME --shared-prefix-tokens system
+    # prompt and differ only in their tail — the draws come AFTER the
+    # base workload's so plain runs keep their exact historical stream
+    shared_prefix = None
+    shared_mask = np.zeros(args.requests, bool)
+    if args.shared_prefix_tokens:
+        shared_prefix = list(rs.randint(
+            0, args.vocab, size=args.shared_prefix_tokens
+        ))
+        shared_mask = rs.rand(args.requests) < args.shared_frac
+        prompt_lens = np.maximum(
+            prompt_lens, args.shared_prefix_tokens + 1
+        )
 
+    def make_prompt(i):
+        n = int(prompt_lens[i])
+        if shared_prefix is not None and shared_mask[i]:
+            tail = list(rs.randint(0, args.vocab,
+                                   size=n - len(shared_prefix)))
+            return list(shared_prefix) + tail
+        return list(rs.randint(0, args.vocab, size=n))
+
+    submitted_reqs = []
     t0 = time.monotonic()
     submitted = 0
     iteration = 0
@@ -244,12 +269,12 @@ def run_load(sched, args, *, watchdog=None, monitor=None, ops=None):
     while submitted < args.requests or sched.pending:
         now = time.monotonic() - t0
         while submitted < args.requests and arrivals[submitted] <= now:
-            sched.submit(Request(
-                prompt=list(rs.randint(0, args.vocab,
-                                       size=prompt_lens[submitted])),
+            req = sched.submit(Request(
+                prompt=make_prompt(submitted),
                 max_new_tokens=int(out_lens[submitted]),
                 slo_ttft_ms=args.slo_ttft_ms,
             ))
+            submitted_reqs.append(req)
             submitted += 1
         if sched.pending:
             sched.step()
@@ -344,6 +369,105 @@ def run_load(sched, args, *, watchdog=None, monitor=None, ops=None):
         "_ttft_samples": ttfts,
         "_per_tok_samples": per_tok,
         "_mid_scrape": mid_scrape,
+        "_requests": submitted_reqs,
+    }
+
+
+def _prefill_flops(cfg, n, start):
+    """Analytic prefill FLOPs for positions ``[start, n)`` of an
+    ``n``-token prompt: per-token linear work (qkv + attention output
+    + MLP matmuls) plus causal attention ``QK^T``/``AV`` work, which
+    for position ``i`` scans a context of ``i + 1`` — the quadratic
+    term the prefix cache's skipped positions save twice over."""
+    h = cfg.hidden_size
+    linear = 4 * h * h + 2 * h * cfg.intermediate_size
+    pairs = (n * (n + 1) - start * (start + 1)) / 2.0
+    return cfg.num_layers * (linear * (n - start) + 2.0 * h * pairs)
+
+
+def prefix_report(sched, cfg, args, load):
+    """The prefix-cache acceptance section: hit-vs-miss TTFT (classified
+    by each completed request's actual ``cache_hit_tokens``), the
+    analytic prefill-FLOPs saving over the whole completed set, the
+    cache ledger, and the pool-accounting proof."""
+    done = [r for r in sched.completed if r.ttft_ms is not None]
+    hit = [r for r in done if r.cache_hit_tokens > 0]
+    miss = [r for r in done if r.cache_hit_tokens == 0]
+    grain = args.chunk_tokens or args.page_size
+    flops_cold = flops_cached = 0.0
+    for r in done:
+        n = len(r.prompt)
+        start = (min(r.cache_hit_tokens, n - 1) // grain) * grain
+        flops_cold += _prefill_flops(cfg, n, 0)
+        flops_cached += _prefill_flops(cfg, n, start)
+    saved_pct = (
+        100.0 * (1.0 - flops_cached / flops_cold) if flops_cold else 0.0
+    )
+    sched.leak_check()  # must not raise — the final accounting proof
+    prefix = sched.prefix
+    return {
+        "shared_prefix_tokens": args.shared_prefix_tokens,
+        "shared_frac": args.shared_frac,
+        "chunk_tokens": args.chunk_tokens,
+        "hit_requests": len(hit),
+        "miss_requests": len(miss),
+        "hit_ttft_ms": {
+            "p50": _percentile(sorted(r.ttft_ms for r in hit), 0.50),
+            "samples": len(hit),
+        },
+        "miss_ttft_ms": {
+            "p50": _percentile(sorted(r.ttft_ms for r in miss), 0.50),
+            "samples": len(miss),
+        },
+        "prefill_flops_saved_pct": saved_pct,
+        "cache": {
+            "hits": prefix.hits,
+            "misses": prefix.misses,
+            "hit_tokens": prefix.hit_tokens,
+            "commits": prefix.commits,
+            "evictions": prefix.evictions,
+            "cached_pages": len(prefix.cached_pages()),
+        },
+        "leak_checks_run": sched.leak_checks_run,
+    }
+
+
+def prefix_replay_check(cfg, params, args, completed):
+    """Bit-identity proof: replay every completed request, one at a
+    time, through a cache-DISABLED scheduler with the same chunk
+    config — the cached run's full token stream must match exactly
+    (greedy sampling; the hit re-runs the same final chunk over
+    bit-identical committed pages, so any divergence means a borrowed
+    page was corrupted)."""
+    from apex_tpu.serve import (
+        ContinuousBatchingScheduler,
+        InferenceEngine,
+        Request,
+        ServeConfig,
+    )
+
+    eng = InferenceEngine(cfg, params, ServeConfig(
+        page_size=args.page_size, num_pages=args.pages,
+        max_batch=2, max_pages_per_seq=args.pages_per_seq,
+        kv_wire=args.kv_wire, weight_wire=args.weight_wire,
+        verify=False,
+    ))
+    sched = ContinuousBatchingScheduler(
+        eng, registry=None, prefix_cache=False,
+        prefill_chunk_tokens=args.chunk_tokens,
+    )
+    mismatches = []
+    for r in completed:
+        ref = sched.submit(Request(
+            prompt=list(r.prompt), max_new_tokens=r.max_new_tokens,
+        ))
+        sched.run()
+        if ref.tokens != r.tokens:
+            mismatches.append(r.rid)
+    return {
+        "replayed": len(completed),
+        "mismatched_rids": mismatches,
+        "bit_identical": not mismatches,
     }
 
 
@@ -390,6 +514,21 @@ def main():
     ap.add_argument("--pages-per-seq", type=int, default=8)
     ap.add_argument("--kv-wire", default="f32", choices=["f32", "int8"])
     ap.add_argument("--weight-wire", default="f32", choices=["f32", "int8"])
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="arm the cross-request prefix cache "
+                    "(docs/serving.md 'Prefix caching')")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=0,
+                    metavar="N", dest="shared_prefix_tokens",
+                    help="length of the shared system prompt opening "
+                    "--shared-frac of the requests (0 = off)")
+    ap.add_argument("--shared-frac", type=float, default=0.8,
+                    dest="shared_frac",
+                    help="fraction of requests drawing the shared prefix")
+    ap.add_argument("--chunk-tokens", type=int, default=None,
+                    metavar="N", dest="chunk_tokens",
+                    help="prefill chunk size (page multiple): slices "
+                    "prefill between decode iterations; also the "
+                    "re-run grain a cache hit's bit-identity rides on")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", metavar="FILE", default=None)
     ap.add_argument("--spans", metavar="FILE", default=None,
@@ -452,7 +591,9 @@ def main():
     from apex_tpu.serve import ContinuousBatchingScheduler
 
     sched = ContinuousBatchingScheduler(
-        engine, registry=registry, spans=recorder
+        engine, registry=registry, spans=recorder,
+        prefix_cache=args.prefix_cache,
+        prefill_chunk_tokens=args.chunk_tokens,
     )
 
     ops = None
@@ -498,6 +639,12 @@ def main():
     ttft_samples = load.pop("_ttft_samples")
     per_tok_samples = load.pop("_per_tok_samples")
     mid_scrape = load.pop("_mid_scrape")
+    load.pop("_requests")
+    if args.prefix_cache:
+        load["prefix"] = prefix_report(sched, cfg, args, load)
+        load["prefix"]["replay"] = prefix_replay_check(
+            cfg, params, args, sched.completed
+        )
     registry.fetch()
 
     # the end-of-run scrape happens AFTER the registry drain, so its
@@ -549,6 +696,22 @@ def main():
         print(f"numerics [{wire} KV vs unpaged f32]: max|dlogit|="
               f"{rec['max_abs_logit_diff']:.2e} tol={rec['tolerance']} "
               f"{'OK' if rec['ok'] else 'FAIL'}")
+    if args.prefix_cache:
+        px = load["prefix"]
+        hp = px["hit_ttft_ms"]["p50"]
+        mp = px["miss_ttft_ms"]["p50"]
+        ratio = (hp / mp) if (hp == hp and mp and mp == mp) else float("nan")
+        print(f"prefix cache: {px['hit_requests']} hit / "
+              f"{px['miss_requests']} miss; hit p50 TTFT {hp:.2f}ms vs "
+              f"miss {mp:.2f}ms (ratio {ratio:.3f}); prefill FLOPs "
+              f"saved {px['prefill_flops_saved_pct']:.1f}%; "
+              f"evictions={px['cache']['evictions']} "
+              f"commits={px['cache']['commits']} "
+              f"leak_checks={px['leak_checks_run']}")
+        rp = px["replay"]
+        print(f"prefix replay: {rp['replayed']} requests vs uncached "
+              f"reference — "
+              f"{'BIT-IDENTICAL' if rp['bit_identical'] else 'MISMATCH'}")
     print(f"graph lint ERRORs: {lint_errors}")
 
     slo_events = list(watchdog.events) if watchdog is not None else []
@@ -593,6 +756,14 @@ def main():
         )
     if any(lint_errors.values()):
         failures.append(f"graph lint ERRORs on serve steps: {lint_errors}")
+    if args.prefix_cache:
+        rp = load["prefix"]["replay"]
+        if not rp["bit_identical"]:
+            failures.append(
+                f"prefix cache broke decode bit-identity: rids "
+                f"{rp['mismatched_rids']} diverged from the uncached "
+                f"reference"
+            )
 
     if args.json:
         from apex_tpu.observability.spans import wall_clock_anchor
@@ -607,6 +778,8 @@ def main():
                     "requests", "rate", "prompt_mix", "output_mix",
                     "slo_ttft_ms", "batch", "page_size", "pages",
                     "pages_per_seq", "kv_wire", "weight_wire", "seed",
+                    "prefix_cache", "shared_prefix_tokens",
+                    "shared_frac", "chunk_tokens",
                 )
             },
             "load": load,

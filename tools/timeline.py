@@ -166,7 +166,15 @@ def account_requests(spans, dropped, ttft_tol_ms: float) -> dict:
                 )
                 continue
             ttft, qw, pf, ct = comps
-            err = abs(ttft - (qw + pf + ct))
+            # prefix-cache component (0.0 pre-cache records, which
+            # predate the key — the 3-component sum is unchanged then)
+            cp = args.get("cached_prefill_ms", 0.0)
+            if not isinstance(cp, (int, float)):
+                violations.append(
+                    f"rid={rid}: non-numeric cached_prefill_ms {cp!r}"
+                )
+                continue
+            err = abs(ttft - (qw + cp + pf + ct))
             ttft_checked += 1
             ttft_max_err = max(ttft_max_err, err)
             if err > ttft_tol_ms:
