@@ -142,7 +142,10 @@ class Fleet:
             # weights — swap in the deploy's params before it takes
             # any traffic, or the "rolling update complete" claim
             # would be false for the newest replica
-            rep.redeploy(self.deploy["params"])
+            rep.redeploy(
+                self.deploy["params"],
+                self.deploy.get("draft_params"),
+            )
             self.deploy["updated"].append(name)
         if self.eject_burn_factor is not None:
             self._eject_trackers[name] = BurnRateTracker(
@@ -282,16 +285,20 @@ class Fleet:
         return False
 
     # -- rolling update ----------------------------------------------------
-    def start_rolling_update(self, params) -> None:
+    def start_rolling_update(self, params, draft_params=None) -> None:
         """Begin a zero-downtime deploy of ``params``: replicas drain
         ONE AT A TIME (never the last live one — the fleet keeps
         serving throughout), rebuild through the supervised path, and
         re-admit.  Advanced by :meth:`step`; done when
-        :attr:`deploy` is None again."""
+        :attr:`deploy` is None again.  ``draft_params`` ships a
+        refreshed speculative draft on the same deploy — every updated
+        replica carries it through its redeploy (self-draft replicas
+        re-alias the new target weights automatically)."""
         if self.deploy is not None:
             raise RuntimeError("a rolling update is already in progress")
         self.deploy = {
             "params": params,
+            "draft_params": draft_params,
             "remaining": [r.name for r in self.live],
             "current": None,
             "updated": [],
@@ -350,7 +357,7 @@ class Fleet:
         reason = rep.drain_reason
         d = self.deploy
         if reason == "deploy" and d is not None and d["current"] == rep.name:
-            rep.redeploy(d["params"])
+            rep.redeploy(d["params"], d.get("draft_params"))
             d["updated"].append(rep.name)
             d["current"] = None
         else:
@@ -491,6 +498,20 @@ class Fleet:
                 if key.startswith("serve/") and reg.kind(key) == "counter":
                     out[key] = out.get(key, 0.0) + float(value)
         return out
+
+    def spec_acceptance(self) -> Dict[str, float]:
+        """Fleet-wide speculative-decoding acceptance: the router-side
+        fold over every replica's draft/accept counters.  A per-replica
+        rate can look fine while one stale-draft replica drags the
+        fleet — this is the number a deploy decision should read."""
+        vals = self.aggregate_values()
+        drafted = vals.get("serve/spec_drafted", 0.0)
+        accepted = vals.get("serve/spec_accepted", 0.0)
+        return {
+            "drafted": drafted,
+            "accepted": accepted,
+            "rate": accepted / drafted if drafted else 0.0,
+        }
 
     def aggregate_scrapes(self) -> Dict[str, object]:
         """The router-side scrape fold: every replica with a running

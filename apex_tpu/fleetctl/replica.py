@@ -152,18 +152,24 @@ class EngineReplica:
         self.drain_reports.append(report)
         return report
 
-    def redeploy(self, params) -> None:
+    def redeploy(self, params, draft_params=None) -> None:
         """Swap in new weights and return to service (the rolling
         update's per-replica step): the engine rebuilds through the
         SAME supervised path a fault recovery uses — ``full=True``
         recompiles the decode program now (re-verified when the
         engine was built with ``verify=True``) and drops every prefill
-        bucket for lazy re-AOT on next use — then admissions resume."""
+        bucket for lazy re-AOT on next use — then admissions resume.
+        A speculative engine's draft weights ride the same deploy:
+        ``draft_params`` swaps them explicitly; otherwise a self-draft
+        engine re-aliases the NEW target params (a draft frozen on old
+        weights would silently bleed acceptance every round)."""
         if self.sched.pending:
             raise RuntimeError(
                 f"replica {self.name} redeployed with work in flight"
             )
         self.engine.params = params
+        if self.engine.spec is not None:
+            self.engine.update_draft_params(draft_params)
         self.engine.rebuild(full=True)
         self.sched.resume()
         self.state = LIVE

@@ -103,6 +103,7 @@ from apex_tpu.observability.health import (  # noqa: F401
     QueueDepthRule,
     QueueWaitFractionRule,
     ServeFaultRule,
+    SpecAcceptanceRule,
     TTFTRule,
     Watchdog,
     default_rules,
@@ -206,6 +207,7 @@ __all__ = [
     "QueueDepthRule",
     "QueueWaitFractionRule",
     "ServeFaultRule",
+    "SpecAcceptanceRule",
     "SpanRecorder",
     "wall_clock_anchor",
     "monotonic_to_epoch",

@@ -99,6 +99,7 @@ __all__ = [
     "TTFTRule",
     "QueueDepthRule",
     "QueueWaitFractionRule",
+    "SpecAcceptanceRule",
     "ServeFaultRule",
     "default_rules",
     "goodput_rules",
@@ -594,6 +595,54 @@ class QueueWaitFractionRule(Rule):
         return []
 
 
+class SpecAcceptanceRule(Rule):
+    """Speculative-decoding acceptance rate under its floor — the
+    draft model has drifted from the target (stale draft weights after
+    a redeploy, a poisoned draft cache) and every rejected token is a
+    wasted draft step plus a rollback.  Reads the
+    ``serve/spec_accept_rate`` gauge the scheduler publishes over its
+    acceptance window (``docs/serving.md`` "Speculative decoding");
+    the scheduler's own degradation ladder falls back to plain decode
+    below ``SpecConfig.min_accept_rate`` — this rule pages BEFORE that
+    cliff so an operator can ship a better draft first.  Emits only
+    when speculation actually ran (a zero-drafted window publishes
+    rate 0.0 — judged only if the ``serve/spec_rounds`` counter is
+    nonzero); like :class:`TTFTRule`, only a freshly fetched value is
+    judged."""
+
+    name = "spec_acceptance"
+
+    def __init__(self, min_rate: float = 0.5,
+                 key: str = "serve/spec_accept_rate",
+                 cooldown: int = 64):
+        super().__init__(cooldown)
+        self.min_rate = min_rate
+        self.key = key
+        self._last_fetched: Optional[int] = None
+
+    def evaluate(self, wd, step):
+        reg = wd.registry
+        if reg is None:
+            return []
+        fetched = reg.fetched_step
+        if fetched is None or fetched == self._last_fetched:
+            return []
+        vals = reg.values()
+        value = vals.get(self.key)
+        if value is None or not vals.get("serve/spec_rounds"):
+            return []
+        self._last_fetched = fetched
+        if value < self.min_rate:
+            return self._event(
+                step, value, self.min_rate,
+                f"spec acceptance {value:.0%} under floor "
+                f"{self.min_rate:.0%} — draft/target drift: redeploy "
+                "the draft or lower k before the fallback ladder "
+                "disables speculation",
+            )
+        return []
+
+
 class ServeFaultRule(Rule):
     """The serving failure ledger moved (docs/serving.md "Failure
     semantics & degradation ladder"): engine faults and supervised
@@ -819,6 +868,7 @@ def serve_rules(**overrides) -> List[Rule]:
         "ttft": TTFTRule,
         "queue_depth": QueueDepthRule,
         "queue_wait_fraction": QueueWaitFractionRule,
+        "spec_acceptance": SpecAcceptanceRule,
         "serve_faults": ServeFaultRule,
         "stale_fetch": StaleFetchRule,
         "hung_step": HungStepRule,

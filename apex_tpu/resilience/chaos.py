@@ -23,6 +23,7 @@ typo'd drill can never silently inject nothing and "pass"):
 ``SERVE_ADMISSION``       ``raise`` / ``stall``
 ``SERVE_KV_ALLOC``        ``fail`` (forced alloc failure) / ``raise``
 ``SERVE_PREFIX_EVICT``    ``force`` (forced prefix-cache eviction)
+``SERVE_DRAFT``           ``raise`` / ``stall`` / ``nan`` (poison)
 ========================  ==========================================
 
 The ``serve.*`` sites live in the serving path
@@ -66,6 +67,7 @@ __all__ = [
     "SERVE_ADMISSION",
     "SERVE_KV_ALLOC",
     "SERVE_PREFIX_EVICT",
+    "SERVE_DRAFT",
     "FLEET_REPLICA_CRASH",
     "FLEET_PREEMPT",
     "FLEET_ROUTER",
@@ -103,6 +105,12 @@ SERVE_KV_ALLOC = "serve.kv_alloc"
 #: drill proving eviction under pressure never corrupts a borrowed
 #: stream — borrowed pages are refcount-pinned and survive the sweep)
 SERVE_PREFIX_EVICT = "serve.prefix_evict"
+#: faults the speculative draft-decode program (docs/serving.md
+#: "Speculative decoding"): ``raise`` makes the scheduler fall back to
+#: plain decode for the round, ``nan`` poisons the draft proposals —
+#: the verify step rejects every poisoned token, so a faulted draft
+#: can slow a stream but NEVER corrupt it.  Indices are spec rounds.
+SERVE_DRAFT = "serve.draft"
 #: fleet-control-plane sites (docs/serving.md "Fleet operations"):
 #: hooks live in apex_tpu/fleetctl — ``fleet.replica_crash`` kills a
 #: replica mid-iteration (its live requests evacuate under the shared
@@ -165,6 +173,7 @@ register_site(SERVE_DECODE, ("raise", "stall", "nan", "inf"), "raise")
 register_site(SERVE_ADMISSION, ("raise", "stall"), "raise")
 register_site(SERVE_KV_ALLOC, ("fail", "raise"), "fail")
 register_site(SERVE_PREFIX_EVICT, ("force",), "force")
+register_site(SERVE_DRAFT, ("raise", "stall", "nan"), "raise")
 register_site(FLEET_REPLICA_CRASH, ("kill",), "kill")
 register_site(FLEET_PREEMPT, ("notice",), "notice")
 register_site(FLEET_ROUTER, ("raise",), "raise")

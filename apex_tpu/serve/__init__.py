@@ -30,9 +30,21 @@ blockwise int8 codec reused as the KV and weight wire formats.
   LRU-evicted under pool pressure) and ``prefill_chunk_tokens=``
   interleaves chunked prefills between decode iterations.  Chaos
   sites at ``serve.prefill``/``serve.decode``/``serve.admission``/
-  ``serve.kv_alloc``/``serve.prefix_evict`` make every failure path
+  ``serve.kv_alloc``/``serve.prefix_evict``/``serve.draft`` make
+  every failure path
   drillable from one ``APEX_TPU_CHAOS`` spec
   (``tools/serve_chaos_drill.py``).
+
+- :mod:`apex_tpu.serve.spec` — speculative decoding
+  (:class:`SpecConfig`): a small draft model proposes ``k`` tokens,
+  ONE target step verifies all of them, and the rejected tail's KV is
+  rolled back in place.  Greedy acceptance is exact argmax match (the
+  emitted stream is bit-identical to plain decode by construction);
+  temperature mode uses the rejection sampler that provably preserves
+  the target distribution.  Draft KV pages live in a distinct
+  ``draft`` PagePool namespace that ``leak_check`` proves never leaks
+  into the prefix cache; the ``serve.draft`` chaos site proves a
+  faulted draft can slow a stream but never corrupt it.
 
 Fused decode attention lives with the other kernels
 (:func:`apex_tpu.ops.paged_decode_attention` /
@@ -50,6 +62,12 @@ from apex_tpu.serve.cache import (  # noqa: F401
 from apex_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     ServeConfig,
+)
+from apex_tpu.serve.spec import (  # noqa: F401
+    SpecConfig,
+    draft_from_params,
+    speculative_verify,
+    target_probs,
 )
 from apex_tpu.serve.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
@@ -69,6 +87,10 @@ __all__ = [
     "prefix_keys",
     "InferenceEngine",
     "ServeConfig",
+    "SpecConfig",
+    "draft_from_params",
+    "speculative_verify",
+    "target_probs",
     "ContinuousBatchingScheduler",
     "Request",
     "SHED_REASONS",

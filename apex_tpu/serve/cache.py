@@ -93,6 +93,15 @@ class PagePool:
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         #: allocated page -> reference count (absent = free)
         self._refs: Dict[int, int] = {}
+        #: allocated page -> namespace tag (absent = free).  The
+        #: default namespace is ``"kv"`` (target-model KV); a
+        #: speculative engine allocates its draft-model pages under
+        #: ``"draft"`` so :meth:`leak_check` can prove draft pages
+        #: never reach the prefix cache (a draft page's content is a
+        #: DIFFERENT model's KV — sharing it into the target cache
+        #: would corrupt every borrower bit-exactly enough to be
+        #: missed by shape checks).
+        self._ns: Dict[int, str] = {}
 
     @property
     def usable(self) -> int:
@@ -114,9 +123,12 @@ class PagePool:
         """Pages needed to hold ``tokens`` KV positions."""
         return -(-max(tokens, 0) // self.page_size)
 
-    def alloc(self, n: int) -> Optional[List[int]]:
+    def alloc(self, n: int, ns: str = "kv") -> Optional[List[int]]:
         """``n`` pages, or None when the pool cannot cover all of them
-        (all-or-nothing; never hands out :data:`NULL_PAGE`)."""
+        (all-or-nothing; never hands out :data:`NULL_PAGE`).  ``ns``
+        tags the pages with a namespace (``"kv"`` target KV —
+        the default — or ``"draft"`` for speculative-draft KV); the
+        tag rides the page until its last reference is freed."""
         if n < 0:
             raise ValueError("cannot allocate a negative page count")
         if n > len(self._free):
@@ -124,7 +136,12 @@ class PagePool:
         taken = [self._free.pop() for _ in range(n)]
         for p in taken:
             self._refs[p] = 1
+            self._ns[p] = ns
         return taken
+
+    def namespace(self, page: int) -> Optional[str]:
+        """The namespace tag of an allocated page (None = free)."""
+        return self._ns.get(page)
 
     def share(self, pages: List[int]) -> None:
         """Add one reference per page (a prefix-cache borrow, or the
@@ -155,6 +172,7 @@ class PagePool:
                 self._refs[p] = r
             else:
                 del self._refs[p]
+                self._ns.pop(p, None)
                 self._free.append(p)
 
     def leak_check(self, owned, cached=()) -> None:
@@ -194,6 +212,14 @@ class PagePool:
             problems.append(
                 f"foreign pages (owned but not allocated): "
                 f"{foreign}"
+            )
+        draft_cached = sorted(
+            p for p in cached if self._ns.get(p, "kv") != "kv"
+        )
+        if draft_cached:
+            problems.append(
+                f"draft-namespace pages shared into the prefix cache: "
+                f"{draft_cached}"
             )
         if problems:
             raise ValueError(

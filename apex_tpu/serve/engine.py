@@ -61,6 +61,7 @@ from apex_tpu.observability.metrics import board
 from apex_tpu.resilience import chaos
 from apex_tpu.serve import cache as cache_lib
 from apex_tpu.serve import model as model_lib
+from apex_tpu.serve import spec as spec_lib
 
 __all__ = ["ServeConfig", "InferenceEngine"]
 
@@ -164,6 +165,7 @@ class InferenceEngine:
         params,
         serve: Optional[ServeConfig] = None,
         *,
+        spec: Optional[spec_lib.SpecConfig] = None,
         registry=None,
     ):
         self.cfg = model_lib.validate_config(cfg)
@@ -195,6 +197,60 @@ class InferenceEngine:
         self._chunk: Dict[int, object] = {}
         self._decode = None
         self._fork = None
+        #: speculative decoding (docs/serving.md "Speculative
+        #: decoding"): None = plain serving; a SpecConfig adds the
+        #: draft model's params + KV pool and the draft/verify/rollback
+        #: step programs, all compiled and verified like every other
+        #: program
+        self.spec = spec
+        self._draft_cfg: Optional[GptConfig] = None
+        self.draft_params = None
+        self.draft_cache = None
+        self._draft_prefill: Dict[int, object] = {}
+        self._draft_decode = None
+        self._verify = None
+        self._rollback = None
+        self._draft_rollback = None
+        #: speculative round counter — the ``serve.draft`` chaos index
+        self.spec_rounds = 0
+        self.draft_prefill_calls = 0
+        if spec is not None:
+            dcfg = model_lib.validate_config(spec.draft_cfg or cfg)
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} (proposals must share the "
+                    f"token space)"
+                )
+            if self.serve.max_context > dcfg.max_seq_len:
+                raise ValueError(
+                    f"max context {self.serve.max_context} exceeds the "
+                    f"draft model's max_seq_len {dcfg.max_seq_len}"
+                )
+            if dcfg.hidden_size % dcfg.num_heads:
+                raise ValueError("draft num_heads must divide hidden_size")
+            self._draft_cfg = dcfg
+            if spec.draft_params is None:
+                # self-draft: share the (possibly wire-packed) weights
+                self.draft_params = self.params
+            elif self.serve.weight_wire == "int8":
+                self.draft_params = model_lib.quantize_params(
+                    spec.draft_params
+                )
+            else:
+                self.draft_params = spec.draft_params
+            # the draft KV pool mirrors the target's page geometry so
+            # ONE PagePool's page ids index both (draft pages ride the
+            # "draft" namespace; only the per-page row shapes differ)
+            self.draft_cache = cache_lib.init_kv_pages(
+                dcfg.num_layers,
+                self.serve.num_pages,
+                dcfg.num_heads,
+                self.serve.page_size,
+                dcfg.hidden_size // dcfg.num_heads,
+                dtype=dcfg.dtype,
+                kv_wire=self.serve.kv_wire,
+            )
         # the fused sampler's key chain: one fold per engine call
         self._rng_base = jax.random.PRNGKey(self.serve.sample_seed)
         #: optional :class:`~apex_tpu.observability.spans.SpanRecorder`
@@ -234,6 +290,9 @@ class InferenceEngine:
         board.set("serve/max_context", s.max_context)
         board.set("serve/kv_wire", s.kv_wire)
         board.set("serve/weight_wire", s.weight_wire)
+        if self.spec is not None:
+            board.set("serve/spec_k", self.spec.k)
+            board.set("serve/spec_mode", self.spec.mode)
 
     def _prefill_fn(self, bucket: int):
         s = self.serve
@@ -306,7 +365,7 @@ class InferenceEngine:
             jnp.zeros((s.max_batch,), jnp.int32),
             jnp.zeros((s.max_batch, s.max_pages_per_seq), jnp.int32),
             jnp.zeros((s.max_batch,), jnp.float32),
-            self._rng_base,
+            jnp.zeros((s.max_batch, 2), jnp.uint32),
         )
         return fn, args
 
@@ -324,6 +383,108 @@ class InferenceEngine:
             self.cache,
             jnp.asarray(0, jnp.int32),
             jnp.asarray(0, jnp.int32),
+        )
+        return fn, args
+
+    def _draft_fn(self):
+        s = self.serve
+        k = self.spec.k
+        dcfg = self._draft_cfg
+
+        def fn(params, kv_pages, tokens, lengths, page_tables, temps,
+               stream_keys, gens):
+            return spec_lib.draft_body(
+                dcfg, params, kv_pages, tokens, lengths, page_tables,
+                temps, stream_keys, gens,
+                k=k, page_size=s.page_size, kv_wire=s.kv_wire,
+                top_k=s.top_k,
+            )
+
+        fn.__name__ = "serve_draft_decode"
+        args = (
+            self.draft_params,
+            self.draft_cache,
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch, s.max_pages_per_seq), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.float32),
+            jnp.zeros((s.max_batch, 2), jnp.uint32),
+            jnp.zeros((s.max_batch,), jnp.int32),
+        )
+        return fn, args
+
+    def _verify_fn(self):
+        s = self.serve
+        k = self.spec.k
+
+        def fn(params, kv_pages, tokens, draft_tokens, lengths,
+               page_tables, temps, draft_probs, stream_keys, gens):
+            return spec_lib.verify_body(
+                self.cfg, params, kv_pages, tokens, draft_tokens,
+                lengths, page_tables, temps, draft_probs, stream_keys,
+                gens,
+                page_size=s.page_size, kv_wire=s.kv_wire, top_k=s.top_k,
+            )
+
+        fn.__name__ = "serve_verify"
+        args = (
+            self.params,
+            self.cache,
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch, k), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch, s.max_pages_per_seq), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.float32),
+            jnp.zeros((k, s.max_batch, self.cfg.vocab_size), jnp.float32),
+            jnp.zeros((s.max_batch, 2), jnp.uint32),
+            jnp.zeros((s.max_batch,), jnp.int32),
+        )
+        return fn, args
+
+    def _rollback_fn(self, cache, name: str):
+        s = self.serve
+        # the stale span after a round is [new ctx, old ctx + k]: at
+        # most k + 1 rows when nothing was accepted
+        kmax = self.spec.k + 1
+
+        def fn(kv_pages, starts, counts, page_tables):
+            return spec_lib.rollback_body(
+                kv_pages, starts, counts, page_tables,
+                k=kmax, page_size=s.page_size, kv_wire=s.kv_wire,
+            )
+
+        fn.__name__ = name
+        args = (
+            cache,
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch,), jnp.int32),
+            jnp.zeros((s.max_batch, s.max_pages_per_seq), jnp.int32),
+        )
+        return fn, args
+
+    def _draft_prefill_fn(self, bucket: int):
+        s = self.serve
+        np_ = bucket // s.page_size
+        dcfg = self._draft_cfg
+
+        def fn(params, kv_pages, tokens, length, page_ids, temp, rng):
+            return model_lib.prefill_body(
+                dcfg, params, kv_pages, tokens, length, page_ids,
+                temp, rng,
+                page_size=s.page_size,
+                kv_wire=s.kv_wire,
+                top_k=s.top_k,
+            )
+
+        fn.__name__ = f"serve_draft_prefill_{bucket}"
+        args = (
+            self.draft_params,
+            self.draft_cache,
+            jnp.zeros((bucket, 1), jnp.int32),
+            jnp.asarray(1, jnp.int32),
+            jnp.zeros((np_,), jnp.int32),
+            jnp.zeros((), jnp.float32),
+            self._rng_base,
         )
         return fn, args
 
@@ -383,9 +544,16 @@ class InferenceEngine:
             self._get_prefill(b)
             if chunked:
                 self._get_chunk(b)
+            if self.spec is not None:
+                self._get_draft_prefill(b)
         if chunked:
             self._get_fork()
         self._get_decode()
+        if self.spec is not None:
+            self._get_draft()
+            self._get_verify()
+            self._get_rollback()
+            self._get_draft_rollback()
         return self
 
     def rebuild(self, *, full: bool = False):
@@ -411,11 +579,19 @@ class InferenceEngine:
         if full:
             self._prefill.clear()
             self._chunk.clear()
+            self._draft_prefill.clear()
             for name in list(self._sentinels):
-                if name.startswith(("prefill", "chunk_prefill")):
+                if name.startswith(
+                    ("prefill", "chunk_prefill", "draft_prefill")
+                ):
                     del self._sentinels[name]
         fn, args = self._decode_fn()
         self._decode = self._compile("decode", fn, args)
+        if self.spec is not None:
+            fn, args = self._draft_fn()
+            self._draft_decode = self._compile("draft_decode", fn, args)
+            fn, args = self._verify_fn()
+            self._verify = self._compile("verify", fn, args)
         board.set("serve/engine_rebuilds", self.rebuilds)
         return self
 
@@ -446,6 +622,42 @@ class InferenceEngine:
             fn, args = self._decode_fn()
             self._decode = self._compile("decode", fn, args)
         return self._decode
+
+    def _get_draft(self):
+        if self._draft_decode is None:
+            fn, args = self._draft_fn()
+            self._draft_decode = self._compile("draft_decode", fn, args)
+        return self._draft_decode
+
+    def _get_verify(self):
+        if self._verify is None:
+            fn, args = self._verify_fn()
+            self._verify = self._compile("verify", fn, args)
+        return self._verify
+
+    def _get_rollback(self):
+        if self._rollback is None:
+            fn, args = self._rollback_fn(self.cache, "serve_rollback")
+            self._rollback = self._compile("rollback", fn, args, donate=0)
+        return self._rollback
+
+    def _get_draft_rollback(self):
+        if self._draft_rollback is None:
+            fn, args = self._rollback_fn(
+                self.draft_cache, "serve_draft_rollback"
+            )
+            self._draft_rollback = self._compile(
+                "draft_rollback", fn, args, donate=0
+            )
+        return self._draft_rollback
+
+    def _get_draft_prefill(self, bucket: int):
+        if bucket not in self._draft_prefill:
+            fn, args = self._draft_prefill_fn(bucket)
+            self._draft_prefill[bucket] = self._compile(
+                f"draft_prefill_{bucket}", fn, args
+            )
+        return self._draft_prefill[bucket]
 
     @property
     def retraces(self) -> int:
@@ -515,6 +727,15 @@ class InferenceEngine:
     def _sample_key(self, idx: int):
         """Deterministic per-call PRNG key for the fused sampler."""
         return jax.random.fold_in(self._rng_base, idx)
+
+    def _stream_keys(self, streams):
+        """Per-slot stream keys: ``fold_in(engine base, stream seed)``
+        — a function of request IDENTITY, never of call counters, so a
+        speculative rollback replays the same draws and a ``k = 0``
+        spec stream equals the plain one (spec.py "RNG discipline")."""
+        return jax.vmap(jax.random.fold_in, (None, 0))(
+            self._rng_base, jnp.asarray(streams, jnp.uint32)
+        )
 
     def prefill(self, prompt_ids, page_ids, *,
                 temperature: float = 0.0) -> Tuple[np.ndarray, int]:
@@ -629,7 +850,8 @@ class InferenceEngine:
         self._sentinels["fork_page"].observe(*args)
         self.cache = compiled(*args)
 
-    def decode(self, tokens, lengths, page_tables, temps=None):
+    def decode(self, tokens, lengths, page_tables, temps=None, *,
+               streams=None, gens=None):
         """One decode iteration over the full slot array.  ``lengths``
         counts each slot's context INCLUDING the token being fed (0 =
         idle slot).  Returns ``(logits (B, V), next_tokens (B,))`` —
@@ -637,9 +859,25 @@ class InferenceEngine:
         left as a lazy on-device array so the hot serving loop never
         pays the (B, V) device→host copy it does not read.  The
         per-slot in-step non-finite screen lands on
-        :attr:`last_decode_finite` (the quarantine evidence)."""
+        :attr:`last_decode_finite` (the quarantine evidence).
+
+        ``streams``/``gens`` (both ``(B,)``) thread per-slot stream
+        seeds and emission indices: each slot samples under the RAW
+        ``fold_in(stream_key, gen)`` — the same key a ``k = 0``
+        speculative round would consume, which is what makes the two
+        paths bit-identical.  None keeps the legacy per-iteration key
+        chain (one fold per call, split per slot)."""
         poison = self._chaos_gate(chaos.SERVE_DECODE, self.decode_iters)
         compiled = self._get_decode()
+        if streams is None:
+            rng = jax.vmap(jax.random.fold_in, (None, 0))(
+                self._sample_key(self.decode_iters),
+                jnp.arange(self.serve.max_batch, dtype=jnp.uint32),
+            )
+        else:
+            rng = spec_lib._fold_each(
+                self._stream_keys(streams), jnp.asarray(gens, jnp.int32)
+            )
         args = (
             self.params,
             self.cache,
@@ -648,7 +886,7 @@ class InferenceEngine:
             jnp.asarray(page_tables, jnp.int32),
             jnp.zeros((self.serve.max_batch,), jnp.float32)
             if temps is None else jnp.asarray(temps, jnp.float32),
-            self._sample_key(self.decode_iters),
+            rng,
         )
         self._sentinels["decode"].observe(*args)
         self.decode_iters += 1
@@ -676,3 +914,165 @@ class InferenceEngine:
                 batch=int((np.asarray(lengths) > 0).sum()),
             )
         return logits, out
+
+    # -- speculative serving calls ----------------------------------------
+    def draft_prefill(self, prompt_ids, page_ids) -> None:
+        """Prefill the DRAFT model's KV for a prompt into the request's
+        draft-namespace pages (the in-step sampled token is discarded —
+        the target prefill's token is the stream's first).  Uses its
+        own call counter so a speculative deployment leaves the target
+        prefill/decode rng chains untouched (the greedy bit-identity
+        gate compares spec and plain runs of the same workload)."""
+        n = len(prompt_ids)
+        bucket = self.bucket_for(n)
+        np_b = bucket // self.serve.page_size
+        tokens = np.zeros((bucket, 1), np.int32)
+        tokens[:n, 0] = np.asarray(prompt_ids, np.int32)
+        ids = np.full((np_b,), cache_lib.NULL_PAGE, np.int32)
+        ids[: len(page_ids)] = np.asarray(page_ids, np.int32)
+        compiled = self._get_draft_prefill(bucket)
+        name = f"draft_prefill_{bucket}"
+        args = (
+            self.draft_params, self.draft_cache, jnp.asarray(tokens),
+            jnp.asarray(n, jnp.int32), jnp.asarray(ids),
+            jnp.zeros((), jnp.float32),
+            jax.random.fold_in(self._rng_base, self.draft_prefill_calls),
+        )
+        self._sentinels[name].observe(*args)
+        self.draft_prefill_calls += 1
+        _logits, _tok, _finite, self.draft_cache = compiled(*args)
+
+    def spec_step(self, tokens, lengths, page_tables, draft_tables,
+                  temps, streams, gens):
+        """One speculative round over the full slot array: the draft
+        program proposes ``k`` tokens per live slot, then ONE verify
+        program scores all ``k + 1`` positions and runs acceptance
+        on-device.  Returns ``(out_tokens (B, k+1), n_accept (B,),
+        finite (B,))`` on host — slot ``b`` emits ``out_tokens[b,
+        :n_accept[b] + 1]``.
+
+        Rides the ``serve.draft`` chaos site (a faulted draft degrades
+        to zero-acceptance proposals — stream correctness never
+        depends on the draft) and the ``serve.decode`` site for the
+        verify step exactly like :meth:`decode`."""
+        spec = self.spec
+        s = self.serve
+        round_idx = self.spec_rounds
+        # the round cursor advances on ATTEMPTS, and before the chaos
+        # gate: a raise-mode serve.draft fault must burn its round
+        # index, or a planted one-shot storm re-fires at the same
+        # index forever and wedges speculation permanently
+        self.spec_rounds += 1
+        fault = self._chaos_gate(chaos.SERVE_DRAFT, round_idx)
+        poison = self._chaos_gate(chaos.SERVE_DECODE, self.decode_iters)
+        tok = jnp.asarray(tokens, jnp.int32)
+        lens = jnp.asarray(lengths, jnp.int32)
+        temps_j = (jnp.zeros((s.max_batch,), jnp.float32)
+                   if temps is None else jnp.asarray(temps, jnp.float32))
+        keys = self._stream_keys(streams)
+        gens_j = jnp.asarray(gens, jnp.int32)
+        d_args = (
+            self.draft_params, self.draft_cache, tok, lens,
+            jnp.asarray(draft_tables, jnp.int32), temps_j, keys, gens_j,
+        )
+        compiled = self._get_draft()
+        self._sentinels["draft_decode"].observe(*d_args)
+        d_tokens, d_probs, d_finite, self.draft_cache = compiled(*d_args)
+        bad = jnp.logical_not(d_finite)
+        if fault is not None:
+            bad = jnp.ones_like(bad)
+        if spec.k:
+            # a faulted/non-finite draft must not smuggle a token into
+            # the stream: pin its proposals to one fixed id and claim
+            # the matching point-mass draft distribution — the
+            # rejection sampler preserves the target distribution for
+            # ANY claimed q consistent with how d was drawn, and greedy
+            # only ever emits the argmax chain, so a poisoned round
+            # degrades to ~zero acceptance instead of corruption
+            pin = jnp.full_like(d_tokens, self.cfg.vocab_size - 1)
+            d_tokens = jnp.where(bad[:, None], pin, d_tokens)
+            d_probs = jnp.where(
+                bad[None, :, None],
+                jax.nn.one_hot(
+                    jnp.transpose(pin), self.cfg.vocab_size,
+                    dtype=jnp.float32,
+                ),
+                d_probs,
+            )
+        v_args = (
+            self.params, self.cache, tok, d_tokens, lens,
+            jnp.asarray(page_tables, jnp.int32), temps_j, d_probs,
+            keys, gens_j,
+        )
+        compiled = self._get_verify()
+        self._sentinels["verify"].observe(*v_args)
+        self.decode_iters += 1
+        rec = self.spans
+        t0 = rec.now() if rec is not None else None
+        out_tokens, n_accept, finite, self.cache = compiled(*v_args)
+        out = np.asarray(out_tokens)
+        acc = np.asarray(n_accept)
+        finite_np = np.array(finite)
+        if poison is not None:
+            live = np.flatnonzero(np.asarray(lengths) > 0)
+            if live.size:
+                finite_np[live[0]] = False
+        self.last_decode_finite = finite_np
+        if rec is not None:
+            # np.asarray(out_tokens) above synced — real device time
+            from apex_tpu.observability.spans import TRACK_ENGINE
+
+            live_n = int((np.asarray(lengths) > 0).sum())
+            rec.span(
+                "engine/decode", t0, rec.now(), track=TRACK_ENGINE,
+                iter=self.decode_iters, batch=live_n, spec=True,
+                drafted=spec.k * live_n, accepted=int(acc.sum()),
+            )
+        return out, acc, finite_np
+
+    def rollback(self, starts, counts, page_tables) -> None:
+        """Zero the target-KV rows of rejected positions ``[starts[b],
+        starts[b] + counts[b])`` through each slot's page table (the
+        compiled truncation program — spec.py :func:`~apex_tpu.serve.
+        spec.rollback_body`).  The scheduler COW-forked any shared tail
+        page BEFORE the round, so every touched page is private."""
+        compiled = self._get_rollback()
+        args = (
+            self.cache,
+            jnp.asarray(starts, jnp.int32),
+            jnp.asarray(counts, jnp.int32),
+            jnp.asarray(page_tables, jnp.int32),
+        )
+        self._sentinels["rollback"].observe(*args)
+        self.cache = compiled(*args)
+
+    def draft_rollback(self, starts, counts, page_tables) -> None:
+        """:meth:`rollback` for the draft KV pool (draft page ids)."""
+        compiled = self._get_draft_rollback()
+        args = (
+            self.draft_cache,
+            jnp.asarray(starts, jnp.int32),
+            jnp.asarray(counts, jnp.int32),
+            jnp.asarray(page_tables, jnp.int32),
+        )
+        self._sentinels["draft_rollback"].observe(*args)
+        self.draft_cache = compiled(*args)
+
+    def update_draft_params(self, draft_params) -> None:
+        """Swap the draft weights in place (a fleet redeploy shipping a
+        refreshed draft beside the target); wire-packs under int8
+        weights.  ``None`` means "no new draft shipped": a SELF-draft
+        engine re-aliases the (possibly just-redeployed) target params
+        so the draft never goes stale against its own target; a
+        distinct-draft engine keeps the draft it has.  The compiled
+        draft programs are shape-specialized, so a different draft
+        ARCHITECTURE needs a new engine."""
+        if self.spec is None:
+            raise ValueError("engine has no speculative config")
+        if draft_params is None:
+            if self.spec.draft_params is None:
+                self.draft_params = self.params
+        elif self.serve.weight_wire == "int8":
+            self.draft_params = model_lib.quantize_params(draft_params)
+        else:
+            self.draft_params = draft_params

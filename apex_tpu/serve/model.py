@@ -210,6 +210,17 @@ def _mlp(x, bp, cfg):
 # ---------------------------------------------------------------------------
 
 
+def _is_key_batch(rng, logits) -> bool:
+    """True when ``rng`` is a PER-SLOT key batch aligned with the
+    leading (batch) dim of ``logits`` — ``(B, 2)`` raw uint32 keys, or
+    ``(B,)`` typed keys — rather than one key for the whole call."""
+    if logits.ndim < 2:
+        return False
+    if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+        return rng.ndim == 1 and rng.shape[0] == logits.shape[0]
+    return rng.ndim == 2 and rng.shape[0] == logits.shape[0]
+
+
 def sample_tokens(logits, temps, rng, *, top_k: int = 0):
     """Sample next tokens INSIDE the compiled step — the host never
     round-trips the logits ("LLM Inference Acceleration via Efficient
@@ -219,9 +230,12 @@ def sample_tokens(logits, temps, rng, *, top_k: int = 0):
     leading dims: a slot with ``temp <= 0`` decodes greedily (argmax —
     bit-identical to the pre-sampling engine), a positive temperature
     draws via the Gumbel-argmax trick over ``logits / temp`` after the
-    static ``top_k`` mask (0 = full vocab).  One PRNG key per engine
-    call keeps the draw deterministic given ``ServeConfig.sample_seed``
-    and the call index."""
+    static ``top_k`` mask (0 = full vocab).  ``rng`` is either one key
+    for the whole call (legacy) or a per-slot key batch ``(B, 2)``
+    aligned with ``logits``'s batch dim — the engine's per-request
+    stream keys, a function of request identity and stream position
+    rather than any global call counter, so a replayed or rolled-back
+    stream re-draws bit-identically."""
     temps = jnp.asarray(temps, jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     vocab = logits.shape[-1]
@@ -230,7 +244,14 @@ def sample_tokens(logits, temps, rng, *, top_k: int = 0):
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         masked = jnp.where(logits < kth, -jnp.inf, logits)
     scaled = masked / jnp.maximum(temps, 1e-6)[..., None]
-    gumbel = jax.random.gumbel(rng, logits.shape, dtype=jnp.float32)
+    if _is_key_batch(rng, logits):
+        gumbel = jax.vmap(
+            lambda kk: jax.random.gumbel(
+                kk, logits.shape[1:], dtype=jnp.float32
+            )
+        )(rng)
+    else:
+        gumbel = jax.random.gumbel(rng, logits.shape, dtype=jnp.float32)
     sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)
 
@@ -522,35 +543,25 @@ def chunk_prefill_body(
 # ---------------------------------------------------------------------------
 
 
-def decode_body(
+def _decode_step(
     cfg: GptConfig,
-    params,
+    tree,         # dequantized ``params["params"]`` tree
     kv_pages: dict,
     tokens,       # (B,) int32 — current token per slot
     lengths,      # (B,) int32 — context length AFTER this token; 0 = idle
     page_tables,  # (B, NP) int32
-    temps=None,   # (B,) f32 per-slot sampling temperature (None = argmax)
-    rng=None,     # PRNG key for the fused sampler
     *,
     page_size: int,
     kv_wire: str = "f32",
-    top_k: int = 0,
 ):
-    """One continuous-batching decode iteration over the full slot
-    array.  Per layer: project the token, rotate K, append K/V to this
-    position's page slot, and run the fused single-query paged
-    attention (query RoPE + int8 dequant fused in the kernel).  Idle
-    slots (``lengths == 0``) write into the null page and read zeros.
-
-    Returns ``(logits (B, V) f32, next_tokens (B,) int32, finite (B,)
-    bool, kv_pages)`` — ``finite[b]`` is slot ``b``'s in-step
-    non-finite screen over its logits row: a poisoned sequence (NaN in
-    its KV pages or a numerically blown state) flags ONLY its own
-    slot, so the scheduler's quarantine can evict the offender without
-    touching the rest of the batch or reading the (B, V) logits back.
-    """
-    params = dequantize_params(params)
-    tree = _tree(params)
+    """The shared decode compute: embed the token column, append each
+    layer's K/V at this position's page slot, run the fused paged
+    attention, and return the final-LN logits.  This ONE function is
+    what both the plain decode program and the speculative verify scan
+    (:func:`apex_tpu.serve.spec.verify_body`) execute — same math,
+    same shapes, same kernels — which is precisely why a greedy
+    speculative stream is bit-identical to the sequential baseline by
+    construction.  Returns ``(logits (B, V) f32, kv_pages)``."""
     b = tokens.shape[0]
     heads = cfg.num_heads
     head_dim = cfg.hidden_size // heads
@@ -619,6 +630,43 @@ def decode_body(
 
     h = _layer_norm(x, tree["ln_f"], cfg.layer_norm_eps)
     logits = _logits(tree, h, cfg.dtype)  # (B, V) f32
+    return logits, kv_pages
+
+
+def decode_body(
+    cfg: GptConfig,
+    params,
+    kv_pages: dict,
+    tokens,       # (B,) int32 — current token per slot
+    lengths,      # (B,) int32 — context length AFTER this token; 0 = idle
+    page_tables,  # (B, NP) int32
+    temps=None,   # (B,) f32 per-slot sampling temperature (None = argmax)
+    rng=None,     # PRNG key (or per-slot key batch) for the sampler
+    *,
+    page_size: int,
+    kv_wire: str = "f32",
+    top_k: int = 0,
+):
+    """One continuous-batching decode iteration over the full slot
+    array (:func:`_decode_step` plus the fused sampling tail).  Per
+    layer: project the token, rotate K, append K/V to this position's
+    page slot, and run the fused single-query paged attention (query
+    RoPE + int8 dequant fused in the kernel).  Idle slots
+    (``lengths == 0``) write into the null page and read zeros.
+
+    Returns ``(logits (B, V) f32, next_tokens (B,) int32, finite (B,)
+    bool, kv_pages)`` — ``finite[b]`` is slot ``b``'s in-step
+    non-finite screen over its logits row: a poisoned sequence (NaN in
+    its KV pages or a numerically blown state) flags ONLY its own
+    slot, so the scheduler's quarantine can evict the offender without
+    touching the rest of the batch or reading the (B, V) logits back.
+    """
+    params = dequantize_params(params)
+    tree = _tree(params)
+    logits, kv_pages = _decode_step(
+        cfg, tree, kv_pages, tokens, lengths, page_tables,
+        page_size=page_size, kv_wire=kv_wire,
+    )
     if rng is None:
         next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:

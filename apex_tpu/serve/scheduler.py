@@ -90,6 +90,24 @@ slices cold prefills into page-multiple chunks advanced one per step
 between decode iterations (the ``prefilling`` slot phase), so a long
 cold prompt no longer stalls running streams.  Both default OFF.
 
+**Speculative decoding** (``docs/serving.md`` "Speculative decoding"):
+when the engine carries a :class:`~apex_tpu.serve.spec.SpecConfig`,
+spec-eligible slots ride a propose → verify → accept/rollback round
+per iteration instead of a single-token decode — a small draft model
+proposes ``k`` tokens from its own KV pages (allocated in the
+``draft`` PagePool namespace, never shared into the prefix cache) and
+ONE target step scores all ``k+1`` positions.  Greedy acceptance is an
+exact argmax match, so the emitted stream is bit-identical to plain
+decode by construction; temperature mode uses the rejection sampler
+that provably preserves the target distribution.  The scheduler owns
+the per-slot state machine: mixed spec/plain batches, demotion on
+draft faults (``serve.draft`` chaos site — a broken draft can slow a
+stream but never corrupt it), COW-forking the whole speculative window
+BEFORE a round so rejected-tail truncation never writes a shared page,
+and a degradation-ladder fallback to plain decode when the windowed
+acceptance rate collapses below ``min_accept_rate`` (sticky until
+:meth:`resume`).
+
 **TTFT attribution** (``docs/observability.md``): each completed
 request's TTFT decomposes into four components that sum to the
 measured TTFT *by construction* (the same remainder discipline
@@ -123,6 +141,7 @@ import collections
 import dataclasses
 import itertools
 import time
+import zlib
 from typing import Deque, Dict, List, Optional
 
 import numpy as np
@@ -243,6 +262,15 @@ class Request:
     #: sampling temperature for the fused in-step sampler; <= 0 is
     #: greedy argmax (bit-identical to the pre-sampler engine)
     temperature: float = 0.0
+    #: per-request sampling-stream seed: every temperature draw for
+    #: this stream keys off ``fold_in(engine base, stream_seed)`` then
+    #: the emission index — a function of request identity and stream
+    #: position, never of engine call counters, so a speculative
+    #: rollback replays identically and a ``k = 0`` spec stream equals
+    #: the plain one.  None derives a seed from :attr:`rid` (distinct
+    #: per request); pass an explicit seed to reproduce a stream
+    #: across schedulers/replicas.
+    stream_seed: Optional[int] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_ids))
 
     # -- runtime ledger (scheduler-owned) --------------------------------
@@ -289,6 +317,14 @@ class Request:
     #: post-admission phase into ``cached_prefill`` (match/borrow/alloc)
     #: and ``prefill`` (compute); None = cache off, component is 0.0
     prefill_started_at: Optional[float] = None
+    # -- speculative decoding (scheduler-owned) --------------------------
+    #: draft-model KV pages (``"draft"`` pool namespace) mirroring
+    #: :attr:`pages` position-for-position; freed on every retire path
+    draft_pages: List[int] = dataclasses.field(default_factory=list)
+    #: False once this request's draft state is unusable (draft prefill
+    #: faulted): the stream decodes plain — spec is an accelerator, a
+    #: broken draft must never cost the stream more than speed
+    spec_ok: bool = True
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -355,9 +391,21 @@ def declare_serve_metrics(registry) -> None:
               "serve/prefix_hits", "serve/prefix_misses",
               "serve/prefix_hit_tokens", "serve/prefix_forks",
               "serve/prefix_commits", "serve/prefix_evictions",
-              "serve/prefix_evict_faults"):
+              "serve/prefix_evict_faults",
+              # speculative-decoding ledger (docs/serving.md
+              # "Speculative decoding"): rounds, proposals drafted /
+              # accepted / rejected, rollback programs run, ladder
+              # fallbacks to plain decode, faulted draft calls
+              "serve/spec_rounds", "serve/spec_drafted",
+              "serve/spec_accepted", "serve/spec_rejected",
+              "serve/spec_rollbacks", "serve/spec_fallbacks",
+              "serve/draft_faults"):
         registry.counter(c)
     registry.gauge("serve/prefix_cached_pages")
+    # windowed acceptance rate + emitted tokens per slot decode step —
+    # the SpecAcceptanceRule watchdog and the bench read these
+    registry.gauge("serve/spec_accept_rate")
+    registry.gauge("serve/spec_tokens_per_step")
     # per-reason shed breakdown (sums to serve/shed)
     for reason in SHED_REASONS:
         registry.counter(f"serve/shed_{reason}")
@@ -429,6 +477,15 @@ class ContinuousBatchingScheduler:
             self.clamp_queue_depth = max(1, max_queue_depth // 2)
         self.rebuild_limit = rebuild_limit
         self.leak_checks = leak_checks
+        # speculative decoding (docs/serving.md "Speculative
+        # decoding"): per-round (drafted, accepted, emitted,
+        # slot_steps) window driving the acceptance gauges and the
+        # degradation-ladder fallback; sticky until resume()
+        self._spec_window: Optional[Deque] = (
+            collections.deque(maxlen=engine.spec.window)
+            if engine.spec is not None else None
+        )
+        self._spec_fallback = False
         self.draining = False
         self._drain_handoff = None
         self._drain_rerouted = 0
@@ -563,6 +620,9 @@ class ContinuousBatchingScheduler:
         if req.pages:
             self.pool.free(req.pages)
             req.pages = []
+        if req.draft_pages:
+            self.pool.free(req.draft_pages)
+            req.draft_pages = []
         req.status = status
         req.shed_reason = reason if status == SHED else None
         req.done_at = self.clock()
@@ -599,6 +659,9 @@ class ContinuousBatchingScheduler:
         if req.pages:
             self.pool.free(req.pages)
             req.pages = []
+        if req.draft_pages:
+            self.pool.free(req.draft_pages)
+            req.draft_pages = []
         if not handoff(req):
             return False
         self._count("serve/shed")
@@ -614,6 +677,11 @@ class ContinuousBatchingScheduler:
         request keeps its pages — that is what makes resume cheap)."""
         owned = [r.pages for r in self.slots if r is not None and r.pages]
         owned.extend(r.pages for r in self.queue if r.pages)
+        owned.extend(
+            r.draft_pages for r in self.slots
+            if r is not None and r.draft_pages
+        )
+        owned.extend(r.draft_pages for r in self.queue if r.draft_pages)
         return owned
 
     def leak_check(self) -> None:
@@ -632,20 +700,22 @@ class ContinuousBatchingScheduler:
         )
         self.leak_checks_run += 1
 
-    def _alloc(self, n: int) -> Optional[List[int]]:
+    def _alloc(self, n: int, ns: str = "kv") -> Optional[List[int]]:
         """Pool allocation behind the ``serve.kv_alloc`` chaos site: an
         active fault forces the all-or-nothing failure path (returns
         None), driving the same shedding/backpressure machinery a
         genuinely exhausted pool drives — no separate failure code.
         An exhausted pool first reclaims idle prefix-cache runs (LRU,
         never a borrowed page) before the failure path is taken —
-        cached history is strictly lower-priority than live work."""
+        cached history is strictly lower-priority than live work.
+        ``ns`` is the page namespace (``"draft"`` for speculative draft
+        KV — the tag ``leak_check`` screens the prefix cache against)."""
         idx = self._kv_allocs
         self._kv_allocs += 1
         if chaos.active(chaos.SERVE_KV_ALLOC, idx) is not None:
             self._count("serve/kv_alloc_faults")
             return None
-        got = self.pool.alloc(n)
+        got = self.pool.alloc(n, ns=ns)
         if got is None and self.prefix is not None:
             freed = self.prefix.evict(need=n)
             if freed:
@@ -654,7 +724,7 @@ class ContinuousBatchingScheduler:
                 # the retry hands out pages no request owns yet
                 if self.leak_checks:
                     self.leak_check()
-                got = self.pool.alloc(n)
+                got = self.pool.alloc(n, ns=ns)
         return got
 
     # -- fault recovery ----------------------------------------------------
@@ -845,6 +915,29 @@ class ContinuousBatchingScheduler:
                 return False
         else:
             pages = req.pages  # retained across a prefill retry
+        # the ledger owns the target pages from here on — set BEFORE the
+        # draft allocation below so a draft-side wait or shed can never
+        # strand freshly-allocated target pages outside the ledger
+        req.pages = pages
+        if self.engine.spec is not None and req.spec_ok:
+            # speculative decoding: the draft model mirrors the target's
+            # page span in its own "draft" namespace.  All-or-nothing,
+            # same wait/shed semantics as the target allocation — a
+            # request never admits with a half-provisioned draft cache.
+            dneed = need - len(req.draft_pages)
+            if dneed > 0:
+                dgot = self._alloc(dneed, ns="draft")
+                if dgot is None:
+                    if (
+                        req.slo_ttft_ms is not None
+                        and 1e3 * (self.clock() - req.submitted_at)
+                        > req.slo_ttft_ms
+                    ):
+                        self.queue.popleft()
+                        self._shed_request(req, SHED_DEADLINE)
+                        return True
+                    return False
+                req.draft_pages.extend(dgot)
         # degradation rung 2 — clamp the token budget while overloaded:
         # admit MORE requests shallower instead of fewer deeper
         if (
@@ -865,7 +958,6 @@ class ContinuousBatchingScheduler:
         now = self.clock()
         self._close_blocked(req, now)
         req.admitted_at = now
-        req.pages = pages
         if self.spans is not None:
             self.spans.request_event(
                 req.rid, "prefill", now,
@@ -980,6 +1072,16 @@ class ContinuousBatchingScheduler:
             )
             if added:
                 self._count("serve/prefix_commits", added)
+        if self.engine.spec is not None and req.spec_ok:
+            # warm the draft KV over the prompt so proposals start from
+            # the same context the target sees.  A crashed draft prefill
+            # DEMOTES the request to plain decode — the draft is an
+            # accelerator, never a correctness dependency.
+            try:
+                self.engine.draft_prefill(req.prompt, req.draft_pages)
+            except Exception:
+                self._count("serve/draft_faults")
+                req.spec_ok = False
         if self._finished(req):
             self.slots[slot] = None
             self._retire(req, DONE)
@@ -1004,16 +1106,14 @@ class ContinuousBatchingScheduler:
         return req.ctx_len + 1 > self.serve.max_context
 
     # -- decode -----------------------------------------------------------
-    def _ensure_growth_page(self, req: Request) -> bool:
-        """The next append lands at position ``ctx_len``; allocate its
-        page if the sequence is about to cross a page boundary.  When
-        the target page is SHARED (a borrowed cache run's tail, or this
-        request's own pages after it committed them), it is
-        copy-on-write forked first: a fresh page gets a device copy of
-        the shared one, the shared reference is dropped, and the append
-        proceeds on the private copy — co-readers never see the
+    def _ensure_target_page(self, req: Request, idx: int) -> bool:
+        """Make target page ``idx`` writable: allocate it if the span
+        has not reached it yet, and copy-on-write fork it first when it
+        is SHARED (a borrowed cache run's tail, or this request's own
+        pages after it committed them) — a fresh page gets a device
+        copy of the shared one, the shared reference is dropped, and
+        appends proceed on the private copy; co-readers never see the
         write."""
-        idx = req.ctx_len // self.serve.page_size
         if idx < len(req.pages):
             page = req.pages[idx]
             if self.pool.refcount(page) > 1:
@@ -1027,17 +1127,64 @@ class ContinuousBatchingScheduler:
                     req.cache_hit_pages = idx
                 self._count("serve/prefix_forks")
             return True
-        got = self._alloc(1)
-        if got is None:
-            return False
-        req.pages.extend(got)
+        while len(req.pages) <= idx:
+            got = self._alloc(1)
+            if got is None:
+                return False
+            req.pages.extend(got)
+        return True
+
+    def _ensure_growth_page(self, req: Request) -> bool:
+        """The next append lands at position ``ctx_len``; allocate (or
+        COW-fork) its page if needed."""
+        return self._ensure_target_page(
+            req, req.ctx_len // self.serve.page_size
+        )
+
+    def _ensure_spec_span(self, req: Request) -> bool:
+        """Provision the whole speculative window BEFORE the round: a
+        spec round may write target KV at positions ``ctx_len`` through
+        ``ctx_len + k``, so every page that span touches must be
+        private and writable NOW.  This is the real COW obligation of
+        speculative decoding — rejected positions are overwritten in
+        place, which is only safe because no shared page is ever
+        written.  The draft span grows in the ``draft`` namespace
+        alongside.  Returns False on allocation failure (the caller
+        demotes the slot to plain decode for this round)."""
+        ps = self.serve.page_size
+        k = self.engine.spec.k
+        for idx in range(req.ctx_len // ps, (req.ctx_len + k) // ps + 1):
+            if idx >= self.serve.max_pages_per_seq:
+                return False
+            if not self._ensure_target_page(req, idx):
+                return False
+            while len(req.draft_pages) <= idx:
+                got = self._alloc(1, ns="draft")
+                if got is None:
+                    return False
+                req.draft_pages.extend(got)
         return True
 
     def _decode_once(self) -> None:
+        """One decode pass over the running batch: speculative rounds
+        for spec-eligible slots (unless the degradation ladder tripped
+        the acceptance fallback), plain single-token decode for the
+        rest."""
+        if self.engine.spec is not None and not self._spec_fallback:
+            self._spec_decode_once()
+        else:
+            self._plain_decode_once(None)
+
+    def _plain_decode_once(self, only: Optional[set]) -> None:
+        """One plain (single-token) decode iteration.  ``only`` limits
+        the pass to the given slot indices (the non-speculative side of
+        a mixed batch); ``None`` rides every running slot."""
         b = len(self.slots)
         tokens = np.zeros((b,), np.int32)
         lengths = np.zeros((b,), np.int32)
         temps = np.zeros((b,), np.float32)
+        streams = np.zeros((b,), np.uint32)
+        gens = np.zeros((b,), np.int32)
         tables = np.full(
             (b, self.serve.max_pages_per_seq), NULL_PAGE, np.int32
         )
@@ -1045,6 +1192,8 @@ class ContinuousBatchingScheduler:
             if req is None or req.status == PREFILLING:
                 # a prefilling slot rides no decode iteration — its
                 # context advances one chunk per step instead
+                continue
+            if only is not None and i not in only:
                 continue
             if not self._ensure_growth_page(req):
                 # pool exhausted mid-decode: shed the youngest running
@@ -1070,13 +1219,16 @@ class ContinuousBatchingScheduler:
             tokens[i] = req.tokens[-1]
             lengths[i] = req.ctx_len + 1  # context incl. the fed token
             temps[i] = req.temperature
+            streams[i] = self._stream(req)
+            gens[i] = len(req.tokens) - 1
             tables[i] = self._page_table_row(req)
         if not lengths.any():
             return
         t0 = self.clock()
         try:
             _, next_tokens = self.engine.decode(
-                tokens, lengths, tables, temps
+                tokens, lengths, tables, temps,
+                streams=streams, gens=gens,
             )
         except Exception as e:
             # a crashed decode step produced nothing host-side: every
@@ -1092,6 +1244,8 @@ class ContinuousBatchingScheduler:
         it = getattr(self.engine, "decode_iters", None)
         for i, req in enumerate(self.slots):
             if req is None or req.status == PREFILLING:
+                continue
+            if only is not None and i not in only:
                 continue
             if finite is not None and not bool(finite[i]):
                 # poisoned-request quarantine: a non-finite logits row
@@ -1130,6 +1284,181 @@ class ContinuousBatchingScheduler:
                 self.slots[i] = None
                 self._retire(req, DONE)
                 self._count("serve/completed")
+
+    # -- speculative decoding ---------------------------------------------
+    def _stream(self, req: Request) -> int:
+        """Stable per-request sampling-stream id.  The engine folds it
+        into its base key and each emission folds its position index, so
+        the sampled token at (request, position) is a pure function of
+        request identity — a rollback replay, a spec bonus draw, and
+        plain decode all reproduce the exact same stream."""
+        if req.stream_seed is not None:
+            return req.stream_seed
+        return zlib.crc32(str(req.rid).encode()) & 0x7FFFFFFF
+
+    def _spec_decode_once(self) -> None:
+        """Partition the running batch: slots with a healthy draft ride
+        a speculative round (propose k, verify once, roll back the
+        rejected tail); everything else — draft-demoted requests, slots
+        whose window cannot be provisioned, streams near the context
+        ceiling — rides plain decode.  Mixed batches are the steady
+        state, not an edge case."""
+        k = self.engine.spec.k
+        spec_idx: List[int] = []
+        plain_idx: List[int] = []
+        for i, req in enumerate(self.slots):
+            if req is None or req.status == PREFILLING:
+                continue
+            if (
+                req.spec_ok
+                and req.draft_pages
+                and req.ctx_len + 1 + k <= self.serve.max_context
+            ):
+                spec_idx.append(i)
+            else:
+                plain_idx.append(i)
+        for i in list(spec_idx):
+            if not self._ensure_spec_span(self.slots[i]):
+                # cannot provision the whole window: demote for THIS
+                # round only — the pool may free up by the next one
+                spec_idx.remove(i)
+                plain_idx.append(i)
+        if spec_idx:
+            self._spec_round(spec_idx, k)
+        if plain_idx:
+            self._plain_decode_once(set(plain_idx))
+
+    def _spec_round(self, idx: List[int], k: int) -> None:
+        """One propose → verify → accept/rollback round for the given
+        slots.  The verify step scans the SAME per-token program body
+        plain decode runs, so every accepted token is bit-identical to
+        the token plain decode would have produced; the rejected tail's
+        KV (target and draft) is truncated afterwards so no stale entry
+        outlives the round."""
+        b = len(self.slots)
+        tokens = np.zeros((b,), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        streams = np.zeros((b,), np.uint32)
+        gens = np.zeros((b,), np.int32)
+        tables = np.full(
+            (b, self.serve.max_pages_per_seq), NULL_PAGE, np.int32
+        )
+        dtables = np.full(
+            (b, self.serve.max_pages_per_seq), NULL_PAGE, np.int32
+        )
+        for i in idx:
+            req = self.slots[i]
+            tokens[i] = req.tokens[-1]
+            lengths[i] = req.ctx_len + 1  # context incl. the fed token
+            temps[i] = req.temperature
+            streams[i] = self._stream(req)
+            gens[i] = len(req.tokens) - 1
+            tables[i] = self._page_table_row(req)
+            dtables[i, : len(req.draft_pages)] = req.draft_pages
+        t0 = self.clock()
+        try:
+            out, acc, finite = self.engine.spec_step(
+                tokens, lengths, tables, dtables, temps, streams, gens
+            )
+        except chaos.InjectedFault as e:
+            if getattr(e, "site", None) == chaos.SERVE_DRAFT:
+                # a faulted draft never corrupts a stream: the round
+                # was abandoned BEFORE any verify-side KV write, and
+                # every rider falls back to plain decode this iteration
+                self._count("serve/draft_faults")
+                self._plain_decode_once(set(idx))
+                return
+            self._on_engine_fault(e)
+            return
+        except Exception as e:
+            self._on_engine_fault(e)
+            return
+        elapsed_ms = 1e3 * (self.clock() - t0)
+        self._count("serve/decode_steps")
+        self._count("serve/spec_rounds")
+        it = getattr(self.engine, "decode_iters", None)
+        rb_starts = np.zeros((b,), np.int32)
+        rb_counts = np.zeros((b,), np.int32)
+        drafted = accepted = emitted = slot_steps = 0
+        for i in idx:
+            req = self.slots[i]
+            slot_steps += 1
+            if finite is not None and not bool(finite[i]):
+                # poisoned VERIFY output — the target's own logits are
+                # garbage, same quarantine as a poisoned plain step
+                self.slots[i] = None
+                self._shed_request(req, SHED_POISONED)
+                continue
+            timeout_ms = (
+                req.decode_timeout_ms
+                if req.decode_timeout_ms is not None
+                else self.decode_timeout_ms
+            )
+            if timeout_ms is not None and elapsed_ms > timeout_ms:
+                self._count("serve/decode_timeouts")
+                self.slots[i] = None
+                self._send_to_retry(
+                    req, f"decode_timeout:{elapsed_ms:.0f}ms"
+                )
+                continue
+            if it is not None:
+                if req.first_decode_iter is None:
+                    req.first_decode_iter = it
+                req.last_decode_iter = it
+            a = int(acc[i])
+            drafted += k
+            accepted += a
+            start_ctx = req.ctx_len
+            n_emit = 0
+            for t in out[i, : a + 1]:
+                req.ctx_len += 1
+                req.tokens.append(int(t))
+                n_emit += 1
+                self._tokens_out += 1
+                if self._finished(req):
+                    break
+            emitted += n_emit
+            self._count("serve/tokens_out", n_emit)
+            if self._finished(req):
+                self.slots[i] = None
+                self._retire(req, DONE)
+                self._count("serve/completed")
+            else:
+                # the round wrote target KV at [start_ctx, start_ctx+k];
+                # everything past the new context is a rejected draft's
+                # residue and is truncated below (slots that retired or
+                # shed keep counts 0 — the rollback masks them to the
+                # null page)
+                stale = start_ctx + k + 1 - req.ctx_len
+                if stale > 0:
+                    rb_starts[i] = req.ctx_len
+                    rb_counts[i] = stale
+        if rb_counts.any():
+            self.engine.rollback(rb_starts, rb_counts, tables)
+            self.engine.draft_rollback(rb_starts, rb_counts, dtables)
+            self._count(
+                "serve/spec_rollbacks", int((rb_counts > 0).sum())
+            )
+        self._count("serve/spec_drafted", drafted)
+        self._count("serve/spec_accepted", accepted)
+        if drafted > accepted:
+            self._count("serve/spec_rejected", drafted - accepted)
+        if self._spec_window is not None:
+            self._spec_window.append(
+                (drafted, accepted, emitted, slot_steps)
+            )
+            if len(self._spec_window) == self._spec_window.maxlen:
+                tot_d = sum(w[0] for w in self._spec_window)
+                tot_a = sum(w[1] for w in self._spec_window)
+                if tot_d and (
+                    tot_a / tot_d < self.engine.spec.min_accept_rate
+                ):
+                    # degradation ladder: speculation is costing more
+                    # than it saves — fall back to plain decode until
+                    # an operator resume() re-arms it
+                    self._spec_fallback = True
+                    self._count("serve/spec_fallbacks")
 
     # -- metrics ----------------------------------------------------------
     def _count(self, name: str, n: float = 1.0) -> None:
@@ -1179,6 +1508,18 @@ class ContinuousBatchingScheduler:
             self._gauge(
                 "serve/prefix_cached_pages",
                 float(len(self.prefix.cached_pages())),
+            )
+        if self._spec_window:
+            tot_d = sum(w[0] for w in self._spec_window)
+            tot_a = sum(w[1] for w in self._spec_window)
+            tot_e = sum(w[2] for w in self._spec_window)
+            tot_s = sum(w[3] for w in self._spec_window)
+            self._gauge(
+                "serve/spec_accept_rate", tot_a / tot_d if tot_d else 0.0
+            )
+            self._gauge(
+                "serve/spec_tokens_per_step",
+                tot_e / tot_s if tot_s else 0.0,
             )
         self._publish_attribution()
         if self._mstate is not None:
@@ -1329,3 +1670,8 @@ class ContinuousBatchingScheduler:
         itself as draining."""
         self.draining = False
         self._gauge("serve/draining", 0.0)
+        # re-arm speculation: a fresh deploy may carry a better draft,
+        # so the acceptance fallback and its window reset here
+        self._spec_fallback = False
+        if self._spec_window is not None:
+            self._spec_window.clear()

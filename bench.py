@@ -1205,6 +1205,48 @@ def bench_serve(trace_dir=None, prompt_len=48, decode_steps=24, trials=3):
     psched.leak_check()
     assert engine.pool.in_use == 0, engine.pool.in_use
 
+    # -- speculative-decode rows (docs/serving.md "Speculative decoding")
+    # serve_spec_accept_rate / serve_spec_tokens_per_step: a friendly
+    # (self-draft) k=4 speculative run through the real scheduler path.
+    # Greedy self-draft acceptance is exact by construction, so the
+    # accept-rate row pins 1.0 and the tokens/step row pins the
+    # k+1-wide emission — deterministic SCHEMA rows like the rest of
+    # this config (the workload-level proof, including the chaos storm
+    # and the plain-decode replay, lives in verify_tier1.sh's spec gate
+    # over tools/serve_bench.py).
+    from apex_tpu.observability import MetricRegistry
+    from apex_tpu.serve import SpecConfig
+
+    sreg = MetricRegistry(fetch_every=1)
+    sengine = InferenceEngine(
+        cfg, params, serve_cfg, registry=sreg,
+        spec=SpecConfig(draft_params=None, k=4),
+    ).build()
+    ssched = ContinuousBatchingScheduler(sengine, registry=sreg)
+    for _ in range(2):
+        ssched.submit(Request(prompt=prompt(16), max_new_tokens=12))
+    ssched.run()
+    ssched.leak_check()
+    assert sengine.pool.in_use == 0, sengine.pool.in_use
+    sreg.fetch()
+    svals = sreg.values()
+    assert svals.get("serve/spec_rounds", 0.0) > 0, svals
+    _emit(
+        "serve_spec_accept_rate",
+        round(svals["serve/spec_accept_rate"], 3),
+        "draft tokens accepted / drafted (self-draft k=4, greedy: "
+        "exact by construction, MUST be 1.0; CI serving smoke on CPU)",
+        None,
+    )
+    _emit(
+        "serve_spec_tokens_per_step",
+        round(svals["serve/spec_tokens_per_step"], 3),
+        "tokens emitted per decode step (self-draft k=4 over %d "
+        "requests; plain decode is 1.0 by definition; CI serving "
+        "smoke on CPU, not a perf claim)" % len(ssched.completed),
+        None,
+    )
+
     # -- serving resilience rows (docs/serving.md "Failure semantics") --
     # reuses tools/serve_chaos_drill.py (the SERVE-CHAOS gate's exact
     # machinery: fault-free Poisson reference + an APEX_TPU_CHAOS storm

@@ -516,6 +516,11 @@ class TestBenchDiff:
             # under the serve chaos storm + p99 TTFT inflation vs the
             # fault-free reference (deterministic virtual-clock drill)
             "serve_chaos_goodput_pct", "serve_chaos_p99_inflation",
+            # the speculative-decode rows (ISSUE 18): self-draft k=4
+            # greedy acceptance (exact by construction) + emitted
+            # tokens per decode step (docs/serving.md "Speculative
+            # decoding")
+            "serve_spec_accept_rate", "serve_spec_tokens_per_step",
             # the composable trainer's honest multi-device rows
             # (ISSUE 12): dp/tp >= 2 on the mocked 8-device mesh —
             # check_schema refuses degenerate train3d rows

@@ -72,23 +72,24 @@ def gpt():
     return cfg, model, params
 
 
-def make_replica(gpt, name, clock, *, spans=None, **sched_kw):
+def make_replica(gpt, name, clock, *, spans=None, spec=None, **sched_kw):
     cfg, _, params = gpt
     registry = MetricRegistry(fetch_every=1)
     engine = InferenceEngine(
         cfg, params,
         ServeConfig(page_size=8, num_pages=32, max_batch=2,
                     max_pages_per_seq=8, verify=False),
-        registry=registry,
+        registry=registry, spec=spec,
     ).build()
     return EngineReplica(name, engine, clock=clock, spans=spans,
                          **sched_kw)
 
 
 def make_fleet(gpt, clock, *, n=2, spans=None, autoscaler=None,
-               hung_ticks=200, **sched_kw):
+               hung_ticks=200, spec=None, **sched_kw):
     def factory(name):
-        return make_replica(gpt, name, clock, spans=spans, **sched_kw)
+        return make_replica(gpt, name, clock, spans=spans, spec=spec,
+                            **sched_kw)
 
     return Fleet(factory, replicas=n, clock=clock, spans=spans,
                  autoscaler=autoscaler, hung_ticks=hung_ticks)
@@ -394,6 +395,51 @@ class TestRollingUpdate:
         assert rep.engine.params is params2 and rep.state == LIVE
         assert r.status == "done"
         assert fleet.deploy_history[0]["lost_requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet: speculative decoding
+# ---------------------------------------------------------------------------
+
+
+class TestSpeculativeFleet:
+    def test_draft_weights_ride_rolling_update(self, gpt):
+        """A speculative fleet keeps speculating across a rolling
+        deploy: self-draft replicas re-alias the NEW target weights at
+        redeploy (a draft frozen on old weights would bleed acceptance
+        silently), and the router-level acceptance aggregate keeps
+        moving afterwards."""
+        from apex_tpu.serve import SpecConfig
+
+        cfg, model, _ = gpt
+        params2 = model.init(
+            jax.random.PRNGKey(44), jnp.zeros((8, 1), jnp.int32)
+        )
+        clock = VClock()
+        fleet = make_fleet(
+            gpt, clock, n=2, spec=SpecConfig(draft_params=None, k=2),
+        )
+        reqs = [fleet.submit(req(n_out=6)) for _ in range(4)]
+        pump(fleet, clock, reqs)
+        acc = fleet.spec_acceptance()
+        # self-draft + greedy: every proposal matches the target argmax
+        assert acc["drafted"] > 0 and acc["rate"] == 1.0
+        fleet.start_rolling_update(params2)
+        for _ in range(60):
+            if fleet.deploy is None:
+                break
+            fleet.step()
+            clock.advance()
+        assert fleet.deploy is None
+        assert fleet.deploy_history[0]["lost_requests"] == 0
+        for rep in fleet.replicas:
+            assert rep.state == LIVE
+            assert rep.engine.params is params2
+            assert rep.engine.draft_params is params2
+        reqs2 = [fleet.submit(req(n_out=4)) for _ in range(2)]
+        pump(fleet, clock, reqs2)
+        acc2 = fleet.spec_acceptance()
+        assert acc2["drafted"] > acc["drafted"] and acc2["rate"] == 1.0
 
 
 # ---------------------------------------------------------------------------

@@ -1427,3 +1427,302 @@ class TestPrefixScheduler:
         sched.run()
         c = req.ttft_components()
         assert c["cached_prefill_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (draft propose, one-step verify, PagePool rollback)
+# ---------------------------------------------------------------------------
+
+
+def make_spec_engine(gpt, k=4, spec_kw=None, **serve_kw):
+    """A speculative engine; default self-draft (the target proposes
+    for itself — 100% greedy acceptance, the tokens/step upper bound)."""
+    from apex_tpu.serve import SpecConfig
+
+    cfg, _, params = gpt
+    kw = dict(
+        page_size=8, num_pages=32, max_batch=2, max_pages_per_seq=8,
+        verify=False,
+    )
+    kw.update(serve_kw)
+    spec = SpecConfig(draft_params=None, k=k, **(spec_kw or {}))
+    return InferenceEngine(cfg, params, ServeConfig(**kw), spec=spec)
+
+
+class TestSpeculativeDecoding:
+    def _prompt(self, rs, n):
+        return [int(t) for t in rs.randint(0, 64, size=n)]
+
+    def _run(self, sched, prompts, max_new=8, **req_kw):
+        reqs = [
+            sched.submit(Request(prompt=list(p), max_new_tokens=max_new,
+                                 rid=f"r{i}", **req_kw))
+            for i, p in enumerate(prompts)
+        ]
+        sched.run()
+        assert all(r.status == "done" for r in reqs), [
+            (r.status, r.shed_reason) for r in reqs
+        ]
+        return reqs
+
+    def test_greedy_spec_bit_identical_f32(self, gpt):
+        """The acceptance gate: self-draft greedy spec at k=4 emits the
+        EXACT token stream plain decode emits, and accepts everything
+        (tokens/decode-step = k+1 >> the 1.5 floor)."""
+        rs = np.random.RandomState(60)
+        prompts = [self._prompt(rs, 6), self._prompt(rs, 11)]
+        plain = ContinuousBatchingScheduler(make_engine(gpt))
+        base = self._run(plain, prompts)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(make_spec_engine(gpt),
+                                            registry=reg)
+        spec = self._run(sched, prompts)
+        for a, b in zip(base, spec):
+            assert b.tokens == a.tokens
+        vals = _vals(reg)
+        assert vals["serve/spec_drafted"] > 0
+        assert vals["serve/spec_accepted"] == vals["serve/spec_drafted"]
+        assert vals["serve/spec_accept_rate"] == 1.0
+        assert vals["serve/spec_tokens_per_step"] >= 1.5
+        # spec rounds ARE decode steps: far fewer than tokens emitted
+        assert vals["serve/decode_steps"] < vals["serve/tokens_out"] - 2
+        assert sched.engine.pool.in_use == 0
+        sched.leak_check()
+
+    def test_greedy_spec_bit_identical_int8_kv(self, gpt):
+        """Same gate on the int8 KV wire: draft and verify quantize
+        through the same codec as plain decode, so greedy acceptance
+        still matches argmax-for-argmax."""
+        rs = np.random.RandomState(61)
+        prompts = [self._prompt(rs, 9), self._prompt(rs, 14)]
+        plain = ContinuousBatchingScheduler(make_engine(gpt, kv_wire="int8"))
+        base = self._run(plain, prompts)
+        sched = ContinuousBatchingScheduler(
+            make_spec_engine(gpt, kv_wire="int8")
+        )
+        spec = self._run(sched, prompts)
+        for a, b in zip(base, spec):
+            assert b.tokens == a.tokens
+        assert sched.engine.pool.in_use == 0
+
+    def test_spec_bit_identical_under_cow_fork(self, gpt):
+        """A spec round may roll back KV on the request's tail page —
+        which a prefix-cache hit BORROWS.  The scheduler must COW-fork
+        the whole speculative window before the round, so the warm
+        stream matches the cold one and the cached copy never drifts."""
+        rs = np.random.RandomState(62)
+        prompt = self._prompt(rs, 12)  # partial tail: 4 of 8 slots live
+        plain = ContinuousBatchingScheduler(make_engine(gpt))
+        base = self._run(plain, [prompt], max_new=6)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(make_spec_engine(gpt),
+                                            registry=reg,
+                                            prefix_cache=True)
+        cold = self._run(sched, [prompt], max_new=6)
+        warm = sched.submit(Request(prompt=list(prompt), max_new_tokens=6,
+                                    rid="warm"))
+        sched.run()
+        assert warm.status == "done"
+        assert warm.cache_hit_tokens == 12
+        assert cold[0].tokens == base[0].tokens
+        assert warm.tokens == base[0].tokens
+        assert _vals(reg)["serve/prefix_forks"] >= 2.0  # cold + warm tails
+        warm2 = sched.submit(Request(prompt=list(prompt), max_new_tokens=6,
+                                     rid="warm2"))
+        sched.run()
+        assert warm2.tokens == base[0].tokens  # cached copy never drifted
+        report = sched.drain()
+        assert report["pool_in_use"] == 0
+
+    def test_draft_pages_never_enter_prefix_cache(self, gpt):
+        """The namespace screen: leak_check refuses a draft-namespace
+        page claimed by the prefix cache, and a spec+cache run never
+        trips it (draft pages are scheduler-owned only)."""
+        pool = PagePool(num_pages=8, page_size=4)
+        draft = pool.alloc(1, ns="draft")
+        assert pool.namespace(draft[0]) == "draft"
+        with pytest.raises(ValueError, match="draft-namespace"):
+            pool.leak_check([], cached=draft)
+        pool.free(draft)
+        # kv-namespace pages cache fine
+        kv = pool.alloc(1)
+        pool.leak_check([], cached=kv)
+
+    def test_temperature_rollback_replay_bit_identical(self, gpt):
+        """The per-slot rng regression pin: sampled tokens are a pure
+        function of (stream, position), so re-decoding a position after
+        a planted rollback replays the SAME token — no global counter
+        leaks into the stream."""
+        eng = make_spec_engine(gpt, k=4)
+        prompt = list(np.random.RandomState(63).randint(0, 64, size=9))
+        pages = eng.pool.alloc(eng.pool.pages_for(len(prompt)))
+        _, first = eng.prefill(prompt, pages)
+        table = np.zeros((2, 8), np.int32)
+        table[0, : len(pages)] = pages
+        args = (
+            np.array([first, 0], np.int32),
+            np.array([len(prompt) + 1, 0], np.int32),
+            table,
+            np.array([0.8, 0.0], np.float32),
+        )
+        kw = dict(streams=np.array([1234, 0], np.uint32),
+                  gens=np.array([1, 0], np.int32))
+        _, t1 = eng.decode(*args, **kw)
+        # plant the rollback: truncate the KV row the decode just wrote
+        eng.rollback(np.array([len(prompt), 0], np.int32),
+                     np.array([1, 0], np.int32), table)
+        _, t2 = eng.decode(*args, **kw)
+        assert int(t1[0]) == int(t2[0])
+        eng.pool.free(pages)
+
+    def test_temperature_k0_matches_plain_stream(self, gpt):
+        """Satellite pin: with k=0 the spec path is plain decode routed
+        through the verify program — a temperature stream with an
+        explicit stream_seed must be bit-identical to the non-spec
+        scheduler's."""
+        rs = np.random.RandomState(64)
+        prompts = [self._prompt(rs, 7), self._prompt(rs, 10)]
+        plain = ContinuousBatchingScheduler(make_engine(gpt))
+        base = self._run(plain, prompts, temperature=0.7, stream_seed=99)
+        sched = ContinuousBatchingScheduler(make_spec_engine(gpt, k=0))
+        spec = self._run(sched, prompts, temperature=0.7, stream_seed=99)
+        for a, b in zip(base, spec):
+            assert b.tokens == a.tokens
+        assert sched.engine.pool.in_use == 0
+
+    def test_rejection_sampling_preserves_target_distribution(self):
+        """Chi-square on the rejection sampler: proposals drawn from a
+        MISMATCHED draft distribution q, accepted/resampled against the
+        target p — the emitted first token must be distributed exactly
+        as p.  Seeded, CPU, critical value hardcoded (df=7, a=0.001)."""
+        from apex_tpu.serve import spec as spec_lib
+
+        V, N, k = 8, 4096, 1
+        rs = np.random.RandomState(0)
+        p_logits = (rs.randn(V) * 1.5).astype(np.float32)
+        q_logits = (rs.randn(V) * 1.5).astype(np.float32)
+        p = np.exp(p_logits - p_logits.max())
+        p /= p.sum()
+        q = np.exp(q_logits - q_logits.max())
+        q /= q.sum()
+        # the consistency the theorem needs: d ~ q
+        d = rs.choice(V, size=(N, k), p=q).astype(np.int32)
+        out, n_acc = spec_lib.speculative_verify(
+            jnp.broadcast_to(jnp.asarray(p_logits), (k + 1, N, V)),
+            jnp.asarray(d),
+            jnp.broadcast_to(jnp.asarray(q, jnp.float32), (k, N, V)),
+            jnp.ones((N,), jnp.float32),
+            jax.vmap(jax.random.fold_in, (None, 0))(
+                jax.random.PRNGKey(42), jnp.arange(N, dtype=jnp.uint32)
+            ),
+            jnp.zeros((N,), jnp.int32),
+        )
+        counts = np.bincount(np.asarray(out[:, 0]), minlength=V)
+        expected = p * N
+        chi2 = float(((counts - expected) ** 2 / expected).sum())
+        assert chi2 < 24.322, (chi2, counts.tolist(), expected.tolist())
+        # and SOME of both outcomes occurred — the test saw real
+        # accepts and real rejections, not a degenerate path
+        acc = np.asarray(n_acc)
+        assert 0 < acc.sum() < N * k
+
+    def test_draft_fault_storm_stream_intact_and_leak_clean(self, gpt):
+        """The serve.draft chaos gate: a raise storm makes every spec
+        round fall back to plain decode and a nan storm poisons the
+        proposals — in BOTH cases the emitted stream stays bit-identical
+        to plain decode and the page ledger stays exact."""
+        from apex_tpu.resilience import chaos
+
+        rs = np.random.RandomState(65)
+        prompts = [self._prompt(rs, 6), self._prompt(rs, 11)]
+        plain = ContinuousBatchingScheduler(make_engine(gpt))
+        base = self._run(plain, prompts)
+        for mode in ("raise", "nan"):
+            reg = _registry()
+            sched = ContinuousBatchingScheduler(make_spec_engine(gpt),
+                                                registry=reg)
+            with chaos.inject(chaos.Fault(
+                chaos.SERVE_DRAFT, steps=(0, 1, 2), mode=mode
+            )):
+                reqs = self._run(sched, prompts)
+            for a, b in zip(base, reqs):
+                assert b.tokens == a.tokens, mode
+            vals = _vals(reg)
+            if mode == "raise":
+                assert vals["serve/draft_faults"] >= 1.0
+            else:
+                # poisoned proposals are REJECTED, never emitted
+                assert vals["serve/spec_rejected"] >= 1.0
+            assert sched.engine.pool.in_use == 0
+            sched.leak_check()
+
+    def test_acceptance_collapse_falls_back_to_plain(self, gpt):
+        """The degradation ladder: a hopeless draft (acceptance under
+        min_accept_rate over the window) trips the sticky fallback —
+        later rounds ride plain decode, resume() re-arms."""
+        import dataclasses as dc
+
+        from apex_tpu.serve import SpecConfig, draft_from_params
+
+        cfg, _, params = gpt
+        spec = SpecConfig(
+            draft_params=draft_from_params(params, 1),
+            k=4,
+            draft_cfg=dc.replace(cfg, num_layers=1),
+            min_accept_rate=0.95,
+            window=2,
+        )
+        eng = InferenceEngine(cfg, params, ServeConfig(
+            page_size=8, num_pages=32, max_batch=2, max_pages_per_seq=8,
+            verify=False,
+        ), spec=spec)
+        reg = _registry()
+        sched = ContinuousBatchingScheduler(eng, registry=reg)
+        rs = np.random.RandomState(66)
+        prompts = [self._prompt(rs, 8), self._prompt(rs, 8)]
+        plain = ContinuousBatchingScheduler(make_engine(gpt))
+        base = self._run(plain, prompts, max_new=12)
+        reqs = self._run(sched, prompts, max_new=12)
+        for a, b in zip(base, reqs):
+            assert b.tokens == a.tokens  # fallback or not: same stream
+        vals = _vals(reg)
+        assert vals["serve/spec_fallbacks"] >= 1.0
+        assert sched._spec_fallback
+        sched.resume()
+        assert not sched._spec_fallback
+        assert eng.pool.in_use == 0
+
+    def test_spec_acceptance_watchdog_rule(self, gpt):
+        """SpecAcceptanceRule pages when the published acceptance gauge
+        sinks under its floor — and stays silent when speculation never
+        ran."""
+        from apex_tpu.observability import (
+            MetricRegistry, SpecAcceptanceRule, Watchdog,
+        )
+        from apex_tpu.serve import declare_serve_metrics
+
+        reg = MetricRegistry(fetch_every=1)
+        declare_serve_metrics(reg)
+        state = reg.update(reg.init(), {
+            "serve/spec_rounds": 8.0,
+            "serve/spec_accept_rate": 0.2,
+        })
+        reg.observe(0, state)
+        reg.observe(1, state)
+        reg.fetch()
+        wd = Watchdog([SpecAcceptanceRule(min_rate=0.5)], registry=reg,
+                      check_every=1)
+        wd.on_step(1)
+        events = [e for e in wd.events if e.rule == "spec_acceptance"]
+        assert len(events) == 1
+        # silent when spec never ran (rate gauge 0.0, rounds 0)
+        reg2 = MetricRegistry(fetch_every=1)
+        declare_serve_metrics(reg2)
+        state2 = reg2.update(reg2.init(), {})
+        reg2.observe(0, state2)
+        reg2.observe(1, state2)
+        reg2.fetch()
+        wd2 = Watchdog([SpecAcceptanceRule(min_rate=0.5)], registry=reg2,
+                       check_every=1)
+        wd2.on_step(1)
+        assert wd2.events == []

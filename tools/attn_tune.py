@@ -21,7 +21,13 @@ cell within 5% of the measured best survives pruning.
 ``--cache-out FILE`` persists each sweep's measured winner into the
 on-disk tuning cache (``apex_tpu.ops.pallas.tune_cache`` schema) —
 point ``APEX_TPU_TUNE_CACHE`` at the file and ``_tuned_tile`` consults
-it at dispatch, no source edit needed.
+it at dispatch, no source edit needed.  Combined with ``--prune
+--dry-run`` it instead persists the cost model's best PREDICTED cell
+per sweep flavor — a device-free ranking artifact
+(``tools/tune_cache_v5e.json`` is committed from exactly this) so the
+next on-chip window starts one command from the model's pick; a real
+measured sweep overwrites the predictions through the same merge
+path.
 
 Run (on a TPU host):  python tools/attn_tune.py [--shapes mha,long]
 """
@@ -375,6 +381,46 @@ def sweep_bwd_only(name, keep=None, keep_dq=None):
     return {"dkdv": best[0], "dq": best_dq[0], "tflops": best_dq[1]}
 
 
+#: dry-run sweep flavor -> tuning-cache tile mode.  The combined
+#: fwd+bwd (or bwd-only phase-1) sweep decides the shared bwd tile
+#: pair; the dq-only phase decides the dq call's independent pair.
+#: Only one of fwd+bwd / bwd-only appears per invocation, so the
+#: shared "bwd" target never collides.
+_CACHE_MODE = {
+    "fwd": "fwd", "fwd+bwd": "bwd", "bwd-only": "bwd",
+    "dq-only": "bwd_dq",
+}
+
+
+def _persist_predicted(cache_out, name, verdicts_by_mode, device_kind):
+    """``--prune --dry-run --cache-out``: persist the cost model's best
+    PREDICTED KEEP cell per sweep flavor.  No device was touched, so
+    these are ranking artifacts, not measurements — but they make the
+    next on-chip session one command (point ``APEX_TPU_TUNE_CACHE`` at
+    the file) instead of a cold heuristic start, and a later measured
+    sweep overwrites them through the same merge-write."""
+    from apex_tpu.ops.pallas import tune_cache
+
+    b, h, sq, d, causal = SHAPES[name]
+    tiles = {}
+    for sweep_mode, verdicts in verdicts_by_mode.items():
+        kept = {
+            cell: p for cell, (vd, p, _) in verdicts.items()
+            if vd == "KEEP"
+        }
+        if kept:
+            best = min(kept.items(), key=lambda cp: cp[1]["time_s"])
+            tiles[_CACHE_MODE[sweep_mode]] = best[0]
+    if not tiles:
+        return
+    tune_cache.update_flash(
+        cache_out, sq=sq, d=fa.padded_head_dim(d), causal=causal,
+        tiles=tiles, dtype="bfloat16", backend=device_kind,
+    )
+    print(f"[attn_tune] cached {name} PREDICTED winners {tiles} "
+          f"-> {cache_out}")
+
+
 def _persist_winner(cache_out, name, tiles):
     """Write a sweep's measured winner(s) into the on-disk tuning
     cache — the artifact ``_tuned_tile`` consults at dispatch."""
@@ -432,6 +478,7 @@ if __name__ == "__main__":
     _PEAK_TFLOPS_BOUND = 1.27 * args.peak_tflops
     for name in args.shapes.split(","):
         keeps = {}
+        verdicts_by_mode = {}
         if args.prune:
             if args.bwd_only:
                 prune_sweeps = ["bwd-only", "dq-only"]
@@ -445,6 +492,7 @@ if __name__ == "__main__":
                     args.device_kind,
                 )
                 _print_verdicts(name, sweep_mode, v, args.prune_ratio)
+                verdicts_by_mode[sweep_mode] = v
                 keeps[sweep_mode] = {
                     c for c, (verdict, _, _) in v.items()
                     if verdict == "KEEP"
@@ -452,6 +500,11 @@ if __name__ == "__main__":
         keep_fwd = keeps.get("fwd")
         keep_bwd = keeps.get("fwd+bwd") or keeps.get("bwd-only")
         if args.dry_run:
+            if args.cache_out:
+                _persist_predicted(
+                    args.cache_out, name, verdicts_by_mode,
+                    args.device_kind,
+                )
             continue
         if args.bwd_only:
             result = sweep_bwd_only(

@@ -55,10 +55,17 @@ The live ops plane (``docs/observability.md`` "Live ops plane"):
   ``--memstats-tolerance`` is reported in the artifact naming the
   program, never silently.
 
+With ``--speculate K`` the run decodes speculatively (optionally with a
+``--draft-layers N`` truncated draft) and the artifact grows a ``spec``
+section — acceptance rate, tokens/decode-step, per-request
+decode-steps-saved percentiles, and the bit-identity replay against a
+plain-decode reference (``docs/serving.md`` "Speculative decoding").
+
 Usage::
 
     python tools/serve_bench.py                  # small CPU run
     python tools/serve_bench.py --requests 32 --rate 50 --json out.json
+    python tools/serve_bench.py --speculate 4 --json out.json
     python tools/serve_bench.py --spans spans.json --json out.json
     python tools/serve_bench.py --ops-port 9400 --slo-ttft-ms 250
 """
@@ -127,12 +134,33 @@ def build_engine(args):
     ids = jax.random.randint(jax.random.PRNGKey(0), (32, 1), 0, cfg.vocab_size)
     params = model.init(jax.random.PRNGKey(1), ids)
     registry = MetricRegistry(fetch_every=1)
+    spec = None
+    if args.speculate:
+        import dataclasses
+
+        from apex_tpu.serve import SpecConfig, draft_from_params
+
+        if args.draft_layers:
+            # truncated draft: the target's first N layers (embeddings
+            # and final norm shared) — cheap to propose, aligned enough
+            # to accept
+            spec = SpecConfig(
+                draft_params=draft_from_params(params, args.draft_layers),
+                k=args.speculate,
+                draft_cfg=dataclasses.replace(
+                    cfg, num_layers=args.draft_layers
+                ),
+            )
+        else:
+            # self-draft: the target proposes for itself — 100% greedy
+            # acceptance, the upper bound the gate pins tokens/step on
+            spec = SpecConfig(draft_params=None, k=args.speculate)
     # build() compiles AND analysis-verifies every bucket + the decode
     # step up front, so engine.reports is the acceptance evidence; the
     # chunk-prefill/fork programs warm too when the run will use them
     # (a lazy compile inside the first cache hit would poison its TTFT)
     engine = InferenceEngine(
-        cfg, params, serve_cfg, registry=registry
+        cfg, params, serve_cfg, spec=spec, registry=registry
     ).build(chunked=bool(args.prefix_cache or args.chunk_tokens))
     return cfg, model, params, engine, registry
 
@@ -471,6 +499,47 @@ def prefix_replay_check(cfg, params, args, completed):
     }
 
 
+def spec_report(sched, registry, args):
+    """The speculative-decoding acceptance section: windowed acceptance
+    rate and tokens/decode-step from the scheduler's own gauges, the
+    draft/accept/rollback ledger, and per-request decode-steps-saved
+    percentiles (each completed request's actual engine iterations vs
+    the one-token-per-step count plain decode would have needed)."""
+    registry.fetch()
+    vals = registry.values()
+    saved = []
+    for r in sched.completed:
+        n_decode = len(r.tokens) - 1
+        if (
+            n_decode > 0
+            and r.first_decode_iter is not None
+            and r.last_decode_iter is not None
+        ):
+            steps = r.last_decode_iter - r.first_decode_iter + 1
+            saved.append(100.0 * (1.0 - steps / n_decode))
+    saved.sort()
+    sched.leak_check()  # draft pages ledgered exactly, proven here
+    return {
+        "k": args.speculate,
+        "draft_layers": args.draft_layers,
+        "rounds": vals.get("serve/spec_rounds", 0.0),
+        "drafted": vals.get("serve/spec_drafted", 0.0),
+        "accepted": vals.get("serve/spec_accepted", 0.0),
+        "rollbacks": vals.get("serve/spec_rollbacks", 0.0),
+        "fallbacks": vals.get("serve/spec_fallbacks", 0.0),
+        "draft_faults": vals.get("serve/draft_faults", 0.0),
+        "accept_rate": vals.get("serve/spec_accept_rate", 0.0),
+        "tokens_per_step": vals.get("serve/spec_tokens_per_step", 0.0),
+        "decode_steps_saved_pct": {
+            "p50": _percentile(saved, 0.50),
+            "p95": _percentile(saved, 0.95),
+            "p99": _percentile(saved, 0.99),
+            "samples": len(saved),
+        },
+        "leak_checks_run": sched.leak_checks_run,
+    }
+
+
 def single_request_baseline(engine, args):
     """Batch-fill a lone request sustains — the bar the continuous
     batcher must beat (one request on max_batch slots)."""
@@ -529,6 +598,15 @@ def main():
                     help="prefill chunk size (page multiple): slices "
                     "prefill between decode iterations; also the "
                     "re-run grain a cache hit's bit-identity rides on")
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="speculative decoding: draft K tokens per "
+                    "round, one target verify step scores them all "
+                    "(0 = off; docs/serving.md 'Speculative decoding')")
+    ap.add_argument("--draft-layers", type=int, default=None,
+                    metavar="N", dest="draft_layers",
+                    help="draft = the target's first N layers "
+                    "(embeddings shared); default self-draft — the "
+                    "target proposes for itself (100%% greedy accept)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", metavar="FILE", default=None)
     ap.add_argument("--spans", metavar="FILE", default=None,
@@ -645,6 +723,13 @@ def main():
         load["prefix"]["replay"] = prefix_replay_check(
             cfg, params, args, sched.completed
         )
+    if args.speculate:
+        load["spec"] = spec_report(sched, registry, args)
+        # bit-identity proof: prefix_replay_check's reference engine is
+        # ALSO speculation-free, so the same replay serves both gates
+        load["spec"]["replay"] = prefix_replay_check(
+            cfg, params, args, sched.completed
+        )
     registry.fetch()
 
     # the end-of-run scrape happens AFTER the registry drain, so its
@@ -712,6 +797,22 @@ def main():
         print(f"prefix replay: {rp['replayed']} requests vs uncached "
               f"reference — "
               f"{'BIT-IDENTICAL' if rp['bit_identical'] else 'MISMATCH'}")
+    if args.speculate:
+        sx = load["spec"]
+        ds = sx["decode_steps_saved_pct"]
+        print(f"speculative decode (k={sx['k']}, draft_layers="
+              f"{sx['draft_layers'] or 'self'}): accept rate "
+              f"{100 * sx['accept_rate']:.1f}%, "
+              f"{sx['tokens_per_step']:.2f} tokens/step over "
+              f"{sx['rounds']:.0f} rounds; decode steps saved "
+              f"p50={ds['p50']:.1f}% p95={ds['p95']:.1f}% "
+              f"(rollbacks={sx['rollbacks']:.0f} "
+              f"fallbacks={sx['fallbacks']:.0f} "
+              f"draft_faults={sx['draft_faults']:.0f})")
+        srp = sx["replay"]
+        print(f"spec replay: {srp['replayed']} requests vs plain-decode "
+              f"reference — "
+              f"{'BIT-IDENTICAL' if srp['bit_identical'] else 'MISMATCH'}")
     print(f"graph lint ERRORs: {lint_errors}")
 
     slo_events = list(watchdog.events) if watchdog is not None else []
@@ -764,6 +865,14 @@ def main():
                 f"{rp['mismatched_rids']} diverged from the uncached "
                 f"reference"
             )
+    if args.speculate:
+        srp = load["spec"]["replay"]
+        if not srp["bit_identical"]:
+            failures.append(
+                f"speculative decoding broke bit-identity: rids "
+                f"{srp['mismatched_rids']} diverged from the "
+                f"plain-decode reference"
+            )
 
     if args.json:
         from apex_tpu.observability.spans import wall_clock_anchor
@@ -779,7 +888,8 @@ def main():
                     "slo_ttft_ms", "batch", "page_size", "pages",
                     "pages_per_seq", "kv_wire", "weight_wire", "seed",
                     "prefix_cache", "shared_prefix_tokens",
-                    "shared_frac", "chunk_tokens",
+                    "shared_frac", "chunk_tokens", "speculate",
+                    "draft_layers",
                 )
             },
             "load": load,

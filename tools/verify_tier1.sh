@@ -1004,6 +1004,56 @@ PYEOF
                 "$PFX_JSON)" | tee -a "$LOG"
         fi
     fi
+    # speculative-decode gate (ISSUE 18): a friendly (self-draft)
+    # speculative run at k=4 must prove the headline — >= 1.5 emitted
+    # tokens per decode step — WITHOUT buying speed with correctness:
+    # the replay harness re-decodes every completed request on a
+    # speculation-free engine and demands bit-identical streams, and a
+    # planted serve.draft fault storm (raise at two draft rounds) must
+    # leave every stream intact and the pool leak-clean with
+    # draft-namespace pages in flight.
+    if [ "$serve_rc" -eq 0 ]; then
+        SPEC_JSON="$(mktemp /tmp/_t1_spec.XXXXXX.json)"
+        timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+            APEX_TPU_CHAOS="serve.draft:raise@1,3" \
+            python tools/serve_bench.py --requests 10 \
+            --output-mix 8 12 --speculate 4 --json "$SPEC_JSON" \
+            2>&1 | tail -n 5 | tee -a "$LOG"
+        serve_rc=${PIPESTATUS[0]}
+        if [ "$serve_rc" -eq 0 ]; then
+            python - "$SPEC_JSON" <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+art = json.load(open(sys.argv[1]))
+sp = art["load"]["spec"]
+assert sp["k"] == 4 and sp["rounds"] > 0, sp
+rp = sp["replay"]
+assert rp["bit_identical"], (
+    f"speculative decode diverged from plain reference: {rp}")
+# self-draft greedy acceptance is exact except in the wake of the
+# planted faults (a plain-fallback round leaves the draft KV one
+# token behind until the next round's first column heals it)
+assert sp["accept_rate"] >= 0.8, (
+    f"self-draft greedy acceptance {sp['accept_rate']} < 0.8")
+tps = sp["tokens_per_step"]
+assert tps >= 1.5, f"spec tokens/decode-step {tps:.2f} < 1.5 at k=4"
+assert sp["draft_faults"] >= 1, (
+    f"planted serve.draft storm never landed: {sp}")
+assert sp["leak_checks_run"] > 0, sp
+print(f"spec gate OK: {sp['rounds']:.0f} rounds, accept rate "
+      f"{100 * sp['accept_rate']:.1f}%, {tps:.2f} tokens/step, "
+      f"{sp['draft_faults']:.0f} draft faults absorbed, replay "
+      f"bit-identical over {rp['replayed']} requests, "
+      f"{sp['leak_checks_run']} leak checks clean")
+PYEOF
+            serve_rc=${PIPESTATUS[0]}
+        fi
+        if [ "$serve_rc" -eq 0 ]; then
+            rm -f "$SPEC_JSON"
+        else
+            echo "TIER1-SERVE: speculative-decode gate failed (artifact" \
+                "at $SPEC_JSON)" | tee -a "$LOG"
+        fi
+    fi
     if [ "$serve_rc" -eq 0 ]; then
         rm -rf "$SV_DIR"
         rm -f "$SV_OUT"
