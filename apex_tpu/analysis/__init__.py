@@ -77,9 +77,11 @@ from apex_tpu.analysis.passes import (  # noqa: F401
     StepGraph,
     iter_eqns,
 )
+from apex_tpu.analysis import concurrency  # noqa: F401
 from apex_tpu.analysis import hlo  # noqa: F401
 from apex_tpu.analysis import kernels  # noqa: F401
 from apex_tpu.analysis import memory  # noqa: F401
+from apex_tpu.analysis import purity  # noqa: F401
 from apex_tpu.analysis import sharding  # noqa: F401
 from apex_tpu.analysis.sharding import (  # noqa: F401
     match_partition_rules,
@@ -89,6 +91,7 @@ __all__ = [
     "check",
     "lint_jaxpr",
     "lint_hlo",
+    "lint_package",
     "publish_report",
     "attach_shard_sections",
     "Finding",
@@ -103,9 +106,11 @@ __all__ = [
     "StepGraph",
     "PASSES",
     "iter_eqns",
+    "concurrency",
     "hlo",
     "kernels",
     "memory",
+    "purity",
     "sharding",
     "match_partition_rules",
 ]
@@ -115,6 +120,10 @@ __all__ = [
 #: dropped from a report's rules_run, so the gap is visible) when
 #: tracing failed and only compiled HLO is available
 _JAXPR_ONLY = ("promotion",)
+
+#: passes whose substrate is SOURCE text (StepGraph.sources), not a
+#: traced/compiled program — same drop-when-absent contract
+_SOURCE_ONLY = ("concurrency", "purity")
 
 
 def _select(rules) -> tuple:
@@ -136,6 +145,8 @@ def _run(graph: StepGraph, rules, target: str) -> Report:
         # a jaxpr-only pass that cannot run must not be REPORTED as run
         # — a "clean" verdict would claim a property nobody checked
         selected = tuple(r for r in selected if r not in _JAXPR_ONLY)
+    if graph.sources is None:
+        selected = tuple(r for r in selected if r not in _SOURCE_ONLY)
     report = Report(target=target, rules_run=selected)
     for name in selected:
         t0 = _time.perf_counter()
@@ -273,6 +284,26 @@ def lint_hlo(
     )
     report = _run(graph, wanted, name or "hlo")
     report.hlo_text = hlo_text
+    return report
+
+
+def lint_package(
+    root: Optional[str] = None,
+    rules=("concurrency", "purity"),
+    name: str = "apex_tpu",
+) -> Report:
+    """Run the HOST-SIDE source passes (lock discipline, replay
+    purity — docs/analysis.md "Concurrency & replay-purity passes")
+    over the package source tree.  The substrate is
+    ``StepGraph.sources`` — every ``.py`` under ``root`` (default: the
+    installed ``apex_tpu`` package) — so the same ``_run`` machinery
+    times the passes and the same Report/RULES schema carries the
+    findings as every graph pass.  ``tools/concurrency_lint.py`` is
+    the CLI (jax-free, via standalone module loading); ``bench.py
+    --lint`` emits the ERROR count as ``concurrency_lint_errors``."""
+    graph = StepGraph(sources=purity.collect_sources(root))
+    report = _run(graph, rules, name)
+    report.sections["files_scanned"] = len(graph.sources)
     return report
 
 

@@ -248,6 +248,78 @@ RULES: Dict[str, Tuple[str, str, str]] = {
         "commit the measured winner via tools/attn_tune.py "
         "--cache-out / the _TUNED_TILES table",
     ),
+    "race-unlocked-shared-state": (
+        ERROR,
+        "an attribute reachable from both a thread body and the main "
+        "path is written without holding the class's lock — a torn or "
+        "stale read is a scheduling accident away, and the GIL only "
+        "protects single bytecodes, not invariants spanning fields",
+        "guard every mutation with the class's lock (use "
+        "observability.TrackedLock so the runtime sanitizer sees it); "
+        "keep blocking calls (queue put/join) OUTSIDE the held region",
+    ),
+    "race-nonatomic-counter": (
+        ERROR,
+        "a read-modify-write counter (x += 1 and friends) is updated "
+        "from both a thread body and the main path without a lock — "
+        "the load/store pair is not atomic, so concurrent updates "
+        "silently lose increments",
+        "wrap the update in the class's lock (a TrackedLock keeps the "
+        "sanitizer's lock-order graph complete), or move the counter "
+        "to the single owning thread",
+    ),
+    "race-lock-across-blocking": (
+        ERROR,
+        "a lock is held across a blocking hand-off (bounded-queue "
+        "put/join, future result) while a consumer thread needs the "
+        "same lock to make progress — the classic two-party deadlock "
+        "shape: the holder waits on the queue, the drainer waits on "
+        "the lock",
+        "shrink the critical section so the blocking call happens "
+        "after release; snapshot what the hand-off needs under the "
+        "lock, then put/join outside it",
+    ),
+    "replay-wall-clock": (
+        ERROR,
+        "a wall-clock read (time.time / datetime.now) in a "
+        "replay-critical module — bit-identical replay (the SERVE/"
+        "GOODPUT/FLEET gates) requires every time source to be "
+        "time.monotonic or the drill's virtual clock; wall time "
+        "diverges across runs and hosts",
+        "use time.monotonic() (durations) or the injected virtual "
+        "clock (scheduling); waive an audited telemetry-only site "
+        "with '# lint: allow(replay-wall-clock): <reason>'",
+    ),
+    "replay-unseeded-rng": (
+        ERROR,
+        "module-level RNG (random.*, np.random.*) in a replay-critical "
+        "module draws from hidden global state — two replays of the "
+        "same request stream sample different numbers, breaking "
+        "bit-identical replay",
+        "thread an explicit seeded generator (np.random.default_rng("
+        "seed), random.Random(seed), or jax.random keys) through the "
+        "call path; never the module-level functions",
+    ),
+    "replay-set-order": (
+        ERROR,
+        "iteration over a set feeds a scheduling/ordering decision in "
+        "a replay-critical module — set order is hash-seed dependent "
+        "(PYTHONHASHSEED), so admission/eviction order differs across "
+        "processes and replay diverges",
+        "iterate sorted(the_set) (or keep an explicitly ordered "
+        "list/dict — dicts preserve insertion order) wherever the "
+        "order can influence scheduling",
+    ),
+    "replay-env-read": (
+        ERROR,
+        "os.environ is read inside a step/tick body of a "
+        "replay-critical module — per-step environment reads make the "
+        "replayed run depend on live process state instead of the "
+        "recorded configuration",
+        "resolve env knobs ONCE at construction (__init__ / from_env /"
+        " a resolve_* helper) and carry the value; the step path "
+        "reads only captured config",
+    ),
 }
 
 

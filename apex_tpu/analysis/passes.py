@@ -66,6 +66,11 @@ class StepGraph:
     expect_plan: Optional[dict] = None
     #: static peak-HBM budget in bytes (apex_tpu.analysis.memory)
     hbm_budget: Optional[int] = None
+    #: source substrate for the host-side passes: [(package-relative
+    #: path, source text), ...] — built by
+    #: apex_tpu.analysis.purity.collect_sources; the concurrency and
+    #: purity passes skip silently when this is None (graph-only runs)
+    sources: Optional[list] = None
 
 
 # ---------------------------------------------------------------------------
@@ -405,7 +410,9 @@ def collective_pass(graph: StepGraph) -> List[Finding]:
     return out
 
 
+from apex_tpu.analysis.concurrency import concurrency_pass  # noqa: E402
 from apex_tpu.analysis.memory import memory_pass  # noqa: E402
+from apex_tpu.analysis.purity import purity_pass  # noqa: E402
 from apex_tpu.analysis.sharding import (  # noqa: E402
     reshard_pass,
     sharding_pass,
@@ -416,6 +423,8 @@ from apex_tpu.analysis.sharding import (  # noqa: E402
 #: sharding/reshard/memory passes live in their own modules
 #: (apex_tpu/analysis/sharding.py, .../memory.py) and are quiet until
 #: their intent (expect_sharding / expect_plan / hbm_budget) is given.
+#: The concurrency/purity passes read the SOURCE substrate
+#: (StepGraph.sources) and are quiet without it.
 PASSES: Dict[str, Callable[[StepGraph], List[Finding]]] = {
     "transfer": transfer_pass,
     "promotion": promotion_pass,
@@ -424,4 +433,6 @@ PASSES: Dict[str, Callable[[StepGraph], List[Finding]]] = {
     "sharding": sharding_pass,
     "reshard": reshard_pass,
     "memory": memory_pass,
+    "concurrency": concurrency_pass,
+    "purity": purity_pass,
 }

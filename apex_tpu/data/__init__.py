@@ -30,6 +30,7 @@ from typing import Iterator, Optional, Sequence, Tuple
 import numpy as np
 
 from apex_tpu import _native
+from apex_tpu.observability.locks import TrackedLock
 
 __all__ = [
     "TokenFileDataset",
@@ -262,6 +263,9 @@ class DevicePrefetcher:
         self._producer_wait_s = 0.0  # queue full: compute-bound (healthy)
         self._batches = 0
         self._occupancy_sum = 0.0
+        # _producer_wait_s is the one field both sides touch: the
+        # worker accumulates it, metrics() reads it from the consumer
+        self._lock = TrackedLock("data.prefetch")
         self._worker = threading.Thread(target=self._fill, daemon=True)
         self._worker.start()
 
@@ -273,7 +277,8 @@ class DevicePrefetcher:
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
-                self._producer_wait_s += time.monotonic() - t0
+                with self._lock:
+                    self._producer_wait_s += time.monotonic() - t0
                 return True
             except queue.Full:
                 continue
@@ -352,11 +357,13 @@ class DevicePrefetcher:
         """The pipeline-balance ledger: consumer stall (input-bound),
         producer wait (compute-bound backpressure — healthy), mean
         queue occupancy at fetch, batches served."""
+        with self._lock:
+            producer_wait_s = self._producer_wait_s
         return {
             "batches": self._batches,
             "stall_fraction": self.stall_fraction,
             "consumer_wait_s": self._consumer_wait_s,
-            "producer_wait_s": self._producer_wait_s,
+            "producer_wait_s": producer_wait_s,
             "mean_occupancy": (
                 self._occupancy_sum / self._batches if self._batches else 0.0
             ),

@@ -71,6 +71,7 @@ import jax
 import numpy as np
 
 from apex_tpu import checkpoint as _ckpt
+from apex_tpu.observability.locks import TrackedLock
 
 __all__ = [
     "host_snapshot",
@@ -204,11 +205,16 @@ class AsyncCheckpointEngine:
             maxsize=resolve_queue_depth(queue_depth)
         )
         self._events: "collections.deque" = collections.deque(maxlen=1024)
-        self._lock = threading.Lock()
+        # one lock for everything the writer thread and the step path
+        # both touch: _error, _stats, _ckptr, _phase, _first_save_t.
+        # TrackedLock so the LOCKSAN lock-order graph sees it and
+        # close() can name the holder when a drain times out.
+        self._lock = TrackedLock("ckpt")
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._ckptr = None  # one StandardCheckpointer, writer-thread only
+        self._phase = "idle"  # writer's current phase, for close() diag
         self._first_save_t: Optional[float] = None
         self._stats: Dict[str, float] = {
             "saves": 0.0,
@@ -237,12 +243,18 @@ class AsyncCheckpointEngine:
             )
             self._thread.start()
 
-    def close(self) -> None:
+    def close(self, timeout: float = 120.0) -> None:
         """Drain pending writes, stop the writer, release orbax.
         Never raises (it runs from ``__exit__``, possibly during
         exception handling) — but a deferred write error is WARNED,
         not swallowed: without a later ``save``/finalize to raise it,
-        close is the last place a lost final write can be reported."""
+        close is the last place a lost final write can be reported.
+
+        The drain is a BOUNDED wait: after ``timeout`` seconds the
+        warning names what the writer was doing when it wedged — its
+        current phase (``write step N`` / ``prune`` / ``bootstrap``),
+        the queue backlog, and who holds the engine lock (TrackedLock
+        state) — instead of a bare "still busy"."""
         if self._closed:
             return
         self._closed = True
@@ -256,19 +268,30 @@ class AsyncCheckpointEngine:
                 except queue.Full:
                     if not self._thread.is_alive():
                         break
-            self._thread.join(timeout=120)
+            self._thread.join(timeout=timeout)
             if self._thread.is_alive():
                 # the daemon writer dies with the process; whatever is
                 # still queued/mid-write never reaches disk — that must
                 # not be silent (run_resilient drains via
                 # wait_until_finished first, but a bare context-manager
-                # user's last checkpoints are on the line here)
+                # user's last checkpoints are on the line here).  Name
+                # the stuck phase so the postmortem starts at the right
+                # layer (a wedged orbax write step vs. a slow prune vs.
+                # a lock-holder that never released).
                 import warnings
 
+                # deliberately lock-free reads: if the writer wedged
+                # WHILE holding the lock, taking it here would hang the
+                # very diagnostic meant to explain the hang
+                phase = self._phase
+                holder = self._lock.holder
                 warnings.warn(
-                    "checkpoint writer still busy after 120s close() "
-                    "drain; pending background writes will be lost "
-                    "when the process exits",
+                    f"checkpoint writer still busy after {timeout:g}s "
+                    f"close() drain (stuck phase: {phase}; "
+                    f"{self._q.qsize()} item(s) still queued; engine "
+                    f"lock held by: {holder or 'nobody'}); pending "
+                    "background writes will be lost when the process "
+                    "exits",
                     RuntimeWarning,
                 )
         elif self._ckptr is not None:
@@ -286,12 +309,16 @@ class AsyncCheckpointEngine:
             )
 
     def _close_ckptr(self) -> None:
-        if self._ckptr is not None:
+        # swap under the lock (both the writer's shutdown path and a
+        # threadless close() reach here); the actual close — which may
+        # block on orbax — runs outside it
+        with self._lock:
+            ckptr, self._ckptr = self._ckptr, None
+        if ckptr is not None:
             try:
-                self._ckptr.close()
+                ckptr.close()
             except Exception:
                 pass
-            self._ckptr = None
 
     # -- queries -----------------------------------------------------------
     def latest_step(self) -> Optional[int]:
@@ -338,17 +365,22 @@ class AsyncCheckpointEngine:
         # event (after the orbax save, long past the fill below), so
         # the event's step-path cost is snapshot AND enqueue.
         enq_slot: List[float] = []
+        # the bounded put blocks when the writer is behind — it must
+        # stay OUTSIDE the lock (the writer needs the same lock to
+        # finish the write that frees the slot: holding it here is the
+        # textbook race-lock-across-blocking deadlock)
         self._q.put((int(step), host, bool(force), t0, t1, enq_slot))
         t2 = time.monotonic()
         enq_slot.append((t2 - t1) * 1e3)
         self._last_saved = int(step)
-        st = self._stats
-        st["saves"] += 1.0
-        st["snapshot_ms_total"] += (t1 - t0) * 1e3
-        st["enqueue_wait_ms_total"] += (t2 - t1) * 1e3
-        st["last_snapshot_ms"] = (t1 - t0) * 1e3
-        if self._first_save_t is None:
-            self._first_save_t = t0
+        with self._lock:
+            st = self._stats
+            st["saves"] += 1.0
+            st["snapshot_ms_total"] += (t1 - t0) * 1e3
+            st["enqueue_wait_ms_total"] += (t2 - t1) * 1e3
+            st["last_snapshot_ms"] = (t1 - t0) * 1e3
+            if self._first_save_t is None:
+                self._first_save_t = t0
         self._publish()
         return True
 
@@ -384,7 +416,8 @@ class AsyncCheckpointEngine:
         self._q.join()
         dt = time.monotonic() - t0
         if dt > 1e-4:  # an actual wait, not the no-op fast path
-            self._stats["finalize_ms_total"] += dt * 1e3
+            with self._lock:
+                self._stats["finalize_ms_total"] += dt * 1e3
             self._events.append({
                 "phase": "finalize", "step": self._last_saved,
                 "t0": t0, "t1": t0 + dt,
@@ -395,10 +428,14 @@ class AsyncCheckpointEngine:
     # -- the background writer ---------------------------------------------
     def _writer_loop(self) -> None:
         try:
+            with self._lock:
+                self._phase = "bootstrap"
             import orbax.checkpoint as ocp
 
-            if self._ckptr is None:
-                self._ckptr = ocp.StandardCheckpointer()
+            with self._lock:
+                if self._ckptr is None:
+                    self._ckptr = ocp.StandardCheckpointer()
+                self._phase = "idle"
         except BaseException as e:
             # bootstrap failed (orbax missing/broken): become a pure
             # drainer — ``q.join()`` callers must never deadlock on
@@ -407,7 +444,8 @@ class AsyncCheckpointEngine:
             # save/finalize); close()'s sentinel ends the loop.
             with self._lock:
                 self._error = e
-            self._stats["failures"] += 1.0
+                self._stats["failures"] += 1.0
+                self._phase = "drain (bootstrap failed)"
             while True:
                 item = self._q.get()
                 if item is not _SENTINEL:
@@ -421,7 +459,7 @@ class AsyncCheckpointEngine:
                     with self._lock:
                         if self._error is None:
                             self._error = e
-                    self._stats["failures"] += 1.0
+                        self._stats["failures"] += 1.0
                 self._q.task_done()
                 if item is _SENTINEL:
                     return
@@ -444,22 +482,28 @@ class AsyncCheckpointEngine:
         w0 = time.monotonic()
         ok = True
         try:
+            with self._lock:
+                self._phase = f"write step {int(step)}"
             if self._commit_hook is not None:
                 self._commit_hook(step)
             self._ckptr.save(path, host, force=force or os.path.exists(path))
             self._ckptr.wait_until_finished()
+            with self._lock:
+                self._phase = "prune"
             self._prune()
         except BaseException as e:  # deferred to the next save() call
             ok = False
             with self._lock:
                 self._error = e
-            self._stats["failures"] += 1.0
+                self._stats["failures"] += 1.0
         w1 = time.monotonic()
-        st = self._stats
-        if ok:
-            st["writes"] += 1.0
-            st["write_ms_total"] += (w1 - w0) * 1e3
-            st["last_write_ms"] = (w1 - w0) * 1e3
+        with self._lock:
+            self._phase = "idle"
+            st = self._stats
+            if ok:
+                st["writes"] += 1.0
+                st["write_ms_total"] += (w1 - w0) * 1e3
+                st["last_write_ms"] = (w1 - w0) * 1e3
         self._events.append({
             "phase": "write", "step": int(step), "ok": ok,
             "t0": w0, "t1": w1,
@@ -532,19 +576,22 @@ class AsyncCheckpointEngine:
         0.0 until :data:`MIN_STALL_WINDOW_S` of wall time has accrued
         (a cold-start fraction over milliseconds is noise, not a
         stall)."""
-        if self._first_save_t is None:
+        with self._lock:
+            first_t = self._first_save_t
+            stalled_ms = (
+                self._stats["snapshot_ms_total"]
+                + self._stats["enqueue_wait_ms_total"]
+            )
+        if first_t is None:
             return 0.0
-        wall = time.monotonic() - self._first_save_t
+        wall = time.monotonic() - first_t
         if wall < self.MIN_STALL_WINDOW_S:
             return 0.0
-        st = self._stats
-        stalled = (
-            st["snapshot_ms_total"] + st["enqueue_wait_ms_total"]
-        ) / 1e3
-        return min(1.0, stalled / wall)
+        return min(1.0, (stalled_ms / 1e3) / wall)
 
     def stats(self) -> Dict[str, float]:
-        out = dict(self._stats)
+        with self._lock:
+            out = dict(self._stats)
         out["pending"] = float(self._q.qsize())
         out["stall_frac"] = self.stall_fraction()
         return out
@@ -552,7 +599,8 @@ class AsyncCheckpointEngine:
     def _publish(self) -> None:
         from apex_tpu.observability.metrics import board
 
-        st = self._stats
+        with self._lock:
+            st = dict(self._stats)
         board.set("goodput/ckpt/saves", st["saves"])
         board.set("goodput/ckpt/writes", st["writes"])
         board.set("goodput/ckpt/failures", st["failures"])

@@ -149,6 +149,17 @@ from apex_tpu.observability.metrics import (  # noqa: F401
     MetricRegistry,
     board,
 )
+from apex_tpu.observability.locks import (  # noqa: F401
+    TrackedLock,
+    lock_order_graph,
+    reset_sanitizer,
+    sanitizer_report,
+)
+from apex_tpu.observability.locks import arm as locksan_arm  # noqa: F401
+from apex_tpu.observability.locks import armed as locksan_armed  # noqa: F401
+from apex_tpu.observability.locks import (  # noqa: F401
+    attach_flight as locksan_attach_flight,
+)
 from apex_tpu.observability.ometrics import (  # noqa: F401
     Histogram,
     OpsServer,
@@ -211,6 +222,13 @@ __all__ = [
     "SpanRecorder",
     "wall_clock_anchor",
     "monotonic_to_epoch",
+    "TrackedLock",
+    "lock_order_graph",
+    "sanitizer_report",
+    "reset_sanitizer",
+    "locksan_arm",
+    "locksan_armed",
+    "locksan_attach_flight",
     "OpsServer",
     "Histogram",
     "metric_name",

@@ -59,6 +59,8 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
 
+from apex_tpu.observability.locks import TrackedLock
+
 __all__ = [
     "ENV_OPS_PORT",
     "ops_port_from_env",
@@ -648,6 +650,9 @@ class OpsServer:
         self.name = name
         self.scrapes = 0
         self.last_scrape_ms: Optional[float] = None
+        # scrape() runs on ThreadingHTTPServer handler threads while
+        # tests/boards read the counters from the main thread
+        self._lock = TrackedLock("ops.scrape")
         self._server = None
         self._thread = None
 
@@ -694,13 +699,15 @@ class OpsServer:
 
             board_snapshot = board.snapshot()
         text = render(self.registries, self.histograms, board_snapshot)
-        self.scrapes += 1
-        self.last_scrape_ms = 1e3 * (time.perf_counter() - t0)
+        with self._lock:
+            self.scrapes += 1
+            self.last_scrape_ms = 1e3 * (time.perf_counter() - t0)
+            scrapes, scrape_ms = self.scrapes, self.last_scrape_ms
         if self.include_board:
             from apex_tpu.observability.metrics import board
 
-            board.set(self._board_key("scrapes"), self.scrapes)
-            board.set(self._board_key("scrape_ms"), self.last_scrape_ms)
+            board.set(self._board_key("scrapes"), scrapes)
+            board.set(self._board_key("scrape_ms"), scrape_ms)
         return text
 
     @property

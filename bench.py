@@ -421,6 +421,22 @@ def bench_bert_lamb(trace_dir=None, batch=128, chunk=6, trials=3,
             "docs/analysis.md)" % (len(krep.warnings()), kernel_waste),
             None,
         )
+        # the host-side half of the linter (PR 19): lock discipline
+        # over every threaded class + replay purity over the
+        # replay-critical modules (docs/analysis.md "Concurrency &
+        # replay-purity passes") — golden-pinned at zero so a new race
+        # or impurity gates like a graph regression does
+        conc_report = analysis.lint_package()
+        _emit(
+            "concurrency_lint_errors",
+            float(len(conc_report.errors())),
+            "concurrency/replay-purity ERROR findings (apex_tpu "
+            "package; warnings=%d, files=%d; docs/analysis.md)" % (
+                len(conc_report.warnings()),
+                conc_report.sections.get("files_scanned", 0),
+            ),
+            None,
+        )
 
     profile = apex_tpu.utils.trace(trace_dir) if trace_dir else None
     step_time, carry, loss = _time_chunks(
@@ -1381,6 +1397,22 @@ def bench_train3d(trace_dir=None, steps=8, trials=3):
             "builds (%s; a failing build raises, so nonzero here means "
             "a verify='warn' escape; docs/training.md)"
             % ", ".join(modes),
+            None,
+        )
+        # host-side concurrency + replay-purity lint (PR 19), riding
+        # the same --lint invocation so the golden stream pins the
+        # package race/impurity ERROR count at zero
+        from apex_tpu import analysis
+
+        conc_report = analysis.lint_package()
+        _emit(
+            "concurrency_lint_errors",
+            float(len(conc_report.errors())),
+            "concurrency/replay-purity ERROR findings (apex_tpu "
+            "package; warnings=%d, files=%d; docs/analysis.md)" % (
+                len(conc_report.warnings()),
+                conc_report.sections.get("files_scanned", 0),
+            ),
             None,
         )
 

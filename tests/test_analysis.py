@@ -338,6 +338,10 @@ def test_every_rule_is_cataloged_and_catalog_is_complete():
         "kernel-vmem-overflow", "kernel-tile-misaligned",
         "kernel-grid-oob", "kernel-block-race", "kernel-dead-tiles",
         "kernel-hardcoded-block",
+        "race-unlocked-shared-state", "race-nonatomic-counter",
+        "race-lock-across-blocking",
+        "replay-wall-clock", "replay-unseeded-rng",
+        "replay-set-order", "replay-env-read",
     }
     for rule, (sev, desc, hint) in analysis.RULES.items():
         assert sev in (analysis.ERROR, analysis.WARNING, analysis.INFO)

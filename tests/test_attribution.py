@@ -526,6 +526,11 @@ class TestBenchDiff:
             # check_schema refuses degenerate train3d rows
             "train3d_dp2_step_ms", "train3d_tp2_step_ms",
             "train3d_dp2tp2_step_ms", "train3d_lint_errors",
+            # the host-side analyzer row (ISSUE 19): lock-discipline +
+            # replay-purity ERROR findings over the whole package,
+            # pinned at 0 (docs/analysis.md "Concurrency &
+            # replay-purity passes")
+            "concurrency_lint_errors",
             # the goodput storm-drill rows (ISSUE 13): chaos-storm
             # goodput, zero-stall bound, ckpt enqueue/finalize stall,
             # input-stall fraction, bit-exact-resume drift

@@ -1,0 +1,127 @@
+"""Concurrency & replay-purity lint CLI — the host-side static half of
+the concurrency story (docs/analysis.md "Concurrency & replay-purity
+passes").
+
+Runs two AST passes over the ``apex_tpu`` package source — no jax, no
+imports of the code under analysis:
+
+- ``apex_tpu.analysis.concurrency`` — lock-discipline lint: per-class
+  maps of attributes mutated under ``with self._lock`` vs. outside,
+  thread entrypoints (``threading.Thread(target=...)``, ``http.server``
+  handler classes) + a lightweight call graph; an attribute reachable
+  from both a thread body and the main path and written without the
+  lock is ``race-unlocked-shared-state`` (or
+  ``race-nonatomic-counter`` when every site is a read-modify-write);
+  a lock held across a bounded-queue ``put``/``join``/``result()``
+  whose consumer thread needs the same lock is
+  ``race-lock-across-blocking``.
+- ``apex_tpu.analysis.purity`` — replay-purity lint over the declared
+  replay-critical modules (``purity.REPLAY_CRITICAL``): wall-clock
+  reads, unseeded RNG, iteration over sets feeding scheduling, env
+  reads outside construction (``replay-*`` rules).
+
+Waiver syntax (same line as the finding, reason REQUIRED by review)::
+
+    t = time.time()  # lint: allow(replay-wall-clock): display only
+
+This is the ``verify_tier1.sh`` LINT gate's concurrency half, and
+``bench.py --lint`` pins its ERROR count at 0 in the golden file.
+
+Usage::
+
+    python tools/concurrency_lint.py                 # table
+    python tools/concurrency_lint.py --json out.json # machine artifact
+    python tools/concurrency_lint.py --root PKG_DIR  # lint another tree
+
+Exit code: 0 clean, 1 findings at/above ``--fail-on`` (default:
+error), 2 usage error.
+
+The passes and the rule catalog (``findings.py``) are stdlib-only;
+this tool loads them standalone under their real dotted names so the
+lint runs on a box with no jax installed (CI lint stage, pre-commit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS = os.path.join(_REPO, "apex_tpu", "analysis")
+
+
+def _load_analysis_modules():
+    """The analysis trio (findings → purity → concurrency) under their
+    full dotted names WITHOUT importing ``apex_tpu`` (whose __init__
+    pulls jax).  Stub package modules hold the namespace; the leaf
+    modules are the real files, so the lazy
+    ``from apex_tpu.analysis.findings import make_finding`` inside the
+    passes resolves against exactly what we loaded."""
+    if "apex_tpu" not in sys.modules:
+        for pkg in ("apex_tpu", "apex_tpu.analysis"):
+            mod = types.ModuleType(pkg)
+            mod.__path__ = []  # mark as package
+            sys.modules[pkg] = mod
+    loaded = {}
+    for name in ("findings", "purity", "concurrency"):
+        dotted = f"apex_tpu.analysis.{name}"
+        if dotted in sys.modules:
+            loaded[name] = sys.modules[dotted]
+            continue
+        spec = importlib.util.spec_from_file_location(
+            dotted, os.path.join(_ANALYSIS, f"{name}.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[dotted] = mod
+        spec.loader.exec_module(mod)
+        setattr(sys.modules["apex_tpu.analysis"], name, mod)
+        loaded[name] = mod
+    return loaded["findings"], loaded["purity"], loaded["concurrency"]
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="host-side concurrency + replay-purity static lint "
+        "(rule catalog: docs/analysis.md)"
+    )
+    ap.add_argument("--root", default=None, metavar="DIR",
+                    help="package directory to lint (default: the "
+                    "repo's apex_tpu/)")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="write the report as one JSON object")
+    ap.add_argument("--fail-on", choices=["error", "warning"],
+                    default="error")
+    args = ap.parse_args()
+
+    findings_mod, purity, concurrency = _load_analysis_modules()
+
+    root = args.root or os.path.join(_REPO, "apex_tpu")
+    sources = purity.collect_sources(root)
+    found = []
+    found.extend(concurrency.lint_sources(sources))
+    found.extend(purity.lint_sources(sources))
+    found.sort(key=lambda f: (f.path, f.rule))
+
+    report = findings_mod.Report(
+        target=os.path.basename(os.path.normpath(root)),
+        findings=found,
+        rules_run=("concurrency", "purity"),
+    )
+    report.sections["files_scanned"] = len(sources)
+
+    print(report.render())
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"report: {args.json}")
+
+    return 0 if report.ok(fail_on=args.fail_on) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

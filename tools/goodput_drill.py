@@ -395,6 +395,13 @@ def _run_drill_inner(
             "resumed_from": resume_res.resumed_from,
             "resume_ok": resume_res.resumed_from == latest_before,
         },
+        # the runtime lock-order sanitizer's census (docs/analysis.md
+        # "Concurrency & replay-purity passes"): under
+        # APEX_TPU_LOCKSAN=1 every TrackedLock acquisition in the
+        # drill — the async engine's writer thread racing the step
+        # path is the real workload — lands in the graph; the GOODPUT
+        # gate asserts armed + zero cycles + a non-empty census
+        "locksan": obs.sanitizer_report(),
     }
 
 
@@ -475,6 +482,11 @@ def main(argv=None) -> int:
         failures.append("no ckpt spans on the timeline")
     if art["watchdog_pages"]:
         failures.append(f"watchdog paged: {art['watchdog_pages']}")
+    if art["locksan"]["armed"] and art["locksan"]["cycles"]:
+        failures.append(
+            "lock-order cycles under LOCKSAN: "
+            f"{art['locksan']['cycles']}"
+        )
     for f_ in failures:
         print(f"GOODPUT DRILL FAIL: {f_}", file=sys.stderr)
     if not failures:

@@ -35,6 +35,15 @@ catch at the source line, before anything traces:
   else.  The kernel entry points' ``block_q=None`` defaults and
   variable-valued plumbing never match — only literal digits do.
 
+- wall clocks in HOST-SIDE replay-critical modules: deterministic
+  replay (resilience runner, serve engine, fleetctl) re-executes a
+  recorded step sequence, and ``time.time()`` there makes the replay
+  diverge from the recording.  The module list is NOT duplicated here
+  — it delegates to ``apex_tpu.analysis.purity.REPLAY_CRITICAL`` (the
+  AST pass's single source of truth, docs/analysis.md "Concurrency &
+  replay-purity passes"); the pass's in-line waiver
+  ``# lint: allow(replay-wall-clock): <reason>`` is honored here too.
+
 A line carrying ``repo-lint: allow`` is waived (use sparingly, with a
 reason in the adjacent comment).  Run from anywhere::
 
@@ -88,6 +97,30 @@ WAIVER = "repo-lint: allow"
 
 
 _CATALOG = None
+_PURITY = None
+
+
+def _purity_mod():
+    """``apex_tpu.analysis.purity`` loaded STANDALONE (stdlib-only at
+    module level) — the one place the replay-critical module list and
+    the wall-clock patterns live.  The AST pass judges semantics; this
+    linter reuses its constants for the cheap source scan."""
+    global _PURITY
+    if _PURITY is None:
+        import importlib.util
+
+        path = os.path.join(REPO, "apex_tpu", "analysis", "purity.py")
+        spec = importlib.util.spec_from_file_location(
+            "_repo_lint_purity", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop(spec.name, None)
+        _PURITY = mod
+    return _PURITY
 
 
 def _catalog_rules():
@@ -179,6 +212,29 @@ def _sharding_violations(rel: str, lines, jitted: bool):
     return out
 
 
+def _replay_clock_violations(rel: str, lines):
+    """Wall clocks in host-side replay-critical modules (rule
+    ``replay-wall-clock``).  Which modules are replay-critical and
+    what counts as a wall clock both come from the purity pass —
+    one list, two enforcement layers."""
+    purity = _purity_mod()
+    if not purity.is_replay_critical(rel.replace(os.sep, "/")):
+        return []
+    catalog = _catalog_rules()
+    patterns = [re.compile(p) for p in purity.WALL_CLOCK_PATTERNS]
+    out = []
+    for lineno, line in enumerate(lines, 1):
+        if WAIVER in line or line.lstrip().startswith("#"):
+            continue
+        m = purity.WAIVER_RE.search(line)
+        if m is not None and m.group(1) == "replay-wall-clock":
+            continue
+        if any(rx.search(line) for rx in patterns):
+            _sev, why, fix = catalog["replay-wall-clock"]
+            out.append((rel, lineno, line.strip(), why, fix))
+    return out
+
+
 def _iter_sources():
     for root, dirs, files in os.walk(PKG):
         dirs[:] = [d for d in dirs if d != "__pycache__"]
@@ -213,6 +269,7 @@ def lint() -> list:
                     )
         violations.extend(_sharding_violations(rel, lines, jitted))
         violations.extend(_kernel_violations(rel, lines, jitted))
+        violations.extend(_replay_clock_violations(rel, lines))
     return violations
 
 

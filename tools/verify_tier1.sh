@@ -349,6 +349,15 @@ if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
     python tools/repo_lint.py 2>&1 | tee -a "$LOG"
     lint_rc=${PIPESTATUS[0]}
     if [ "$lint_rc" -eq 0 ]; then
+        # concurrency + replay-purity lint: lock discipline over every
+        # threaded class and purity over the replay-critical modules —
+        # any ERROR finding exits 1 (also jax-free)
+        CLINT_JSON="${T1_CLINT_JSON:-/tmp/_t1_concurrency_lint.json}"
+        python tools/concurrency_lint.py --json "$CLINT_JSON" \
+            2>&1 | tee -a "$LOG"
+        lint_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$lint_rc" -eq 0 ]; then
         # graph lint: the resilient example's compiled step must carry
         # zero ERROR findings (exit 1 otherwise — the acceptance gate)
         LINT_JSON="${T1_LINT_JSON:-/tmp/_t1_graph_lint.json}"
@@ -440,7 +449,7 @@ PYEOF
     if [ "$lint_rc" -eq 0 ]; then
         echo "TIER1-LINT: PASS"
     else
-        echo "TIER1-LINT: FAIL (rc=$lint_rc; findings in ${LINT_JSON:-repo_lint output} / ${SHARD_JSON:-shard_report})"
+        echo "TIER1-LINT: FAIL (rc=$lint_rc; findings in ${LINT_JSON:-repo_lint output} / ${CLINT_JSON:-concurrency_lint} / ${SHARD_JSON:-shard_report})"
     fi
 fi
 
@@ -513,7 +522,11 @@ if [ "${T1_SKIP_GOODPUT:-0}" != "1" ]; then
     # artifact assertions below re-prove the verdict from the evidence.
     GP_JSON="$(mktemp /tmp/_t1_goodput.XXXXXX.json)"
     GP_DIR="$(mktemp -d /tmp/_t1_goodput_drill.XXXXXX)"
+    # APEX_TPU_LOCKSAN=1 arms the runtime lock-order sanitizer for the
+    # whole storm: the artifact's "locksan" section must come back
+    # armed, with acquisitions recorded and ZERO cycles
     timeout -k 10 420 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+        APEX_TPU_LOCKSAN=1 \
         python tools/goodput_drill.py --steps 60 --preempt-every 12 \
         --dir "$GP_DIR" --json "$GP_JSON" 2>&1 | tail -n 5 | tee -a "$LOG"
     goodput_rc=${PIPESTATUS[0]}
@@ -534,6 +547,10 @@ sc = a["stream_cursor"]
 assert sc["restored_next_batch"] == sc["expected"], sc
 assert a["spans"]["ckpt_write"] > 0 and a["spans"]["ckpt_snapshot"] > 0
 assert a["watchdog_pages"] == [], a["watchdog_pages"]
+ls = a["locksan"]
+assert ls["armed"], "LOCKSAN was not armed for the drill"
+assert ls["cycles"] == [], f"lock-order cycles: {ls['cycles']}"
+assert ls["locks"], "sanitizer saw no TrackedLock acquisitions"
 print(f"GOODPUT artifact OK: goodput={a['goodput']:.4f} over "
       f"{a['invocations']} invocations ({a['accountant']['resumes']} "
       f"preemption resumes), stall={a['ckpt']['stall_frac']:.4%}, "
