@@ -5,8 +5,13 @@ and rolling deploys: in-process :class:`EngineReplica`\\ s (each its
 own engine/scheduler/pool/registry) behind one :class:`Router`, with
 burn-rate :class:`Autoscaler` capacity control and a deterministic
 :class:`Fleet` tick loop drillable on a virtual clock
-(``tools/fleet_drill.py``).
+(``tools/fleet_drill.py``).  Rolling deploys can be canary-gated
+(``start_rolling_update(..., canary=CanaryConfig(...))``): golden-probe
+fingerprints + statistical drift verdicts with auto-halt and rollback
+(:mod:`apex_tpu.observability.canary`, ``tools/canary_drill.py``).
 """
+
+from apex_tpu.observability.canary import CanaryConfig  # noqa: F401
 
 from apex_tpu.fleetctl.autoscale import Autoscaler, AutoscalerConfig
 from apex_tpu.fleetctl.fleet import Fleet, declare_fleet_metrics
@@ -31,4 +36,5 @@ __all__ = [
     "AutoscalerConfig",
     "Fleet",
     "declare_fleet_metrics",
+    "CanaryConfig",
 ]

@@ -66,9 +66,13 @@ def declare_fleet_metrics(registry) -> None:
               "fleet/router_faults", "fleet/replica_crashes",
               "fleet/preempts", "fleet/ejections", "fleet/rejoins",
               "fleet/scale_out", "fleet/scale_in", "fleet/deploys",
-              "fleet/spawned"):
+              "fleet/deploys_rolled_back", "fleet/spawned",
+              "fleet/canary/probes", "fleet/canary/routed",
+              "fleet/canary/verdict_pass", "fleet/canary/verdict_fail"):
         registry.counter(c)
-    for g in ("fleet/replicas_live", "fleet/door_depth"):
+    for g in ("fleet/replicas_live", "fleet/door_depth",
+              "fleet/canary/fingerprint_distance",
+              "fleet/canary/detect_ticks", "fleet/canary/exposure_frac"):
         registry.gauge(g)
 
 
@@ -110,6 +114,8 @@ class Fleet:
         self._eject_objective = float(eject_objective)
         #: the in-progress rolling update, or None
         self.deploy: Optional[Dict[str, object]] = None
+        #: canary window observer for the in-progress deploy, or None
+        self._canary_ctl = None
         #: completed rolling updates, newest last
         self.deploy_history: List[Dict[str, object]] = []
         self.health_events: List[HealthEvent] = []
@@ -138,15 +144,26 @@ class Fleet:
         self.replicas.append(rep)
         self._count("fleet/spawned")
         if self.deploy is not None:
-            # born mid-deploy: the factory built it with the OLD
-            # weights — swap in the deploy's params before it takes
-            # any traffic, or the "rolling update complete" claim
-            # would be false for the newest replica
-            rep.redeploy(
-                self.deploy["params"],
-                self.deploy.get("draft_params"),
-            )
-            self.deploy["updated"].append(name)
+            phase = self.deploy.get("phase", "rolling")
+            if phase == "rolling":
+                # born mid-deploy: the factory built it with the OLD
+                # weights — swap in the deploy's params before it takes
+                # any traffic, or the "rolling update complete" claim
+                # would be false for the newest replica
+                rep.redeploy(
+                    self.deploy["params"],
+                    self.deploy.get("draft_params"),
+                )
+                self.deploy["updated"].append(name)
+            elif phase in ("canary_pending", "canary"):
+                # born before the canary verdict: it KEEPS the
+                # incumbent weights the factory built it with (the
+                # exposure bound says at most the canary serves the
+                # unproven weights) and queues for the rolling phase
+                # so a PASS still updates it
+                self.deploy["remaining"].append(name)
+            # phase == "rollback": incumbent weights, and the deploy
+            # is being unwound — nothing to do
         if self.eject_burn_factor is not None:
             self._eject_trackers[name] = BurnRateTracker(
                 self._eject_objective, self._eject_burn_window_s,
@@ -285,7 +302,8 @@ class Fleet:
         return False
 
     # -- rolling update ----------------------------------------------------
-    def start_rolling_update(self, params, draft_params=None) -> None:
+    def start_rolling_update(self, params, draft_params=None, *,
+                             canary=None) -> None:
         """Begin a zero-downtime deploy of ``params``: replicas drain
         ONE AT A TIME (never the last live one — the fleet keeps
         serving throughout), rebuild through the supervised path, and
@@ -293,7 +311,20 @@ class Fleet:
         :attr:`deploy` is None again.  ``draft_params`` ships a
         refreshed speculative draft on the same deploy — every updated
         replica carries it through its redeploy (self-draft replicas
-        re-alias the new target weights automatically)."""
+        re-alias the new target weights automatically).
+
+        ``canary`` (a :class:`~apex_tpu.observability.canary.
+        CanaryConfig`) gates the deploy: the FIRST updated replica
+        becomes the canary — golden-probe fingerprinted before and
+        after the weight swap (old→new distance on the board) — and
+        the router holds its load share at ``canary.frac`` while a
+        :class:`~apex_tpu.observability.canary.CanaryController`
+        compares its windowed metric distributions against the
+        incumbent pool.  The deploy proceeds to the remaining replicas
+        only on a PASS verdict; a FAIL halts it, drains the canary,
+        rebuilds it back to the captured incumbent weights, and bumps
+        ``fleet/deploys_rolled_back`` — bad-weight exposure is bounded
+        by the canary fraction, re-provable from the span dump."""
         if self.deploy is not None:
             raise RuntimeError("a rolling update is already in progress")
         self.deploy = {
@@ -304,12 +335,29 @@ class Fleet:
             "updated": [],
             "started_tick": self.tick,
             "draining_shed_before": self.shed_count("draining"),
+            "phase": "rolling" if canary is None else "canary_pending",
         }
+        if canary is not None:
+            from apex_tpu.observability.canary import CanaryConfig
+
+            if not isinstance(canary, CanaryConfig):
+                raise TypeError(
+                    f"canary must be a CanaryConfig, got {type(canary)}"
+                )
+            self.deploy["canary_cfg"] = canary
+            self.deploy["canary"] = {"frac": canary.frac}
+        self._canary_ctl = None
 
     def _advance_deploy(self) -> None:
         d = self.deploy
         if d is None:
             return
+        phase = d.get("phase", "rolling")
+        if phase == "canary":
+            self._canary_tick()
+            return
+        if phase == "rollback":
+            return  # the canary's rollback drain completes in the loop
         if d["current"] is not None:
             return  # the per-replica drain completes in the step loop
         while d["remaining"]:
@@ -340,9 +388,10 @@ class Fleet:
         d["lost_requests"] = (
             d["draining_shed_after"] - d["draining_shed_before"]
         )
-        del d["params"]
+        self._strip_deploy_weights(d)
         self.deploy_history.append(d)
         self.deploy = None
+        self._canary_ctl = None
         self._count("fleet/deploys")
         self._note(HealthEvent(
             "fleet_deploy", "info", self.tick, float(d["lost_requests"]),
@@ -352,18 +401,230 @@ class Fleet:
             f"{d['lost_requests']} requests lost to draining",
         ))
 
+    @staticmethod
+    def _strip_deploy_weights(d: Dict[str, object]) -> None:
+        """Drop the weight trees (and the config object) before a
+        deploy record enters :attr:`deploy_history` — the history is
+        part of the drill artifact and must stay JSON-sized."""
+        for key in ("params", "draft_params", "incumbent_params",
+                    "incumbent_draft", "canary_cfg"):
+            d.pop(key, None)
+
     def _seal_drain(self, rep: EngineReplica) -> None:
         report = rep.finish_drain()
         reason = rep.drain_reason
         d = self.deploy
         if reason == "deploy" and d is not None and d["current"] == rep.name:
-            rep.redeploy(d["params"], d.get("draft_params"))
-            d["updated"].append(rep.name)
-            d["current"] = None
+            if d.get("phase") == "canary_pending":
+                self._promote_canary(rep)
+            else:
+                rep.redeploy(d["params"], d.get("draft_params"))
+                d["updated"].append(rep.name)
+                d["current"] = None
+        elif reason == "canary_rollback" and d is not None:
+            self._finish_rollback(rep)
         else:
             rep.state = DEAD
             rep.end_cause = reason
         assert report["pool_in_use"] == 0
+
+    # -- canary gating -----------------------------------------------------
+    def _promote_canary(self, rep: EngineReplica) -> None:
+        """The drained first replica becomes the canary: capture the
+        incumbent weights for a possible rollback, fingerprint the old
+        and new weights across the swap (the distance is recorded, not
+        judged — an intentional update SHOULD move it), open the
+        router hold + deploy window, and baseline the controller."""
+        from apex_tpu.observability.canary import (
+            CanaryController,
+            fingerprint_distance,
+        )
+
+        d = self.deploy
+        cfg = d["canary_cfg"]
+        # the raw incumbent params object: redeploy() assigns it back
+        # verbatim (no re-quantization), so a rollback is bit-exact
+        d["incumbent_params"] = rep.engine.params
+        d["incumbent_draft"] = None
+        if rep.engine.spec is not None and \
+                rep.engine.draft_params is not rep.engine.params:
+            # a real (non-self-draft) draft tree must roll back too;
+            # self-draft re-aliases from the target on redeploy(None)
+            d["incumbent_draft"] = rep.engine.draft_params
+        summary = d["canary"]
+        summary["name"] = rep.name
+        if cfg.probes is not None:
+            fp_old = rep.probe(cfg.probes)
+            self._count("fleet/canary/probes")
+        rep.redeploy(d["params"], d.get("draft_params"))
+        if cfg.probes is not None:
+            fp_new = rep.probe(cfg.probes)
+            self._count("fleet/canary/probes")
+            dist = fingerprint_distance(fp_old, fp_new)
+            self._gauge(
+                "fleet/canary/fingerprint_distance", dist["distance"]
+            )
+            summary["fingerprint"] = {
+                "old_digest": fp_old["digest"],
+                "new_digest": fp_new["digest"],
+                "distance": dist["distance"],
+                "streams_differing": dist["streams_differing"],
+                "new_finite": fp_new["finite"],
+            }
+            self._note(HealthEvent(
+                "fleet_canary_fingerprint", "info", self.tick,
+                float(dist["distance"]), 0.0,
+                f"canary {rep.name} fingerprint "
+                f"{fp_old['digest'][:12]} -> {fp_new['digest'][:12]} "
+                f"(distance {dist['distance']:.3f}, "
+                f"finite={fp_new['finite']})",
+            ))
+        d["updated"].append(rep.name)
+        d["current"] = None
+        d["phase"] = "canary"
+        summary["window_open_tick"] = self.tick
+        self.router.set_canary(rep.name, cfg.frac)
+        if self.spans is not None:
+            self.spans.begin_deploy_window(
+                self.clock(), canary=rep.name, frac=cfg.frac
+            )
+        incumbents = [r for r in self.live if r.name != rep.name]
+        self._canary_ctl = CanaryController(rep, incumbents, cfg)
+
+    def _close_canary_window(self, verdict: str) -> Dict[str, object]:
+        """Tear down the hold + window and fold the routing tallies
+        and token exposure into the deploy's canary summary."""
+        d = self.deploy
+        stats = self.router.clear_canary()
+        if self.spans is not None:
+            self.spans.end_deploy_window(self.clock(), verdict=verdict)
+        summary = d["canary"]
+        summary["verdict"] = verdict
+        summary["window_close_tick"] = self.tick
+        summary["routed"] = stats["routed"]
+        summary["canary_routed"] = stats["canary_routed"]
+        exposure = (
+            stats["canary_routed"] / stats["routed"]
+            if stats["routed"] else 0.0
+        )
+        summary["exposure_frac"] = exposure
+        self._gauge("fleet/canary/exposure_frac", exposure)
+        if self._canary_ctl is not None:
+            tok_c, tok_total = self._canary_ctl.token_exposure()
+            summary["tokens_canary"] = tok_c
+            summary["tokens_total"] = tok_total
+        self._canary_ctl = None
+        return summary
+
+    def _canary_tick(self) -> None:
+        """One tick of the open canary window: observe, and act on the
+        verdict — FAIL halts immediately (the canary drains for
+        rollback), PASS is accepted only after ``soak_ticks`` (early
+        quiet is not evidence), and a window that reaches
+        ``max_window_ticks`` without meeting the honesty floor closes
+        INCONCLUSIVE with a warning and lets the deploy proceed (an
+        idle fleet must not wedge a deploy forever)."""
+        d = self.deploy
+        cfg = d["canary_cfg"]
+        summary = d["canary"]
+        rep = self.replica(summary["name"])
+        win_ticks = self.tick - summary["window_open_tick"]
+        if rep.state != LIVE:
+            # the canary died mid-window (crash/preempt/eject): the
+            # unproven weights are gone with it and nothing else has
+            # them — seal the deploy as rolled back
+            self._close_canary_window("fail")
+            summary["canary_died"] = True
+            self._count("fleet/canary/verdict_fail")
+            self._note(HealthEvent(
+                "fleet_canary_verdict", "critical", self.tick, 0.0, 0.0,
+                f"canary {rep.name} left the fleet mid-window "
+                f"({rep.state}); deploy rolled back",
+            ))
+            self._seal_rolled_back()
+            return
+        ctl = self._canary_ctl
+        ctl.observe()
+        verdict = ctl.verdict()
+        if verdict.status == "fail":
+            self._gauge("fleet/canary/detect_ticks", win_ticks)
+            summary["detect_ticks"] = win_ticks
+            summary["failed_checks"] = [
+                {k: v for k, v in c.items()}
+                for c in verdict.failed
+            ]
+            self._close_canary_window("fail")
+            self._count("fleet/canary/verdict_fail")
+            d["phase"] = "rollback"
+            rep.begin_drain(self.router.reroute, reason="canary_rollback")
+            self._note(HealthEvent(
+                "fleet_canary_verdict", "critical", self.tick,
+                float(len(verdict.failed)), 0.0,
+                f"canary {rep.name} FAILED after {win_ticks} ticks "
+                f"({', '.join(c['metric'] for c in verdict.failed)}); "
+                f"deploy halted, rolling back",
+            ))
+            return
+        if verdict.status == "pass" and win_ticks >= cfg.soak_ticks:
+            self._gauge("fleet/canary/detect_ticks", win_ticks)
+            summary["detect_ticks"] = win_ticks
+            self._close_canary_window("pass")
+            self._count("fleet/canary/verdict_pass")
+            d["phase"] = "rolling"
+            self._note(HealthEvent(
+                "fleet_canary_verdict", "info", self.tick,
+                float(win_ticks), 0.0,
+                f"canary {rep.name} PASSED after {win_ticks} ticks "
+                f"(exposure {summary['exposure_frac']:.3f} <= "
+                f"{cfg.frac}); deploy proceeding",
+            ))
+            return
+        if win_ticks >= cfg.max_window_ticks:
+            self._close_canary_window("inconclusive")
+            d["phase"] = "rolling"
+            self._note(HealthEvent(
+                "fleet_canary_inconclusive", "warn", self.tick,
+                float(win_ticks), float(cfg.max_window_ticks),
+                f"canary {rep.name} window expired below the "
+                f"min-sample floor after {win_ticks} ticks; deploy "
+                f"proceeding UNPROVEN",
+            ))
+
+    def _seal_rolled_back(self) -> None:
+        d = self.deploy
+        d["finished_tick"] = self.tick
+        d["draining_shed_after"] = self.shed_count("draining")
+        d["lost_requests"] = (
+            d["draining_shed_after"] - d["draining_shed_before"]
+        )
+        d["rolled_back"] = True
+        self._strip_deploy_weights(d)
+        self.deploy_history.append(d)
+        self.deploy = None
+        self._canary_ctl = None
+        self._count("fleet/deploys_rolled_back")
+        self._note(HealthEvent(
+            "fleet_deploy_rollback", "critical", self.tick,
+            float(d["lost_requests"]), 0.0,
+            f"deploy rolled back at tick {self.tick}: canary "
+            f"{d['canary'].get('name')} verdict "
+            f"{d['canary'].get('verdict')}, "
+            f"{d['lost_requests']} requests lost",
+        ))
+
+    def _finish_rollback(self, rep: EngineReplica) -> None:
+        """The failed canary's drain sealed: rebuild it back onto the
+        captured incumbent weights (bit-exact — the raw params object
+        is reassigned, never re-derived) and seal the deploy as rolled
+        back."""
+        d = self.deploy
+        rep.redeploy(d["incumbent_params"], d.get("incumbent_draft"))
+        cfg = d.get("canary_cfg")
+        if cfg is not None and cfg.probes is not None:
+            fp = rep.probe(cfg.probes)
+            self._count("fleet/canary/probes")
+            d["canary"]["rollback_digest"] = fp["digest"]
+        self._seal_rolled_back()
 
     # -- scaling -----------------------------------------------------------
     def _scale_out(self, event: HealthEvent) -> EngineReplica:

@@ -77,6 +77,9 @@ class EngineReplica:
         #: why the replica ended (crash cause, eject cause, ...)
         self.end_cause: Optional[str] = None
         self.drain_reports: List[Dict[str, object]] = []
+        #: golden-probe fingerprints taken on this replica, in order
+        #: (:meth:`probe` appends) — the canary gate's identity ledger
+        self.fingerprints: List[Dict[str, object]] = []
         self.ops = None
 
     def __repr__(self) -> str:
@@ -167,6 +170,17 @@ class EngineReplica:
             raise RuntimeError(
                 f"replica {self.name} redeployed with work in flight"
             )
+        if self.sched.prefix is not None:
+            # cached prefix runs hold old-weight K/V — garbage under
+            # the new weights, and they pin pages the cache reset
+            # below requires free
+            self.sched.prefix.flush()
+        # the drained pool is empty, so re-zero the KV arrays: stale
+        # K/V written by the OUTGOING weights must not leak into the
+        # new tenancy through recycled pages (a NaN-poisoned row
+        # survives the attention mask — 0 * NaN — and would break the
+        # canary rollback's bit-exact fingerprint)
+        self.engine.reset_cache()
         self.engine.params = params
         if self.engine.spec is not None:
             self.engine.update_draft_params(draft_params)
@@ -174,6 +188,19 @@ class EngineReplica:
         self.sched.resume()
         self.state = LIVE
         self.drain_reason = None
+
+    def probe(self, probes) -> Dict[str, object]:
+        """Golden-probe fingerprint of the CURRENT weights
+        (:func:`apex_tpu.observability.canary.model_fingerprint`),
+        appended to :attr:`fingerprints`.  Callers probe quiet
+        replicas — freshly built, drained, or just-redeployed — where
+        the pool has room for the probe's transient pages; the
+        canary-gated deploy probes at exactly those moments."""
+        from apex_tpu.observability.canary import model_fingerprint
+
+        fp = model_fingerprint(self.engine, probes)
+        self.fingerprints.append(fp)
+        return fp
 
     # -- evacuation (crash / ejection) -------------------------------------
     def evacuate(self, cause: str) -> List[Request]:

@@ -52,6 +52,45 @@ class Router:
         self.door: Deque[Request] = collections.deque()
         #: dispatch ticks lost to an injected ``fleet.router`` fault
         self.faulted_ticks = 0
+        # canary hold: while set, the named replica's share of routed
+        # requests is capped at `frac` (the deploy exposure bound)
+        self._canary_name: Optional[str] = None
+        self._canary_frac = 0.0
+        self.window_routed = 0
+        self.window_canary = 0
+
+    # -- canary hold -------------------------------------------------------
+    def set_canary(self, name: str, frac: float) -> None:
+        """Open a canary hold: until :meth:`clear_canary`, replica
+        ``name`` receives at most ``frac`` of the window's dispatches
+        (enforced per-request, counted from zero at the hold's open) —
+        THE mechanism behind the deploy's provable bad-weight exposure
+        bound.  Every canary dispatch is additionally annotated
+        ``canary=True`` on its validated ``routed`` span, so the bound
+        is re-provable from the span dump alone."""
+        self._canary_name = str(name)
+        self._canary_frac = float(frac)
+        self.window_routed = 0
+        self.window_canary = 0
+
+    def clear_canary(self) -> Dict[str, Any]:
+        """Close the hold; returns the window's routing tallies."""
+        stats = {
+            "canary": self._canary_name,
+            "frac": self._canary_frac,
+            "routed": self.window_routed,
+            "canary_routed": self.window_canary,
+        }
+        self._canary_name = None
+        self._canary_frac = 0.0
+        return stats
+
+    def _canary_admissible(self) -> bool:
+        """Would one more canary dispatch keep the window share within
+        the hold?  ``(canary + 1) <= frac * (routed + 1)`` — the +1s
+        make the very first dispatches honest (0/0 is not "under")."""
+        return (self.window_canary + 1) <= \
+            self._canary_frac * (self.window_routed + 1)
 
     # -- intake ------------------------------------------------------------
     def submit(self, req: Request) -> Request:
@@ -159,18 +198,45 @@ class Router:
         for _ in range(len(self.door)):
             req = self.door[0]
             target = self.pick(replicas, prompt=req.prompt)
+            is_canary = (
+                self._canary_name is not None
+                and target is not None
+                and target.name == self._canary_name
+            )
+            if is_canary and not self._canary_admissible():
+                # the hold: re-pick from the non-canary pool; if no
+                # incumbent can take it, the request WAITS at the door
+                # — holding is what makes the exposure bound provable
+                # (the door's depth is the autoscaler's scale-out
+                # signal, and an inconclusive window expires, so a
+                # canary-only fleet cannot deadlock here)
+                target = self.pick(
+                    [r for r in replicas
+                     if r.name != self._canary_name],
+                    prompt=req.prompt,
+                )
+                is_canary = False
             if target is None:
                 break
             self.door.popleft()
             if self.peek_cached(target, req.prompt) > 0:
                 self._count("fleet/prefix_affinity_hits")
             now = self.clock()
+            span_args: Dict[str, Any] = {"replica": target.name}
+            if self._canary_name is not None:
+                self.window_routed += 1
+                if is_canary:
+                    self.window_canary += 1
+                    self._count("fleet/canary/routed")
+                    # validated annotation: legal only on a routed hop
+                    # inside an open deploy window (spans.py enforces)
+                    span_args["canary"] = True
             if self.spans is not None:
                 # the validated `routed` phase: opened here with the
                 # destination, closed by the target's own `queued`
                 # event — the hop is on the timeline, replica named
                 self.spans.request_event(
-                    req.rid, REQ_ROUTED, now, replica=target.name,
+                    req.rid, REQ_ROUTED, now, **span_args,
                 )
             self._count("fleet/routed")
             target.sched.submit(req)

@@ -68,6 +68,15 @@ session over bench logs:
   :class:`~apex_tpu.observability.health.HealthEvent`, so an SLO page
   lands on the same merged timeline as the request spans that blew
   the budget.
+- :mod:`apex_tpu.observability.canary` — canary analysis for fleet
+  deploys: golden-probe model fingerprints (seeded probe prompts,
+  greedy streams + prefill-logits bytes hashed blake2b — a single
+  flipped weight bit flips the digest) and
+  :class:`~apex_tpu.observability.canary.CanaryAnalyzer` statistical
+  drift verdicts (one-sided Mann–Whitney U / exact binomial tails
+  with a min-sample honesty floor), driving the fleet's canary-gated
+  rolling updates with auto-halt + rollback
+  (``tools/canary_drill.py``).
 - :mod:`apex_tpu.observability.memstats` — live device-memory
   watermarks (``device.memory_stats()`` behind a provider interface,
   fake provider on CPU) cross-checked against the static analyzer's
@@ -109,6 +118,17 @@ from apex_tpu.observability.health import (  # noqa: F401
     default_rules,
     goodput_rules,
     serve_rules,
+)
+from apex_tpu.observability.canary import (  # noqa: F401
+    CanaryAnalyzer,
+    CanaryConfig,
+    CanaryController,
+    CanaryVerdict,
+    GoldenProbeSet,
+    binom_tail,
+    fingerprint_distance,
+    mann_whitney_p,
+    model_fingerprint,
 )
 from apex_tpu.observability.spans import (  # noqa: F401
     SpanRecorder,
@@ -222,6 +242,15 @@ __all__ = [
     "SpanRecorder",
     "wall_clock_anchor",
     "monotonic_to_epoch",
+    "CanaryAnalyzer",
+    "CanaryConfig",
+    "CanaryController",
+    "CanaryVerdict",
+    "GoldenProbeSet",
+    "model_fingerprint",
+    "fingerprint_distance",
+    "mann_whitney_p",
+    "binom_tail",
     "TrackedLock",
     "lock_order_graph",
     "sanitizer_report",

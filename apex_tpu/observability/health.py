@@ -55,6 +55,16 @@ plus the substrate rules (stale fetch, hung step) — so tail-latency
 regressions page the SAME health layer training uses
 (``docs/serving.md``).
 
+The fleet control plane and its canary-gated deploys emit events
+through the same type without a rule class: ``fleet_*`` events come
+straight from :class:`~apex_tpu.fleetctl.Fleet` (crash/preempt/eject/
+scale/deploy), and the canary gate adds ``fleet_canary_fingerprint``
+(old→new probe distance on a weight swap), ``fleet_canary_verdict``
+(the pass/fail drift verdict — critical on fail, which also triggers
+``fleet_deploy_rollback``), and ``fleet_canary_inconclusive`` (window
+expired under the min-sample floor; the deploy proceeds UNPROVEN).
+See :mod:`apex_tpu.observability.canary`.
+
 The two fraction rules read the step-time attribution published by
 :func:`~apex_tpu.observability.attribution.publish_attribution` —
 either an object handed to ``Watchdog(attribution=...)`` or the board

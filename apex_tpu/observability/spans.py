@@ -225,6 +225,9 @@ class SpanRecorder:
         # observer-bridge state
         self._step_tick: Optional[float] = None
         self._prev_step: Optional[int] = None
+        #: True while a canary deploy window is open — the only time a
+        #: ``canary=...`` routing annotation is legal
+        self._deploy_window = False
         self.dumps: List[str] = []
 
     @classmethod
@@ -297,6 +300,23 @@ class SpanRecorder:
         ``t`` (defaults to :meth:`now`).  Illegal transitions and
         backwards timestamps raise ``ValueError`` loudly."""
         t = self.now() if t is None else float(t)
+        if "canary" in args:
+            # the canary routing annotation is part of the exposure
+            # PROOF (timeline --json re-derives the bound from these
+            # spans), so it is validated like a state transition: only
+            # a routing hop can carry it, and only while a deploy
+            # window is open — a canary tag outside a window would be
+            # unfalsifiable noise
+            if state != REQ_ROUTED:
+                raise ValueError(
+                    f"canary annotation on {state!r} event for "
+                    f"rid={rid}: only {REQ_ROUTED!r} hops carry it"
+                )
+            if not self._deploy_window:
+                raise ValueError(
+                    f"canary annotation for rid={rid} outside a "
+                    f"deploy window (begin_deploy_window not open)"
+                )
         cur = self._open_req.get(rid)
         cur_state = cur[0] if cur is not None else None
         allowed = _REQ_TRANSITIONS.get(cur_state, frozenset())
@@ -338,6 +358,47 @@ class SpanRecorder:
     def open_requests(self) -> Dict[Any, str]:
         """``{rid: current_phase}`` for requests not yet terminal."""
         return {rid: st for rid, (st, _, _) in self._open_req.items()}
+
+    # -- canary deploy windows ---------------------------------------------
+    def begin_deploy_window(self, t: Optional[float] = None, *,
+                            canary: str, frac: float) -> None:
+        """Open a canary deploy window: emits a
+        ``fleet/deploy_window_open`` instant on :data:`TRACK_HEALTH`
+        carrying the canary replica's name + its router load-share
+        ceiling, and arms the ``canary`` routing-annotation validator.
+        ``tools/timeline.py --json`` pairs open/close markers into
+        windows and re-proves the exposure bound per-request from the
+        annotated ``req/routed`` spans inside them."""
+        if self._deploy_window:
+            raise RuntimeError(
+                "begin_deploy_window: a deploy window is already open "
+                "(one canary at a time per recorder)"
+            )
+        self._deploy_window = True
+        self.instant(
+            "fleet/deploy_window_open",
+            self.now() if t is None else float(t),
+            track=TRACK_HEALTH, canary=str(canary), frac=float(frac),
+        )
+
+    def end_deploy_window(self, t: Optional[float] = None, *,
+                          verdict: str) -> None:
+        """Close the open deploy window with its verdict (``"pass"`` /
+        ``"fail"`` / ``"inconclusive"``)."""
+        if not self._deploy_window:
+            raise RuntimeError(
+                "end_deploy_window: no deploy window is open"
+            )
+        self._deploy_window = False
+        self.instant(
+            "fleet/deploy_window_close",
+            self.now() if t is None else float(t),
+            track=TRACK_HEALTH, verdict=str(verdict),
+        )
+
+    @property
+    def deploy_window_open(self) -> bool:
+        return self._deploy_window
 
     # -- run_resilient observer bridge -------------------------------------
     def on_step(self, step: int, skipped: bool = False, info=None) -> None:

@@ -915,6 +915,108 @@ class InferenceEngine:
             )
         return logits, out
 
+    def reset_cache(self) -> None:
+        """Re-zero the paged KV arrays (target AND draft).  Only legal
+        with an EMPTY pool — live pages hold state requests will read.
+        The deploy path calls this on every weight swap: freed pages
+        are never scrubbed (a finite stale row costs nothing under the
+        attention mask's exact-zero weights), but K/V written by
+        NaN-poisoned weights breaks that bargain — ``0 * NaN`` is NaN,
+        so one poisoned tenancy would haunt every later request (and
+        the rollback's bit-exact fingerprint) through pages it no
+        longer owns."""
+        if self.pool.in_use != 0:
+            raise RuntimeError(
+                f"reset_cache with {self.pool.in_use} pages in use"
+            )
+        cfg = self.cfg
+        self.cache = cache_lib.init_kv_pages(
+            cfg.num_layers,
+            self.serve.num_pages,
+            cfg.num_heads,
+            self.serve.page_size,
+            cfg.hidden_size // cfg.num_heads,
+            dtype=cfg.dtype,
+            kv_wire=self.serve.kv_wire,
+        )
+        if self.draft_cache is not None:
+            dcfg = self._draft_cfg
+            self.draft_cache = cache_lib.init_kv_pages(
+                dcfg.num_layers,
+                self.serve.num_pages,
+                dcfg.num_heads,
+                self.serve.page_size,
+                dcfg.hidden_size // dcfg.num_heads,
+                dtype=dcfg.dtype,
+                kv_wire=self.serve.kv_wire,
+            )
+
+    def probe_stream(self, prompt_ids, max_new_tokens: int):
+        """Golden-probe hook (:mod:`apex_tpu.observability.canary`):
+        run ONE prompt greedily (temperature 0) through prefill plus a
+        single-slot decode loop and return ``(tokens,
+        prefill_logits_bytes, finite)`` — the raw material of a model
+        fingerprint.  Greedy argmax ignores the sampler rng, so the
+        stream is a pure function of the weights + compiled programs;
+        the prefill last-logits float32 bytes make the caller's digest
+        sensitive to corruptions too small to flip any argmax.
+
+        Pages come from the engine's own pool and are freed before
+        returning; callers probe QUIET engines (drained replicas,
+        freshly built engines), so the transient page hold never
+        competes with live requests.  ``finite`` folds in the in-step
+        non-finite screens — NaN-poisoned weights fingerprint honestly
+        instead of crashing the probe."""
+        n = len(prompt_ids)
+        total = n + int(max_new_tokens)
+        if total > self.serve.max_context:
+            raise ValueError(
+                f"probe needs {total} tokens of context, "
+                f"max_context={self.serve.max_context}"
+            )
+        pages_needed = -(-total // self.serve.page_size)
+        if pages_needed > self.serve.max_pages_per_seq:
+            raise ValueError(
+                f"probe needs {pages_needed} pages/seq, "
+                f"max_pages_per_seq={self.serve.max_pages_per_seq}"
+            )
+        page_ids = self.pool.alloc(pages_needed)
+        if page_ids is None:
+            raise RuntimeError(
+                f"probe_stream: page pool exhausted "
+                f"({pages_needed} pages needed) — probe a quiet engine"
+            )
+        try:
+            # prefill takes only the prompt-covering pages (its ids
+            # buffer is bucket-sized); decode reaches the growth pages
+            # through the full page-table row below
+            prompt_pages = page_ids[: -(-n // self.serve.page_size)]
+            logits, first = self.prefill(
+                prompt_ids, prompt_pages, temperature=0.0
+            )
+            logits_bytes = np.asarray(logits, np.float32).tobytes()
+            finite = bool(self.last_prefill_finite)
+            tokens = [first]
+            b = self.serve.max_batch
+            table = np.full(
+                (b, self.serve.max_pages_per_seq),
+                cache_lib.NULL_PAGE, np.int32,
+            )
+            table[0, :pages_needed] = np.asarray(page_ids, np.int32)
+            for i in range(int(max_new_tokens) - 1):
+                tok = np.zeros((b,), np.int32)
+                lengths = np.zeros((b,), np.int32)
+                tok[0] = tokens[-1]
+                lengths[0] = n + i + 1  # ctx incl. the fed token
+                _, next_tokens = self.decode(tok, lengths, table)
+                finite = finite and bool(
+                    np.asarray(self.last_decode_finite)[0]
+                )
+                tokens.append(int(next_tokens[0]))
+        finally:
+            self.pool.free(page_ids)
+        return tokens, logits_bytes, finite
+
     # -- speculative serving calls ----------------------------------------
     def draft_prefill(self, prompt_ids, page_ids) -> None:
         """Prefill the DRAFT model's KV for a prompt into the request's

@@ -1632,6 +1632,10 @@ def bench_fleet(trace_dir=None):
     contract — a nonzero value means the zero-downtime guarantee broke,
     not that a knob needs tuning), and the storm's p99 TTFT inflation
     over the fault-free reference (bound 2.0 in the drill itself).
+    Plus the canary-gate rows from ``tools/canary_drill.py``: the
+    detection latency of a planted bad deploy
+    (``fleet_canary_detect_ticks``) and the clean-deploy false-verdict
+    count (``fleet_canary_false_positive``, pinned 0.0).
     CI-grade numbers on CPU virtual time; not TPU perf claims.
 
     The FLEET gate's evidence artifact is reused via
@@ -1702,6 +1706,59 @@ def bench_fleet(trace_dir=None):
         "x storm p99 TTFT over the fault-free fixed-size reference "
         "(drill bound 2.0x; <1.0 means the autoscaled storm fleet "
         "beat the reference; %s)" % desc,
+        None,
+    )
+
+    # -- canary-gate rows (tools/canary_drill.py) --------------------------
+    # same reuse contract as the storm above: the CANARY gate runs the
+    # drill before PERF and hands its --json via APEX_TPU_CANARY_ARTIFACT,
+    # accepted only when the artifact's recorded config equals the
+    # drill's defaults; otherwise the drill runs here.
+    cspec = _ilu.spec_from_file_location(
+        "canary_drill", os.path.join(root, "tools", "canary_drill.py"),
+    )
+    cd = _ilu.module_from_spec(cspec)
+    cspec.loader.exec_module(cd)
+    cdefaults = cd.build_parser().parse_args([])
+    cart = None
+    creuse = os.environ.get("APEX_TPU_CANARY_ARTIFACT")
+    if creuse and os.path.exists(creuse):
+        try:
+            with open(creuse) as f:
+                cand = json.load(f)
+            cfg_sec = cand.get("config", {})
+            if cfg_sec and all(
+                getattr(cdefaults, k, None) == v
+                for k, v in cfg_sec.items()
+            ):
+                cart = cand
+        except (OSError, ValueError):
+            cart = None
+    if cart is None:
+        cart = cd.run_drill(cdefaults)
+    cdesc = (
+        "planted NaN-poisoned weights + %dx-throttled decode behind a "
+        "frac=%.2f canary hold, %d replicas, soak=%d window=%d ticks"
+        % (cdefaults.slow_factor, cdefaults.canary_frac,
+           cdefaults.replicas, cdefaults.soak_ticks,
+           cdefaults.max_window_ticks)
+    )
+    detect = cart.get("detect_ticks")
+    _emit(
+        "fleet_canary_detect_ticks",
+        float(detect) if detect is not None else float("nan"),
+        "virtual ticks from canary window open to the FAIL verdict + "
+        "auto-rollback on the planted regression (%s; lower is faster "
+        "detection, bounded by the drill's soak floor)" % cdesc,
+        None,
+    )
+    _emit(
+        "fleet_canary_false_positive",
+        float(cart.get("false_positives", -1)),
+        "canary FAIL verdicts across %d clean deploys of re-seeded "
+        "same-architecture weights (MUST stay 0.0: the one-sided "
+        "tests + min-sample honesty floor admit no verdict from the "
+        "hold's own load skew)" % len(cart.get("clean_runs", [])),
         None,
     )
 

@@ -543,6 +543,11 @@ class TestBenchDiff:
             # inflation vs the fault-free fixed-size reference
             "fleet_chaos_goodput_pct", "fleet_deploy_lost_requests",
             "fleet_p99_inflation",
+            # the canary deploy-gate rows (ISSUE 20): ticks from window
+            # open to the planted regression's FAIL verdict + rollback,
+            # and FAIL verdicts across clean re-seeded deploys (must
+            # stay 0.0 — docs/serving.md "Canary deploys")
+            "fleet_canary_detect_ticks", "fleet_canary_false_positive",
         }
 
 

@@ -1,0 +1,547 @@
+"""Canary analysis for fleet deploys (ISSUE 20).
+
+Covers: the dependency-free statistics (one-sided Mann–Whitney U,
+exact binomial tail), the CanaryAnalyzer honesty floor ("no verdict"
+is NOT a pass) and its seeded false-positive pin, golden-probe model
+fingerprints (bit-exact across a same-weights rebuild, flipped by a
+SINGLE corrupted weight bit), the validated ``canary`` routing-span
+annotation, and the canary-gated rolling update end to end: clean
+deploy passes, planted NaN regression fails + rolls back bit-exact,
+mid-canary spawns keep incumbent weights, and router exposure stays
+within the canary fraction.  The full drill (throttled decode,
+timeline re-proof, golden rows) lives in ``tools/canary_drill.py``
+behind the CANARY CI gate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fleetctl import EngineReplica, Fleet, LIVE
+from apex_tpu.models.gpt import GptConfig, GptModel
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.observability.canary import (
+    CanaryAnalyzer,
+    CanaryConfig,
+    GoldenProbeSet,
+    binom_tail,
+    fingerprint_distance,
+    mann_whitney_p,
+    model_fingerprint,
+)
+from apex_tpu.observability.spans import (
+    REQ_QUEUED,
+    REQ_ROUTED,
+    SpanRecorder,
+)
+from apex_tpu.serve import InferenceEngine, Request, ServeConfig
+
+
+class VClock:
+    def __init__(self, tick_s=0.005):
+        self.t = 0.0
+        self.tick_s = tick_s
+
+    def __call__(self):
+        return self.t
+
+    def advance(self):
+        self.t += self.tick_s
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GptConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_seq_len=128, dtype=jnp.float32,
+    )
+    model = GptModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((8, 1), jnp.int32)
+    )
+    return cfg, model, params
+
+
+def make_engine(gpt, params=None):
+    cfg, _, base = gpt
+    return InferenceEngine(
+        cfg, params if params is not None else base,
+        ServeConfig(page_size=8, num_pages=32, max_batch=2,
+                    max_pages_per_seq=8, verify=False),
+        registry=MetricRegistry(fetch_every=1),
+    ).build()
+
+
+PROBES = GoldenProbeSet.generate(
+    64, n_probes=2, prompt_len=6, max_new_tokens=4
+)
+
+
+# ---------------------------------------------------------------------------
+# statistics
+# ---------------------------------------------------------------------------
+
+
+class TestStats:
+    def test_mwu_same_distribution_no_signal(self):
+        rs = np.random.RandomState(7)
+        a = rs.normal(10.0, 2.0, size=60)
+        b = rs.normal(10.0, 2.0, size=200)
+        assert mann_whitney_p(a, b, worse="greater") > 1e-3
+
+    def test_mwu_detects_shift(self):
+        rs = np.random.RandomState(7)
+        a = rs.normal(14.0, 2.0, size=60)      # canary clearly worse
+        b = rs.normal(10.0, 2.0, size=200)
+        assert mann_whitney_p(a, b, worse="greater") < 1e-9
+
+    def test_mwu_one_sided_direction(self):
+        """A canary that is BETTER in the worse direction never
+        signals — the held canary serves less load and would
+        false-positive under any two-sided test."""
+        rs = np.random.RandomState(7)
+        a = rs.normal(6.0, 2.0, size=60)       # canary better
+        b = rs.normal(10.0, 2.0, size=200)
+        assert mann_whitney_p(a, b, worse="greater") > 0.99
+        # ...and the same data signals when lower IS worse
+        assert mann_whitney_p(a, b, worse="less") < 1e-9
+
+    def test_mwu_all_ties_is_p1(self):
+        assert mann_whitney_p([3.0] * 30, [3.0] * 50) == 1.0
+
+    def test_mwu_rejects_bad_direction(self):
+        with pytest.raises(ValueError):
+            mann_whitney_p([1.0] * 20, [1.0] * 20, worse="sideways")
+
+    def test_binom_tail_matches_direct_sum(self):
+        from math import comb
+
+        n, p = 12, 0.3
+        for k in range(n + 1):
+            direct = sum(
+                comb(n, i) * p ** i * (1 - p) ** (n - i)
+                for i in range(k, n + 1)
+            )
+            assert binom_tail(k, n, p) == pytest.approx(
+                direct, rel=1e-10
+            )
+
+    def test_binom_tail_edges(self):
+        assert binom_tail(0, 8, 0.1) == 1.0
+        assert binom_tail(9, 8, 0.1) == 0.0
+        assert binom_tail(8, 8, 0.5) == pytest.approx(0.5 ** 8)
+
+
+# ---------------------------------------------------------------------------
+# analyzer: honesty floor + false-positive pin
+# ---------------------------------------------------------------------------
+
+
+class TestCanaryAnalyzer:
+    def test_empty_is_no_verdict(self):
+        v = CanaryAnalyzer().verdict()
+        assert v.status == "no_verdict"
+        assert v.status != "pass"
+
+    def test_below_floor_is_no_verdict_not_pass(self):
+        an = CanaryAnalyzer(min_samples=16, min_event_total=8)
+        an.add_samples("canary", "ttft_ms", [1.0] * 15)   # one short
+        an.add_samples("incumbent", "ttft_ms", [1.0] * 100)
+        an.add_events("canary", "shed_deadline", 0, 7)    # one short
+        an.add_events("incumbent", "shed_deadline", 0, 100)
+        v = an.verdict()
+        assert v.status == "no_verdict"
+        assert all(c["verdict"] is None for c in v.checks)
+
+    def test_identical_distributions_pass(self):
+        an = CanaryAnalyzer(min_samples=16)
+        vals = [float(i % 7) for i in range(40)]
+        an.add_samples("canary", "ttft_ms", vals)
+        an.add_samples("incumbent", "ttft_ms", vals * 3)
+        assert an.verdict().status == "pass"
+
+    def test_planted_sample_drift_fails(self):
+        an = CanaryAnalyzer(min_samples=16, alpha=1e-3)
+        rs = np.random.RandomState(3)
+        an.add_samples("canary", "ttft_ms",
+                       rs.normal(20.0, 1.0, size=40))
+        an.add_samples("incumbent", "ttft_ms",
+                       rs.normal(10.0, 1.0, size=120))
+        v = an.verdict()
+        assert v.status == "fail"
+        assert v.failed[0]["metric"] == "ttft_ms"
+
+    def test_planted_event_drift_fails(self):
+        an = CanaryAnalyzer(min_events=4, min_event_total=8)
+        an.add_events("canary", "shed_poisoned", 9, 12)
+        an.add_events("incumbent", "shed_poisoned", 0, 200)
+        assert an.verdict().status == "fail"
+
+    def test_event_fail_needs_min_events(self):
+        """p alone cannot fail a channel: one unlucky request out of
+        few trials is an anecdote, not a regression."""
+        an = CanaryAnalyzer(min_events=4, min_event_total=8,
+                            alpha=0.05)
+        an.add_events("canary", "shed_deadline", 3, 10)
+        an.add_events("incumbent", "shed_deadline", 0, 500)
+        v = an.verdict()
+        (check,) = v.checks
+        assert check["p"] < 0.05 and v.status == "pass"
+
+    def test_events_accumulate(self):
+        an = CanaryAnalyzer(min_event_total=8)
+        for _ in range(4):
+            an.add_events("canary", "shed_deadline", 1, 3)
+            an.add_events("incumbent", "shed_deadline", 1, 3)
+        (check,) = an.verdict().checks
+        assert check["n_canary"] == 12 and check["bad_canary"] == 4
+
+    def test_direction_change_rejected(self):
+        an = CanaryAnalyzer()
+        an.add_samples("canary", "m", [1.0], worse="greater")
+        with pytest.raises(ValueError):
+            an.add_samples("canary", "m", [1.0], worse="less")
+
+    def test_bogus_direction_rejected(self):
+        # a typo'd direction would silently invert the one-sided test
+        with pytest.raises(ValueError, match="greater"):
+            CanaryAnalyzer().add_samples("canary", "m", [1.0],
+                                         worse="sideways")
+
+    def test_false_positive_pin_20_seeds(self):
+        """Identical generating distributions on both sides across 20
+        seeds: ZERO fail verdicts — the satellite-3 pin."""
+        fails = 0
+        for seed in range(20):
+            rs = np.random.RandomState(seed)
+            an = CanaryAnalyzer(min_samples=16, alpha=1e-3)
+            an.add_samples("canary", "ttft_ms",
+                           rs.normal(10.0, 3.0, size=48))
+            an.add_samples("incumbent", "ttft_ms",
+                           rs.normal(10.0, 3.0, size=160))
+            an.add_samples("canary", "tokens_per_slot_tick",
+                           rs.poisson(3.0, size=48).astype(float),
+                           worse="less")
+            an.add_samples("incumbent", "tokens_per_slot_tick",
+                           rs.poisson(3.0, size=160).astype(float),
+                           worse="less")
+            bad_c = rs.binomial(40, 0.02)
+            bad_i = rs.binomial(160, 0.02)
+            an.add_events("canary", "shed_deadline", bad_c, 40)
+            an.add_events("incumbent", "shed_deadline", bad_i, 160)
+            if an.verdict().status == "fail":
+                fails += 1
+        assert fails == 0
+
+
+# ---------------------------------------------------------------------------
+# golden-probe fingerprints
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprint:
+    def test_rebuild_bit_exact_and_pool_clean(self, gpt):
+        engine = make_engine(gpt)
+        fp_a = model_fingerprint(engine, PROBES)
+        assert engine.pool.in_use == 0
+        engine.rebuild(full=True)
+        fp_b = model_fingerprint(engine, PROBES)
+        assert fp_a["digest"] == fp_b["digest"]
+        assert fp_a["finite"] and fp_b["finite"]
+        d = fingerprint_distance(fp_a, fp_b)
+        assert d["match"] and d["distance"] == 0.0
+
+    def test_single_bit_corruption_flips_digest(self, gpt):
+        """Flip ONE bit — the sign of the highest-magnitude weight,
+        chosen so the corrupted value provably participates — and the
+        digest must change; restoring the weights must restore it."""
+        _, _, params = gpt
+        engine = make_engine(gpt)
+        fp_a = model_fingerprint(engine, PROBES)
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        mags = [float(np.abs(np.asarray(x)).max()) for x in leaves]
+        i = int(np.argmax(mags))
+        flat = np.asarray(leaves[i]).copy()
+        j = int(np.abs(flat).argmax())
+        flat.view(np.uint32).flat[j] ^= np.uint32(0x80000000)
+        corrupt = list(leaves)
+        corrupt[i] = jnp.asarray(flat)
+        engine.params = jax.tree_util.tree_unflatten(treedef, corrupt)
+        engine.rebuild(full=True)
+        fp_bit = model_fingerprint(engine, PROBES)
+        assert fp_bit["digest"] != fp_a["digest"]
+        d = fingerprint_distance(fp_a, fp_bit)
+        assert not d["match"] and d["distance"] > 0.0
+
+        engine.params = params
+        engine.rebuild(full=True)
+        fp_back = model_fingerprint(engine, PROBES)
+        assert fp_back["digest"] == fp_a["digest"]
+
+    def test_nan_weights_fingerprint_not_finite(self, gpt):
+        _, _, params = gpt
+        bad = jax.tree_util.tree_map(
+            lambda a: a.at[...].set(jnp.nan) if a.ndim else a, params
+        )
+        engine = make_engine(gpt, params=bad)
+        fp = model_fingerprint(engine, PROBES)
+        assert not fp["finite"]
+
+    def test_probe_set_is_deterministic(self):
+        a = GoldenProbeSet.generate(64, n_probes=3, prompt_len=5,
+                                    max_new_tokens=4, seed=11)
+        b = GoldenProbeSet.generate(64, n_probes=3, prompt_len=5,
+                                    max_new_tokens=4, seed=11)
+        c = GoldenProbeSet.generate(64, n_probes=3, prompt_len=5,
+                                    max_new_tokens=4, seed=12)
+        assert a.prompts == b.prompts
+        assert a.prompts != c.prompts
+        assert all(t >= 1 for p in a.prompts for t in p)
+
+
+# ---------------------------------------------------------------------------
+# validated `canary` routing annotation
+# ---------------------------------------------------------------------------
+
+
+class TestCanarySpanAnnotation:
+    def test_annotation_requires_open_deploy_window(self):
+        clock = VClock()
+        rec = SpanRecorder(64, clock=clock)
+        with pytest.raises(ValueError, match="deploy window"):
+            rec.request_event(1, REQ_ROUTED, canary=True)
+        rec.begin_deploy_window(canary="r0", frac=0.25)
+        rec.request_event(1, REQ_ROUTED, canary=True)
+        rec.request_event(1, REQ_QUEUED, replica="r0")
+        rec.end_deploy_window(verdict="pass")
+        with pytest.raises(ValueError, match="deploy window"):
+            rec.request_event(2, REQ_ROUTED, canary=True)
+
+    def test_annotation_only_on_routed_hops(self):
+        clock = VClock()
+        rec = SpanRecorder(64, clock=clock)
+        rec.begin_deploy_window(canary="r0", frac=0.25)
+        with pytest.raises(ValueError, match="routed"):
+            rec.request_event(1, REQ_QUEUED, canary=True)
+
+    def test_window_pairing_enforced(self):
+        rec = SpanRecorder(64, clock=VClock())
+        with pytest.raises(RuntimeError):
+            rec.end_deploy_window(verdict="pass")
+        rec.begin_deploy_window(canary="r0", frac=0.5)
+        assert rec.deploy_window_open
+        with pytest.raises(RuntimeError):
+            rec.begin_deploy_window(canary="r1", frac=0.5)
+        rec.end_deploy_window(verdict="fail")
+        assert not rec.deploy_window_open
+
+
+# ---------------------------------------------------------------------------
+# canary-gated rolling update, end to end
+# ---------------------------------------------------------------------------
+
+
+def make_fleet(gpt, clock, *, n=3, spans=None):
+    def factory(name):
+        cfg, _, params = gpt
+        engine = InferenceEngine(
+            cfg, params,
+            ServeConfig(page_size=8, num_pages=32, max_batch=2,
+                        max_pages_per_seq=8, verify=False),
+            registry=MetricRegistry(fetch_every=1),
+        ).build()
+        return EngineReplica(name, engine, clock=clock, spans=spans,
+                             max_queue_depth=16)
+
+    return Fleet(factory, replicas=n, clock=clock, spans=spans)
+
+
+def canary_cfg(**kw):
+    kw.setdefault("frac", 0.34)
+    kw.setdefault("probes", PROBES)
+    kw.setdefault("min_samples", 8)
+    kw.setdefault("min_events", 3)
+    kw.setdefault("min_event_total", 6)
+    kw.setdefault("soak_ticks", 60)
+    kw.setdefault("max_window_ticks", 400)
+    return CanaryConfig(**kw)
+
+
+def run_deploy(gpt, deploy_params, *, cfg=None, n_requests=60,
+               submit_every=3, deploy_after=25, max_ticks=5000,
+               spans=None, mid_canary=None):
+    """Drive a seeded load through a canary-gated deploy until every
+    request is terminal and the deploy machinery is idle.  Returns
+    ``(fleet, reqs)``; ``mid_canary(fleet)`` runs once on the first
+    tick the deploy is in its canary phase."""
+    clock = VClock()
+    fleet = make_fleet(gpt, clock, spans=spans)
+    rs = np.random.RandomState(0)
+    reqs = []
+    deployed = False
+    fired = mid_canary is None
+    for tick in range(max_ticks):
+        if len(reqs) < n_requests and tick % submit_every == 0:
+            reqs.append(fleet.submit(Request(
+                prompt=list(rs.randint(1, 64, size=8)),
+                max_new_tokens=8,
+            )))
+        if not deployed and tick >= deploy_after:
+            fleet.start_rolling_update(
+                deploy_params, canary=cfg or canary_cfg()
+            )
+            deployed = True
+        if not fired and fleet.deploy is not None \
+                and fleet.deploy.get("phase") == "canary":
+            mid_canary(fleet)
+            fired = True
+        fleet.step()
+        clock.advance()
+        if deployed and len(reqs) >= n_requests \
+                and not fleet.pending and fleet.deploy is None:
+            break
+    else:
+        raise AssertionError(
+            f"deploy did not settle in {max_ticks} ticks "
+            f"(deploy={fleet.deploy})"
+        )
+    assert all(r.status in ("done", "shed") for r in reqs)
+    return fleet, reqs
+
+
+class TestCanaryDeploy:
+    def test_rejects_non_config_canary(self, gpt):
+        clock = VClock()
+        fleet = make_fleet(gpt, clock)
+        _, _, params = gpt
+        with pytest.raises(TypeError):
+            fleet.start_rolling_update(params, canary=0.25)
+
+    def test_clean_deploy_passes(self, gpt):
+        cfg, _, _ = gpt
+        new_params = GptModel(cfg).init(
+            jax.random.PRNGKey(9), jnp.zeros((8, 1), jnp.int32)
+        )
+        fleet, reqs = run_deploy(gpt, new_params)
+        d = fleet.deploy_history[-1]
+        c = d["canary"]
+        assert c["verdict"] == "pass"
+        assert not d.get("rolled_back")
+        assert d["lost_requests"] == 0
+        assert sorted(d["updated"]) == sorted(
+            r.name for r in fleet.replicas
+        )
+        # every live replica really serves the new weights
+        assert all(
+            r.engine.params is new_params for r in fleet.live
+        )
+        # exposure honored while the verdict was out
+        assert c["canary_routed"] <= 0.34 * c["routed"] + 1
+        fr = fleet.registry.fetch()
+        assert fr["fleet/deploys_rolled_back"] == 0
+        assert fr["fleet/canary/verdict_pass"] == 1
+        assert fr["fleet/canary/verdict_fail"] == 0
+        # intentional weight change: recorded as a distance, not a
+        # failure
+        assert c["fingerprint"]["distance"] > 0.0
+
+    def test_nan_regression_fails_and_rolls_back(self, gpt):
+        _, _, params = gpt
+        bad = jax.tree_util.tree_map(
+            lambda a: a.at[...].set(jnp.nan) if a.ndim else a, params
+        )
+        fleet, reqs = run_deploy(gpt, bad)
+        d = fleet.deploy_history[-1]
+        c = d["canary"]
+        assert d["rolled_back"] and c["verdict"] == "fail"
+        assert d["lost_requests"] == 0
+        assert c["detect_ticks"] > 0
+        assert not c["fingerprint"]["new_finite"]
+        # the rollback is bit-exact: post-rollback probe digest equals
+        # the pre-deploy incumbent digest
+        assert c["rollback_digest"] == c["fingerprint"]["old_digest"]
+        # every live replica is back on the incumbent weights
+        assert all(r.engine.params is params for r in fleet.live)
+        # the bad weights only ever saw the canary slice
+        assert c["canary_routed"] <= 0.34 * c["routed"] + 1
+        fr = fleet.registry.fetch()
+        assert fr["fleet/deploys_rolled_back"] == 1
+        assert fr["fleet/canary/verdict_fail"] == 1
+        rules = [e.rule for e in fleet.health_events]
+        assert "fleet_canary_verdict" in rules
+        assert "fleet_deploy_rollback" in rules
+        # NaN quarantine sheds are the DETECTION signal and the only
+        # casualties — bounded by the canary slice, never silent junk
+        # tokens served as answers
+        shed = [r for r in reqs if r.status == "shed"]
+        assert all(r.shed_reason == "poisoned" for r in shed)
+        assert len(shed) <= c["canary_routed"]
+
+    def test_mid_canary_spawn_keeps_incumbent_weights(self, gpt):
+        cfg, _, params = gpt
+        new_params = GptModel(cfg).init(
+            jax.random.PRNGKey(9), jnp.zeros((8, 1), jnp.int32)
+        )
+        seen = {}
+
+        def spawn(fleet):
+            rep = fleet._spawn()
+            seen["name"] = rep.name
+            # born before the verdict: incumbent weights, queued for
+            # the rolling phase
+            assert rep.engine.params is params
+            assert rep.name in fleet.deploy["remaining"]
+
+        fleet, _ = run_deploy(gpt, new_params, mid_canary=spawn)
+        d = fleet.deploy_history[-1]
+        assert d["canary"]["verdict"] == "pass"
+        # ...and the PASS still rolled the newcomer forward
+        assert seen["name"] in d["updated"]
+        assert fleet.replica(seen["name"]).engine.params is new_params
+
+    def test_clean_deploy_emits_valid_span_windows(self, gpt):
+        cfg, _, _ = gpt
+        new_params = GptModel(cfg).init(
+            jax.random.PRNGKey(9), jnp.zeros((8, 1), jnp.int32)
+        )
+        clock = VClock()
+        rec = SpanRecorder(65536, clock=clock)
+        fleet = make_fleet(gpt, clock, spans=rec)
+        rs = np.random.RandomState(1)
+        reqs = []
+        deployed = False
+        for tick in range(5000):
+            if len(reqs) < 50 and tick % 3 == 0:
+                reqs.append(fleet.submit(Request(
+                    prompt=list(rs.randint(1, 64, size=8)),
+                    max_new_tokens=8,
+                )))
+            if not deployed and tick >= 25:
+                fleet.start_rolling_update(
+                    new_params, canary=canary_cfg()
+                )
+                deployed = True
+            fleet.step()
+            clock.advance()
+            if deployed and len(reqs) >= 50 and not fleet.pending \
+                    and fleet.deploy is None:
+                break
+        else:
+            raise AssertionError("deploy did not settle")
+        assert not rec.deploy_window_open
+        entries = rec.snapshot()
+        names = [e["name"] for e in entries]
+        assert names.count("fleet/deploy_window_open") == 1
+        assert names.count("fleet/deploy_window_close") == 1
+        marked = [
+            e for e in entries
+            if e["name"] == "req/routed"
+            and (e.get("args") or {}).get("canary")
+        ]
+        canary_name = fleet.deploy_history[-1]["canary"]["name"]
+        assert marked, "no canary-annotated routing hops recorded"
+        assert all(
+            e["args"]["replica"] == canary_name for e in marked
+        )

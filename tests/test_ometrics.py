@@ -107,6 +107,9 @@ class TestMetricName:
         from apex_tpu.serve.scheduler import declare_serve_metrics
 
         declare_serve_metrics(reg)  # raises on any illegal serve key
+        from apex_tpu.fleetctl.fleet import declare_fleet_metrics
+
+        declare_fleet_metrics(reg)  # raises on any illegal fleet key
         # the resilient example's device metric set
         reg.counter("guard/skipped")
         for key in ("train/loss", "guard/found_inf",
@@ -127,6 +130,12 @@ class TestMetricName:
             "attribution/host_stall_fraction",
             "health/slo_ttft", "health/memstats_drift",
             "fleet/train/step_time_ms/host0",
+            # the canary deploy gate's ledger (ISSUE 20)
+            "fleet/deploys_rolled_back", "fleet/canary/probes",
+            "fleet/canary/routed", "fleet/canary/verdict_pass",
+            "fleet/canary/verdict_fail",
+            "fleet/canary/fingerprint_distance",
+            "fleet/canary/detect_ticks", "fleet/canary/exposure_frac",
             "memstats/device0/bytes_in_use",
             "memstats/device0/peak_bytes_in_use", "memstats/crosscheck",
             "ops/scrape_ms", "ops/scrapes", "ops/port",

@@ -170,11 +170,16 @@ def collapse(records: List[dict]) -> Dict[str, dict]:
 
 
 def direction(metric: str, unit: str = "") -> str:
-    """"lower" for time-like and inflation-ratio metrics, else
-    "higher"."""
+    """"lower" for time-like, inflation-ratio, detection-latency and
+    false-positive metrics, else "higher"."""
     if metric.endswith("_ms") or metric.endswith("_s"):
         return "lower"
     if metric.endswith("_inflation"):
+        return "lower"
+    # canary-gate rows: detection latency in virtual ticks, and the
+    # false-verdict count (pinned at 0.0 — any rise past the golden
+    # value regresses)
+    if metric.endswith("_ticks") or metric.endswith("_false_positive"):
         return "lower"
     if (unit or "").strip().startswith("ms"):
         return "lower"

@@ -34,7 +34,12 @@ every attributed TTFT must equal the sum of its
 queue-wait/prefill/contention components within ``--ttft-tol-ms``, and
 a record carrying request chains must not have dropped ring entries (a
 truncated record cannot prove completeness; a wrapped train-only
-record claims nothing about chains and stays clean).  Exit status: 0
+record claims nothing about chains and stays clean).  Canary deploy
+windows get their own accounting (:func:`account_canary`): the
+``canary``-annotated routing hops between each
+``fleet/deploy_window_open``/``_close`` pair re-prove the
+``canary_frac`` exposure bound from the span dump alone, independent
+of the fleet's own counters.  Exit status: 0
 clean (always, for a plain ``--out`` merge — violations are printed
 but only ``--json`` gates on them), 1 accounting violated under
 ``--json``, 2 unreadable input.
@@ -51,6 +56,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 TERMINALS = ("req/done", "req/shed")
+WINDOW_OPEN = "fleet/deploy_window_open"
+WINDOW_CLOSE = "fleet/deploy_window_close"
 
 
 def load_spans_dump(path: str) -> dict:
@@ -224,6 +231,137 @@ def account_requests(spans, dropped, ttft_tol_ms: float) -> dict:
     }
 
 
+def account_canary(spans) -> dict:
+    """Re-prove the canary exposure bound from the span dump ALONE.
+
+    The fleet's own counters claim ``canary_routed <= frac * routed +
+    1`` during a deploy window; this accounting re-derives it from the
+    validated ``canary`` annotations on ``req/routed`` spans, with no
+    trust in the fleet's arithmetic.  Windows pair
+    ``fleet/deploy_window_open``/``_close`` instants per source, and
+    membership uses the recorder's append order (``seq``) rather than
+    timestamps: on a virtual clock every event in a tick shares one
+    timestamp, but append order preserves the tick's phase order
+    (dispatch before the window opens in the same tick is genuinely
+    outside the window).
+
+    Invariants, each a violation when broken:
+
+    - windows nest/pair correctly (no nested open, no orphan close;
+      an unclosed window extends to the end of the record);
+    - INSIDE a window, a routed hop targets the canary replica iff it
+      carries the ``canary`` annotation (both directions);
+    - per window, annotated hops ``<= frac * routed + 1``;
+    - every ``canary``-annotated hop falls inside some window (the
+      recorder enforces this at write time; re-proven from the dump).
+    """
+    by_src: dict = {}
+    for e in spans:
+        by_src.setdefault(e.get("_src", 0), []).append(e)
+    windows = []
+    violations = []
+    canary_hops = 0
+    for src in sorted(by_src):
+        entries = sorted(by_src[src], key=lambda e: e.get("seq", 0))
+        open_evt = None
+        wins = []
+        for e in entries:
+            if e.get("track") != "health":
+                continue
+            name = e.get("name")
+            if name == WINDOW_OPEN:
+                if open_evt is not None:
+                    violations.append(
+                        f"dump {src}: nested {WINDOW_OPEN} at "
+                        f"seq {e.get('seq')}"
+                    )
+                open_evt = e
+            elif name == WINDOW_CLOSE:
+                if open_evt is None:
+                    violations.append(
+                        f"dump {src}: {WINDOW_CLOSE} without an open "
+                        f"window at seq {e.get('seq')}"
+                    )
+                    continue
+                wins.append((open_evt, e))
+                open_evt = None
+        if open_evt is not None:
+            wins.append((open_evt, None))
+        routed = [
+            e for e in entries
+            if e.get("track") == "serve/requests"
+            and e.get("name") == "req/routed"
+        ]
+
+        def _inside(e, o, c):
+            lo = o.get("seq", 0)
+            hi = c.get("seq") if c is not None else float("inf")
+            return lo < e.get("seq", 0) < hi
+
+        for o, c in wins:
+            oargs = o.get("args") or {}
+            cname = oargs.get("canary")
+            frac = oargs.get("frac")
+            n_routed = n_canary = 0
+            for e in routed:
+                if not _inside(e, o, c):
+                    continue
+                args = e.get("args") or {}
+                n_routed += 1
+                annotated = bool(args.get("canary"))
+                to_canary = args.get("replica") == cname
+                if annotated:
+                    n_canary += 1
+                if annotated != to_canary:
+                    violations.append(
+                        f"dump {src}: routed span seq {e.get('seq')} "
+                        f"to {args.get('replica')!r} inside the "
+                        f"{cname!r} window has canary={annotated} "
+                        f"(want {to_canary})"
+                    )
+            if not isinstance(frac, (int, float)) or not cname:
+                violations.append(
+                    f"dump {src}: {WINDOW_OPEN} at seq "
+                    f"{o.get('seq')} missing canary/frac args "
+                    f"(have {sorted(oargs)})"
+                )
+            elif n_canary > frac * n_routed + 1:
+                violations.append(
+                    f"dump {src}: window {cname!r} routed {n_canary} "
+                    f"canary hops of {n_routed} — breaks the "
+                    f"frac={frac} exposure bound "
+                    f"(max {frac * n_routed + 1:.1f})"
+                )
+            windows.append({
+                "src": src,
+                "canary": cname,
+                "frac": frac,
+                "verdict": ((c.get("args") or {}).get("verdict")
+                            if c is not None else None),
+                "closed": c is not None,
+                "routed": n_routed,
+                "canary_routed": n_canary,
+                "exposure_frac": (
+                    n_canary / n_routed if n_routed else 0.0
+                ),
+            })
+        for e in routed:
+            if not (e.get("args") or {}).get("canary"):
+                continue
+            canary_hops += 1
+            if not any(_inside(e, o, c) for o, c in wins):
+                violations.append(
+                    f"dump {src}: canary-annotated routed span seq "
+                    f"{e.get('seq')} falls outside every deploy window"
+                )
+    return {
+        "windows": windows,
+        "canary_hops": canary_hops,
+        "violations": violations,
+        "ok": not violations,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="merge spans/flight/profiler artifacts into one "
@@ -323,6 +461,10 @@ def main(argv=None) -> int:
     summary = account_requests(
         all_spans, dropped_by_src, args.ttft_tol_ms
     )
+    canary = account_canary(all_spans)
+    summary["canary"] = canary
+    summary["violations"].extend(canary["violations"])
+    summary["ok"] = summary["ok"] and canary["ok"]
     summary["sources"] = {
         "spans": len(span_dumps),
         "flight": len(flight_dumps),
@@ -340,6 +482,13 @@ def main(argv=None) -> int:
             f"{summary['ttft_accounting']['max_error_ms']:.4f}ms), "
             f"shed by reason: {summary['shed_reasons'] or '{}'}"
         )
+        for w in canary["windows"]:
+            print(
+                f"  canary window {w['canary']!r}"
+                f" (dump {w['src']}): {w['canary_routed']}/"
+                f"{w['routed']} hops (frac {w['exposure_frac']:.3f}"
+                f" <= {w['frac']}), verdict={w['verdict']}"
+            )
         for v in summary["violations"]:
             print(f"  VIOLATION: {v}")
     # the exit status is the CI gate, and the gate is --json mode: a

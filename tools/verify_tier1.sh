@@ -170,8 +170,27 @@
 # fleet emits its fleet_* golden rows from the SAME storm — which is
 # why FLEET runs before PERF.
 #
+# A CANARY stage proves canary-gated deploys end to end
+# (docs/serving.md "Canary deploys", ISSUE 20): tools/canary_drill.py
+# asserts golden-probe fingerprints are bit-exact across a
+# same-weights rebuild yet flip on a SINGLE corrupted weight bit,
+# runs clean canary deploys across independent seeds (ZERO false
+# fail verdicts by contract — the one-sided drift tests + min-sample
+# honesty floor must not page on the canary hold's own load skew),
+# then plants a NaN-poisoned + decode-throttled deploy and asserts
+# the drift verdict FAILS inside the window, the deploy halts and
+# rolls the canary back to the incumbent weights (rollback
+# fingerprint bit-exact), fleet/deploys_rolled_back bumps, ZERO
+# requests are lost, and bad-weight exposure stays within the canary
+# fraction.  The gate re-proves the exposure bound from the span dump
+# alone via tools/timeline.py --json (account_canary over the
+# validated `canary` routing annotations), and hands the artifact to
+# the PERF stage (APEX_TPU_CANARY_ARTIFACT) so bench.py --config
+# fleet emits the fleet_canary_* golden rows from the SAME drill —
+# which is why CANARY runs before PERF.
+#
 # Usage:
-#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + train + goodput + serve-chaos + fleet + perf + serve + ops
+#   tools/verify_tier1.sh              # quick tier + comm + obs + flight + lint + train + goodput + serve-chaos + fleet + canary + perf + serve + ops
 #   tools/verify_tier1.sh -m chaos     # extra pytest args are passed through
 #
 # Env:
@@ -188,6 +207,7 @@
 #   T1_SKIP_GOODPUT=1           skip the goodput storm-drill pass
 #   T1_SKIP_SERVECHAOS=1        skip the serving chaos-drill pass
 #   T1_SKIP_FLEET=1             skip the fleet control-plane drill pass
+#   T1_SKIP_CANARY=1            skip the canary-deploy drill pass
 
 set -o pipefail
 
@@ -717,6 +737,82 @@ PYEOF
     fi
 fi
 
+canary_rc=0
+if [ "${T1_SKIP_CANARY:-0}" != "1" ]; then
+    CN_JSON="$(mktemp /tmp/_t1_canary.XXXXXX.json)"
+    CN_SPANS="$(mktemp /tmp/_t1_canary_spans.XXXXXX.json)"
+    # the drill hard-fails on its own acceptance set (fingerprint
+    # bit-exactness + single-bit sensitivity, zero false verdicts on
+    # clean deploys, planted-regression detection + bit-exact
+    # rollback, zero lost requests, exposure bound) — see its header
+    timeout -k 10 600 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
+        python tools/canary_drill.py \
+        --json "$CN_JSON" --spans "$CN_SPANS" \
+        2>&1 | tail -n 10 | tee -a "$LOG"
+    canary_rc=${PIPESTATUS[0]}
+    if [ "$canary_rc" -eq 0 ]; then
+        # the exposure bound re-proven from the span dump alone: every
+        # canary-annotated routing hop falls inside a deploy window,
+        # and per window canary hops <= frac * routed + 1
+        timeout -k 10 120 env JAX_PLATFORMS=cpu \
+            python tools/timeline.py --spans "$CN_SPANS" --json \
+            > /tmp/_t1_canary_timeline.json 2>>"$LOG"
+        canary_rc=$?
+    fi
+    if [ "$canary_rc" -eq 0 ]; then
+        python - "$CN_JSON" /tmp/_t1_canary_timeline.json \
+            <<'PYEOF' 2>&1 | tee -a "$LOG"
+import json, sys
+a = json.load(open(sys.argv[1]))
+tl = json.load(open(sys.argv[2]))
+fp = a["fingerprints"]
+assert fp["rebuild_bit_exact"], fp
+assert fp["single_bit_flips_digest"], fp
+assert fp["restore_matches"], fp
+assert a["false_positives"] == 0, a["false_positives"]
+frac = a["config"]["canary_frac"]
+for run in a["clean_runs"]:
+    d = run["deploys"][-1]
+    assert d["canary"]["verdict"] == "pass", (run["label"], d)
+    assert d["lost_requests"] == 0, (run["label"], d)
+reg = a["regression"]
+d = reg["deploys"][-1]
+c = d["canary"]
+assert d["rolled_back"] and c["verdict"] == "fail", d
+assert reg["rolled_back"] == 1, reg["rolled_back"]
+assert d["lost_requests"] == 0, d
+assert c["rollback_digest"] == reg["incumbent_digest"], c
+assert a["detect_ticks"] is not None and a["detect_ticks"] > 0
+# the timeline's independent re-derivation: one pass + one fail
+# window, both within the canary fraction
+assert tl["ok"], tl["violations"]
+wins = tl["canary"]["windows"]
+verdicts = sorted(w["verdict"] for w in wins)
+assert verdicts == ["fail", "pass"], wins
+for w in wins:
+    assert w["closed"], w
+    assert w["canary_routed"] <= w["frac"] * w["routed"] + 1, w
+    assert w["frac"] == frac, (w, frac)
+print(f"CANARY artifact OK: fingerprint bit-exact + single-bit "
+      f"sensitive, {len(a['clean_runs'])} clean deploys 0 false "
+      f"verdicts, regression detected in {a['detect_ticks']} ticks "
+      f"and rolled back bit-exact, exposure "
+      f"{max(w['exposure_frac'] for w in wins):.3f} <= {frac} "
+      f"re-proven from {len(wins)} span-dump windows")
+PYEOF
+        canary_rc=${PIPESTATUS[0]}
+    fi
+    if [ "$canary_rc" -eq 0 ]; then
+        # keep CN_JSON: the PERF stage's bench --config fleet reuses it
+        # (APEX_TPU_CANARY_ARTIFACT) instead of a second drill
+        rm -f "$CN_SPANS" /tmp/_t1_canary_timeline.json
+        echo "TIER1-CANARY: PASS"
+    else
+        echo "TIER1-CANARY: FAIL (rc=$canary_rc; artifacts at" \
+            "$CN_JSON $CN_SPANS)"
+    fi
+fi
+
 perf_rc=0
 if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
     # 1a. the flatline catch: r03 vs r05 sat at 43 TFLOP/s — the gate
@@ -810,13 +906,22 @@ if [ "${T1_SKIP_PERF:-0}" != "1" ]; then
                 && [ "$fleet_rc" -eq 0 ] && [ -s "${FL_JSON:-}" ]; then
                 FL_REUSE="$FL_JSON"
             fi
+            # ...and the CANARY stage's artifact rides the same config
+            # (fleet_canary_detect_ticks / fleet_canary_false_positive)
+            CN_REUSE=""
+            if [ "${T1_SKIP_CANARY:-0}" != "1" ] \
+                && [ "$canary_rc" -eq 0 ] && [ -s "${CN_JSON:-}" ]; then
+                CN_REUSE="$CN_JSON"
+            fi
             timeout -k 10 600 env JAX_PLATFORMS=cpu XLA_FLAGS="" \
                 APEX_TPU_BENCH_WATCHDOG_S=0 \
                 APEX_TPU_FLEET_ARTIFACT="$FL_REUSE" \
+                APEX_TPU_CANARY_ARTIFACT="$CN_REUSE" \
                 python bench.py --config fleet --metrics-out "$PERF_OUT" \
                 2>&1 | tail -n 3 | tee -a "$LOG"
             perf_rc=${PIPESTATUS[0]}
             [ -n "$FL_REUSE" ] && rm -f "$FL_REUSE"
+            [ -n "$CN_REUSE" ] && rm -f "$CN_REUSE"
         fi
         if [ "$perf_rc" -eq 0 ]; then
             python tools/bench_diff.py "$PERF_OUT" \
@@ -1199,10 +1304,10 @@ if [ "$rc" -eq 0 ] && [ "$comm_rc" -eq 0 ] && [ "$obs_rc" -eq 0 ] \
     && [ "$train_rc" -eq 0 ] && [ "$perf_rc" -eq 0 ] \
     && [ "$serve_rc" -eq 0 ] && [ "$ops_rc" -eq 0 ] \
     && [ "$goodput_rc" -eq 0 ] && [ "$servechaos_rc" -eq 0 ] \
-    && [ "$fleet_rc" -eq 0 ]; then
+    && [ "$fleet_rc" -eq 0 ] && [ "$canary_rc" -eq 0 ]; then
     echo "TIER1: PASS"
 else
-    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, train rc=$train_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc, goodput rc=$goodput_rc, serve-chaos rc=$servechaos_rc)"
+    echo "TIER1: FAIL (pytest rc=$rc, comm rc=$comm_rc, obs rc=$obs_rc, flight rc=$flight_rc, lint rc=$lint_rc, train rc=$train_rc, perf rc=$perf_rc, serve rc=$serve_rc, ops rc=$ops_rc, goodput rc=$goodput_rc, serve-chaos rc=$servechaos_rc, fleet rc=$fleet_rc, canary rc=$canary_rc)"
 fi
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
@@ -1215,4 +1320,5 @@ fi
 [ "$ops_rc" -ne 0 ] && exit "$ops_rc"
 [ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
 [ "$servechaos_rc" -ne 0 ] && exit "$servechaos_rc"
-exit "$fleet_rc"
+[ "$fleet_rc" -ne 0 ] && exit "$fleet_rc"
+exit "$canary_rc"
